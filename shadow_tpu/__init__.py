@@ -1,0 +1,32 @@
+"""shadow_tpu — a TPU-native discrete-event network simulation framework.
+
+shadow_tpu directly executes application models (and, via the native runtime,
+real Linux programs) inside a deterministic discrete-event simulation of a
+network: topology-derived latency and packet loss, token-bucket bandwidth
+enforcement, CoDel router queues, and an in-simulator TCP/UDP stack.
+
+Architecture (TPU-first, not a port):
+
+* The **inter-host network model** — per-host event queues, topology
+  latency/reliability lookups, router queues, and cross-host packet delivery
+  — runs on device as batched JAX arrays: each scheduling round is one jitted
+  ``round_step`` mapped over the host dimension with ``shard_map`` over a
+  ``jax.sharding.Mesh``, and cross-shard packet delivery is an XLA collective
+  (``all_to_all`` / ``all_gather``) over ICI/DCN.
+* The **host runtime** (controller/manager/scheduler, config, logging,
+  process management) runs on CPU in Python/C++, mirroring the layer map of
+  the reference simulator (see SURVEY.md §1).
+
+Determinism is a first-class property: events are totally ordered by the
+(time, dst, src, seq) key and all randomness is counter-based
+(`threefry`, keyed by stable ids), so results are bit-identical across
+reruns *and* across different device-mesh shapes — a stronger guarantee
+than the reference's per-host RNG streams.
+
+jax is imported lazily (see shadow_tpu/_jax.py): config parsing, the CLI's
+--show-config path, and the pure-Python reference engine never touch it.
+"""
+
+from shadow_tpu.version import __version__
+
+__all__ = ["__version__"]

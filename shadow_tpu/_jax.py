@@ -1,0 +1,14 @@
+"""Central jax import point.
+
+Every module that uses jax imports it via ``from shadow_tpu._jax import
+jax, jnp`` so that x64 mode (int64 sim times) is enabled exactly once,
+before any tracing, while jax-free paths (CLI --show-config, config
+parsing, the pure-Python engine) never pay the jax import cost.
+"""
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+__all__ = ["jax", "jnp"]

@@ -1,0 +1,80 @@
+"""Command-line entry point.
+
+Equivalent of the reference's CLI layer (src/main/core/main.c:133
+main_runShadow + the clap-based CliOptions, configuration.rs:27-80):
+parse CLI args, load + merge the YAML config, initialize logging, and
+hand off to the Controller. `show-config` mirrors the reference's
+--show-config debugging aid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from shadow_tpu import simtime
+from shadow_tpu.config import load_config
+from shadow_tpu.utils import slog
+
+
+def _config_to_jsonable(obj):
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _config_to_jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, list):
+        return [_config_to_jsonable(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _config_to_jsonable(v) for k, v in obj.items()}
+    return obj
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="shadow-tpu",
+        description="TPU-native discrete-event network simulator",
+    )
+    parser.add_argument("config", help="simulation config (YAML)")
+    parser.add_argument("--show-config", action="store_true",
+                        help="print the parsed config as JSON and exit")
+    parser.add_argument("-o", "--option", action="append", default=[],
+                        metavar="KEY=VALUE",
+                        help="override a config value by dotted path, "
+                             "e.g. -o general.stop_time=10s")
+    parser.add_argument("--log-level", default=None,
+                        choices=["error", "warning", "info", "debug", "trace"])
+    args = parser.parse_args(argv)
+
+    try:
+        cfg = load_config(args.config, overrides=args.option)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"shadow-tpu: failed to load config: {e}", file=sys.stderr)
+        return 1
+
+    if args.log_level:
+        cfg.general.log_level = args.log_level
+    slog.init_logging(cfg.general.log_level)
+
+    if args.show_config:
+        json.dump(_config_to_jsonable(cfg), sys.stdout, indent=2)
+        print()
+        return 0
+
+    if cfg.general.stop_time <= 0:
+        print("shadow-tpu: general.stop_time must be > 0", file=sys.stderr)
+        return 1
+
+    # Defer the heavy imports so `--show-config` stays fast.
+    from shadow_tpu.core.controller import Controller
+
+    controller = Controller(cfg)
+    stats = controller.run()
+    log = slog.get_logger("cli")
+    log.info("simulation finished at %s: %s",
+             simtime.format_time(stats.end_time), stats.summary())
+    return 0 if stats.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

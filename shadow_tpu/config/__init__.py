@@ -1,0 +1,28 @@
+from shadow_tpu.config.units import (
+    parse_bandwidth_bits,
+    parse_size_bytes,
+    parse_time_ns,
+)
+from shadow_tpu.config.schema import (
+    ConfigOptions,
+    GeneralOptions,
+    NetworkOptions,
+    ExperimentalOptions,
+    HostOptions,
+    ProcessOptions,
+)
+from shadow_tpu.config.loader import load_config, load_config_str
+
+__all__ = [
+    "parse_bandwidth_bits",
+    "parse_size_bytes",
+    "parse_time_ns",
+    "ConfigOptions",
+    "GeneralOptions",
+    "NetworkOptions",
+    "ExperimentalOptions",
+    "HostOptions",
+    "ProcessOptions",
+    "load_config",
+    "load_config_str",
+]

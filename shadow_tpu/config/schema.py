@@ -1,0 +1,287 @@
+"""Configuration schema.
+
+YAML-compatible with the reference's config format (reference
+src/main/core/support/configuration.rs:27-760 and
+docs/shadow_config_spec.md): sections `general`, `network`, `experimental`,
+and `hosts.<name>` with nested `processes`. New TPU-specific knobs live
+under `experimental` (the reference's escape-hatch section) so existing
+configs parse unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from shadow_tpu.config.units import (
+    parse_bandwidth_bits,
+    parse_time_ns,
+    parse_size_bytes,
+)
+
+LOG_LEVELS = ("error", "warning", "info", "debug", "trace")
+
+# Scheduler policies: the five CPU policies of the reference
+# (scheduler_policy_type.h:26, configuration.rs:575) plus the new `tpu`
+# policy that runs the network model on device.
+SCHEDULER_POLICIES = (
+    "host",          # thread-per-host set, per-host queues (host_single)
+    "steal",         # work stealing (host_steal)
+    "thread",        # thread_single
+    "threadXthread",  # thread_perthread
+    "threadXhost",   # thread_perhost
+    "serial",        # single-threaded reference oracle (new)
+    "tpu",           # JAX device engine (new)
+)
+
+INTERPOSE_METHODS = ("preload", "ptrace", "model")
+
+
+def _check_keys(section: str, d: dict, allowed: set[str]) -> None:
+    """Reject unknown keys, like the reference's serde
+    `deny_unknown_fields` on every config struct — a typo'd option must
+    fail loudly, not silently keep its default."""
+    unknown = set(d) - allowed
+    if unknown:
+        raise ValueError(
+            f"unknown key(s) in {section}: {sorted(unknown)} "
+            f"(allowed: {sorted(allowed)})"
+        )
+
+
+def _check_choice(section: str, name: str, value: str, choices) -> None:
+    if value not in choices:
+        raise ValueError(
+            f"{section}.{name}={value!r} is not one of {list(choices)}"
+        )
+
+
+@dataclass
+class ProcessOptions:
+    """One virtual process (configuration.rs:478-503)."""
+
+    path: str
+    args: Any = ""
+    environment: str = ""
+    quantity: int = 1
+    start_time: int = 0            # sim ns
+    stop_time: Optional[int] = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProcessOptions":
+        _check_keys("process", d, {"path", "args", "environment", "quantity",
+                                   "start_time", "stop_time"})
+        return cls(
+            path=d["path"],
+            args=d.get("args", ""),
+            environment=d.get("environment", ""),
+            quantity=int(d.get("quantity", 1)),
+            start_time=parse_time_ns(d.get("start_time", 0)),
+            stop_time=(parse_time_ns(d["stop_time"])
+                       if d.get("stop_time") is not None else None),
+        )
+
+
+@dataclass
+class HostOptions:
+    """One host group (configuration.rs:505+)."""
+
+    name: str = ""
+    quantity: int = 1
+    bandwidth_down: Optional[int] = None   # bits/s; default from topology vertex
+    bandwidth_up: Optional[int] = None
+    network_node_id: Optional[int] = None  # pin to a topology vertex id
+    ip_address_hint: Optional[str] = None
+    country_code_hint: Optional[str] = None
+    city_code_hint: Optional[str] = None
+    log_level: Optional[str] = None
+    pcap_directory: Optional[str] = None
+    options: dict = field(default_factory=dict)
+    processes: list[ProcessOptions] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, name: str, d: dict) -> "HostOptions":
+        _check_keys(f"hosts.{name}", d, {
+            "quantity", "bandwidth_down", "bandwidth_up", "network_node_id",
+            "ip_address_hint", "ip_addr", "country_code_hint",
+            "city_code_hint", "log_level", "pcap_directory", "options",
+            "processes",
+        })
+        return cls(
+            name=name,
+            quantity=int(d.get("quantity", 1)),
+            network_node_id=(int(d["network_node_id"])
+                             if d.get("network_node_id") is not None
+                             else None),
+            bandwidth_down=(parse_bandwidth_bits(d["bandwidth_down"])
+                            if d.get("bandwidth_down") is not None else None),
+            bandwidth_up=(parse_bandwidth_bits(d["bandwidth_up"])
+                          if d.get("bandwidth_up") is not None else None),
+            ip_address_hint=d.get("ip_address_hint") or d.get("ip_addr"),
+            country_code_hint=d.get("country_code_hint"),
+            city_code_hint=d.get("city_code_hint"),
+            log_level=d.get("log_level"),
+            pcap_directory=d.get("pcap_directory"),
+            options=dict(d.get("options", {})),
+            processes=[ProcessOptions.from_dict(p)
+                       for p in d.get("processes", [])],
+        )
+
+
+@dataclass
+class GeneralOptions:
+    """`general` section (configuration.rs:129-195)."""
+
+    stop_time: int = 0                      # sim ns; required in practice
+    seed: int = 1
+    parallelism: int = 0                    # 0 => use all cores/devices
+    bootstrap_end_time: int = 0             # unlimited bandwidth until here
+    log_level: str = "info"
+    heartbeat_interval: Optional[int] = None
+    data_directory: str = "shadow.data"
+    template_directory: Optional[str] = None
+    progress: bool = False
+    model_unblocked_syscall_latency: bool = False
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GeneralOptions":
+        _check_keys("general", d, {
+            "stop_time", "seed", "parallelism", "bootstrap_end_time",
+            "log_level", "heartbeat_interval", "data_directory",
+            "template_directory", "progress",
+            "model_unblocked_syscall_latency",
+        })
+        return cls(
+            stop_time=parse_time_ns(d.get("stop_time", 0)),
+            seed=int(d.get("seed", 1)),
+            parallelism=int(d.get("parallelism", 0)),
+            bootstrap_end_time=parse_time_ns(d.get("bootstrap_end_time", 0)),
+            log_level=d.get("log_level", "info"),
+            heartbeat_interval=(parse_time_ns(d["heartbeat_interval"])
+                                if d.get("heartbeat_interval") is not None
+                                else None),
+            data_directory=d.get("data_directory", "shadow.data"),
+            template_directory=d.get("template_directory"),
+            progress=bool(d.get("progress", False)),
+            model_unblocked_syscall_latency=bool(
+                d.get("model_unblocked_syscall_latency", False)),
+        )
+
+
+@dataclass
+class NetworkOptions:
+    """`network` section (configuration.rs:199-213).
+
+    graph.type is "gml" (with `file.path` or `inline`) or the builtin
+    "1_gbit_switch" (configuration.rs:732-760).
+    """
+
+    graph_type: str = "1_gbit_switch"
+    graph_file: Optional[str] = None
+    graph_inline: Optional[str] = None
+    use_shortest_path: bool = True
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NetworkOptions":
+        _check_keys("network", d, {"graph", "use_shortest_path"})
+        graph = d.get("graph", {}) or {}
+        _check_keys("network.graph", graph, {"type", "file", "inline"})
+        gtype = graph.get("type", "1_gbit_switch")
+        gfile = None
+        if isinstance(graph.get("file"), dict):
+            gfile = graph["file"].get("path")
+        elif isinstance(graph.get("file"), str):
+            gfile = graph["file"]
+        return cls(
+            graph_type=gtype,
+            graph_file=gfile,
+            graph_inline=graph.get("inline"),
+            use_shortest_path=bool(d.get("use_shortest_path", True)),
+        )
+
+
+@dataclass
+class ExperimentalOptions:
+    """`experimental` escape hatches (configuration.rs:230-392) plus the
+    TPU engine's capacity/layout knobs (new)."""
+
+    interpose_method: str = "model"
+    scheduler_policy: str = "tpu"
+    runahead: Optional[int] = None          # override lookahead window, ns
+    use_cpu_pinning: bool = True
+    use_memory_manager: bool = True
+    use_seccomp: bool = True
+    use_shim_syscall_handler: bool = True
+    preload_spin_max: int = 8096
+    interface_qdisc: str = "fifo"           # fifo | roundrobin
+    interface_buffer: int = 1024 * 1024     # bytes
+    socket_recv_buffer: int = 174760
+    socket_send_buffer: int = 131072
+    socket_recv_autotune: bool = True
+    socket_send_autotune: bool = True
+    router_queue: str = "codel"             # codel | single | static
+    router_static_capacity: int = 1024      # packets, for `static` queue
+
+    # --- TPU engine knobs (new; absent from the reference) ---
+    event_capacity: int = 64        # device event slots per host
+    outbox_capacity: int = 32       # device packet sends per host per round
+    exchange: str = "all_gather"    # all_gather | all_to_all
+    mesh_axis: str = "hosts"
+    device_batch_rounds: int = 64   # rounds fused into one device while_loop
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ExperimentalOptions":
+        _check_keys("experimental", d,
+                    {f.name for f in dataclasses.fields(cls)})
+        out = cls()
+        for f in dataclasses.fields(cls):
+            if f.name in d:
+                v = d[f.name]
+                if f.name == "runahead":
+                    v = parse_time_ns(v)
+                elif f.name in ("interface_buffer", "socket_recv_buffer",
+                                "socket_send_buffer"):
+                    v = parse_size_bytes(v)
+                elif f.type == "int":
+                    v = int(v)
+                elif f.type == "bool":
+                    v = bool(v)
+                setattr(out, f.name, v)
+        _check_choice("experimental", "scheduler_policy",
+                      out.scheduler_policy, SCHEDULER_POLICIES)
+        _check_choice("experimental", "interpose_method",
+                      out.interpose_method, INTERPOSE_METHODS)
+        _check_choice("experimental", "interface_qdisc",
+                      out.interface_qdisc, ("fifo", "roundrobin"))
+        _check_choice("experimental", "router_queue",
+                      out.router_queue, ("codel", "single", "static"))
+        _check_choice("experimental", "exchange",
+                      out.exchange, ("all_gather", "all_to_all"))
+        return out
+
+
+@dataclass
+class ConfigOptions:
+    general: GeneralOptions = field(default_factory=GeneralOptions)
+    network: NetworkOptions = field(default_factory=NetworkOptions)
+    experimental: ExperimentalOptions = field(default_factory=ExperimentalOptions)
+    hosts: list[HostOptions] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ConfigOptions":
+        _check_keys("config", d, {"general", "network", "experimental",
+                                  "hosts", "host_option_defaults",
+                                  "host_defaults"})
+        hosts = [HostOptions.from_dict(name, hd or {})
+                 for name, hd in (d.get("hosts", {}) or {}).items()]
+        return cls(
+            general=GeneralOptions.from_dict(d.get("general", {}) or {}),
+            network=NetworkOptions.from_dict(d.get("network", {}) or {}),
+            experimental=ExperimentalOptions.from_dict(
+                d.get("experimental", {}) or {}),
+            hosts=hosts,
+        )
+
+    def total_hosts(self) -> int:
+        return sum(h.quantity for h in self.hosts)

@@ -1,0 +1,3 @@
+from shadow_tpu.core.event import Event, EventKey
+
+__all__ = ["Event", "EventKey"]

@@ -1,0 +1,44 @@
+"""Events and their deterministic total order.
+
+The reference orders events by (time, dstHostID, srcHostID, per-src-host
+sequence number) — event_compare, src/main/core/work/event.c:109-152 —
+which makes the simulation schedule a pure function of the config seed.
+We keep exactly that key. It is a lexicographic sort key, so it
+vectorizes directly on device (device/heap.py uses the same tuple).
+
+CPU-side events carry an arbitrary task closure (the reference's
+refcounted Task, core/work/task.c); device-side events are rows of a
+struct-of-arrays with an integer `kind` dispatched by the model app.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, NamedTuple
+
+
+class EventKey(NamedTuple):
+    time: int          # sim ns
+    dst_host: int
+    src_host: int
+    seq: int           # unique per (src_host); ties therefore impossible
+
+
+@dataclass(order=False)
+class Event:
+    time: int
+    dst_host: int
+    src_host: int
+    seq: int
+    # CPU path: a closure to run. Device path encodes (kind, data) instead.
+    task: Callable[..., Any] | None = None
+    kind: int = 0
+    data: tuple = field(default_factory=tuple)
+
+    @property
+    def key(self) -> EventKey:
+        return EventKey(self.time, self.dst_host, self.src_host, self.seq)
+
+    def execute(self, ctx) -> None:
+        if self.task is not None:
+            self.task(ctx, self)

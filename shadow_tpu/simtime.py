@@ -1,0 +1,84 @@
+"""Simulation time: signed 64-bit nanoseconds since simulation start.
+
+Mirrors the reference's SimulationTime/EmulatedTime split (reference
+src/main/core/support/definitions.h:40-90): simulation time starts at 0 ns;
+emulated (wall-clock visible to applications) time is offset so that sim
+start corresponds to a fixed epoch, giving deterministic `gettimeofday`
+results inside the simulation.
+
+We use *signed* int64 (not u64 like the reference) because JAX/XLA has no
+native uint64 on TPU and signed arithmetic makes "invalid = -1" sentinels
+cheap. 2**63 ns is ~292 years of simulated time, far beyond any run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# dtype used for every time value, host- and device-side.
+TIME_DTYPE = np.int64
+
+SIMTIME_INVALID: int = -1
+SIMTIME_MAX: int = np.iinfo(np.int64).max - 1
+
+SIMTIME_ONE_NANOSECOND: int = 1
+SIMTIME_ONE_MICROSECOND: int = 1_000
+SIMTIME_ONE_MILLISECOND: int = 1_000_000
+SIMTIME_ONE_SECOND: int = 1_000_000_000
+SIMTIME_ONE_MINUTE: int = 60 * SIMTIME_ONE_SECOND
+SIMTIME_ONE_HOUR: int = 60 * SIMTIME_ONE_MINUTE
+
+# Emulated time offset: simulation time 0 == 2000-01-01 00:00:00 UTC
+# (946684800 seconds after the Unix epoch), matching the reference
+# (definitions.h:79) so applications observe plausible wall-clock dates.
+EMULATED_TIME_OFFSET: int = 946_684_800 * SIMTIME_ONE_SECOND
+
+# Network constants (reference definitions.h:173-195).
+CONFIG_MTU: int = 1500
+CONFIG_HEADER_SIZE_TCP: int = 20
+CONFIG_HEADER_SIZE_IP: int = 20
+CONFIG_HEADER_SIZE_UDP: int = 8
+CONFIG_HEADER_SIZE_TCPIPETH: int = 54
+CONFIG_HEADER_SIZE_UDPIPETH: int = 42
+CONFIG_TCP_TIMEWAIT_SECONDS: int = 60
+CONFIG_TCP_MAX_SEGMENT_SIZE: int = CONFIG_MTU - CONFIG_HEADER_SIZE_TCP - CONFIG_HEADER_SIZE_IP
+
+
+def from_seconds(s: float) -> int:
+    return int(round(s * SIMTIME_ONE_SECOND))
+
+
+def from_millis(ms: float) -> int:
+    return int(round(ms * SIMTIME_ONE_MILLISECOND))
+
+
+def from_micros(us: float) -> int:
+    return int(round(us * SIMTIME_ONE_MICROSECOND))
+
+
+def to_seconds(t: int) -> float:
+    return t / SIMTIME_ONE_SECOND
+
+
+def to_millis(t: int) -> float:
+    return t / SIMTIME_ONE_MILLISECOND
+
+
+def to_emulated(t: int) -> int:
+    """Sim time -> emulated (application-visible) nanoseconds since Unix epoch."""
+    return t + EMULATED_TIME_OFFSET
+
+
+def from_emulated(t: int) -> int:
+    return t - EMULATED_TIME_OFFSET
+
+
+def format_time(t: int) -> str:
+    """Human-readable hh:mm:ss.nnnnnnnnn, for log stamps."""
+    if t < 0:
+        return "n/a"
+    ns = t % SIMTIME_ONE_SECOND
+    s = t // SIMTIME_ONE_SECOND
+    h, s = divmod(s, 3600)
+    m, s = divmod(s, 60)
+    return f"{h:02d}:{m:02d}:{s:02d}.{ns:09d}"

@@ -1,0 +1,6 @@
+# Deliberately jax-free: utils.rng pulls in jax, so it is imported
+# directly by the modules that need it (see shadow_tpu/_jax.py).
+from shadow_tpu.utils.pqueue import PriorityQueue
+from shadow_tpu.utils.counters import Counter
+
+__all__ = ["PriorityQueue", "Counter"]

@@ -1,0 +1,32 @@
+"""String-keyed counters, like the reference's utility/counter.rs (531 LoC):
+a name -> u64 histogram used for object/syscall/packet accounting, merged
+across workers at shutdown (manager.c:663-729)."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+
+class Counter:
+    def __init__(self):
+        self._c: dict[str, int] = defaultdict(int)
+
+    def add(self, name: str, n: int = 1) -> None:
+        self._c[name] += n
+
+    def sub(self, name: str, n: int = 1) -> None:
+        self._c[name] -= n
+
+    def get(self, name: str) -> int:
+        return self._c.get(name, 0)
+
+    def merge(self, other: "Counter") -> None:
+        for k, v in other._c.items():
+            self._c[k] += v
+
+    def as_dict(self) -> dict[str, int]:
+        return dict(self._c)
+
+    def __str__(self) -> str:
+        items = ", ".join(f"{k}:{v}" for k, v in sorted(self._c.items()))
+        return "{" + items + "}"

@@ -1,0 +1,90 @@
+"""Deterministic randomness.
+
+Two layers, both fully determined by the global config seed:
+
+* **Host-side hierarchy** (`SeededRandom`): controller -> manager -> host,
+  like the reference's seeded GLib Random chain
+  (src/main/utility/random.c, seeded controller->manager->host per
+  SURVEY §5). Children are derived by hashing (parent_seed, label), so
+  host creation order doesn't matter — an improvement over stream-order
+  seeding.
+
+* **Device-side counter RNG**: threefry keyed by stable integer ids
+  (`jax.random.fold_in`). Every stochastic decision in the network model
+  (per-packet drop rolls, jitter) is keyed by (purpose, host_id, seq), so
+  results are bit-identical across reruns *and* across device-mesh
+  shapes, unlike per-host sequential streams.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import numpy as np
+
+from shadow_tpu._jax import jax, jnp
+
+# Stable purpose tags for counter-RNG domains.
+PURPOSE_PACKET_DROP = 1
+PURPOSE_HOST_BOOT = 2
+PURPOSE_APP = 3
+PURPOSE_JITTER = 4
+
+
+def _derive(seed: int, label: str) -> int:
+    h = hashlib.blake2b(
+        struct.pack("<q", seed) + label.encode(), digest_size=8
+    ).digest()
+    return struct.unpack("<q", h)[0] & 0x7FFF_FFFF_FFFF_FFFF
+
+
+class SeededRandom:
+    """Deterministic RNG node in the controller->manager->host hierarchy."""
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self._rng = np.random.Generator(np.random.PCG64(self.seed))
+
+    def child(self, label: str) -> "SeededRandom":
+        return SeededRandom(_derive(self.seed, label))
+
+    def random(self) -> float:
+        return float(self._rng.random())
+
+    def randint(self, low: int, high: int) -> int:
+        """Uniform integer in [low, high)."""
+        return int(self._rng.integers(low, high))
+
+    def shuffle(self, items: list) -> None:
+        self._rng.shuffle(items)
+
+    def np_rng(self) -> np.random.Generator:
+        return self._rng
+
+
+def base_key(seed: int) -> jax.Array:
+    """Root device PRNG key for a simulation.
+
+    The full 64-bit seed feeds the key (x64 mode is always on — _jax.py),
+    so device randomness, like the host-side hierarchy, is a pure function
+    of the whole config seed.
+    """
+    return jax.random.PRNGKey(seed)
+
+
+def packet_key(key: jax.Array, purpose, host_id, seq) -> jax.Array:
+    """Counter-based key for one stochastic decision.
+
+    Works under jit/vmap: fold_in accepts traced integers.
+    """
+    k = jax.random.fold_in(key, purpose)
+    k = jax.random.fold_in(k, host_id)
+    return jax.random.fold_in(k, seq)
+
+
+def uniform01(key: jax.Array, purpose, host_id, seq) -> jax.Array:
+    """One deterministic uniform in [0,1) keyed by (purpose, host, seq)."""
+    return jax.random.uniform(
+        packet_key(key, purpose, host_id, seq), (), dtype=jnp.float32
+    )

@@ -1,0 +1,67 @@
+"""Sim-time-stamped logging.
+
+Equivalent of the reference's ShadowLogger (core/logger/shadow_logger.rs):
+records are tagged with both wall time and simulation time plus the active
+host context, and buffered per run. We layer on Python's logging with a
+context object the worker sets around event execution.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+from shadow_tpu import simtime
+
+_context = threading.local()
+
+
+@dataclass
+class LogContext:
+    sim_time: int = simtime.SIMTIME_INVALID
+    host_name: str = ""
+    host_id: int = -1
+
+
+def set_context(sim_time: int, host_name: str = "", host_id: int = -1) -> None:
+    _context.ctx = LogContext(sim_time, host_name, host_id)
+
+
+def clear_context() -> None:
+    _context.ctx = LogContext()
+
+
+def get_context() -> LogContext:
+    return getattr(_context, "ctx", LogContext())
+
+
+class SimTimeFormatter(logging.Formatter):
+    def __init__(self):
+        super().__init__()
+        self._start = time.monotonic()
+
+    def format(self, record: logging.LogRecord) -> str:
+        ctx = get_context()
+        wall = time.monotonic() - self._start
+        stamp = simtime.format_time(ctx.sim_time)
+        host = f" [{ctx.host_name}]" if ctx.host_name else ""
+        return (f"{wall:012.6f} [{stamp}] {record.levelname.lower()}"
+                f"{host} [{record.name}] {record.getMessage()}")
+
+
+def init_logging(level: str = "info", stream=None) -> None:
+    lvl = {"error": logging.ERROR, "warning": logging.WARNING,
+           "info": logging.INFO, "debug": logging.DEBUG,
+           "trace": logging.DEBUG}.get(level, logging.INFO)
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(SimTimeFormatter())
+    root = logging.getLogger("shadow_tpu")
+    root.handlers[:] = [handler]
+    root.setLevel(lvl)
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"shadow_tpu.{name}")

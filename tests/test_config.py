@@ -1,0 +1,75 @@
+from shadow_tpu.config import load_config_str
+
+YAML = """
+general:
+  stop_time: 10s
+  seed: 42
+  parallelism: 4
+  bootstrap_end_time: 2s
+
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ node [ id 0 ] ]
+  use_shortest_path: false
+
+experimental:
+  scheduler_policy: tpu
+  runahead: 5 ms
+  event_capacity: 128
+
+hosts:
+  server:
+    network_node_id: 0
+    bandwidth_down: 100 Mbit
+    bandwidth_up: 50 Mbit
+    processes:
+    - path: /bin/server
+      args: "--listen 80"
+      start_time: 1s
+  client:
+    quantity: 10
+    processes:
+    - path: /bin/client
+      args: ["--connect", "server"]
+      start_time: 2s
+      stop_time: 9s
+"""
+
+
+def test_parse_full():
+    cfg = load_config_str(YAML)
+    assert cfg.general.stop_time == 10 * 10**9
+    assert cfg.general.seed == 42
+    assert cfg.general.parallelism == 4
+    assert cfg.general.bootstrap_end_time == 2 * 10**9
+    assert cfg.network.graph_type == "gml"
+    assert "node" in cfg.network.graph_inline
+    assert not cfg.network.use_shortest_path
+    assert cfg.experimental.scheduler_policy == "tpu"
+    assert cfg.experimental.runahead == 5_000_000
+    assert cfg.experimental.event_capacity == 128
+    assert cfg.total_hosts() == 11
+    server = next(h for h in cfg.hosts if h.name == "server")
+    assert server.bandwidth_down == 100_000_000
+    assert server.bandwidth_up == 50_000_000
+    assert server.processes[0].start_time == 10**9
+    client = next(h for h in cfg.hosts if h.name == "client")
+    assert client.quantity == 10
+    assert client.processes[0].stop_time == 9 * 10**9
+
+
+def test_overrides():
+    cfg = load_config_str(YAML, overrides=["general.stop_time=20s",
+                                           "general.seed=7"])
+    assert cfg.general.stop_time == 20 * 10**9
+    assert cfg.general.seed == 7
+
+
+def test_defaults():
+    cfg = load_config_str("general: {stop_time: 1}")
+    assert cfg.network.graph_type == "1_gbit_switch"
+    assert cfg.experimental.router_queue == "codel"
+    assert cfg.experimental.exchange == "all_gather"
+    assert cfg.hosts == []

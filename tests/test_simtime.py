@@ -1,0 +1,28 @@
+from shadow_tpu import simtime
+
+
+def test_constants():
+    assert simtime.SIMTIME_ONE_SECOND == 10**9
+    assert simtime.SIMTIME_ONE_MILLISECOND == 10**6
+    assert simtime.SIMTIME_ONE_MINUTE == 60 * 10**9
+    assert simtime.CONFIG_MTU == 1500
+    assert simtime.CONFIG_TCP_MAX_SEGMENT_SIZE == 1460
+
+
+def test_conversions():
+    assert simtime.from_seconds(1.5) == 1_500_000_000
+    assert simtime.from_millis(10) == 10_000_000
+    assert simtime.to_seconds(simtime.SIMTIME_ONE_HOUR) == 3600.0
+
+
+def test_emulated_offset():
+    # Sim time 0 is 2000-01-01 UTC.
+    assert simtime.to_emulated(0) == 946_684_800 * 10**9
+    assert simtime.from_emulated(simtime.to_emulated(123)) == 123
+
+
+def test_format():
+    assert simtime.format_time(0) == "00:00:00.000000000"
+    t = 2 * simtime.SIMTIME_ONE_HOUR + 3 * simtime.SIMTIME_ONE_MINUTE + 7
+    assert simtime.format_time(t) == "02:03:00.000000007"
+    assert simtime.format_time(simtime.SIMTIME_INVALID) == "n/a"

@@ -1,0 +1,43 @@
+import pytest
+
+from shadow_tpu.config.units import (
+    parse_bandwidth_bits,
+    parse_size_bytes,
+    parse_time_ns,
+)
+
+
+def test_time():
+    assert parse_time_ns("10 ms") == 10_000_000
+    assert parse_time_ns("50ms") == 50_000_000
+    assert parse_time_ns("1 s") == 10**9
+    assert parse_time_ns("2 min") == 120 * 10**9
+    assert parse_time_ns("1h") == 3600 * 10**9
+    assert parse_time_ns("250 us") == 250_000
+    assert parse_time_ns("3 ns") == 3
+    assert parse_time_ns(10) == 10 * 10**9      # bare number = seconds
+    assert parse_time_ns("10") == 10 * 10**9
+    assert parse_time_ns(0.5) == 500_000_000
+
+
+def test_bandwidth():
+    assert parse_bandwidth_bits("10 Mbit") == 10_000_000
+    assert parse_bandwidth_bits("1 Gbit") == 10**9
+    assert parse_bandwidth_bits("100 kbit") == 100_000
+    assert parse_bandwidth_bits("10 MB") == 80_000_000
+    assert parse_bandwidth_bits(1000) == 1000
+
+
+def test_size():
+    assert parse_size_bytes("16 MiB") == 16 * 2**20
+    assert parse_size_bytes("1 KiB") == 1024
+    assert parse_size_bytes("2 MB") == 2_000_000
+    assert parse_size_bytes("100 B") == 100
+    assert parse_size_bytes(42) == 42
+
+
+def test_errors():
+    with pytest.raises(ValueError):
+        parse_time_ns("10 parsecs")
+    with pytest.raises(ValueError):
+        parse_bandwidth_bits("fast")
