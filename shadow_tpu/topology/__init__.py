@@ -1,0 +1,11 @@
+from shadow_tpu.topology.gml import parse_gml, GmlGraph
+from shadow_tpu.topology.graph import Topology, ONE_GBIT_SWITCH_GML
+from shadow_tpu.topology.attach import HostAttachment
+
+__all__ = [
+    "parse_gml",
+    "GmlGraph",
+    "Topology",
+    "ONE_GBIT_SWITCH_GML",
+    "HostAttachment",
+]

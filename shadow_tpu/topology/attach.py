@@ -1,0 +1,107 @@
+"""Host -> topology-vertex attachment.
+
+Mirrors the reference's topology_attach (src/main/routing/topology.c:
+2024-2272): an explicit vertex pin (`network_node_id`, the modern config
+style) wins; otherwise candidates are filtered by city/country hints,
+then an IP hint selects the vertex whose `ip_address` shares the longest
+prefix; remaining ties (or no hints) resolve by a draw from the host's
+deterministic RNG. The chosen vertex's bandwidths become the host's
+defaults (host.c:170-183).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from shadow_tpu.topology.graph import Topology
+from shadow_tpu.utils.rng import SeededRandom
+
+
+def _ip_to_int(ip: str) -> Optional[int]:
+    try:
+        return int(ipaddress.IPv4Address(ip))
+    except (ipaddress.AddressValueError, ValueError):
+        return None
+
+
+def _common_prefix_bits(a: int, b: int) -> int:
+    x = a ^ b
+    bits = 0
+    for shift in range(31, -1, -1):
+        if x >> shift:
+            break
+        bits += 1
+    return bits
+
+
+@dataclass
+class HostAttachment:
+    """Resolved placement of one host on the topology."""
+
+    vertex: int
+    bw_down_bits: int
+    bw_up_bits: int
+
+
+class Attacher:
+    def __init__(self, topology: Topology, rng: SeededRandom):
+        self._top = topology
+        self._rng = rng
+        self._vertex_ips = [
+            (_ip_to_int(ip) if ip else None) for ip in topology.ip_strs
+        ]
+
+    def attach(self,
+               network_node_id: Optional[int] = None,
+               ip_hint: Optional[str] = None,
+               city_hint: Optional[str] = None,
+               country_hint: Optional[str] = None,
+               bw_down_override: Optional[int] = None,
+               bw_up_override: Optional[int] = None) -> HostAttachment:
+        top = self._top
+        if network_node_id is not None:
+            vertex = top.vertex_index_for_id(network_node_id)
+        else:
+            vertex = self._pick_vertex(ip_hint, city_hint, country_hint)
+
+        bw_down = (bw_down_override if bw_down_override is not None
+                   else int(top.bw_down_bits[vertex]))
+        bw_up = (bw_up_override if bw_up_override is not None
+                 else int(top.bw_up_bits[vertex]))
+        return HostAttachment(vertex=vertex, bw_down_bits=bw_down,
+                              bw_up_bits=bw_up)
+
+    def _pick_vertex(self, ip_hint, city_hint, country_hint) -> int:
+        top = self._top
+        candidates = list(range(top.n_vertices))
+
+        def _filtered(attr_list, want):
+            hits = [v for v in candidates if attr_list[v] == want]
+            return hits or candidates
+
+        if country_hint:
+            candidates = _filtered(top.country_codes, country_hint)
+        if city_hint:
+            candidates = _filtered(top.city_codes, city_hint)
+
+        if ip_hint:
+            want = _ip_to_int(ip_hint)
+            if want is not None:
+                best_bits, best = -1, None
+                for v in candidates:
+                    have = self._vertex_ips[v]
+                    if have is None:
+                        continue
+                    bits = _common_prefix_bits(want, have)
+                    if bits > best_bits:
+                        best_bits, best = bits, v
+                if best is not None:
+                    return best
+
+        if len(candidates) == 1:
+            return candidates[0]
+        return candidates[self._rng.randint(0, len(candidates))]

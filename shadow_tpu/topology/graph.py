@@ -1,0 +1,362 @@
+"""Network topology as dense arrays.
+
+The reference wraps igraph and computes shortest paths lazily per source
+with a RW-locked path cache (src/main/routing/topology.c:1166-1858). The
+TPU-first design precomputes **all-pairs** latency and reliability
+matrices once at load time: for the graph sizes Shadow-style topologies
+use (vertices are network points-of-presence, not hosts — even the
+full-consensus Tor atlas is a few thousand vertices), a dense [V,V]
+int64/float32 pair is small, and it turns every per-packet
+latency/reliability lookup into a device-side gather.
+
+Semantics mirrored from the reference:
+
+* vertices require `bandwidth_down`/`bandwidth_up` (unit strings, e.g.
+  "1 Gbit"); optional ip_address/city_code/country_code/label
+  (topology.c:87-104, 561-601).
+* edges require `latency` (> 0) and `packet_loss` in [0,1]; optional
+  jitter/label (topology.c:98-104, 612-640).
+* the graph must be connected (strongly, if directed) as a single
+  component (topology.c:659-716).
+* `use_shortest_path=false` requires a complete graph and uses direct
+  edges only (topology.c:1816-1858).
+* self-paths: a self-loop edge is used as-is; otherwise the cheapest
+  incident edge is used out-and-back (latency doubled, reliability
+  squared) (topology.c:1431-1576).
+* computed zero-latency paths are clamped to 1 ms (topology.c:1788).
+* reliability of a multi-edge path is the product of per-edge
+  (1 - packet_loss) (topology.c:1341).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from shadow_tpu import simtime
+from shadow_tpu.config.units import parse_bandwidth_bits, parse_time_ns
+from shadow_tpu.topology.gml import GmlGraph, GmlError, parse_gml
+
+# Builtin graph, byte-identical semantics to the reference's
+# ONE_GBIT_SWITCH_GRAPH (configuration.rs:732-760).
+ONE_GBIT_SWITCH_GML = """graph [
+  directed 0
+  node [
+    id 0
+    ip_address "0.0.0.0"
+    bandwidth_up "1 Gbit"
+    bandwidth_down "1 Gbit"
+  ]
+  edge [
+    source 0
+    target 0
+    latency "1 ms"
+    packet_loss 0.0
+  ]
+]"""
+
+_MIN_PATH_LATENCY_NS = simtime.SIMTIME_ONE_MILLISECOND  # 0-latency clamp
+
+
+def _parse_edge_latency_ns(value) -> int:
+    """Edge latency: unit string ("50 ms") per the reference's
+    _topology_findEdgeAttributeStringTimeMs; bare numbers are taken as
+    milliseconds for compatibility with older numeric GML files."""
+    if isinstance(value, (int, float)):
+        return int(round(value * simtime.SIMTIME_ONE_MILLISECOND))
+    return parse_time_ns(value)
+
+
+@dataclass
+class Topology:
+    directed: bool
+    complete: bool
+    use_shortest_path: bool
+    vertex_ids: np.ndarray          # [V] original GML ids
+    bw_down_bits: np.ndarray        # [V] int64 bits/s
+    bw_up_bits: np.ndarray          # [V] int64 bits/s
+    ip_strs: list[Optional[str]]
+    country_codes: list[Optional[str]]
+    city_codes: list[Optional[str]]
+    labels: list[Optional[str]]
+    edge_src: np.ndarray            # [E] vertex indices
+    edge_dst: np.ndarray
+    edge_latency_ns: np.ndarray     # [E] int64
+    edge_reliability: np.ndarray    # [E] float32 (1 - packet_loss)
+    latency_ns: np.ndarray          # [V,V] int64 path latency
+    reliability: np.ndarray         # [V,V] float32 path reliability
+
+    @property
+    def n_vertices(self) -> int:
+        return len(self.vertex_ids)
+
+    @property
+    def min_latency_ns(self) -> int:
+        """Minimum path latency — the conservative lookahead window
+        ("min time jump", controller.c:125-153)."""
+        return int(self.latency_ns.min())
+
+    def get_latency_ns(self, src_vertex: int, dst_vertex: int) -> int:
+        return int(self.latency_ns[src_vertex, dst_vertex])
+
+    def get_reliability(self, src_vertex: int, dst_vertex: int) -> float:
+        return float(self.reliability[src_vertex, dst_vertex])
+
+    def vertex_index_for_id(self, gml_id: int) -> int:
+        idx = np.nonzero(self.vertex_ids == gml_id)[0]
+        if len(idx) == 0:
+            raise GmlError(f"no vertex with GML id {gml_id}")
+        return int(idx[0])
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_gml(cls, text: str, use_shortest_path: bool = True) -> "Topology":
+        g = parse_gml(text)
+        return cls.from_parsed(g, use_shortest_path)
+
+    @classmethod
+    def builtin_1_gbit_switch(cls) -> "Topology":
+        return cls.from_gml(ONE_GBIT_SWITCH_GML, use_shortest_path=True)
+
+    @classmethod
+    def from_parsed(cls, g: GmlGraph, use_shortest_path: bool) -> "Topology":
+        V = len(g.nodes)
+        if V == 0:
+            raise GmlError("graph has no vertices")
+
+        ids = np.array([int(n.get("id")) for n in g.nodes], dtype=np.int64)
+        if len(set(ids.tolist())) != V:
+            raise GmlError("duplicate vertex ids")
+        id_to_idx = {int(i): k for k, i in enumerate(ids)}
+
+        def _bw(node, key):
+            v = node.get(key)
+            if v is None:
+                raise GmlError(f"vertex {node.get('id')} missing required "
+                               f"attribute {key!r}")
+            return parse_bandwidth_bits(v)
+
+        bw_down = np.array([_bw(n, "bandwidth_down") for n in g.nodes],
+                           dtype=np.int64)
+        bw_up = np.array([_bw(n, "bandwidth_up") for n in g.nodes],
+                         dtype=np.int64)
+        ip_strs = [n.get("ip_address") for n in g.nodes]
+        countries = [n.get("country_code") for n in g.nodes]
+        cities = [n.get("city_code") for n in g.nodes]
+        labels = [n.get("label") for n in g.nodes]
+
+        E = len(g.edges)
+        esrc = np.empty(E, dtype=np.int64)
+        edst = np.empty(E, dtype=np.int64)
+        elat = np.empty(E, dtype=np.int64)
+        erel = np.empty(E, dtype=np.float32)
+        for k, e in enumerate(g.edges):
+            try:
+                esrc[k] = id_to_idx[int(e.get("source"))]
+                edst[k] = id_to_idx[int(e.get("target"))]
+            except KeyError as bad:
+                raise GmlError(f"edge references unknown vertex id {bad}")
+            lat = e.get("latency")
+            if lat is None:
+                raise GmlError("edge missing required attribute 'latency'")
+            elat[k] = _parse_edge_latency_ns(lat)
+            if elat[k] <= 0:
+                raise GmlError(f"edge {k} has latency <= 0")
+            loss = e.get("packet_loss")
+            if loss is None:
+                raise GmlError("edge missing required attribute "
+                               "'packet_loss'")
+            loss = float(loss)
+            if not (0.0 <= loss <= 1.0):
+                raise GmlError(f"edge {k} packet_loss {loss} not in [0,1]")
+            erel[k] = 1.0 - loss
+
+        top = cls(
+            directed=g.directed, complete=False,
+            use_shortest_path=use_shortest_path,
+            vertex_ids=ids, bw_down_bits=bw_down, bw_up_bits=bw_up,
+            ip_strs=ip_strs, country_codes=countries, city_codes=cities,
+            labels=labels,
+            edge_src=esrc, edge_dst=edst, edge_latency_ns=elat,
+            edge_reliability=erel,
+            latency_ns=np.zeros((V, V), dtype=np.int64),
+            reliability=np.zeros((V, V), dtype=np.float32),
+        )
+        top._check_connected()
+        top.complete = top._detect_complete()
+        if not use_shortest_path and not top.complete:
+            raise GmlError("use_shortest_path=false requires a complete "
+                           "graph (every ordered vertex pair needs a "
+                           "direct edge)")
+        top._compute_paths()
+        return top
+
+    # ------------------------------------------------------------------
+    def _adjacency(self) -> tuple[np.ndarray, np.ndarray]:
+        """Dense [V,V] direct-edge latency (ns; 0 = no edge) and
+        reliability matrices, keeping the cheapest parallel edge."""
+        V = self.n_vertices
+        lat = np.zeros((V, V), dtype=np.int64)
+        rel = np.zeros((V, V), dtype=np.float32)
+
+        def _store(s, d, l, r):
+            if lat[s, d] == 0 or l < lat[s, d]:
+                lat[s, d] = l
+                rel[s, d] = r
+
+        for s, d, l, r in zip(self.edge_src, self.edge_dst,
+                              self.edge_latency_ns, self.edge_reliability):
+            _store(s, d, l, r)
+            if not self.directed:
+                _store(d, s, l, r)
+        return lat, rel
+
+    def _check_connected(self) -> None:
+        """Single (strongly-)connected component (topology.c:659-716)."""
+        V = self.n_vertices
+        adj = [[] for _ in range(V)]
+        radj = [[] for _ in range(V)]
+        for s, d in zip(self.edge_src, self.edge_dst):
+            adj[s].append(int(d))
+            radj[d].append(int(s))
+            if not self.directed:
+                adj[d].append(int(s))
+                radj[s].append(int(d))
+
+        def _bfs(start, neighbors):
+            seen = np.zeros(V, dtype=bool)
+            seen[start] = True
+            stack = [start]
+            while stack:
+                u = stack.pop()
+                for v in neighbors[u]:
+                    if not seen[v]:
+                        seen[v] = True
+                        stack.append(v)
+            return seen
+
+        if not _bfs(0, adj).all():
+            raise GmlError("graph is not connected")
+        if self.directed and not _bfs(0, radj).all():
+            raise GmlError("directed graph is not strongly connected")
+
+    def _detect_complete(self) -> bool:
+        """Every ordered pair of distinct vertices has a direct edge
+        (topology.c:409-511)."""
+        V = self.n_vertices
+        if V == 1:
+            return True
+        lat, _ = self._adjacency()
+        off_diag = ~np.eye(V, dtype=bool)
+        return bool((lat[off_diag] > 0).all())
+
+    # ------------------------------------------------------------------
+    def _compute_paths(self) -> None:
+        V = self.n_vertices
+        direct_lat, direct_rel = self._adjacency()
+
+        if not self.use_shortest_path:
+            path_lat = direct_lat.copy()
+            path_rel = direct_rel.copy()
+        else:
+            path_lat, path_rel = self._all_pairs_shortest(direct_lat,
+                                                          direct_rel)
+
+        # Self paths (topology.c:1431-1576): self-loop edge as-is,
+        # otherwise cheapest incident edge doubled.
+        for v in range(V):
+            options: list[tuple[int, float]] = []
+            if direct_lat[v, v] > 0:
+                options.append((int(direct_lat[v, v]),
+                                float(direct_rel[v, v])))
+            out = [(int(2 * direct_lat[v, u]), float(direct_rel[v, u] ** 2))
+                   for u in range(V) if u != v and direct_lat[v, u] > 0]
+            options.extend(out)
+            if options:
+                path_lat[v, v], path_rel[v, v] = min(options)
+            else:
+                path_lat[v, v], path_rel[v, v] = 0, 1.0
+
+        unreachable = path_lat <= 0
+        if self.use_shortest_path and unreachable.any():
+            # clamp zero paths to 1 ms like the reference (self paths on
+            # isolated vertices; connectivity was already validated)
+            path_rel = np.where(unreachable, 1.0, path_rel)
+        path_lat = np.maximum(path_lat, _MIN_PATH_LATENCY_NS)
+
+        self.latency_ns = path_lat.astype(np.int64)
+        self.reliability = path_rel.astype(np.float32)
+
+    def _all_pairs_shortest(self, direct_lat: np.ndarray,
+                            direct_rel: np.ndarray
+                            ) -> tuple[np.ndarray, np.ndarray]:
+        """All-pairs Dijkstra by latency; reliability is accumulated
+        along the chosen (latency-)shortest path via the predecessor
+        tree, replacing the reference's lazy per-source
+        igraph_get_shortest_paths_dijkstra (topology.c:1682-1701)."""
+        V = self.n_vertices
+        try:
+            from scipy.sparse import csr_matrix
+            from scipy.sparse.csgraph import dijkstra
+        except ImportError:
+            return self._all_pairs_minplus(direct_lat, direct_rel)
+
+        # Exclude self-loops from transit paths (the reference's Dijkstra
+        # operates on the simple graph; self paths are computed separately).
+        w = direct_lat.astype(np.float64)
+        np.fill_diagonal(w, 0.0)
+        graph = csr_matrix(w)
+        dist, pred = dijkstra(graph, directed=True, return_predecessors=True)
+        if np.isinf(dist).any():
+            raise GmlError("graph is not connected (no path between some "
+                           "vertex pair)")
+
+        # Walk the predecessor tree breadth-first from each source:
+        # rel[s,d] = rel[s,pred[d]] * edge_rel[pred[d],d]. Hop levels are
+        # found by fixpoint (hops[s,d] = hops[s,pred]+1), <= diameter
+        # iterations of O(V^2) vectorized work.
+        hops = np.full((V, V), -1, dtype=np.int64)
+        np.fill_diagonal(hops, 0)
+        for _ in range(V):
+            pending = (pred >= 0) & (hops < 0)
+            if not pending.any():
+                break
+            s_idx, d_idx = np.nonzero(pending)
+            parent_hops = hops[s_idx, pred[s_idx, d_idx]]
+            ready = parent_hops >= 0
+            if not ready.any():
+                break
+            hops[s_idx[ready], d_idx[ready]] = parent_hops[ready] + 1
+
+        rel = np.zeros((V, V), dtype=np.float64)
+        np.fill_diagonal(rel, 1.0)
+        for h in range(1, int(hops.max()) + 1):
+            s_idx, d_idx = np.nonzero(hops == h)
+            pr = pred[s_idx, d_idx]
+            rel[s_idx, d_idx] = rel[s_idx, pr] * direct_rel[pr, d_idx]
+
+        lat = np.rint(dist).astype(np.int64)
+        return lat, rel.astype(np.float32)
+
+    def _all_pairs_minplus(self, direct_lat: np.ndarray,
+                           direct_rel: np.ndarray
+                           ) -> tuple[np.ndarray, np.ndarray]:
+        """Dense Floyd-Warshall carrying reliability, scipy-free."""
+        V = self.n_vertices
+        # float64 avoids int64 INF+INF overflow; ns latencies are far
+        # below 2**53 so the arithmetic stays exact.
+        lat = np.where(direct_lat > 0, direct_lat.astype(np.float64), np.inf)
+        np.fill_diagonal(lat, 0.0)
+        rel = np.where(direct_lat > 0, direct_rel.astype(np.float64), 0.0)
+        np.fill_diagonal(rel, 1.0)
+        for k in range(V):
+            via = lat[:, k, None] + lat[None, k, :]
+            better = via < lat
+            lat = np.where(better, via, lat)
+            rel = np.where(better, rel[:, k, None] * rel[None, k, :], rel)
+        if np.isinf(lat).any():
+            raise GmlError("graph is not connected (no path between some "
+                           "vertex pair)")
+        return np.rint(lat).astype(np.int64), rel.astype(np.float32)

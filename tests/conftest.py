@@ -2,18 +2,26 @@
 
 Tests run on CPU with 8 virtual XLA devices so multi-chip sharding
 (shard_map over a Mesh, all_to_all / all_gather collectives) is exercised
-without TPU hardware. The env vars must be set before jax initializes.
+without TPU hardware.
+
+This environment preloads a TPU PJRT plugin via sitecustomize which force-
+sets jax's `jax_platforms` config to "axon,cpu" — with exactly one
+physical chip behind a relay that admits one client at a time. Tests must
+never dial it (concurrent test runs would deadlock on the claim), so we
+override the platform list back to cpu-only *before* any backend
+initialization, which wins over both the env var and the plugin's write.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,179 @@
+import numpy as np
+import pytest
+
+from shadow_tpu import simtime
+from shadow_tpu.topology import Topology, parse_gml
+from shadow_tpu.topology.attach import Attacher
+from shadow_tpu.topology.gml import GmlError
+from shadow_tpu.utils.rng import SeededRandom
+
+MS = simtime.SIMTIME_ONE_MILLISECOND
+
+# A 4-vertex line + shortcut:  0 --10ms-- 1 --10ms-- 2 --10ms-- 3
+# plus a direct 0--3 edge at 50ms (shortest 0->3 is 30ms via the line).
+LINE_GML = """
+graph [
+  directed 0
+  node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "10 Mbit"
+         ip_address "10.0.0.1" country_code "US" ]
+  node [ id 1 bandwidth_down "200 Mbit" bandwidth_up "20 Mbit"
+         ip_address "10.0.1.1" country_code "US" ]
+  node [ id 2 bandwidth_down "300 Mbit" bandwidth_up "30 Mbit"
+         ip_address "10.1.0.1" country_code "DE" ]
+  node [ id 3 bandwidth_down "400 Mbit" bandwidth_up "40 Mbit"
+         ip_address "10.1.1.1" country_code "DE" city_code "BER" ]
+  edge [ source 0 target 1 latency "10 ms" packet_loss 0.1 ]
+  edge [ source 1 target 2 latency "10 ms" packet_loss 0.1 ]
+  edge [ source 2 target 3 latency "10 ms" packet_loss 0.1 ]
+  edge [ source 0 target 3 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+
+def test_gml_parse_basic():
+    g = parse_gml(LINE_GML)
+    assert not g.directed
+    assert len(g.nodes) == 4
+    assert len(g.edges) == 4
+    assert g.nodes[1].get("ip_address") == "10.0.1.1"
+    assert g.edges[0].get("latency") == "10 ms"
+    assert g.edges[0].get("packet_loss") == 0.1
+
+
+def test_gml_errors():
+    with pytest.raises(GmlError):
+        parse_gml("graph [ node [ ] ]")              # missing id
+    with pytest.raises(GmlError):
+        parse_gml("graph [ edge [ source 0 ] ]")     # missing target
+    with pytest.raises(GmlError):
+        parse_gml("nothing here")
+    with pytest.raises(GmlError):
+        parse_gml("graph [ node [ id 0 ]")           # unbalanced
+
+
+def test_builtin_switch():
+    top = Topology.builtin_1_gbit_switch()
+    assert top.n_vertices == 1
+    assert top.bw_down_bits[0] == 10**9
+    # self path = self-loop edge latency (1 ms), reliability 1.0
+    assert top.get_latency_ns(0, 0) == 1 * MS
+    assert top.get_reliability(0, 0) == 1.0
+    assert top.min_latency_ns == 1 * MS
+
+
+def test_shortest_paths():
+    top = Topology.from_gml(LINE_GML)
+    # direct neighbors
+    assert top.get_latency_ns(0, 1) == 10 * MS
+    # 0 -> 2 via 1: 20 ms, reliability 0.9^2
+    assert top.get_latency_ns(0, 2) == 20 * MS
+    assert abs(top.get_reliability(0, 2) - 0.81) < 1e-6
+    # 0 -> 3: line (30ms, 0.9^3) beats direct edge (50ms)
+    assert top.get_latency_ns(0, 3) == 30 * MS
+    assert abs(top.get_reliability(0, 3) - 0.729) < 1e-6
+    # symmetric (undirected)
+    np.testing.assert_array_equal(top.latency_ns, top.latency_ns.T)
+    # self path: vertex 0's cheapest incident edge (10ms) doubled
+    assert top.get_latency_ns(0, 0) == 20 * MS
+    assert abs(top.get_reliability(0, 0) - 0.81) < 1e-6
+    assert top.min_latency_ns == 10 * MS
+
+
+def test_direct_mode_requires_complete():
+    with pytest.raises(GmlError):
+        Topology.from_gml(LINE_GML, use_shortest_path=False)
+
+
+def test_direct_mode_complete_graph():
+    gml = """
+    graph [ directed 0
+      node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+      node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+      edge [ source 0 target 1 latency "5 ms" packet_loss 0.0 ]
+      edge [ source 0 target 0 latency "2 ms" packet_loss 0.0 ]
+      edge [ source 1 target 1 latency "3 ms" packet_loss 0.0 ]
+    ]
+    """
+    top = Topology.from_gml(gml, use_shortest_path=False)
+    assert top.complete
+    assert top.get_latency_ns(0, 1) == 5 * MS
+    assert top.get_latency_ns(0, 0) == 2 * MS   # self loop as-is
+    assert top.get_latency_ns(1, 1) == 3 * MS
+
+
+def test_disconnected_rejected():
+    gml = """
+    graph [ directed 0
+      node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+      node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+      edge [ source 0 target 0 latency "1 ms" packet_loss 0.0 ]
+      edge [ source 1 target 1 latency "1 ms" packet_loss 0.0 ]
+    ]
+    """
+    with pytest.raises(GmlError):
+        Topology.from_gml(gml)
+
+
+def test_validation_errors():
+    with pytest.raises(GmlError):  # missing bandwidth
+        Topology.from_gml("""graph [ node [ id 0 ]
+          edge [ source 0 target 0 latency "1 ms" packet_loss 0.0 ] ]""")
+    with pytest.raises(GmlError):  # loss out of range
+        Topology.from_gml("""graph [
+          node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+          edge [ source 0 target 0 latency "1 ms" packet_loss 1.5 ] ]""")
+    with pytest.raises(GmlError):  # zero latency edge
+        Topology.from_gml("""graph [
+          node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+          edge [ source 0 target 0 latency "0 ms" packet_loss 0.0 ] ]""")
+
+
+def test_attachment():
+    top = Topology.from_gml(LINE_GML)
+    att = Attacher(top, SeededRandom(1))
+    # explicit pin
+    a = att.attach(network_node_id=2)
+    assert a.vertex == 2
+    assert a.bw_down_bits == 300_000_000   # vertex default
+    assert a.bw_up_bits == 30_000_000
+    # bandwidth override beats vertex default
+    a = att.attach(network_node_id=2, bw_down_override=5)
+    assert a.bw_down_bits == 5
+    # longest-prefix ip match: 10.1.1.7 -> vertex 3 (10.1.1.1)
+    a = att.attach(ip_hint="10.1.1.7")
+    assert a.vertex == 3
+    # country filter: DE -> vertex 2 or 3; city BER -> 3
+    a = att.attach(country_hint="DE", city_hint="BER")
+    assert a.vertex == 3
+    # hint-less attach is deterministic given the seed
+    att2 = Attacher(top, SeededRandom(1))
+    seq1 = [att.attach().vertex for _ in range(5)]
+    # fresh attacher replays only if RNG state matches call-for-call
+    att3 = Attacher(top, SeededRandom(1))
+    for _ in range(4):
+        att3.attach(network_node_id=0)  # pins don't consume RNG draws
+    assert att2.attach().vertex == seq1[0]
+
+
+def test_large_random_graph_paths_match_floyd():
+    # cross-check scipy dijkstra path against the scipy-free fallback
+    rng = np.random.default_rng(0)
+    V = 12
+    lines = ["graph [", "  directed 0"]
+    for v in range(V):
+        lines.append(f'  node [ id {v} bandwidth_down "1 Gbit" '
+                     f'bandwidth_up "1 Gbit" ]')
+    for a in range(V):
+        for b in range(a + 1, V):
+            if rng.random() < 0.4 or b == a + 1:
+                lat = int(rng.integers(1, 40))
+                lines.append(f'  edge [ source {a} target {b} '
+                             f'latency "{lat} ms" packet_loss 0.01 ]')
+    lines.append("]")
+    gml = "\n".join(lines)
+    top = Topology.from_gml(gml)
+    direct_lat, direct_rel = top._adjacency()
+    fb_lat, fb_rel = top._all_pairs_minplus(direct_lat, direct_rel)
+    off = ~np.eye(V, dtype=bool)
+    np.testing.assert_array_equal(top.latency_ns[off], fb_lat[off])
+    np.testing.assert_allclose(top.reliability[off], fb_rel[off], rtol=1e-5)
