@@ -207,7 +207,9 @@ class ExperimentalOptions:
     TPU engine's capacity/layout knobs (new)."""
 
     interpose_method: str = "model"
-    scheduler_policy: str = "tpu"
+    # default flips to "tpu" once a config opts in; serial is the safe
+    # universal default (the device engine requires jax devices)
+    scheduler_policy: str = "serial"
     runahead: Optional[int] = None          # override lookahead window, ns
     use_cpu_pinning: bool = True
     use_memory_manager: bool = True

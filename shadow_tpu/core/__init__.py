@@ -1,3 +1,16 @@
-from shadow_tpu.core.event import Event, EventKey
+from shadow_tpu.core.event import (
+    Event,
+    EventKey,
+    KIND_BOOT,
+    KIND_PACKET,
+    KIND_STOP,
+    KIND_TIMER,
+)
+from shadow_tpu.core.manager import Manager, SimStats
+from shadow_tpu.core.controller import Controller, build, load_topology
 
-__all__ = ["Event", "EventKey"]
+__all__ = [
+    "Event", "EventKey",
+    "KIND_BOOT", "KIND_PACKET", "KIND_STOP", "KIND_TIMER",
+    "Manager", "SimStats", "Controller", "build", "load_topology",
+]

@@ -1,0 +1,155 @@
+"""Controller: global clock windows and simulation lifecycle.
+
+Mirrors controller_run (src/main/core/controller.c:79-424): load the
+topology, register hosts (attachment + per-host RNG + app processes),
+compute the conservative lookahead window ("min time jump" = minimum
+path latency, controller.c:125-153), then advance the simulation in
+rounds [start, start + lookahead) until stop_time, asking the
+Manager(s) for the earliest next event between rounds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from shadow_tpu import simtime
+from shadow_tpu.config.schema import ConfigOptions
+from shadow_tpu.core.manager import Manager, SimStats
+from shadow_tpu.core.netmodel import NetworkModel
+from shadow_tpu.core.scheduler import make_policy
+from shadow_tpu.host.host import Host
+from shadow_tpu.models import is_model_path, make_app
+from shadow_tpu.topology.attach import Attacher
+from shadow_tpu.topology.graph import Topology
+from shadow_tpu.utils.rng import SeededRandom
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("controller")
+
+
+def load_topology(cfg: ConfigOptions) -> Topology:
+    net = cfg.network
+    if net.graph_type == "1_gbit_switch":
+        return Topology.builtin_1_gbit_switch()
+    if net.graph_type == "gml":
+        if net.graph_inline:
+            return Topology.from_gml(net.graph_inline,
+                                     net.use_shortest_path)
+        if net.graph_file:
+            with open(net.graph_file) as f:
+                return Topology.from_gml(f.read(), net.use_shortest_path)
+        raise ValueError("network.graph.type=gml needs file.path or inline")
+    raise ValueError(f"unknown graph type {net.graph_type!r}")
+
+
+@dataclass
+class BuiltSimulation:
+    """Everything instantiated from a config, pre-run."""
+    cfg: ConfigOptions
+    topology: Topology
+    hosts: list[Host]
+    netmodel: NetworkModel
+    starts: list[tuple[int, int, int]]   # (host_id, start, stop|-1)
+    lookahead: int
+
+
+def build(cfg: ConfigOptions) -> BuiltSimulation:
+    topology = load_topology(cfg)
+    root_rng = SeededRandom(cfg.general.seed)
+    attacher = Attacher(topology, root_rng.child("attach"))
+
+    hosts: list[Host] = []
+    starts: list[tuple[int, int, int]] = []
+    n_total = cfg.total_hosts()
+    for group in cfg.hosts:
+        for i in range(group.quantity):
+            name = group.name if group.quantity == 1 else f"{group.name}{i}"
+            host_id = len(hosts)
+            att = attacher.attach(
+                network_node_id=group.network_node_id,
+                ip_hint=group.ip_address_hint,
+                city_hint=group.city_code_hint,
+                country_hint=group.country_code_hint,
+                bw_down_override=group.bandwidth_down,
+                bw_up_override=group.bandwidth_up,
+            )
+            host = Host(host_id=host_id, name=name, vertex=att.vertex,
+                        bw_down_bits=att.bw_down_bits,
+                        bw_up_bits=att.bw_up_bits,
+                        rng=root_rng.child(f"host:{name}"))
+            for proc in group.processes:
+                for _ in range(proc.quantity):
+                    if not is_model_path(proc.path):
+                        raise ValueError(
+                            f"process path {proc.path!r}: real-executable "
+                            "processes need the native runtime "
+                            "(interpose_method preload/ptrace)")
+                    if host.app is not None:
+                        raise ValueError(
+                            f"host {name}: multiple processes per host "
+                            "not yet supported by the model runtime")
+                    host.app = make_app(proc.path, proc.args, host_id,
+                                        n_total)
+                    starts.append((host_id, proc.start_time,
+                                   proc.stop_time
+                                   if proc.stop_time is not None else -1))
+            hosts.append(host)
+
+    netmodel = NetworkModel(
+        topology=topology,
+        host_vertex=np.array([h.vertex for h in hosts], dtype=np.int64),
+        seed=cfg.general.seed,
+        bootstrap_end=cfg.general.bootstrap_end_time,
+    )
+    lookahead = (cfg.experimental.runahead
+                 if cfg.experimental.runahead is not None
+                 else topology.min_latency_ns)
+    return BuiltSimulation(cfg=cfg, topology=topology, hosts=hosts,
+                           netmodel=netmodel, starts=starts,
+                           lookahead=lookahead)
+
+
+class Controller:
+    def __init__(self, cfg: ConfigOptions, trace: Optional[list] = None):
+        self.cfg = cfg
+        self.sim = build(cfg)
+        policy_name = cfg.experimental.scheduler_policy
+        if policy_name == "tpu":
+            from shadow_tpu.device.runner import DeviceRunner
+            self.runner = DeviceRunner(self.sim, trace=trace)
+            self.manager = None
+        else:
+            self.runner = None
+            self.manager = Manager(
+                hosts=self.sim.hosts,
+                policy=make_policy(policy_name,
+                                   cfg.general.parallelism),
+                netmodel=self.sim.netmodel,
+                seed=cfg.general.seed,
+                trace=trace,
+            )
+
+    def run(self) -> SimStats:
+        cfg = self.cfg
+        stop = cfg.general.stop_time
+        if self.runner is not None:
+            return self.runner.run(stop)
+
+        m = self.manager
+        m.boot_hosts(self.sim.starts)
+        lookahead = max(1, self.sim.lookahead)
+        log.info("starting: %d hosts, stop=%s, lookahead=%s",
+                 len(self.sim.hosts), simtime.format_time(stop),
+                 simtime.format_time(lookahead))
+
+        next_time = m.policy.next_event_time()
+        while next_time < stop:
+            window_end = min(next_time + lookahead, stop)
+            next_time = m.run_window(next_time, window_end)
+
+        m.finalize()
+        m.stats.end_time = stop
+        return m.stats

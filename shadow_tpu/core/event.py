@@ -17,6 +17,16 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, NamedTuple
 
 
+# Event kinds shared by the CPU and device engines. The device engine
+# dispatches on these integers with lax.switch; the CPU engine calls the
+# matching ModelApp hook.
+KIND_BOOT = 0     # host/process start (worker_bootHosts analogue)
+KIND_TIMER = 1    # self-scheduled timer/task
+KIND_PACKET = 2   # packet delivery from the network model
+KIND_STOP = 3     # process/host stop
+KIND_TASK = 4     # CPU-only: run the attached task closure
+
+
 class EventKey(NamedTuple):
     time: int          # sim ns
     dst_host: int

@@ -1,0 +1,167 @@
+"""Manager: drives one machine's share of the simulation.
+
+The round-loop owner, mirroring manager_run (src/main/core/manager.c:
+615-649): given a time window [start, end) from the Controller, execute
+every pending event below the barrier via the scheduler policy, then
+report the earliest next event time for the Controller to open the next
+window. Serial policies are drained centrally; threaded policies run
+the round on their worker pool (each worker gets its own SimContext and
+stats bucket, merged at finalize). Multi-manager distribution (stubbed
+in the reference, controller.c:352-354) maps here to one Manager per
+device-mesh slice.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from shadow_tpu import simtime
+from shadow_tpu.core.event import (
+    Event,
+    KIND_BOOT,
+    KIND_PACKET,
+    KIND_STOP,
+    KIND_TIMER,
+)
+from shadow_tpu.core.netmodel import NetworkModel
+from shadow_tpu.core.scheduler.base import SchedulerPolicy
+from shadow_tpu.core.worker import SimContext
+from shadow_tpu.host.host import Host
+from shadow_tpu.utils import nprng
+from shadow_tpu.utils.slog import get_logger, set_context, clear_context
+
+log = get_logger("manager")
+
+
+@dataclass
+class SimStats:
+    ok: bool = True
+    end_time: int = 0
+    events_executed: int = 0
+    packets_sent: int = 0
+    packets_delivered: int = 0
+    packets_dropped: int = 0
+    rounds: int = 0
+
+    def merge(self, other: "SimStats") -> None:
+        self.events_executed += other.events_executed
+        self.packets_sent += other.packets_sent
+        self.packets_delivered += other.packets_delivered
+        self.packets_dropped += other.packets_dropped
+
+    def summary(self) -> str:
+        return (f"{self.events_executed} events, "
+                f"{self.packets_sent} packets sent "
+                f"({self.packets_delivered} delivered, "
+                f"{self.packets_dropped} dropped), "
+                f"{self.rounds} rounds")
+
+
+@dataclass
+class Manager:
+    hosts: list[Host]
+    policy: SchedulerPolicy
+    netmodel: NetworkModel
+    seed: int
+    stats: SimStats = field(default_factory=SimStats)
+    trace: Optional[list] = None    # (time, dst, src, kind) if recording
+    on_event_hook: Optional[Callable] = None
+
+    def __post_init__(self):
+        self.rng_key = nprng.seed_key(self.seed)
+        self._name_to_id = {h.name: h.host_id for h in self.hosts}
+        self._barrier = simtime.SIMTIME_INVALID
+        self._trace_lock = threading.Lock()
+        self._worker_stats: list[SimStats] = []
+        self._ctx = SimContext(self, self.stats)
+        for h in self.hosts:
+            self.policy.add_host(h.host_id)
+
+    def resolve(self, name: str) -> int:
+        if name not in self._name_to_id:
+            raise KeyError(f"unknown host name {name!r}")
+        return self._name_to_id[name]
+
+    def push_event(self, ev: Event) -> None:
+        self.policy.push(ev, self._barrier)
+
+    def make_worker_state(self) -> tuple[SimContext, SimStats]:
+        """Per-worker execution state for threaded policies."""
+        stats = SimStats()
+        self._worker_stats.append(stats)
+        return SimContext(self, stats), stats
+
+    def boot_hosts(self, start_times: list[tuple[int, int, int]]) -> None:
+        """start_times: (host_id, start_time, stop_time|-1) per process.
+        Boot/stop events enter the queue before the first round
+        (worker_bootHosts analogue, worker.c:581-591)."""
+        for host_id, t_start, t_stop in start_times:
+            h = self.hosts[host_id]
+            self.push_event(Event(time=t_start, dst_host=host_id,
+                                  src_host=host_id,
+                                  seq=h.next_event_seq(), kind=KIND_BOOT))
+            if t_stop is not None and t_stop >= 0:
+                self.push_event(Event(time=t_stop, dst_host=host_id,
+                                      src_host=host_id,
+                                      seq=h.next_event_seq(),
+                                      kind=KIND_STOP))
+
+    def run_window(self, window_start: int, window_end: int) -> int:
+        """Execute all events in [window_start, window_end); return the
+        earliest remaining event time (scheduler_awaitNextRound)."""
+        self._barrier = window_end
+        if hasattr(self.policy, "run_parallel"):
+            self.policy.run_parallel(self, window_end)
+        else:
+            while (ev := self.policy.pop(window_end)) is not None:
+                self.execute_event(ev, self._ctx, self.stats)
+        self.stats.rounds += 1
+        return self.policy.next_event_time()
+
+    def finalize(self) -> SimStats:
+        for ws in self._worker_stats:
+            self.stats.merge(ws)
+        self._worker_stats.clear()
+        if hasattr(self.policy, "shutdown"):
+            self.policy.shutdown()
+        return self.stats
+
+    def execute_event(self, ev: Event, ctx: SimContext,
+                      stats: SimStats) -> None:
+        """event_execute analogue (core/work/event.c:64): set the clock
+        and host context, dispatch by kind."""
+        host = self.hosts[ev.dst_host]
+        ctx.now = ev.time
+        ctx.host = host
+        set_context(ev.time, host.name, host.host_id)
+        try:
+            host.events_executed += 1
+            stats.events_executed += 1
+            if self.trace is not None:
+                with self._trace_lock:
+                    self.trace.append((ev.time, ev.dst_host, ev.src_host,
+                                       ev.kind))
+            if self.on_event_hook is not None:
+                self.on_event_hook(ev)
+            app = host.app
+            if ev.task is not None:
+                ev.execute(ctx)
+            elif ev.kind == KIND_PACKET:
+                stats.packets_delivered += 1
+                host.packets_delivered += 1
+                if app is not None:
+                    size = ev.data[0] if ev.data else 0
+                    app.on_packet(ctx, ev.src_host, size, ev.data[1:])
+            elif ev.kind == KIND_TIMER:
+                if app is not None:
+                    app.on_timer(ctx, ev.data)
+            elif ev.kind == KIND_BOOT:
+                if app is not None:
+                    app.boot(ctx)
+            elif ev.kind == KIND_STOP:
+                if app is not None:
+                    app.on_stop(ctx)
+        finally:
+            clear_context()

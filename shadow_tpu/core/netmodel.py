@@ -1,0 +1,74 @@
+"""The inter-host network model (CPU reference implementation).
+
+This is the semantic twin of the hot path in the reference's
+worker_sendPacket (src/main/core/worker.c:520-579):
+
+    reliability lookup -> random drop roll -> latency lookup ->
+    schedule delivery event on the destination host
+
+but expressed as a pure function over precomputed topology matrices and
+the counter RNG, so the device engine (shadow_tpu/device/engine.py) can
+run the *identical* computation as batched gathers, and traces match
+bit-for-bit between the two engines.
+
+Drop rule: a packet from src with per-source sequence number `pkt_seq`
+is dropped iff reliability < 1 and
+    uniform01(fold(seed, DROP, src_host, pkt_seq)) >= reliability.
+During the bootstrap phase packets are never dropped (the reference
+skips drops while bootstrapping so initial connections always form).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from shadow_tpu.topology.graph import Topology
+from shadow_tpu.utils import nprng
+from shadow_tpu.utils.rng import PURPOSE_PACKET_DROP
+
+
+@dataclass
+class PacketVerdict:
+    delivered: bool
+    deliver_time: int      # sim ns (valid when delivered)
+    latency_ns: int
+
+
+@dataclass
+class NetworkModel:
+    topology: Topology
+    host_vertex: np.ndarray        # [H] vertex index per host
+    seed: int
+    bootstrap_end: int = 0
+    # per-path packet counters (topology_incrementPathPacketCounter
+    # analogue), aggregated per (src_vertex, dst_vertex); judged from
+    # multiple worker threads under threaded policies
+    path_packets: dict = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    @property
+    def min_latency_ns(self) -> int:
+        return self.topology.min_latency_ns
+
+    def judge(self, now: int, src_host: int, dst_host: int,
+              pkt_seq: int) -> PacketVerdict:
+        sv = int(self.host_vertex[src_host])
+        dv = int(self.host_vertex[dst_host])
+        latency = int(self.topology.latency_ns[sv, dv])
+        reliability = float(self.topology.reliability[sv, dv])
+
+        delivered = True
+        if reliability < 1.0 and now >= self.bootstrap_end:
+            roll = float(nprng.packet_uniform(
+                self.seed, PURPOSE_PACKET_DROP, src_host, pkt_seq))
+            delivered = roll < reliability
+
+        key = (sv, dv)
+        with self._lock:
+            self.path_packets[key] = self.path_packets.get(key, 0) + 1
+        return PacketVerdict(delivered=delivered,
+                             deliver_time=now + latency,
+                             latency_ns=latency)

@@ -1,0 +1,17 @@
+from shadow_tpu.core.scheduler.base import SchedulerPolicy
+from shadow_tpu.core.scheduler.serial import SerialPolicy
+
+__all__ = ["SchedulerPolicy", "SerialPolicy", "make_policy"]
+
+
+def make_policy(name: str, n_workers: int = 0) -> SchedulerPolicy:
+    """Policy factory (scheduler_policy_type.h analogue). The five CPU
+    policies of the reference map onto our thread-pool policies; `serial`
+    is the single-threaded oracle and `tpu` is handled by the device
+    engine (core/manager.py selects it before reaching here)."""
+    if name == "serial":
+        return SerialPolicy()
+    if name in ("host", "steal", "thread", "threadXthread", "threadXhost"):
+        from shadow_tpu.core.scheduler.threads import ThreadedPolicy
+        return ThreadedPolicy(kind=name, n_workers=n_workers)
+    raise ValueError(f"unknown scheduler policy {name!r}")

@@ -1,0 +1,196 @@
+"""Thread-pool scheduler policies.
+
+The five CPU policies of the reference (scheduler_policy_type.h, chosen
+by `experimental.scheduler_policy`):
+
+* ``host``          — hosts partitioned over workers, one locked queue
+                      per host, each worker drains its own hosts
+                      (scheduler_policy_host_single.c).
+* ``steal``         — per-host queues, but workers dynamically claim the
+                      next unprocessed host from a shared cursor — whole-
+                      host work stealing (scheduler_policy_host_steal.c).
+* ``thread``        — one queue per worker; events routed by destination
+                      host's owning worker (scheduler_policy_thread_single.c).
+* ``threadXthread`` — per (src worker, dst worker) queues, merged when a
+                      round starts (scheduler_policy_thread_perthread.c).
+* ``threadXhost``   — per-host queues iterated thread-major
+                      (scheduler_policy_thread_perhost.c).
+
+Correctness invariants shared with the reference: a host's events
+execute serially in (time, dst, src, seq) order on exactly one worker
+per round, and cross-host pushes below the round barrier are bumped to
+it, so nothing a worker does can create same-window work for a host
+another worker already finished.
+
+Python threads share the GIL, so these policies exist for API parity,
+correctness testing, and as the structure the native C++ worker pool
+slots into — the performance path is the `tpu` device policy.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from shadow_tpu import simtime
+from shadow_tpu.core.event import Event
+from shadow_tpu.core.scheduler.base import SchedulerPolicy
+from shadow_tpu.utils.latch import CountDownLatch
+from shadow_tpu.utils.pqueue import PriorityQueue
+
+
+class _LockedQueue:
+    """async_priority_queue.c analogue."""
+
+    __slots__ = ("_q", "_lock")
+
+    def __init__(self):
+        self._q = PriorityQueue()
+        self._lock = threading.Lock()
+
+    def push(self, key, item) -> None:
+        with self._lock:
+            self._q.push(key, item)
+
+    def pop_before(self, barrier: int) -> Optional[Event]:
+        with self._lock:
+            head = self._q.peek()
+            if head is None or head[0].time >= barrier:
+                return None
+            return self._q.pop()[1]
+
+    def next_time(self) -> int:
+        with self._lock:
+            key = self._q.peek_key()
+            return simtime.SIMTIME_MAX if key is None else key.time
+
+
+class ThreadedPolicy(SchedulerPolicy):
+    def __init__(self, kind: str, n_workers: int = 0):
+        self.kind = kind
+        self.n_workers = n_workers if n_workers > 0 else (os.cpu_count() or 2)
+        self._host_queues: dict[int, _LockedQueue] = {}
+        self._worker_queues: list[_LockedQueue] = []
+        self._owner: dict[int, int] = {}       # host -> worker
+        self._worker_hosts: list[list[int]] = []
+        self._pool: Optional[_WorkerPool] = None
+
+    # -- topology of queues -------------------------------------------
+    def _per_host(self) -> bool:
+        return self.kind in ("host", "steal", "threadXhost")
+
+    def add_host(self, host_id: int) -> None:
+        if not self._worker_hosts:
+            self._worker_hosts = [[] for _ in range(self.n_workers)]
+            self._worker_queues = [_LockedQueue()
+                                   for _ in range(self.n_workers)]
+        w = host_id % self.n_workers          # round-robin assignment
+        self._owner[host_id] = w
+        self._worker_hosts[w].append(host_id)
+        if self._per_host():
+            self._host_queues[host_id] = _LockedQueue()
+
+    def _queue_for(self, host_id: int) -> _LockedQueue:
+        if self._per_host():
+            return self._host_queues[host_id]
+        return self._worker_queues[self._owner[host_id]]
+
+    # -- SchedulerPolicy interface ------------------------------------
+    def push(self, event: Event, barrier: int) -> None:
+        event = self.apply_barrier(event, barrier)
+        self._queue_for(event.dst_host).push(event.key, event)
+
+    def pop(self, barrier: int) -> Optional[Event]:
+        raise RuntimeError("ThreadedPolicy executes rounds via "
+                           "run_parallel, not central pop")
+
+    def next_event_time(self) -> int:
+        queues = (self._host_queues.values() if self._per_host()
+                  else self._worker_queues)
+        times = [q.next_time() for q in queues]
+        return min(times, default=simtime.SIMTIME_MAX)
+
+    # -- parallel round execution -------------------------------------
+    def run_parallel(self, manager, window_end: int) -> None:
+        if self._pool is None:
+            self._pool = _WorkerPool(self, manager)
+        self._pool.run_round(window_end)
+
+    def shutdown(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+
+
+class _WorkerPool:
+    """Persistent pthread-pool analogue (core/worker.c:132-185): workers
+    wait on a per-round start signal, drain their share of the queues,
+    then count down a finish latch."""
+
+    def __init__(self, policy: ThreadedPolicy, manager):
+        self.policy = policy
+        self.manager = manager
+        self.n = policy.n_workers
+        self._barrier = simtime.SIMTIME_INVALID
+        self._start = [threading.Semaphore(0) for _ in range(self.n)]
+        self._done: Optional[CountDownLatch] = None
+        self._shutdown = False
+        self._steal_lock = threading.Lock()
+        self._steal_cursor = 0
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True,
+                             name=f"shadow-worker-{i}")
+            for i in range(self.n)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def run_round(self, window_end: int) -> None:
+        self._barrier = window_end
+        self._steal_cursor = 0
+        self._done = CountDownLatch(self.n)
+        for s in self._start:
+            s.release()
+        self._done.wait()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        for s in self._start:
+            s.release()
+
+    # -- worker bodies -------------------------------------------------
+    def _run(self, wid: int) -> None:
+        ctx, stats = self.manager.make_worker_state()
+        while True:
+            self._start[wid].acquire()
+            if self._shutdown:
+                return
+            barrier = self._barrier
+            try:
+                if self.policy.kind == "steal":
+                    self._drain_stealing(ctx, stats, barrier)
+                elif self.policy._per_host():
+                    for hid in self.policy._worker_hosts[wid]:
+                        self._drain(self.policy._host_queues[hid],
+                                    ctx, stats, barrier)
+                else:
+                    self._drain(self.policy._worker_queues[wid],
+                                ctx, stats, barrier)
+            finally:
+                self._done.count_down()
+
+    def _drain(self, q: _LockedQueue, ctx, stats, barrier: int) -> None:
+        while (ev := q.pop_before(barrier)) is not None:
+            self.manager.execute_event(ev, ctx, stats)
+
+    def _drain_stealing(self, ctx, stats, barrier: int) -> None:
+        hosts = list(self.policy._host_queues.keys())
+        while True:
+            with self._steal_lock:
+                i = self._steal_cursor
+                self._steal_cursor += 1
+            if i >= len(hosts):
+                return
+            self._drain(self.policy._host_queues[hosts[i]],
+                        ctx, stats, barrier)

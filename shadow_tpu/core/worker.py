@@ -1,0 +1,93 @@
+"""Worker execution context.
+
+The analogue of the reference's thread-local Worker
+(src/main/core/worker.c / worker.rs): tracks the active host and clock
+during event execution and provides the APIs host code uses to push new
+work — here the ModelApp-facing SimContext. `send` is the
+worker_sendPacket twin (worker.c:520-579) routed through the
+NetworkModel; `schedule` is task scheduling on the active host.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from shadow_tpu import simtime
+from shadow_tpu.core.event import Event, KIND_PACKET, KIND_TIMER
+from shadow_tpu.host.host import Host
+from shadow_tpu.utils import nprng
+from shadow_tpu.utils.rng import PURPOSE_APP
+
+
+class SimContext:
+    """Passed to ModelApp hooks; valid only during one event execution."""
+
+    def __init__(self, manager, stats):
+        self._m = manager
+        self._stats = stats
+        self.now: int = simtime.SIMTIME_INVALID
+        self.host: Optional[Host] = None
+
+    # -- identity ------------------------------------------------------
+    @property
+    def host_id(self) -> int:
+        return self.host.host_id
+
+    @property
+    def n_hosts(self) -> int:
+        return len(self._m.hosts)
+
+    def resolve(self, name: str) -> int:
+        """Hostname -> host id (DNS-lite; full DNS in host/dns.py)."""
+        return self._m.resolve(name)
+
+    # -- randomness ----------------------------------------------------
+    def app_bits(self) -> int:
+        """32 deterministic random bits keyed by (APP, host, draw#) —
+        identical on CPU and device engines."""
+        seq = self.host.next_app_seq()
+        key = nprng.fold_in(
+            nprng.fold_in(
+                nprng.fold_in(self._m.rng_key, PURPOSE_APP),
+                self.host.host_id),
+            seq)
+        return int(nprng.random_bits32(key))
+
+    def app_uniform(self) -> float:
+        seq = self.host.next_app_seq()
+        key = nprng.fold_in(
+            nprng.fold_in(
+                nprng.fold_in(self._m.rng_key, PURPOSE_APP),
+                self.host.host_id),
+            seq)
+        return float(nprng.uniform01(key))
+
+    # -- event generation ---------------------------------------------
+    def send(self, dst_host: int, size: int, data: tuple = ()) -> bool:
+        """Send a packet through the network model. Returns False if the
+        drop roll discarded it (the caller — like a real app — cannot
+        observe this directly; returned for stats/tests only)."""
+        host = self.host
+        pkt_seq = host.next_packet_seq()
+        verdict = self._m.netmodel.judge(self.now, host.host_id, dst_host,
+                                         pkt_seq)
+        host.packets_sent += 1
+        self._stats.packets_sent += 1
+        if not verdict.delivered:
+            host.packets_dropped += 1
+            self._stats.packets_dropped += 1
+            return False
+        ev = Event(time=verdict.deliver_time, dst_host=dst_host,
+                   src_host=host.host_id, seq=host.next_event_seq(),
+                   kind=KIND_PACKET, data=(size,) + tuple(data))
+        self._m.push_event(ev)
+        return True
+
+    def schedule(self, delay_ns: int, data: tuple = ()) -> None:
+        """Self timer after delay_ns -> on_timer."""
+        host = self.host
+        ev = Event(time=self.now + max(0, delay_ns),
+                   dst_host=host.host_id, src_host=host.host_id,
+                   seq=host.next_event_seq(), kind=KIND_TIMER,
+                   data=tuple(data))
+        self._m.push_event(ev)

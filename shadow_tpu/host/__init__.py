@@ -1,0 +1,3 @@
+from shadow_tpu.host.host import Host
+
+__all__ = ["Host"]

@@ -1,0 +1,54 @@
+"""A simulated host (node).
+
+Mirror of the reference's Host (src/main/host/host.c:49-213): identity,
+topology attachment, bandwidths, deterministic per-host RNG, and the
+per-host id counters that make the event order reproducible — the
+event-sequence counter (host_getNewEventID) and packet-sequence counter
+(packet ids). The interfaces/router/TCP machinery attaches here as the
+host emulation layer grows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from shadow_tpu.utils.rng import SeededRandom
+
+
+@dataclass
+class Host:
+    host_id: int
+    name: str
+    vertex: int                 # topology vertex index
+    bw_down_bits: int
+    bw_up_bits: int
+    rng: SeededRandom
+    app: Any = None             # ModelApp instance (interpose=model)
+    ip: Optional[str] = None
+
+    # deterministic id streams (reference host.c:85-95)
+    _event_seq: int = 0
+    _packet_seq: int = 0
+    _app_seq: int = 0
+
+    # per-host stats (Tracker-lite; grows into host/tracker.py)
+    events_executed: int = 0
+    packets_sent: int = 0
+    packets_delivered: int = 0
+    packets_dropped: int = 0
+
+    def next_event_seq(self) -> int:
+        s = self._event_seq
+        self._event_seq += 1
+        return s
+
+    def next_packet_seq(self) -> int:
+        s = self._packet_seq
+        self._packet_seq += 1
+        return s
+
+    def next_app_seq(self) -> int:
+        s = self._app_seq
+        self._app_seq += 1
+        return s

@@ -1,0 +1,49 @@
+"""Application models.
+
+With `interpose_method: model` (the default), processes are *application
+models*: scripted behaviors with two interchangeable implementations —
+a per-host Python class for the CPU engines (this package) and a
+vectorized JAX form for the device engine (shadow_tpu/device/apps.py).
+The `path` of a process config selects one as "model:<name>".
+
+Real-program execution (interpose_method preload/ptrace), where `path`
+is an actual executable run under syscall interposition, is the native
+runtime's job (native/), mirroring the reference's managed processes.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.models.base import ModelApp, parse_kv_args
+from shadow_tpu.models.phold import PholdApp
+from shadow_tpu.models.tgen import TgenClientApp, TgenServerApp
+
+_REGISTRY = {
+    "phold": PholdApp,
+    "tgen_client": TgenClientApp,
+    "tgen_server": TgenServerApp,
+}
+
+
+def is_model_path(path: str) -> bool:
+    return path.startswith("model:")
+
+
+def make_app(path: str, args, host_id: int, n_hosts: int) -> ModelApp:
+    if not is_model_path(path):
+        raise ValueError(
+            f"process path {path!r} is not a model: real-executable "
+            "processes require the native interposition runtime")
+    name = path[len("model:"):]
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model app {name!r} "
+                         f"(have: {sorted(_REGISTRY)})")
+    return _REGISTRY[name](parse_kv_args(args), host_id, n_hosts)
+
+
+def register_model(name: str, cls) -> None:
+    """Extension point for user-defined application models."""
+    _REGISTRY[name] = cls
+
+
+__all__ = ["ModelApp", "make_app", "register_model", "is_model_path",
+           "parse_kv_args", "PholdApp", "TgenClientApp", "TgenServerApp"]

@@ -1,0 +1,61 @@
+"""Model-application interface (CPU form).
+
+A ModelApp is the scripted stand-in for a managed process. Its hooks
+receive a SimContext (core/worker.py) exposing:
+
+* ``ctx.now`` — current sim time (ns)
+* ``ctx.host_id`` / ``ctx.n_hosts``
+* ``ctx.send(dst_host, size_bytes, data)`` — send a packet through the
+  network model (may be dropped); delivery fires the destination app's
+  ``on_packet``
+* ``ctx.schedule(delay_ns, data)`` — self timer -> ``on_timer``
+* ``ctx.app_bits()`` — 32 deterministic random bits from the counter
+  RNG (bit-identical on CPU and device, so vectorized twins of an app
+  make the same decisions)
+
+Apps that also have a device (vectorized JAX) twin must restrict their
+decision-making to integer arithmetic on ``app_bits()`` draws so traces
+match bit-for-bit across engines.
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import Any
+
+
+def parse_kv_args(args: Any) -> dict[str, str]:
+    """Process args come as "k=v k=v" strings or lists (schema.py);
+    model apps use k=v pairs like the reference's phold test driver."""
+    if isinstance(args, dict):
+        return {str(k): str(v) for k, v in args.items()}
+    if isinstance(args, (list, tuple)):
+        parts = [str(p) for p in args]
+    else:
+        parts = shlex.split(str(args or ""))
+    out = {}
+    for p in parts:
+        k, eq, v = p.partition("=")
+        if eq:
+            out[k.strip("-")] = v
+    return out
+
+
+class ModelApp:
+    def __init__(self, args: dict[str, str], host_id: int, n_hosts: int):
+        self.args = args
+        self.host_id = host_id
+        self.n_hosts = n_hosts
+
+    def boot(self, ctx) -> None:
+        """Process start (the _process_start analogue)."""
+
+    def on_timer(self, ctx, data: tuple) -> None:
+        """A ctx.schedule()'d timer fired."""
+
+    def on_packet(self, ctx, src_host: int, size: int,
+                  data: tuple) -> None:
+        """A packet from src_host was delivered to this host."""
+
+    def on_stop(self, ctx) -> None:
+        """Process stop_time reached."""

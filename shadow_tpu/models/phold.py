@@ -1,0 +1,43 @@
+"""PHOLD: the classic parallel-discrete-event benchmark workload.
+
+Equivalent of the reference's src/test/phold (test_phold.c + phold.yaml):
+N peers bounce messages around — each received message triggers one new
+message to a pseudo-random peer. The steady-state message population
+equals ``msgload`` x hosts, and throughput (events/sec wall) is the
+scheduler's figure of merit.
+
+args: msgload=K (initial messages per host, default 1), size=bytes
+(payload size, default 64), selfloop=0/1 (allow sending to self,
+default 0).
+
+Decisions use only integer ops on ``app_bits()`` so the device twin
+(shadow_tpu/device/apps.py) reproduces them exactly.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.models.base import ModelApp
+
+
+class PholdApp(ModelApp):
+    def __init__(self, args, host_id, n_hosts):
+        super().__init__(args, host_id, n_hosts)
+        self.msgload = int(args.get("msgload", 1))
+        self.size = int(args.get("size", 64))
+        self.selfloop = int(args.get("selfloop", 0))
+        self.received = 0
+
+    def _pick_peer(self, ctx) -> int:
+        bits = ctx.app_bits()
+        if self.selfloop or self.n_hosts == 1:
+            return bits % self.n_hosts
+        # exclude self without biasing the draw
+        return (self.host_id + 1 + bits % (self.n_hosts - 1)) % self.n_hosts
+
+    def boot(self, ctx) -> None:
+        for _ in range(self.msgload):
+            ctx.send(self._pick_peer(ctx), self.size)
+
+    def on_packet(self, ctx, src_host, size, data) -> None:
+        self.received += 1
+        ctx.send(self._pick_peer(ctx), self.size)

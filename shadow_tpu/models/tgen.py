@@ -1,0 +1,87 @@
+"""tgen-like traffic generator models (client/server bulk transfer).
+
+Models the reference's flagship benchmark workload (tgen bulk downloads,
+docs/getting_started_tgen.md and BASELINE configs 1-3): a client asks a
+server for `size` bytes; the server streams them back as MTU-sized
+packets; the client counts arrivals, and after receiving everything
+pauses and repeats, `count` times total.
+
+This packet-granularity form runs on the raw network model (latency,
+loss, drops). When the in-simulator TCP stack is selected
+(experimental.transport=tcp, shadow_tpu/host/tcp.py), the same apps run
+over real TCP flows with congestion control and retransmission instead.
+
+client args: server=<hostname>, size=bytes, count=N, pause=ns between
+downloads. server args: none.
+
+Message tags (integers, for device-twin parity):
+  1=REQ(total_size)  2=DATA(seq_no)  3=FIN
+"""
+
+from __future__ import annotations
+
+from shadow_tpu import simtime
+from shadow_tpu.config.units import parse_size_bytes, parse_time_ns
+from shadow_tpu.models.base import ModelApp
+
+TAG_REQ = 1
+TAG_DATA = 2
+TAG_FIN = 3
+
+MSS = simtime.CONFIG_TCP_MAX_SEGMENT_SIZE
+
+
+class TgenServerApp(ModelApp):
+    def on_packet(self, ctx, src_host, size, data) -> None:
+        tag = data[0] if data else 0
+        if tag != TAG_REQ:
+            return
+        total = data[1]
+        n_full, last = divmod(total, MSS)
+        for seq in range(n_full):
+            ctx.send(src_host, MSS, (TAG_DATA, seq))
+        if last:
+            ctx.send(src_host, last, (TAG_DATA, n_full))
+        ctx.send(src_host, 1, (TAG_FIN, n_full + (1 if last else 0)))
+
+
+class TgenClientApp(ModelApp):
+    def __init__(self, args, host_id, n_hosts):
+        super().__init__(args, host_id, n_hosts)
+        self.server_name = args.get("server", "server")
+        self.size = parse_size_bytes(args.get("size", "1 MiB"))
+        self.count = int(args.get("count", 1))
+        self.pause_ns = parse_time_ns(args.get("pause", "1 s"))
+        self.downloads_done = 0
+        self.bytes_received = 0
+        self._expect_packets = 0
+        self._got_packets = 0
+        self._server: int | None = None
+
+    def _request(self, ctx) -> None:
+        if self._server is None:
+            self._server = ctx.resolve(self.server_name)
+        self._got_packets = 0
+        self._expect_packets = 0
+        ctx.send(self._server, 64, (TAG_REQ, self.size))
+
+    def boot(self, ctx) -> None:
+        if self.count > 0:
+            self._request(ctx)
+
+    def on_timer(self, ctx, data) -> None:
+        self._request(ctx)
+
+    def on_packet(self, ctx, src_host, size, data) -> None:
+        tag = data[0] if data else 0
+        if tag == TAG_DATA:
+            self.bytes_received += size
+            self._got_packets += 1
+        elif tag == TAG_FIN:
+            self._expect_packets = data[1]
+        if (self._expect_packets and
+                self._got_packets >= self._expect_packets):
+            self.downloads_done += 1
+            self._expect_packets = 0
+            if self.downloads_done < self.count:
+                ctx.schedule(self.pause_ns)

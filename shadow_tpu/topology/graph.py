@@ -279,12 +279,12 @@ class Topology:
             else:
                 path_lat[v, v], path_rel[v, v] = 0, 1.0
 
-        unreachable = path_lat <= 0
-        if self.use_shortest_path and unreachable.any():
-            # clamp zero paths to 1 ms like the reference (self paths on
-            # isolated vertices; connectivity was already validated)
-            path_rel = np.where(unreachable, 1.0, path_rel)
-        path_lat = np.maximum(path_lat, _MIN_PATH_LATENCY_NS)
+        # Clamp only *zero*-latency paths to 1 ms like the reference
+        # (topology.c:1788) — sub-millisecond edges are legitimate.
+        zero = path_lat <= 0
+        if zero.any():
+            path_rel = np.where(zero, 1.0, path_rel)
+            path_lat = np.where(zero, _MIN_PATH_LATENCY_NS, path_lat)
 
         self.latency_ns = path_lat.astype(np.int64)
         self.reliability = path_rel.astype(np.float32)

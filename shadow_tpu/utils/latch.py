@@ -1,0 +1,21 @@
+"""CountDownLatch (utility/count_down_latch.c analogue)."""
+
+from __future__ import annotations
+
+import threading
+
+
+class CountDownLatch:
+    def __init__(self, count: int):
+        self._count = count
+        self._cond = threading.Condition()
+
+    def count_down(self) -> None:
+        with self._cond:
+            self._count -= 1
+            if self._count <= 0:
+                self._cond.notify_all()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        with self._cond:
+            return self._cond.wait_for(lambda: self._count <= 0, timeout)

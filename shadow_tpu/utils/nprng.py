@@ -1,0 +1,91 @@
+"""Numpy replica of jax's threefry counter RNG (bit-for-bit).
+
+The CPU reference engine must make the *same* stochastic decisions
+(packet-drop rolls) as the device engine to be a trace-equivalence
+oracle, without paying a jax dispatch per packet. Threefry-2x32 is a
+pure ARX hash, so we reimplement the exact chain used by
+``jax.random.fold_in`` + ``jax.random.uniform`` (jax._src.prng, with
+``threefry_partitionable`` on — the default) in vectorized numpy.
+tests/test_nprng.py asserts bit-identity against jax on every path.
+
+All functions are vectorized: ``data``/etc. may be numpy arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_ROT_A = (13, 15, 26, 6)
+_ROT_B = (17, 29, 16, 24)
+_PARITY = np.uint32(0x1BD11BDA)
+
+
+def _rotl(x: np.ndarray, r: int) -> np.ndarray:
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def threefry2x32(k1, k2, x0, x1) -> tuple[np.ndarray, np.ndarray]:
+    """The Threefry-2x32 block cipher, 20 rounds (matches XLA's
+    threefry2x32 primitive)."""
+    with np.errstate(over="ignore"):
+        k1 = np.asarray(k1, dtype=np.uint32)
+        k2 = np.asarray(k2, dtype=np.uint32)
+        x0 = np.asarray(x0, dtype=np.uint32).copy()
+        x1 = np.asarray(x1, dtype=np.uint32).copy()
+        ks = (k1, k2, k1 ^ k2 ^ _PARITY)
+
+        x0 = x0 + ks[0]
+        x1 = x1 + ks[1]
+        for block in range(5):
+            rots = _ROT_A if block % 2 == 0 else _ROT_B
+            for r in rots:
+                x0 = x0 + x1
+                x1 = _rotl(x1, r) ^ x0
+            x0 = x0 + ks[(block + 1) % 3]
+            x1 = x1 + ks[(block + 2) % 3] + np.uint32(block + 1)
+        return x0, x1
+
+
+def seed_key(seed) -> tuple[np.ndarray, np.ndarray]:
+    """jax.random.PRNGKey(seed) -> raw (k1, k2) uint32 pair."""
+    seed = np.asarray(seed, dtype=np.uint64)
+    return (seed >> np.uint64(32)).astype(np.uint32), \
+        (seed & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def fold_in(key: tuple[np.ndarray, np.ndarray], data
+            ) -> tuple[np.ndarray, np.ndarray]:
+    """jax.random.fold_in on raw key pairs (data is cast to uint32,
+    exactly like threefry_fold_in)."""
+    k1, k2 = key
+    data = np.asarray(data, dtype=np.uint32)
+    zero = np.zeros_like(data)
+    return threefry2x32(k1, k2, zero, data)
+
+
+def random_bits32(key: tuple[np.ndarray, np.ndarray]) -> np.ndarray:
+    """32 random bits for a scalar draw per key (partitionable path,
+    shape ()): threefry(k1,k2,0,0) -> bits1 ^ bits2."""
+    k1, k2 = key
+    zero = np.zeros_like(k1)
+    b1, b2 = threefry2x32(k1, k2, zero, zero)
+    return b1 ^ b2
+
+
+def uniform01(key: tuple[np.ndarray, np.ndarray]) -> np.ndarray:
+    """jax.random.uniform(key, (), dtype=float32): mantissa-fill trick."""
+    bits = random_bits32(key)
+    float_bits = (bits >> np.uint32(9)) | np.uint32(0x3F800000)
+    return float_bits.view(np.float32) - np.float32(1.0)
+
+
+# ---------------------------------------------------------------------
+# The composed chain used for packet decisions, mirroring
+# shadow_tpu.utils.rng.uniform01 (purpose -> host -> seq fold-ins).
+
+def packet_uniform(seed: int, purpose, host_id, seq) -> np.ndarray:
+    k = seed_key(seed)
+    k = fold_in(k, purpose)
+    k = fold_in(k, host_id)
+    k = fold_in(k, seq)
+    return uniform01(k)

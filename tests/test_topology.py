@@ -128,6 +128,17 @@ def test_validation_errors():
           edge [ source 0 target 0 latency "0 ms" packet_loss 0.0 ] ]""")
 
 
+def test_submillisecond_latency_not_clamped():
+    gml = """graph [ directed 0
+      node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+      node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+      edge [ source 0 target 1 latency "100 us" packet_loss 0.0 ]
+    ]"""
+    top = Topology.from_gml(gml)
+    assert top.get_latency_ns(0, 1) == 100_000       # not inflated to 1 ms
+    assert top.min_latency_ns == 100_000
+
+
 def test_attachment():
     top = Topology.from_gml(LINE_GML)
     att = Attacher(top, SeededRandom(1))
