@@ -1,0 +1,129 @@
+"""Benchmark: device engine vs CPU serial scheduler on PHOLD.
+
+Prints ONE JSON line:
+  {"metric": "packets_routed_per_sec_per_chip", "value": N,
+   "unit": "packets/s", "vs_baseline": ratio}
+
+The workload is the PHOLD PDES benchmark (the reference's own perf
+probe, src/test/phold/): H hosts on a 2-vertex lossy topology, msgload
+messages per host in steady state. `value` is packets routed per wall
+second by the device engine on the available accelerator; `vs_baseline`
+is the speedup over the single-threaded CPU reference policy running
+the identical simulation (the stand-in for the reference's CPU
+scheduler on this machine).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# Keep bench runs honest: one process, whatever platform jax selects
+# (TPU under the driver, CPU elsewhere).
+
+GML = """graph [ directed 0
+  node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 0 latency "10 ms" packet_loss 0.01 ]
+  edge [ source 0 target 1 latency "5 ms" packet_loss 0.01 ]
+  edge [ source 1 target 1 latency "10 ms" packet_loss 0.01 ]
+]"""
+
+H = 1024           # hosts
+MSGLOAD = 4        # steady-state messages per host
+DEV_STOP_S = 2.0   # simulated seconds on device
+CPU_STOP_S = 0.25  # simulated seconds for the CPU baseline slice
+
+
+def yaml_cfg(policy: str, stop_s: float) -> str:
+    return f"""
+general:
+  stop_time: {stop_s}s
+  seed: 1
+network:
+  graph:
+    type: gml
+    inline: |
+{_indent(GML, 6)}
+experimental:
+  scheduler_policy: {policy}
+  event_capacity: 64
+  outbox_capacity: 32
+hosts:
+  left:
+    quantity: {H // 2}
+    network_node_id: 0
+    processes:
+    - path: model:phold
+      args: msgload={MSGLOAD} size=64
+      start_time: 10ms
+  right:
+    quantity: {H // 2}
+    network_node_id: 1
+    processes:
+    - path: model:phold
+      args: msgload={MSGLOAD} size=64
+      start_time: 10ms
+"""
+
+
+def _indent(text: str, n: int) -> str:
+    pad = " " * n
+    return "\n".join(pad + line for line in text.splitlines())
+
+
+def run_policy(policy: str, stop_s: float) -> tuple[float, int, float]:
+    """Returns (wall_seconds, packets_routed, sim_seconds)."""
+    from shadow_tpu.config import load_config_str
+    from shadow_tpu.core.controller import Controller
+
+    cfg = load_config_str(yaml_cfg(policy, stop_s))
+    c = Controller(cfg)
+    if policy == "tpu":
+        # warm-up: compile once on a throwaway run of the same shapes
+        t0 = time.perf_counter()
+        c.run()
+        compile_and_run = time.perf_counter() - t0
+        c2 = Controller(cfg)
+        c2.runner.engine = c.runner.engine      # reuse compiled program
+        t0 = time.perf_counter()
+        stats = c2.run()
+        wall = time.perf_counter() - t0
+        print(f"bench: device compile+first run {compile_and_run:.1f}s, "
+              f"steady run {wall:.2f}s", file=sys.stderr)
+    else:
+        t0 = time.perf_counter()
+        stats = c.run()
+        wall = time.perf_counter() - t0
+    if not stats.ok:
+        print(f"bench: WARNING {policy} run not ok (overflow?)",
+              file=sys.stderr)
+    return wall, stats.packets_sent, stop_s
+
+
+def main() -> int:
+    dev_wall, dev_packets, dev_sim_s = run_policy("tpu", DEV_STOP_S)
+    dev_rate = dev_packets / dev_wall
+
+    cpu_wall, cpu_packets, cpu_sim_s = run_policy("serial", CPU_STOP_S)
+    cpu_rate = cpu_packets / cpu_wall
+
+    print(f"bench: device {dev_packets} pkts in {dev_wall:.2f}s "
+          f"({dev_rate:,.0f}/s; {dev_sim_s / dev_wall:.2f} sim-s/wall-s) | "
+          f"cpu {cpu_packets} pkts in {cpu_wall:.2f}s "
+          f"({cpu_rate:,.0f}/s)", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "packets_routed_per_sec_per_chip",
+        "value": round(dev_rate, 1),
+        "unit": "packets/s",
+        "vs_baseline": round(dev_rate / cpu_rate, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

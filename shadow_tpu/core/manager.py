@@ -30,6 +30,7 @@ from shadow_tpu.core.scheduler.base import SchedulerPolicy
 from shadow_tpu.core.worker import SimContext
 from shadow_tpu.host.host import Host
 from shadow_tpu.utils import nprng
+from shadow_tpu.utils.checksum import chk_mix
 from shadow_tpu.utils.slog import get_logger, set_context, clear_context
 
 log = get_logger("manager")
@@ -138,6 +139,8 @@ class Manager:
         set_context(ev.time, host.name, host.host_id)
         try:
             host.events_executed += 1
+            host.trace_checksum = chk_mix(host.trace_checksum, ev.time,
+                                          ev.src_host, ev.kind, ev.seq)
             stats.events_executed += 1
             if self.trace is not None:
                 with self._trace_lock:

@@ -11,8 +11,12 @@ by `experimental.scheduler_policy`):
                       host work stealing (scheduler_policy_host_steal.c).
 * ``thread``        — one queue per worker; events routed by destination
                       host's owning worker (scheduler_policy_thread_single.c).
-* ``threadXthread`` — per (src worker, dst worker) queues, merged when a
-                      round starts (scheduler_policy_thread_perthread.c).
+* ``threadXthread`` — cross-worker pushes go to unlocked per-(src
+                      worker, dst worker) staging queues, merged into
+                      the destination worker's main queue when its next
+                      round starts; same-worker pushes (which may be
+                      runnable in the current window) go direct
+                      (scheduler_policy_thread_perthread.c).
 * ``threadXhost``   — per-host queues iterated thread-major
                       (scheduler_policy_thread_perhost.c).
 
@@ -66,12 +70,19 @@ class _LockedQueue:
             return simtime.SIMTIME_MAX if key is None else key.time
 
 
+_worker_tls = threading.local()
+
+
 class ThreadedPolicy(SchedulerPolicy):
     def __init__(self, kind: str, n_workers: int = 0):
         self.kind = kind
         self.n_workers = n_workers if n_workers > 0 else (os.cpu_count() or 2)
         self._host_queues: dict[int, _LockedQueue] = {}
         self._worker_queues: list[_LockedQueue] = []
+        # threadXthread: staging[src_worker][dst_worker], unlocked —
+        # written only by src worker, merged by dst worker at its next
+        # round start (the latch/semaphore handoff orders the accesses)
+        self._staging: list[list[PriorityQueue]] = []
         self._owner: dict[int, int] = {}       # host -> worker
         self._worker_hosts: list[list[int]] = []
         self._pool: Optional[_WorkerPool] = None
@@ -85,30 +96,52 @@ class ThreadedPolicy(SchedulerPolicy):
             self._worker_hosts = [[] for _ in range(self.n_workers)]
             self._worker_queues = [_LockedQueue()
                                    for _ in range(self.n_workers)]
+            if self.kind == "threadXthread":
+                self._staging = [
+                    [PriorityQueue() for _ in range(self.n_workers)]
+                    for _ in range(self.n_workers)
+                ]
         w = host_id % self.n_workers          # round-robin assignment
         self._owner[host_id] = w
         self._worker_hosts[w].append(host_id)
         if self._per_host():
             self._host_queues[host_id] = _LockedQueue()
 
-    def _queue_for(self, host_id: int) -> _LockedQueue:
-        if self._per_host():
-            return self._host_queues[host_id]
-        return self._worker_queues[self._owner[host_id]]
-
     # -- SchedulerPolicy interface ------------------------------------
     def push(self, event: Event, barrier: int) -> None:
         event = self.apply_barrier(event, barrier)
-        self._queue_for(event.dst_host).push(event.key, event)
+        dst_w = self._owner[event.dst_host]
+        src_w = getattr(_worker_tls, "wid", None)
+        if (self.kind == "threadXthread" and src_w is not None
+                and src_w != dst_w):
+            # cross-worker: stage without locking (events are barrier-
+            # bumped, so they cannot be runnable before the next round)
+            self._staging[src_w][dst_w].push(event.key, event)
+        elif self._per_host():
+            self._host_queues[event.dst_host].push(event.key, event)
+        else:
+            self._worker_queues[dst_w].push(event.key, event)
+
+    def merge_staging(self, dst_w: int) -> None:
+        for src_w in range(self.n_workers):
+            q = self._staging[src_w][dst_w]
+            while q:
+                key, ev = q.pop()
+                self._worker_queues[dst_w].push(key, ev)
 
     def pop(self, barrier: int) -> Optional[Event]:
         raise RuntimeError("ThreadedPolicy executes rounds via "
                            "run_parallel, not central pop")
 
     def next_event_time(self) -> int:
-        queues = (self._host_queues.values() if self._per_host()
-                  else self._worker_queues)
+        queues = list(self._host_queues.values() if self._per_host()
+                      else self._worker_queues)
         times = [q.next_time() for q in queues]
+        for row in self._staging:
+            for q in row:
+                key = q.peek_key()
+                if key is not None:
+                    times.append(key.time)
         return min(times, default=simtime.SIMTIME_MAX)
 
     # -- parallel round execution -------------------------------------
@@ -132,6 +165,7 @@ class _WorkerPool:
         self.policy = policy
         self.manager = manager
         self.n = policy.n_workers
+        self._error: Optional[BaseException] = None
         self._barrier = simtime.SIMTIME_INVALID
         self._start = [threading.Semaphore(0) for _ in range(self.n)]
         self._done: Optional[CountDownLatch] = None
@@ -149,10 +183,15 @@ class _WorkerPool:
     def run_round(self, window_end: int) -> None:
         self._barrier = window_end
         self._steal_cursor = 0
+        self._error: Optional[BaseException] = None
         self._done = CountDownLatch(self.n)
         for s in self._start:
             s.release()
         self._done.wait()
+        if self._error is not None:
+            raise RuntimeError(
+                "worker thread failed during simulation round"
+            ) from self._error
 
     def shutdown(self) -> None:
         self._shutdown = True
@@ -161,6 +200,8 @@ class _WorkerPool:
 
     # -- worker bodies -------------------------------------------------
     def _run(self, wid: int) -> None:
+        from shadow_tpu.core.scheduler.threads import _worker_tls
+        _worker_tls.wid = wid
         ctx, stats = self.manager.make_worker_state()
         while True:
             self._start[wid].acquire()
@@ -168,6 +209,8 @@ class _WorkerPool:
                 return
             barrier = self._barrier
             try:
+                if self.policy.kind == "threadXthread":
+                    self.policy.merge_staging(wid)
                 if self.policy.kind == "steal":
                     self._drain_stealing(ctx, stats, barrier)
                 elif self.policy._per_host():
@@ -177,6 +220,9 @@ class _WorkerPool:
                 else:
                     self._drain(self.policy._worker_queues[wid],
                                 ctx, stats, barrier)
+            except BaseException as e:   # propagate to run_round
+                if self._error is None:
+                    self._error = e
             finally:
                 self._done.count_down()
 

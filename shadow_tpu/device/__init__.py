@@ -1,0 +1,19 @@
+"""The device (TPU) simulation engine.
+
+The reference advances the simulation with N worker pthreads popping
+per-host priority queues under locks (SURVEY §3.2). Here the entire
+round loop runs on device instead: per-host event heaps are fixed-
+capacity arrays, one `round_step` pops/executes/pushes events for every
+host in lockstep (vectorized over the host dimension), topology
+latency/reliability lookups are gathers into dense matrices, packet
+drops are counter-RNG rolls, and cross-host delivery is a per-round
+collective exchange over the device mesh (`all_gather`/`all_to_all`
+over ICI/DCN). The conservative window barrier of the reference's
+scheduler becomes the natural per-round synchronization of the SPMD
+program, and the min-next-event reduction is a `pmin`.
+"""
+
+from shadow_tpu.device.engine import DeviceEngine
+from shadow_tpu.device.runner import DeviceRunner
+
+__all__ = ["DeviceEngine", "DeviceRunner"]

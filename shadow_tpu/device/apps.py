@@ -1,0 +1,112 @@
+"""Device (vectorized) application models.
+
+A DeviceApp is the JAX twin of a CPU ModelApp (shadow_tpu/models/):
+`handle` processes one popped event for EVERY host simultaneously —
+all inputs/outputs are batched over the local host dimension [H]. To
+keep traces bit-identical with the CPU twin, an app must:
+
+* make decisions only from the provided `draws` bits (counter RNG,
+  consumed in order: draw i corresponds to the CPU twin's i-th
+  ctx.app_bits() call within the same hook), reporting how many draws
+  each host consumed in `n_draws`;
+* emit sends in the same order as the CPU twin's ctx.send() calls
+  (send slot k <-> k-th send), and timers after sends (the engine
+  consumes event-sequence numbers sends-first).
+
+Static per-app capacities (max_sends/max_timers/max_draws) size the
+engine's arrays; they are compile-time constants.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+from shadow_tpu._jax import jnp
+from shadow_tpu.core.event import KIND_BOOT, KIND_PACKET
+
+
+class AppOut(NamedTuple):
+    # sends, each [H, K]
+    send_dst: jnp.ndarray        # destination global host id (i32)
+    send_size: jnp.ndarray       # bytes (i32)
+    send_d0: jnp.ndarray         # payload word 0 (i32)
+    send_d1: jnp.ndarray         # payload word 1 (i32)
+    send_valid: jnp.ndarray      # bool
+    # timers, each [H, T]
+    timer_delay: jnp.ndarray     # ns (i64)
+    timer_d0: jnp.ndarray        # i32
+    timer_valid: jnp.ndarray     # bool
+    # bookkeeping, each [H]
+    n_draws: jnp.ndarray         # app RNG draws consumed (i32)
+    app_state: jnp.ndarray       # updated [H, W]
+
+
+class DeviceApp:
+    """Interface; see PholdDevice for the canonical implementation."""
+
+    n_state_words: int = 1
+    max_sends: int = 1
+    max_timers: int = 0
+    max_draws: int = 1
+
+    def init_state(self, n_hosts: int) -> jnp.ndarray:
+        return jnp.zeros((n_hosts, self.n_state_words), jnp.int32)
+
+    def handle(self, gid, now, kind, src, size, d0, d1, app_state, draws
+               ) -> AppOut:
+        raise NotImplementedError
+
+
+@dataclass
+class PholdDevice(DeviceApp):
+    """Vectorized twin of models/phold.py (PholdApp) — identical
+    decision stream: boot sends `msgload` messages to peers picked as
+    (self + 1 + bits % (n-1)) % n, one draw per message; each received
+    packet triggers one more send the same way."""
+
+    n_hosts_total: int
+    msgload: int = 1
+    size: int = 64
+    selfloop: int = 0
+
+    def __post_init__(self):
+        self.n_state_words = 1          # [received_count]
+        self.max_sends = max(1, self.msgload)
+        self.max_timers = 0
+        self.max_draws = max(1, self.msgload)
+
+    def _pick_peer(self, gid, bits):
+        n = self.n_hosts_total
+        if self.selfloop or n == 1:
+            return (bits % jnp.uint32(n)).astype(jnp.int32)
+        return ((gid.astype(jnp.uint32) + 1
+                 + bits % jnp.uint32(n - 1))
+                % jnp.uint32(n)).astype(jnp.int32)
+
+    def handle(self, gid, now, kind, src, size, d0, d1, app_state, draws
+               ) -> AppOut:
+        H, K = draws.shape[0], self.max_sends
+        boot = kind == KIND_BOOT
+        pkt = kind == KIND_PACKET
+
+        ks = jnp.arange(K, dtype=jnp.int32)[None, :]          # [1,K]
+        valid = jnp.where(boot[:, None], ks < self.msgload,
+                          pkt[:, None] & (ks == 0))           # [H,K]
+        peers = self._pick_peer(gid[:, None], draws[:, :K])   # [H,K]
+        sizes = jnp.full((H, K), self.size, jnp.int32)
+        zeros = jnp.zeros((H, K), jnp.int32)
+
+        n_draws = jnp.where(boot, self.msgload,
+                            jnp.where(pkt, 1, 0)).astype(jnp.int32)
+        new_state = app_state.at[:, 0].add(pkt.astype(jnp.int32))
+
+        return AppOut(
+            send_dst=peers, send_size=sizes, send_d0=zeros, send_d1=zeros,
+            send_valid=valid,
+            timer_delay=jnp.zeros((H, 0), jnp.int64),
+            timer_d0=jnp.zeros((H, 0), jnp.int32),
+            timer_valid=jnp.zeros((H, 0), bool),
+            n_draws=n_draws,
+            app_state=new_state,
+        )

@@ -1,0 +1,113 @@
+"""DeviceRunner: adapts the device engine to the Controller.
+
+Selected by `experimental.scheduler_policy: tpu` — the device-mesh
+scheduler policy slotting in beside the CPU thread policies, exactly as
+the north-star design places it (a new policy alongside
+src/main/core/scheduler's five).
+
+v1 restriction: all hosts must run the *same* model app (with identical
+args), because the device program dispatches one vectorized app.
+Heterogeneous-app device dispatch (per-host app ids + lax.switch) and
+real-process hybrid execution land later; mixed configs run on the CPU
+policies meanwhile.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from shadow_tpu._jax import jax
+from shadow_tpu.core.manager import SimStats
+from shadow_tpu.device.apps import DeviceApp, PholdDevice
+from shadow_tpu.device.engine import DeviceEngine, EngineConfig
+from shadow_tpu.models.phold import PholdApp
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("device")
+
+
+def device_twin(apps: list, n_hosts: int) -> DeviceApp:
+    """Map a homogeneous set of CPU model apps to their device twin."""
+    real = [a for a in apps if a is not None]
+    if not real:
+        raise ValueError("tpu policy: no model apps configured")
+    cls = type(real[0])
+    if not all(type(a) is cls for a in real):
+        raise ValueError(
+            "tpu policy currently requires all hosts to run the same "
+            "model app; use a CPU scheduler policy for mixed configs")
+    if cls is PholdApp:
+        first = real[0]
+        for a in real:
+            if (a.msgload, a.size, a.selfloop) != (first.msgload,
+                                                   first.size,
+                                                   first.selfloop):
+                raise ValueError("tpu policy: phold args must match "
+                                 "across hosts")
+        return PholdDevice(n_hosts_total=n_hosts, msgload=first.msgload,
+                           size=first.size, selfloop=first.selfloop)
+    raise ValueError(f"no device twin registered for {cls.__name__}; "
+                     "available: phold")
+
+
+class DeviceRunner:
+    def __init__(self, sim, trace: Optional[list] = None, mesh=None):
+        if trace is not None:
+            raise ValueError(
+                "the tpu policy does not record python event traces; "
+                "use per-host trace checksums (Host.trace_checksum) for "
+                "equivalence testing")
+        self.sim = sim
+        cfg = sim.cfg
+        apps = [h.app for h in sim.hosts]
+        self.app = device_twin(apps, len(sim.hosts))
+        self.engine = DeviceEngine(
+            EngineConfig(
+                n_hosts=len(sim.hosts),
+                event_capacity=cfg.experimental.event_capacity,
+                outbox_capacity=cfg.experimental.outbox_capacity,
+                lookahead=max(1, sim.lookahead),
+                stop_time=cfg.general.stop_time,
+                bootstrap_end=cfg.general.bootstrap_end_time,
+                seed=cfg.general.seed,
+            ),
+            self.app,
+            host_vertex=sim.netmodel.host_vertex.astype(np.int32),
+            latency_ns=sim.topology.latency_ns,
+            reliability=sim.topology.reliability,
+            mesh=mesh,
+        )
+        self.final_state: Optional[dict] = None
+
+    def run(self, stop: int) -> SimStats:
+        state = self.engine.init_state(self.sim.starts)
+        final, rounds = self.engine.run(state)
+        final = jax.device_get(final)
+        self.final_state = final
+        H = len(self.sim.hosts)
+
+        stats = SimStats()
+        stats.end_time = stop
+        stats.rounds = int(rounds)
+        stats.events_executed = int(final["n_exec"][:H].sum())
+        stats.packets_sent = int(final["n_sent"][:H].sum())
+        stats.packets_dropped = int(final["n_drop"][:H].sum())
+        stats.packets_delivered = int(final["n_deliv"][:H].sum())
+        overflow = int(final["overflow"][:H].sum())
+        if overflow:
+            stats.ok = False
+            log.error("device engine overflow: %d events lost — raise "
+                      "experimental.event_capacity/outbox_capacity",
+                      overflow)
+
+        # reflect per-host results back onto the Host objects
+        for h in self.sim.hosts:
+            i = h.host_id
+            h.events_executed = int(final["n_exec"][i])
+            h.packets_sent = int(final["n_sent"][i])
+            h.packets_dropped = int(final["n_drop"][i])
+            h.packets_delivered = int(final["n_deliv"][i])
+            h.trace_checksum = int(final["chk"][i])
+        return stats

@@ -37,6 +37,9 @@ class Host:
     packets_sent: int = 0
     packets_delivered: int = 0
     packets_dropped: int = 0
+    # rolling hash of the executed-event schedule (utils/checksum.py);
+    # equal across engines/policies iff per-host schedules match
+    trace_checksum: int = 0
 
     def next_event_seq(self) -> int:
         s = self._event_seq

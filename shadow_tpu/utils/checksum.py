@@ -1,0 +1,25 @@
+"""Per-host trace checksums.
+
+Both engines fold every executed event's (time, src, kind, seq) into a
+63-bit rolling hash per host. Because a host's events execute in the
+same order under every policy and engine (the (time, dst, src, seq)
+total order), equal checksums certify equal per-host schedules — the
+cross-engine equivalence oracle used by tests, and the spiritual
+successor of the reference's determinism suite (src/test/determinism/,
+which byte-compares host stdout between runs).
+
+Pure integer math, identical in Python and in jax int64 (both sides
+mask to 63 bits, which commutes with two's-complement wraparound).
+"""
+
+MASK63 = (1 << 63) - 1
+CHK_MUL = 1000003
+CHK_SRC = 2654435761
+CHK_KIND = 1315423911
+CHK_SEQ = 2246822519
+
+
+def chk_mix(chk: int, time: int, src: int, kind: int, seq: int) -> int:
+    mix = (time ^ (src * CHK_SRC) ^ (kind * CHK_KIND)
+           ^ (seq * CHK_SEQ)) & MASK63
+    return (chk * CHK_MUL + mix) & MASK63

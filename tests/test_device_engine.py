@@ -1,0 +1,169 @@
+"""Device engine: prng bit-identity and trace equivalence vs the CPU
+serial oracle — the core correctness argument of the TPU design."""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.utils import nprng
+from shadow_tpu.utils.rng import PURPOSE_APP, PURPOSE_PACKET_DROP
+
+
+def test_device_prng_matches_numpy():
+    from shadow_tpu.device import prng as dprng
+    from shadow_tpu._jax import jnp
+    seed = 42
+    ids = np.array([0, 3, 17, 1000], dtype=np.uint32)
+    seqs = np.array([0, 100, 2**20, 7], dtype=np.uint32)
+    jk = dprng.chain_key(dprng.seed_key(seed), PURPOSE_PACKET_DROP,
+                         jnp.asarray(ids), jnp.asarray(seqs))
+    ju = np.asarray(dprng.uniform01(jk))
+    nu = nprng.packet_uniform(seed, PURPOSE_PACKET_DROP, ids, seqs)
+    np.testing.assert_array_equal(ju, nu)
+    jb = np.asarray(dprng.random_bits32(dprng.chain_key(
+        dprng.seed_key(seed), PURPOSE_APP, jnp.asarray(ids),
+        jnp.asarray(seqs))))
+    k = nprng.fold_in(nprng.fold_in(nprng.fold_in(
+        nprng.seed_key(seed), PURPOSE_APP), ids), seqs)
+    np.testing.assert_array_equal(jb, nprng.random_bits32(k))
+
+
+PHOLD_YAML = """
+general:
+  stop_time: 2s
+  seed: {seed}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        node [ id 1 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+        edge [ source 0 target 0 latency "30 ms" packet_loss {loss} ]
+        edge [ source 0 target 1 latency "10 ms" packet_loss {loss} ]
+        edge [ source 1 target 1 latency "30 ms" packet_loss {loss} ]
+      ]
+experimental:
+  scheduler_policy: {policy}
+  event_capacity: 64
+  outbox_capacity: 16
+hosts:
+  left:
+    quantity: {q}
+    network_node_id: 0
+    processes:
+    - path: model:phold
+      args: msgload={msgload}
+      start_time: 100ms
+  right:
+    quantity: {q}
+    network_node_id: 1
+    processes:
+    - path: model:phold
+      args: msgload={msgload}
+      start_time: 150ms
+"""
+
+
+def _run(policy, seed=5, loss=0.0, q=8, msgload=2):
+    yaml = PHOLD_YAML.format(policy=policy, seed=seed, loss=loss, q=q,
+                             msgload=msgload)
+    c = Controller(load_config_str(yaml))
+    stats = c.run()
+    hosts = c.sim.hosts
+    return stats, hosts
+
+
+@pytest.mark.parametrize("loss,msgload", [(0.0, 2), (0.1, 2), (0.0, 1)])
+def test_device_matches_serial_oracle(loss, msgload):
+    s_stats, s_hosts = _run("serial", loss=loss, msgload=msgload)
+    d_stats, d_hosts = _run("tpu", loss=loss, msgload=msgload)
+    assert d_stats.ok
+    assert s_stats.events_executed == d_stats.events_executed
+    assert s_stats.packets_sent == d_stats.packets_sent
+    assert s_stats.packets_dropped == d_stats.packets_dropped
+    assert s_stats.packets_delivered == d_stats.packets_delivered
+    for sh, dh in zip(s_hosts, d_hosts):
+        assert sh.events_executed == dh.events_executed, sh.name
+        assert sh.trace_checksum == dh.trace_checksum, sh.name
+
+
+def test_device_in_window_self_packets_match_oracle():
+    # runahead larger than the self-path latency: self packets deliver
+    # inside the window and must execute in-window, in timestamp order
+    yaml = """
+general: {{stop_time: 1s, seed: 4}}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ directed 0
+        node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ] ]
+experimental:
+  scheduler_policy: {policy}
+  runahead: 100 ms
+hosts:
+  peer:
+    quantity: 4
+    network_node_id: 0
+    processes:
+    - path: model:phold
+      args: msgload=2 selfloop=1
+      start_time: 5ms
+"""
+    s = Controller(load_config_str(yaml.format(policy="serial")))
+    s_stats = s.run()
+    d = Controller(load_config_str(yaml.format(policy="tpu")))
+    d_stats = d.run()
+    assert d_stats.ok
+    assert s_stats.events_executed == d_stats.events_executed
+    assert s_stats.rounds == d_stats.rounds
+    for sh, dh in zip(s.sim.hosts, d.sim.hosts):
+        assert sh.trace_checksum == dh.trace_checksum, sh.name
+
+
+def test_threaded_policy_propagates_app_errors():
+    yaml = """
+general: {stop_time: 1s, seed: 1}
+network: {graph: {type: 1_gbit_switch}}
+experimental: {scheduler_policy: host, runahead: 10 ms}
+hosts:
+  client:
+    processes:
+    - path: model:tgen_client
+      args: server=nonexistent
+      start_time: 1ms
+"""
+    c = Controller(load_config_str(yaml))
+    with pytest.raises(RuntimeError, match="worker thread failed"):
+        c.run()
+
+
+def test_device_deterministic_across_runs():
+    _, h1 = _run("tpu", seed=9)
+    _, h2 = _run("tpu", seed=9)
+    assert [h.trace_checksum for h in h1] == \
+        [h.trace_checksum for h in h2]
+    _, h3 = _run("tpu", seed=10)
+    assert [h.trace_checksum for h in h1] != \
+        [h.trace_checksum for h in h3]
+
+
+def test_device_app_state_matches_cpu():
+    from shadow_tpu.core.controller import Controller as C
+    yaml = PHOLD_YAML.format(policy="serial", seed=3, loss=0.05, q=4,
+                             msgload=1)
+    c = C(load_config_str(yaml))
+    c.run()
+    cpu_recv = [h.app.received for h in c.sim.hosts]
+
+    yaml = PHOLD_YAML.format(policy="tpu", seed=3, loss=0.05, q=4,
+                             msgload=1)
+    c2 = C(load_config_str(yaml))
+    c2.run()
+    dev_recv = list(np.asarray(
+        c2.runner.final_state["app"][:len(c2.sim.hosts), 0]))
+    assert cpu_recv == dev_recv
