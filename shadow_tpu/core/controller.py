@@ -123,6 +123,7 @@ class Controller:
             self.manager = None
         else:
             self.runner = None
+            from shadow_tpu.core.manager import NetOptions
             self.manager = Manager(
                 hosts=self.sim.hosts,
                 policy=make_policy(policy_name,
@@ -130,6 +131,13 @@ class Controller:
                 netmodel=self.sim.netmodel,
                 seed=cfg.general.seed,
                 trace=trace,
+                net_opts=NetOptions(
+                    qdisc=cfg.experimental.interface_qdisc,
+                    router_queue=cfg.experimental.router_queue,
+                    router_static_capacity=cfg.experimental
+                    .router_static_capacity,
+                    bootstrap_end=cfg.general.bootstrap_end_time,
+                ),
             )
 
     def run(self) -> SimStats:

@@ -25,6 +25,11 @@ KIND_TIMER = 1    # self-scheduled timer/task
 KIND_PACKET = 2   # packet delivery from the network model
 KIND_STOP = 3     # process/host stop
 KIND_TASK = 4     # CPU-only: run the attached task closure
+# network-stack kinds (CPU fidelity path; the device transport model
+# mirrors their semantics in vectorized form)
+KIND_ROUTER_ARRIVAL = 5   # packet arrived at dst's upstream router
+KIND_NIC_WAKE = 6         # token-bucket refill wakeup (data: (side,))
+KIND_TCP_TIMER = 7        # TCP timer (data: (conn_id, generation))
 
 
 class EventKey(NamedTuple):

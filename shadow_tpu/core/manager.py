@@ -21,8 +21,11 @@ from shadow_tpu import simtime
 from shadow_tpu.core.event import (
     Event,
     KIND_BOOT,
+    KIND_NIC_WAKE,
     KIND_PACKET,
+    KIND_ROUTER_ARRIVAL,
     KIND_STOP,
+    KIND_TCP_TIMER,
     KIND_TIMER,
 )
 from shadow_tpu.core.netmodel import NetworkModel
@@ -61,6 +64,15 @@ class SimStats:
 
 
 @dataclass
+class NetOptions:
+    """Per-host network-stack knobs plumbed from the config."""
+    qdisc: str = "fifo"
+    router_queue: str = "codel"
+    router_static_capacity: int = 1024
+    bootstrap_end: int = 0
+
+
+@dataclass
 class Manager:
     hosts: list[Host]
     policy: SchedulerPolicy
@@ -69,16 +81,24 @@ class Manager:
     stats: SimStats = field(default_factory=SimStats)
     trace: Optional[list] = None    # (time, dst, src, kind) if recording
     on_event_hook: Optional[Callable] = None
+    net_opts: NetOptions = field(default_factory=NetOptions)
 
     def __post_init__(self):
+        from shadow_tpu.host.netstack import HostNetStack
+
         self.rng_key = nprng.seed_key(self.seed)
         self._name_to_id = {h.name: h.host_id for h in self.hosts}
         self._barrier = simtime.SIMTIME_INVALID
         self._trace_lock = threading.Lock()
         self._worker_stats: list[SimStats] = []
         self._ctx = SimContext(self, self.stats)
+        no = self.net_opts
         for h in self.hosts:
             self.policy.add_host(h.host_id)
+            h.net = HostNetStack(
+                h, self, qdisc=no.qdisc, router_queue=no.router_queue,
+                router_static_capacity=no.router_static_capacity,
+                bootstrap_end=no.bootstrap_end)
 
     def resolve(self, name: str) -> int:
         if name not in self._name_to_id:
@@ -125,6 +145,14 @@ class Manager:
         for ws in self._worker_stats:
             self.stats.merge(ws)
         self._worker_stats.clear()
+        # packet totals come from the per-host counters, which both the
+        # raw-send path (worker.py) and the socket path (netstack.py)
+        # maintain — the single source of truth
+        self.stats.packets_sent = sum(h.packets_sent for h in self.hosts)
+        self.stats.packets_dropped = sum(h.packets_dropped
+                                         for h in self.hosts)
+        self.stats.packets_delivered = sum(h.packets_delivered
+                                           for h in self.hosts)
         if hasattr(self.policy, "shutdown"):
             self.policy.shutdown()
         return self.stats
@@ -151,8 +179,10 @@ class Manager:
             app = host.app
             if ev.task is not None:
                 ev.execute(ctx)
+            elif ev.kind in (KIND_ROUTER_ARRIVAL, KIND_NIC_WAKE,
+                             KIND_TCP_TIMER):
+                host.net.handle_event(ev, ev.time, ctx)
             elif ev.kind == KIND_PACKET:
-                stats.packets_delivered += 1
                 host.packets_delivered += 1
                 if app is not None:
                     size = ev.data[0] if ev.data else 0

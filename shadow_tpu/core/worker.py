@@ -71,11 +71,11 @@ class SimContext:
         pkt_seq = host.next_packet_seq()
         verdict = self._m.netmodel.judge(self.now, host.host_id, dst_host,
                                          pkt_seq)
+        # per-host counters are the single source of truth for packet
+        # totals (Manager.finalize sums them)
         host.packets_sent += 1
-        self._stats.packets_sent += 1
         if not verdict.delivered:
             host.packets_dropped += 1
-            self._stats.packets_dropped += 1
             return False
         ev = Event(time=verdict.deliver_time, dst_host=dst_host,
                    src_host=host.host_id, seq=host.next_event_seq(),
@@ -91,3 +91,24 @@ class SimContext:
                    seq=host.next_event_seq(), kind=KIND_TIMER,
                    data=tuple(data))
         self._m.push_event(ev)
+
+    # -- socket API (CPU fidelity path: NIC token buckets, router
+    # queues, in-simulator TCP/UDP — see shadow_tpu/host/netstack.py) --
+    def tcp_connect(self, dst_host: int, dst_port: int,
+                    on_connected=None, on_data=None, on_closed=None):
+        self.host.net.ctx = self
+        return self.host.net.tcp_connect(self.now, dst_host, dst_port,
+                                         on_connected=on_connected,
+                                         on_data=on_data,
+                                         on_closed=on_closed)
+
+    def tcp_listen(self, port: int, on_accept=None, on_data=None,
+                   on_closed=None):
+        self.host.net.ctx = self
+        return self.host.net.tcp_listen(port, on_accept=on_accept,
+                                        on_data=on_data,
+                                        on_closed=on_closed)
+
+    def udp_socket(self, port=None, on_datagram=None):
+        self.host.net.ctx = self
+        return self.host.net.udp_socket(port, on_datagram=on_datagram)

@@ -25,6 +25,7 @@ class Host:
     bw_up_bits: int
     rng: SeededRandom
     app: Any = None             # ModelApp instance (interpose=model)
+    net: Any = None             # HostNetStack (CPU engines)
     ip: Optional[str] = None
 
     # deterministic id streams (reference host.c:85-95)

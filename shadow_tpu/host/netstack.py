@@ -1,0 +1,199 @@
+"""Per-host network stack: interfaces + router + socket demux.
+
+The glue the reference spreads across host.c (interface/router
+creation, host.c:184-199), network_interface.c (socket association
+:257-339) and the descriptor table: one eth interface fed by an
+upstream Router, a socket table keyed (protocol, local port) for
+listeners plus (local port, peer host, peer port) for TCP connections,
+ephemeral port allocation, and the event plumbing (packet arrivals,
+NIC refill wakeups, TCP timers) into the discrete-event engine.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from shadow_tpu.core.event import (
+    Event,
+    KIND_NIC_WAKE,
+    KIND_ROUTER_ARRIVAL,
+    KIND_TCP_TIMER,
+)
+from shadow_tpu.host.nic import NetworkInterface
+from shadow_tpu.host.sockets import (
+    BaseSocket,
+    EPHEMERAL_PORT_START,
+    UdpSocket,
+)
+from shadow_tpu.host.tcp import TcpSocket
+from shadow_tpu.routing.packet import Packet, PacketStatus, Protocol
+from shadow_tpu.routing.router import Router
+from shadow_tpu.routing.queues import make_router_queue
+
+
+class HostNetStack:
+    def __init__(self, host, manager, qdisc: str = "fifo",
+                 router_queue: str = "codel",
+                 router_static_capacity: int = 1024,
+                 bootstrap_end: int = 0):
+        self.host = host
+        self._m = manager
+        router = Router(make_router_queue(router_queue,
+                                          router_static_capacity))
+        self.eth = NetworkInterface(
+            host.host_id, host.bw_down_bits, host.bw_up_bits,
+            qdisc=qdisc, router=router, bootstrap_end=bootstrap_end)
+        self.eth.transmit = self._transmit
+        self.eth.deliver = self._demux
+        self.eth.schedule_wakeup = self._schedule_nic_wake
+        self.eth.count_drops = self._count_drops
+
+        self._listeners: dict[tuple[Protocol, int], BaseSocket] = {}
+        self._conns: dict[tuple[int, int, int], TcpSocket] = {}
+        self._by_conn_id: dict[int, TcpSocket] = {}
+        self._next_conn_id = 0
+        self._next_ephemeral = EPHEMERAL_PORT_START
+        # the SimContext of the event currently being executed on this
+        # host — set by handle_event / the app-facing API so socket
+        # callbacks can reach scheduling/stats (a host only ever
+        # executes on one worker at a time, so this is race-free)
+        self.ctx = None
+
+    # -- registration --------------------------------------------------
+    def new_conn_id(self, sock) -> int:
+        cid = self._next_conn_id
+        self._next_conn_id += 1
+        self._by_conn_id[cid] = sock
+        return cid
+
+    def alloc_port(self) -> int:
+        p = self._next_ephemeral
+        self._next_ephemeral += 1
+        return p
+
+    def register(self, sock: BaseSocket) -> None:
+        if isinstance(sock, TcpSocket) and sock.peer is not None:
+            self._conns[(sock.local_port, *sock.peer)] = sock
+        else:
+            self._listeners[(sock.proto, sock.local_port)] = sock
+
+    def unregister(self, sock: BaseSocket) -> None:
+        if isinstance(sock, TcpSocket) and sock.peer is not None:
+            self._conns.pop((sock.local_port, *sock.peer), None)
+        # a TCP child shares its listener's port: only remove the
+        # listener entry if this socket *is* the registered listener
+        key = (sock.proto, sock.local_port)
+        if self._listeners.get(key) is sock:
+            self._listeners.pop(key)
+        if isinstance(sock, TcpSocket):
+            self._by_conn_id.pop(sock.conn_id, None)
+
+    def interface_for(self, dst_host: int) -> NetworkInterface:
+        return self.eth           # lo short-circuits inside _transmit
+
+    # -- packet creation ----------------------------------------------
+    def new_packet(self, dst_host: int, protocol: Protocol, size: int,
+                   src_port: int = 0, dst_port: int = 0,
+                   payload=None) -> Packet:
+        pkt = Packet(src_host=self.host.host_id,
+                     packet_id=self.host.next_packet_seq(),
+                     dst_host=dst_host, protocol=protocol, size=size,
+                     src_port=src_port, dst_port=dst_port,
+                     payload=payload)
+        pkt.add_status(PacketStatus.SND_CREATED)
+        return pkt
+
+    # -- egress: interface -> network model -> dst router --------------
+    def _transmit(self, packet: Packet, now: int) -> None:
+        host = self.host
+        verdict = self._m.netmodel.judge(now, host.host_id,
+                                         packet.dst_host,
+                                         packet.packet_id)
+        host.packets_sent += 1
+        if not verdict.delivered:
+            packet.add_status(PacketStatus.INET_DROPPED)
+            host.packets_dropped += 1
+            return
+        packet.add_status(PacketStatus.INET_SENT)
+        ev = Event(time=verdict.deliver_time, dst_host=packet.dst_host,
+                   src_host=host.host_id, seq=host.next_event_seq(),
+                   kind=KIND_ROUTER_ARRIVAL, data=(packet,))
+        self._m.push_event(ev)
+
+    # -- ingress: router arrival -> NIC -> socket ----------------------
+    def _demux(self, packet: Packet, now: int) -> None:
+        sock: Optional[BaseSocket] = None
+        if packet.protocol == Protocol.TCP and packet.tcp is not None:
+            sock = self._conns.get((packet.dst_port, packet.src_host,
+                                    packet.tcp.src_port))
+        if sock is None:
+            sock = self._listeners.get((packet.protocol, packet.dst_port))
+        if sock is None:
+            packet.add_status(PacketStatus.RCV_INTERFACE_DROPPED)
+            self.host.packets_dropped += 1
+            return
+        self.host.packets_delivered += 1
+        sock.handle_packet(packet, now)
+
+    def _count_drops(self, n: int) -> None:
+        self.host.packets_dropped += n
+
+    # -- event plumbing ------------------------------------------------
+    def _self_event(self, when: int, kind: int, data: tuple) -> None:
+        h = self.host
+        self._m.push_event(Event(time=when, dst_host=h.host_id,
+                                 src_host=h.host_id,
+                                 seq=h.next_event_seq(), kind=kind,
+                                 data=data))
+
+    def _schedule_nic_wake(self, when: int, side: int) -> None:
+        self._self_event(when, KIND_NIC_WAKE, (side,))
+
+    def schedule_tcp_timer(self, conn_id: int, gen: int,
+                           when: int) -> None:
+        self._self_event(when, KIND_TCP_TIMER, (conn_id, gen))
+
+    def handle_event(self, ev: Event, now: int, ctx=None) -> None:
+        if ctx is not None:
+            self.ctx = ctx
+        if ev.kind == KIND_ROUTER_ARRIVAL:
+            packet: Packet = ev.data[0]
+            if not self.eth.router.enqueue(packet, now):
+                self.host.packets_dropped += 1   # single/static tail drop
+        elif ev.kind == KIND_NIC_WAKE:
+            if ev.data[0] == 0:
+                self.eth.on_send_wakeup(now)
+            else:
+                self.eth.on_recv_wakeup(now)
+        elif ev.kind == KIND_TCP_TIMER:
+            conn_id, gen = ev.data
+            sock = self._by_conn_id.get(conn_id)
+            if sock is not None:
+                sock.on_timer(now, gen)
+
+    # -- app-facing API (used via SimContext) --------------------------
+    def udp_socket(self, port: Optional[int] = None,
+                   on_datagram=None) -> UdpSocket:
+        port = port if port is not None else self.alloc_port()
+        sock = UdpSocket(self, port, on_datagram=on_datagram)
+        self.register(sock)
+        return sock
+
+    def tcp_listen(self, port: int, on_accept=None, on_data=None,
+                   on_closed=None) -> TcpSocket:
+        sock = TcpSocket(self, port)
+        sock.on_accept = on_accept
+        sock.on_data = on_data
+        sock.on_closed = on_closed
+        sock.listen()
+        return sock
+
+    def tcp_connect(self, now: int, dst_host: int, dst_port: int,
+                    on_connected=None, on_data=None,
+                    on_closed=None) -> TcpSocket:
+        sock = TcpSocket(self, self.alloc_port())
+        sock.on_connected = on_connected
+        sock.on_data = on_data
+        sock.on_closed = on_closed
+        sock.connect(now, dst_host, dst_port)
+        return sock

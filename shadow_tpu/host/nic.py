@@ -1,0 +1,207 @@
+"""Network interface: token-bucket rate limiting + send qdiscs.
+
+Equivalent of the reference's NetworkInterface (src/main/host/
+network_interface.c): each interface polices bandwidth with token
+buckets refilled every 1 ms to `bytes_per_ms` with burst capacity
+refill+MTU (network_interface.c:33-41, 99-228); the receive side drains
+the Router until tokens run out (:448-482); the send side pulls packets
+from sockets that registered interest, in FIFO-by-priority or round-
+robin qdisc order (:497-631); during bootstrap bandwidth is unlimited
+(:459-461).
+
+The interface is event-driven: when tokens run dry it schedules a
+wakeup at the next 1 ms refill boundary instead of polling.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Optional, Protocol as TProtocol
+
+from shadow_tpu import simtime
+from shadow_tpu.routing.packet import Packet, PacketStatus
+from shadow_tpu.routing.router import Router
+
+REFILL_NS = simtime.SIMTIME_ONE_MILLISECOND
+
+
+class PacketSource(TProtocol):
+    """A socket that can be pulled for outbound packets
+    (compat_socket pull model, network_interface.c:497-631)."""
+
+    def has_packet_to_send(self) -> bool: ...
+
+    def peek_packet_size(self) -> Optional[int]:
+        """Total on-wire size of the next packet, or None if none."""
+        ...
+
+    def pull_packet(self, now: int) -> Optional[Packet]: ...
+
+
+class TokenBucket:
+    """Refill-on-access token bucket with 1 ms granularity
+    (network_interface.c:99-228)."""
+
+    def __init__(self, bytes_per_second: int):
+        self.refill_bytes = max(1, bytes_per_second // 1000)  # per ms
+        self.capacity = self.refill_bytes + simtime.CONFIG_MTU
+        self.tokens = self.capacity
+        self._last_refill_ms = 0
+
+    def _advance(self, now: int) -> None:
+        now_ms = now // REFILL_NS
+        if now_ms > self._last_refill_ms:
+            self.tokens = min(
+                self.capacity,
+                self.tokens + (now_ms - self._last_refill_ms)
+                * self.refill_bytes)
+            self._last_refill_ms = now_ms
+
+    def try_consume(self, now: int, nbytes: int) -> bool:
+        self._advance(now)
+        if self.tokens >= nbytes:
+            self.tokens -= nbytes
+            return True
+        return False
+
+    def can_consume(self, now: int, nbytes: int) -> bool:
+        self._advance(now)
+        return self.tokens >= nbytes
+
+    def consume_deficit(self, now: int, nbytes: int) -> None:
+        """Charge for a packet that must go through even if it differs
+        from the one the caller budgeted for (deficit accounting: the
+        balance may dip negative and recovers on refill)."""
+        self._advance(now)
+        self.tokens -= nbytes
+
+    def next_refill_time(self, now: int) -> int:
+        return (now // REFILL_NS + 1) * REFILL_NS
+
+
+class NetworkInterface:
+    def __init__(self, host_id: int, bw_down_bits: int, bw_up_bits: int,
+                 qdisc: str = "fifo",
+                 router: Optional[Router] = None,
+                 bootstrap_end: int = 0):
+        self.host_id = host_id
+        self.recv_bucket = TokenBucket(bw_down_bits // 8)
+        self.send_bucket = TokenBucket(bw_up_bits // 8)
+        self.qdisc = qdisc
+        self.router = router or Router()
+        self.router.on_enqueue = self._on_router_enqueue
+        self.bootstrap_end = bootstrap_end
+
+        # send side: sockets wanting to send (fifo keeps registration
+        # order = priority order; rr rotates — the reference's
+        # FifoSocketQueue / RrSocketQueue, network_queuing_disciplines.c)
+        self._send_queue: deque[PacketSource] = deque()
+        self._send_pending_wakeup = False
+        self._recv_pending_wakeup = False
+
+        # wired by HostNetStack
+        self.transmit: Optional[Callable[[Packet, int], None]] = None
+        self.deliver: Optional[Callable[[Packet, int], None]] = None
+        self.schedule_wakeup: Optional[Callable[[int, int], None]] = None
+        self.count_drops: Optional[Callable[[int], None]] = None
+        # counters (Tracker feed)
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.packets_sent = 0
+        self.packets_received = 0
+        self.recv_dropped = 0
+
+    # -- helpers -------------------------------------------------------
+    def _unlimited(self, now: int) -> bool:
+        return now < self.bootstrap_end
+
+    # -- send side -----------------------------------------------------
+    def wants_send(self, source: PacketSource, now: int) -> None:
+        """A socket has packets ready (networkinterface_wantsSend,
+        network_interface.c:633-663)."""
+        if source not in self._send_queue:
+            self._send_queue.append(source)
+        self.send_packets(now)
+
+    def send_packets(self, now: int) -> None:
+        """Pull from sockets while tokens allow (:571-631)."""
+        while self._send_queue:
+            src = self._send_queue[0]
+            size = src.peek_packet_size()
+            if size is None:
+                self._send_queue.popleft()
+                continue
+            if not self._unlimited(now) and \
+                    not self.send_bucket.try_consume(now, size):
+                self._schedule_send_wakeup(now)
+                return
+            packet = src.pull_packet(now)
+            if packet is None:
+                self._send_queue.popleft()
+                continue
+            if self.qdisc == "roundrobin":
+                self._send_queue.rotate(-1)
+            self._transmit(packet, now)
+
+    def _transmit(self, packet: Packet, when: int) -> None:
+        packet.add_status(PacketStatus.SND_INTERFACE_SENT)
+        self.bytes_sent += packet.total_size
+        self.packets_sent += 1
+        assert self.transmit is not None
+        self.transmit(packet, when)
+
+    def _schedule_send_wakeup(self, now: int) -> None:
+        if not self._send_pending_wakeup and self.schedule_wakeup:
+            self._send_pending_wakeup = True
+            self.schedule_wakeup(self.send_bucket.next_refill_time(now), 0)
+
+    def on_send_wakeup(self, now: int) -> None:
+        self._send_pending_wakeup = False
+        self.send_packets(now)
+
+    # -- receive side --------------------------------------------------
+    def _on_router_enqueue(self, now: int) -> None:
+        self.receive_packets(now)
+
+    def receive_packets(self, now: int) -> None:
+        """Drain the router while tokens allow
+        (networkinterface_receivePackets, :448-482)."""
+        while True:
+            head = self.router.peek()
+            if head is None:
+                return
+            if not self._unlimited(now) and \
+                    not self.recv_bucket.can_consume(now, head.total_size):
+                self._schedule_recv_wakeup(now)
+                return
+            drops_before = self._router_drop_count()
+            packet = self.router.dequeue(now)
+            dropped = self._router_drop_count() - drops_before
+            if dropped and self.count_drops is not None:
+                self.recv_dropped += dropped
+                self.count_drops(dropped)
+            if packet is None:     # CoDel dropped the whole backlog
+                if self.router.peek() is not None:
+                    continue
+                return
+            # charge the packet actually delivered (CoDel may have
+            # dropped the peeked head and returned a later one)
+            if not self._unlimited(now):
+                self.recv_bucket.consume_deficit(now, packet.total_size)
+            packet.add_status(PacketStatus.RCV_INTERFACE_RECEIVED)
+            self.bytes_received += packet.total_size
+            self.packets_received += 1
+            assert self.deliver is not None
+            self.deliver(packet, now)
+
+    def _router_drop_count(self) -> int:
+        return getattr(self.router.queue, "total_dropped", 0)
+
+    def _schedule_recv_wakeup(self, now: int) -> None:
+        if not self._recv_pending_wakeup and self.schedule_wakeup:
+            self._recv_pending_wakeup = True
+            self.schedule_wakeup(self.recv_bucket.next_refill_time(now), 1)
+
+    def on_recv_wakeup(self, now: int) -> None:
+        self._recv_pending_wakeup = False
+        self.receive_packets(now)

@@ -1,0 +1,101 @@
+"""Socket layer: base socket + UDP.
+
+Equivalent of the reference's descriptor/socket subsystem
+(src/main/host/descriptor/socket.c, udp.c): sockets associate with an
+interface by (protocol, local port, peer), buffer outbound packets for
+the NIC's pull loop, and surface readability to the application
+(status-listener pattern -> app callbacks here).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Optional
+
+from shadow_tpu import simtime
+from shadow_tpu.routing.packet import Packet, PacketStatus, Protocol
+
+EPHEMERAL_PORT_START = 10000
+
+
+class BaseSocket:
+    def __init__(self, net, proto: Protocol, local_port: int):
+        self.net = net                    # HostNetStack
+        self.proto = proto
+        self.local_port = local_port
+        self.peer: Optional[tuple[int, int]] = None   # (host, port)
+        self.closed = False
+        # outbound packets staged for the NIC pull loop
+        self._out: deque[Packet] = deque()
+
+    # PacketSource interface (host/nic.py)
+    def has_packet_to_send(self) -> bool:
+        return bool(self._out)
+
+    def peek_packet_size(self) -> Optional[int]:
+        return self._out[0].total_size if self._out else None
+
+    def pull_packet(self, now: int) -> Optional[Packet]:
+        return self._out.popleft() if self._out else None
+
+    def _stage(self, packet: Packet, now: int) -> None:
+        packet.add_status(PacketStatus.SND_SOCKET_BUFFERED)
+        self._out.append(packet)
+        self.net.interface_for(packet.dst_host).wants_send(self, now)
+
+    def handle_packet(self, packet: Packet, now: int) -> None:
+        raise NotImplementedError
+
+    def close(self, now: int) -> None:
+        self.closed = True
+        self.net.unregister(self)
+
+
+class UdpSocket(BaseSocket):
+    """Datagram socket (descriptor/udp.c): no connection state, one
+    packet per datagram, fixed-size receive queue with tail drop."""
+
+    MAX_DATAGRAM = 65507
+    RECV_QUEUE_DATAGRAMS = 256
+
+    def __init__(self, net, local_port: int,
+                 on_datagram: Optional[Callable] = None):
+        super().__init__(net, Protocol.UDP, local_port)
+        self.on_datagram = on_datagram
+        self.recv_queue: deque[Packet] = deque()
+        self.dropped = 0
+
+    def sendto(self, now: int, dst_host: int, dst_port: int,
+               size: int, payload: Optional[bytes] = None) -> bool:
+        if size > self.MAX_DATAGRAM:
+            raise ValueError(f"datagram too large: {size}")
+        # fragment at the MSS boundary like the reference's UDP-over-
+        # packets (each simulated packet carries <= MTU-headers bytes)
+        mss = simtime.CONFIG_MTU - simtime.CONFIG_HEADER_SIZE_UDPIPETH
+        remaining = size
+        while True:
+            chunk = min(remaining, mss)
+            pkt = self.net.new_packet(
+                dst_host=dst_host, protocol=Protocol.UDP, size=chunk,
+                src_port=self.local_port, dst_port=dst_port,
+                payload=payload)
+            self._stage(pkt, now)
+            remaining -= chunk
+            if remaining <= 0:
+                break
+        return True
+
+    def handle_packet(self, packet: Packet, now: int) -> None:
+        packet.add_status(PacketStatus.RCV_SOCKET_DELIVERED)
+        if self.on_datagram is not None:
+            # callback mode: deliver directly, nothing to drain later
+            self.on_datagram(self.net.ctx, self, packet, now)
+            return
+        if len(self.recv_queue) >= self.RECV_QUEUE_DATAGRAMS:
+            self.dropped += 1
+            packet.add_status(PacketStatus.RCV_INTERFACE_DROPPED)
+            return
+        self.recv_queue.append(packet)
+
+    def recvfrom(self) -> Optional[Packet]:
+        return self.recv_queue.popleft() if self.recv_queue else None

@@ -1,0 +1,428 @@
+"""In-simulator TCP with Reno congestion control.
+
+Equivalent of the reference's TCP stack (src/main/host/descriptor/
+tcp.c — state machine tcp.c:41-51; tcp_cong_reno.c — slow start /
+AIMD congestion avoidance / fast recovery; retransmit queue — the C++
+tally, tcp_retransmit_tally.cc), rebuilt event-driven over the packet
+layer:
+
+* three-way handshake, server child-socket multiplexing off a LISTEN
+  socket, FIN teardown with TIME_WAIT (60 s, definitions.h:195)
+* byte-sequence send space with MSS segmentation, a retransmit queue,
+  cumulative ACKs, duplicate-ACK fast retransmit (3 dupacks) with
+  NewReno-style partial-ACK recovery, and RFC 6298 RTO estimation from
+  RFC 7323-style timestamps
+* Reno congestion window: slow start to ssthresh, +MSS*MSS/cwnd per ACK
+  in congestion avoidance, halving on loss, cwnd=1 MSS on RTO
+* receive-side reordering buffer with cumulative ACK generation and a
+  fixed advertised window (buffer autotuning lands with the
+  socket-buffer work)
+
+Payload bytes are modeled as counts (apps observe sizes, not content);
+`size` rides the packet like the reference's payload length.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+from shadow_tpu import simtime
+from shadow_tpu.routing.packet import (
+    Packet,
+    PacketStatus,
+    Protocol,
+    TcpFlags,
+    TcpHeader,
+)
+from shadow_tpu.host.sockets import BaseSocket
+
+MSS = simtime.CONFIG_TCP_MAX_SEGMENT_SIZE
+INIT_CWND_SEGMENTS = 10          # modern initial window (RFC 6928)
+DEFAULT_RECV_WINDOW = 174760     # reference socket_recv_buffer default
+MIN_RTO_NS = 200 * simtime.SIMTIME_ONE_MILLISECOND
+MAX_RTO_NS = 60 * simtime.SIMTIME_ONE_SECOND
+TIME_WAIT_NS = simtime.CONFIG_TCP_TIMEWAIT_SECONDS \
+    * simtime.SIMTIME_ONE_SECOND
+
+
+class TcpState(enum.Enum):
+    CLOSED = 0
+    LISTEN = 1
+    SYN_SENT = 2
+    SYN_RCVD = 3
+    ESTABLISHED = 4
+    FIN_WAIT_1 = 5
+    FIN_WAIT_2 = 6
+    CLOSING = 7
+    TIME_WAIT = 8
+    CLOSE_WAIT = 9
+    LAST_ACK = 10
+
+
+class TcpSocket(BaseSocket):
+    def __init__(self, net, local_port: int):
+        super().__init__(net, Protocol.TCP, local_port)
+        self.state = TcpState.CLOSED
+        self.conn_id = net.new_conn_id(self)
+
+        # callbacks (status-listener equivalents)
+        self.on_connected: Optional[Callable] = None
+        self.on_data: Optional[Callable] = None       # (sock, nbytes, now)
+        self.on_closed: Optional[Callable] = None
+        self.on_accept: Optional[Callable] = None     # listener only
+
+        # send sequence state (byte space; SYN/FIN consume one each)
+        self.iss = 0
+        self.snd_una = 0
+        self.snd_nxt = 0
+        self.send_pending = 0          # app bytes not yet segmented
+        self.fin_pending = False
+        self.fin_sent_seq: Optional[int] = None
+        self.retx: list[list] = []     # [seq, len, n_tx, ts_staged, flags]
+        self.peer_window = DEFAULT_RECV_WINDOW
+
+        # congestion control (tcp_cong_reno.c)
+        self.cwnd = INIT_CWND_SEGMENTS * MSS
+        self.ssthresh = 1 << 30
+        self.dup_acks = 0
+        self.in_recovery = False
+        self.recover = 0
+
+        # RTO (RFC 6298)
+        self.srtt: Optional[int] = None
+        self.rttvar = 0
+        self.rto = simtime.SIMTIME_ONE_SECOND
+        self._timer_gen = 0
+        self._rto_armed = False
+
+        # receive state
+        self.irs = 0
+        self.rcv_nxt = 0
+        self.reorder: dict[int, int] = {}      # seq -> len
+        self.recv_window = DEFAULT_RECV_WINDOW
+        self.bytes_received = 0
+        self.bytes_acked = 0
+        # stats (tracker feed; retransmit split like tracker.c:12-50)
+        self.segments_sent = 0
+        self.segments_retransmitted = 0
+
+    # ------------------------------------------------------------------
+    # public API (the syscall layer's entry points)
+    # ------------------------------------------------------------------
+    def listen(self) -> None:
+        self.state = TcpState.LISTEN
+        self.net.register(self)
+
+    def connect(self, now: int, dst_host: int, dst_port: int) -> None:
+        self.peer = (dst_host, dst_port)
+        self.net.register(self)
+        self.state = TcpState.SYN_SENT
+        self._emit(now, TcpFlags.SYN, seq=self.snd_nxt)
+        self.snd_nxt += 1
+        self._arm_rto(now)
+
+    def send(self, now: int, nbytes: int) -> int:
+        """App write: queue nbytes for transmission."""
+        if self.state not in (TcpState.ESTABLISHED, TcpState.CLOSE_WAIT):
+            raise RuntimeError(f"send in state {self.state}")
+        self.send_pending += nbytes
+        self._try_send(now)
+        return nbytes
+
+    def close(self, now: int) -> None:
+        if self.state == TcpState.LISTEN or self.state == TcpState.CLOSED:
+            self.state = TcpState.CLOSED
+            super().close(now)
+            return
+        self.fin_pending = True
+        self._try_send(now)
+
+    # ------------------------------------------------------------------
+    # segment emission
+    # ------------------------------------------------------------------
+    def _flight(self) -> int:
+        return self.snd_nxt - self.snd_una
+
+    def _emit(self, now: int, flags: TcpFlags, seq: int, size: int = 0,
+              track: bool = True) -> None:
+        dst_host, dst_port = self.peer
+        hdr = TcpHeader(flags=int(flags), seq=seq, ack=self.rcv_nxt,
+                        window=self.recv_window,
+                        src_port=self.local_port, dst_port=dst_port,
+                        ts_val=now, ts_echo=self._ts_echo)
+        pkt = self.net.new_packet(dst_host=dst_host, protocol=Protocol.TCP,
+                                  size=size, src_port=self.local_port,
+                                  dst_port=dst_port)
+        pkt.tcp = hdr
+        self.segments_sent += 1
+        if track and (size > 0 or flags & (TcpFlags.SYN | TcpFlags.FIN)):
+            self.retx.append([seq, size, 1, now, int(flags)])
+        self._stage(pkt, now)
+
+    _ts_echo = 0
+
+    def _try_send(self, now: int) -> None:
+        window = min(self.cwnd, self.peer_window)
+        while self.send_pending > 0 and self._flight() < window:
+            seg = min(MSS, self.send_pending, window - self._flight())
+            if seg <= 0:
+                break
+            self._emit(now, TcpFlags.ACK, seq=self.snd_nxt, size=seg)
+            self.snd_nxt += seg
+            self.send_pending -= seg
+            self._arm_rto(now)
+        if (self.fin_pending and self.send_pending == 0
+                and self.fin_sent_seq is None):
+            self.fin_sent_seq = self.snd_nxt
+            self._emit(now, TcpFlags.FIN | TcpFlags.ACK, seq=self.snd_nxt)
+            self.snd_nxt += 1
+            self._arm_rto(now)
+            if self.state == TcpState.ESTABLISHED:
+                self.state = TcpState.FIN_WAIT_1
+            elif self.state == TcpState.CLOSE_WAIT:
+                self.state = TcpState.LAST_ACK
+
+    def _send_ack(self, now: int) -> None:
+        self._emit(now, TcpFlags.ACK, seq=self.snd_nxt, track=False)
+
+    # ------------------------------------------------------------------
+    # timers
+    # ------------------------------------------------------------------
+    def _arm_rto(self, now: int) -> None:
+        if not self._rto_armed:
+            self._rto_armed = True
+            self._timer_gen += 1
+            self.net.schedule_tcp_timer(self.conn_id, self._timer_gen,
+                                        now + self.rto)
+
+    def _restart_rto(self, now: int) -> None:
+        self._rto_armed = False
+        if self.retx:
+            self._arm_rto(now)
+
+    def on_timer(self, now: int, gen: int) -> None:
+        if self.state == TcpState.TIME_WAIT:
+            if gen == self._timer_gen:
+                self._finish_close(now)
+            return
+        if gen != self._timer_gen or not self._rto_armed:
+            return                      # stale timer
+        self._rto_armed = False
+        if not self.retx:
+            return
+        # RTO fire (tcp retransmit timer): back off, collapse cwnd
+        self.ssthresh = max(self._flight() // 2, 2 * MSS)
+        self.cwnd = MSS
+        self.dup_acks = 0
+        self.in_recovery = False
+        self.rto = min(self.rto * 2, MAX_RTO_NS)
+        self._retransmit_first(now)
+        self._arm_rto(now)
+
+    def _retransmit_first(self, now: int) -> None:
+        if not self.retx:
+            return
+        seq, size, n_tx, _, flags = min(self.retx, key=lambda e: e[0])
+        for e in self.retx:
+            if e[0] == seq:
+                e[2] += 1
+                e[3] = now
+        self.segments_retransmitted += 1
+        self._emit(now, TcpFlags(flags), seq=seq, size=size, track=False)
+
+    # ------------------------------------------------------------------
+    # inbound segments
+    # ------------------------------------------------------------------
+    def handle_packet(self, packet: Packet, now: int) -> None:
+        hdr = packet.tcp
+        if hdr is None:
+            return
+        flags = TcpFlags(hdr.flags)
+        packet.add_status(PacketStatus.RCV_SOCKET_PROCESSED)
+        self._ts_echo = hdr.ts_val
+        self.peer_window = max(hdr.window, 1)
+
+        if flags & TcpFlags.RST:
+            self._abort(now)
+            return
+
+        if self.state == TcpState.LISTEN:
+            if flags & TcpFlags.SYN:
+                self._accept_child(packet, now)
+            return
+
+        if self.state == TcpState.SYN_SENT:
+            if flags & TcpFlags.SYN and flags & TcpFlags.ACK:
+                self.irs = hdr.seq
+                self.rcv_nxt = hdr.seq + 1
+                self._handle_ack(hdr, now)
+                self.state = TcpState.ESTABLISHED
+                self._send_ack(now)
+                if self.on_connected:
+                    self.on_connected(self.net.ctx, self, now)
+                self._try_send(now)
+            return
+
+        if flags & TcpFlags.SYN:
+            # duplicate SYN in SYN_RCVD: re-ack
+            self._send_ack(now)
+            return
+
+        if flags & TcpFlags.ACK:
+            self._handle_ack(hdr, now)
+            if self.state == TcpState.SYN_RCVD and \
+                    hdr.ack > self.iss:
+                self.state = TcpState.ESTABLISHED
+                if self.on_accept:
+                    self.on_accept(self.net.ctx, self, now)
+            elif self.state == TcpState.FIN_WAIT_1 and \
+                    self.fin_sent_seq is not None and \
+                    hdr.ack > self.fin_sent_seq:
+                self.state = TcpState.FIN_WAIT_2
+            elif self.state == TcpState.CLOSING and \
+                    self.fin_sent_seq is not None and \
+                    hdr.ack > self.fin_sent_seq:
+                self._enter_time_wait(now)
+            elif self.state == TcpState.LAST_ACK and \
+                    self.fin_sent_seq is not None and \
+                    hdr.ack > self.fin_sent_seq:
+                self._finish_close(now)
+                return
+
+        if packet.size > 0:
+            self._handle_data(hdr.seq, packet.size, now)
+
+        if flags & TcpFlags.FIN:
+            self._handle_fin(hdr, now)
+
+    # -- ACK processing + Reno (tcp_cong_reno.c) -----------------------
+    def _handle_ack(self, hdr: TcpHeader, now: int) -> None:
+        ack = hdr.ack
+        if ack > self.snd_nxt:
+            return
+        if ack > self.snd_una:
+            acked = ack - self.snd_una
+            self.snd_una = ack
+            self.bytes_acked += acked
+            self.retx = [e for e in self.retx if e[0] + max(e[1], 1) > ack]
+            self._sample_rtt(now, hdr.ts_echo)
+            if self.in_recovery:
+                if ack >= self.recover:
+                    self.in_recovery = False
+                    self.cwnd = self.ssthresh
+                    self.dup_acks = 0
+                else:
+                    # NewReno partial ACK: retransmit next hole
+                    self._retransmit_first(now)
+            else:
+                self.dup_acks = 0
+                if self.cwnd < self.ssthresh:
+                    self.cwnd += min(acked, MSS)          # slow start
+                else:
+                    self.cwnd += max(1, MSS * MSS // self.cwnd)
+            self._restart_rto(now)
+            self._try_send(now)
+        elif ack == self.snd_una and self._flight() > 0:
+            self.dup_acks += 1
+            if self.dup_acks == 3 and not self.in_recovery:
+                # fast retransmit + fast recovery
+                self.ssthresh = max(self._flight() // 2, 2 * MSS)
+                self.cwnd = self.ssthresh + 3 * MSS
+                self.in_recovery = True
+                self.recover = self.snd_nxt
+                self._retransmit_first(now)
+            elif self.in_recovery:
+                self.cwnd += MSS                          # inflation
+                self._try_send(now)
+
+    def _sample_rtt(self, now: int, ts_echo: int) -> None:
+        if ts_echo <= 0:
+            return
+        r = now - ts_echo
+        if r < 0:
+            return
+        if self.srtt is None:
+            self.srtt = r
+            self.rttvar = r // 2
+        else:
+            self.rttvar = (3 * self.rttvar + abs(self.srtt - r)) // 4
+            self.srtt = (7 * self.srtt + r) // 8
+        self.rto = min(max(self.srtt + max(4 * self.rttvar,
+                                           simtime.SIMTIME_ONE_MILLISECOND),
+                           MIN_RTO_NS), MAX_RTO_NS)
+
+    # -- inbound data --------------------------------------------------
+    def _handle_data(self, seq: int, size: int, now: int) -> None:
+        if seq + size <= self.rcv_nxt:
+            self._send_ack(now)                 # old retransmission
+            return
+        if seq > self.rcv_nxt:
+            self.reorder[seq] = max(self.reorder.get(seq, 0), size)
+            self._send_ack(now)                 # dup ACK
+            return
+        # in order (possibly overlapping)
+        delivered = seq + size - self.rcv_nxt
+        self.rcv_nxt = seq + size
+        while self.rcv_nxt in self.reorder:
+            sz = self.reorder.pop(self.rcv_nxt)
+            delivered += sz
+            self.rcv_nxt += sz
+        self.bytes_received += delivered
+        self._send_ack(now)
+        if self.on_data:
+            self.on_data(self.net.ctx, self, delivered, now)
+
+    # -- teardown ------------------------------------------------------
+    def _handle_fin(self, hdr: TcpHeader, now: int) -> None:
+        fin_seq = hdr.seq + 0    # FIN occupies seq after any data
+        if hdr.seq > self.rcv_nxt:
+            return               # out of order FIN; wait for data
+        self.rcv_nxt = max(self.rcv_nxt, hdr.seq + 1)
+        self._send_ack(now)
+        if self.state == TcpState.ESTABLISHED:
+            self.state = TcpState.CLOSE_WAIT
+            if self.on_closed:
+                self.on_closed(self.net.ctx, self, now)
+        elif self.state == TcpState.FIN_WAIT_1:
+            self.state = TcpState.CLOSING
+        elif self.state == TcpState.FIN_WAIT_2:
+            self._enter_time_wait(now)
+
+    def _enter_time_wait(self, now: int) -> None:
+        self.state = TcpState.TIME_WAIT
+        self._timer_gen += 1
+        self.net.schedule_tcp_timer(self.conn_id, self._timer_gen,
+                                    now + TIME_WAIT_NS)
+
+    def _finish_close(self, now: int) -> None:
+        was = self.state
+        self.state = TcpState.CLOSED
+        super().close(now)
+        if was != TcpState.TIME_WAIT and self.on_closed:
+            self.on_closed(self.net.ctx, self, now)
+
+    def _abort(self, now: int) -> None:
+        self.state = TcpState.CLOSED
+        super().close(now)
+        if self.on_closed:
+            self.on_closed(self.net.ctx, self, now)
+
+    # -- server side ---------------------------------------------------
+    def _accept_child(self, packet: Packet, now: int) -> None:
+        """Spawn a connection socket for an incoming SYN (the
+        reference's server child-socket multiplexing in tcp.c)."""
+        hdr = packet.tcp
+        child = TcpSocket(self.net, self.local_port)
+        child.peer = (packet.src_host, hdr.src_port)
+        child.state = TcpState.SYN_RCVD
+        child.irs = hdr.seq
+        child.rcv_nxt = hdr.seq + 1
+        child._ts_echo = hdr.ts_val
+        child.on_accept = self.on_accept
+        child.on_data = self.on_data
+        child.on_closed = self.on_closed
+        self.net.register(child)
+        child._emit(now, TcpFlags.SYN | TcpFlags.ACK, seq=child.snd_nxt)
+        child.snd_nxt += 1
+        child._arm_rto(now)

@@ -16,11 +16,14 @@ from __future__ import annotations
 from shadow_tpu.models.base import ModelApp, parse_kv_args
 from shadow_tpu.models.phold import PholdApp
 from shadow_tpu.models.tgen import TgenClientApp, TgenServerApp
+from shadow_tpu.models.tgen_tcp import TgenTcpClientApp, TgenTcpServerApp
 
 _REGISTRY = {
     "phold": PholdApp,
     "tgen_client": TgenClientApp,
     "tgen_server": TgenServerApp,
+    "tgen_tcp_client": TgenTcpClientApp,
+    "tgen_tcp_server": TgenTcpServerApp,
 }
 
 

@@ -1,0 +1,89 @@
+"""tgen-like bulk transfer over the in-simulator TCP stack.
+
+The TCP-fidelity twin of models/tgen.py: the client opens a real
+(simulated) TCP connection — three-way handshake, Reno congestion
+control, token-bucket bandwidth, CoDel router queues, retransmissions —
+sends a 64-byte request, and the server streams `size` bytes back.
+This is the shape of the reference's flagship tgen workload (BASELINE
+configs 1-3) running over its in-Shadow TCP (descriptor/tcp.c).
+
+server args: size=bytes (per-request response size)
+client args: server=<hostname>, port=, size= (expected; for accounting
+only — the server's own size config governs), count=, pause=.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.config.units import parse_size_bytes, parse_time_ns
+from shadow_tpu.models.base import ModelApp
+
+REQUEST_BYTES = 64
+
+
+class TgenTcpServerApp(ModelApp):
+    def __init__(self, args, host_id, n_hosts):
+        super().__init__(args, host_id, n_hosts)
+        self.size = parse_size_bytes(args.get("size", "1 MiB"))
+        self.port = int(args.get("port", 80))
+        self.requests_served = 0
+        self._pending: dict[int, int] = {}   # conn_id -> request bytes
+
+    def boot(self, ctx) -> None:
+        ctx.tcp_listen(self.port, on_accept=self._on_accept,
+                       on_data=self._on_data)
+
+    def _on_accept(self, ctx, conn, now) -> None:
+        self._pending[conn.conn_id] = 0
+
+    def _on_data(self, ctx, conn, nbytes, now) -> None:
+        got = self._pending.get(conn.conn_id, 0) + nbytes
+        self._pending[conn.conn_id] = got
+        if got >= REQUEST_BYTES:
+            self._pending.pop(conn.conn_id, None)
+            self.requests_served += 1
+            conn.send(now, self.size)
+            # one response per connection: FIN rides after the last
+            # data segment, so the client sees data then close
+            conn.close(now)
+
+
+class TgenTcpClientApp(ModelApp):
+    def __init__(self, args, host_id, n_hosts):
+        super().__init__(args, host_id, n_hosts)
+        self.server_name = args.get("server", "server")
+        self.port = int(args.get("port", 80))
+        self.size = parse_size_bytes(args.get("size", "1 MiB"))
+        self.count = int(args.get("count", 1))
+        self.pause_ns = parse_time_ns(args.get("pause", "1 s"))
+        self.downloads_done = 0
+        self.bytes_received = 0
+        self._conn_bytes = 0
+        self._last_download_ns = 0
+        self._started_at = 0
+
+    def boot(self, ctx) -> None:
+        if self.count > 0:
+            self._connect(ctx)
+
+    def on_timer(self, ctx, data) -> None:
+        self._connect(ctx)
+
+    def _connect(self, ctx) -> None:
+        self._conn_bytes = 0
+        self._started_at = ctx.now
+        ctx.tcp_connect(ctx.resolve(self.server_name), self.port,
+                        on_connected=self._on_connected,
+                        on_data=self._on_data)
+
+    def _on_connected(self, ctx, conn, now) -> None:
+        conn.send(now, REQUEST_BYTES)
+
+    def _on_data(self, ctx, conn, nbytes, now) -> None:
+        self.bytes_received += nbytes
+        self._conn_bytes += nbytes
+        if self._conn_bytes >= self.size:
+            self.downloads_done += 1
+            self._last_download_ns = now - self._started_at
+            conn.close(now)
+            if self.downloads_done < self.count:
+                ctx.schedule(self.pause_ns)

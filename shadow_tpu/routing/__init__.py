@@ -1,0 +1,14 @@
+from shadow_tpu.routing.packet import Packet, PacketStatus, Protocol
+from shadow_tpu.routing.router import Router
+from shadow_tpu.routing.queues import (
+    CoDelQueue,
+    SingleQueue,
+    StaticQueue,
+    make_router_queue,
+)
+
+__all__ = [
+    "Packet", "PacketStatus", "Protocol",
+    "Router", "CoDelQueue", "SingleQueue", "StaticQueue",
+    "make_router_queue",
+]

@@ -1,0 +1,104 @@
+"""Packets.
+
+Equivalent of the reference's refcounted Packet (src/main/routing/
+packet.c/h): identity (src host + per-source packet id), protocol,
+header fields, payload length, a priority for qdisc ordering, and a
+delivery-status trail for debugging (packet.h:37-81, PDS_* flags).
+
+Payload bytes: the device network model never needs them (latency,
+loss, and ordering depend only on metadata), and model apps usually
+count bytes rather than inspect them — so `payload` is optional bytes
+kept host-side only, with `size` the authoritative length (mirroring
+the reference's decision to copy payloads out of plugin memory lazily,
+payload.c:25-48).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class Protocol(enum.IntEnum):
+    LOCAL = 0
+    UDP = 1
+    TCP = 2
+
+
+class PacketStatus(enum.IntFlag):
+    """Delivery-status trail (packet.h PDS_* equivalents)."""
+
+    NONE = 0
+    SND_CREATED = 1 << 0
+    SND_TCP_ENQUEUE_THROTTLED = 1 << 1
+    SND_SOCKET_BUFFERED = 1 << 2
+    SND_INTERFACE_SENT = 1 << 3
+    INET_SENT = 1 << 4
+    INET_DROPPED = 1 << 5
+    ROUTER_ENQUEUED = 1 << 6
+    ROUTER_DEQUEUED = 1 << 7
+    ROUTER_DROPPED = 1 << 8
+    RCV_INTERFACE_RECEIVED = 1 << 9
+    RCV_INTERFACE_DROPPED = 1 << 10
+    RCV_SOCKET_PROCESSED = 1 << 11
+    RCV_SOCKET_DELIVERED = 1 << 12
+    DESTROYED = 1 << 13
+    RELAY_CACHED = 1 << 14
+    RELAY_FORWARDED = 1 << 15
+
+
+@dataclass
+class TcpHeader:
+    """TCP header fields the simulated stack uses (packet.h:20-33)."""
+
+    flags: int = 0            # TcpFlags bitmask
+    seq: int = 0              # sequence number of first payload byte
+    ack: int = 0              # cumulative acknowledgement
+    window: int = 0           # advertised receive window (bytes)
+    src_port: int = 0
+    dst_port: int = 0
+    # SACK blocks [(start, end), ...] and timestamps land with the
+    # full SACK implementation
+    ts_val: int = 0
+    ts_echo: int = 0
+
+
+class TcpFlags(enum.IntFlag):
+    NONE = 0
+    RST = 1 << 0
+    SYN = 1 << 1
+    ACK = 1 << 2
+    FIN = 1 << 3
+
+
+@dataclass
+class Packet:
+    src_host: int
+    packet_id: int            # per-source counter: (src_host, packet_id)
+    dst_host: int
+    protocol: Protocol
+    size: int                 # payload bytes
+    src_port: int = 0
+    dst_port: int = 0
+    priority: int = 0         # FIFO qdisc ordering (send time surrogate)
+    tcp: Optional[TcpHeader] = None
+    payload: Optional[bytes] = None
+    status: PacketStatus = PacketStatus.NONE
+    enqueue_time: int = -1    # set by router queues (CoDel sojourn)
+
+    def add_status(self, s: PacketStatus) -> None:
+        self.status |= s
+
+    @property
+    def header_size(self) -> int:
+        from shadow_tpu import simtime
+        if self.protocol == Protocol.TCP:
+            return simtime.CONFIG_HEADER_SIZE_TCPIPETH
+        if self.protocol == Protocol.UDP:
+            return simtime.CONFIG_HEADER_SIZE_UDPIPETH
+        return 0
+
+    @property
+    def total_size(self) -> int:
+        return self.size + self.header_size

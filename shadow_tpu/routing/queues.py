@@ -1,0 +1,204 @@
+"""Router queue management: CoDel (default), single, static drop-tail.
+
+Equivalents of the reference's three router-queue implementations
+(src/main/routing/router_queue_codel.c, _single.c, _static.c). CoDel
+follows RFC 8289 with the reference's parameters: 10 ms target sojourn,
+100 ms interval, unbounded hard limit, and the inverse-sqrt control law
+(router_queue_codel.c:36-48, 198-267).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Optional
+
+from shadow_tpu import simtime
+from shadow_tpu.routing.packet import Packet, PacketStatus
+
+CODEL_TARGET_NS = 10 * simtime.SIMTIME_ONE_MILLISECOND
+CODEL_INTERVAL_NS = 100 * simtime.SIMTIME_ONE_MILLISECOND
+
+
+class RouterQueue:
+    """vtable equivalent (router.h queue hooks)."""
+
+    def enqueue(self, packet: Packet, now: int) -> bool:
+        raise NotImplementedError
+
+    def dequeue(self, now: int) -> Optional[Packet]:
+        raise NotImplementedError
+
+    def peek(self) -> Optional[Packet]:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class SingleQueue(RouterQueue):
+    """One-packet buffer (router_queue_single.c): a new arrival while
+    occupied is dropped."""
+
+    def __init__(self):
+        self._slot: Optional[Packet] = None
+
+    def enqueue(self, packet: Packet, now: int) -> bool:
+        if self._slot is not None:
+            packet.add_status(PacketStatus.ROUTER_DROPPED)
+            return False
+        packet.enqueue_time = now
+        packet.add_status(PacketStatus.ROUTER_ENQUEUED)
+        self._slot = packet
+        return True
+
+    def dequeue(self, now: int) -> Optional[Packet]:
+        p, self._slot = self._slot, None
+        if p is not None:
+            p.add_status(PacketStatus.ROUTER_DEQUEUED)
+        return p
+
+    def peek(self) -> Optional[Packet]:
+        return self._slot
+
+    def __len__(self) -> int:
+        return 0 if self._slot is None else 1
+
+
+class StaticQueue(RouterQueue):
+    """Fixed-capacity drop-tail FIFO (router_queue_static.c)."""
+
+    def __init__(self, capacity: int = 1024):
+        self._q: deque[Packet] = deque()
+        self._capacity = capacity
+
+    def enqueue(self, packet: Packet, now: int) -> bool:
+        if len(self._q) >= self._capacity:
+            packet.add_status(PacketStatus.ROUTER_DROPPED)
+            return False
+        packet.enqueue_time = now
+        packet.add_status(PacketStatus.ROUTER_ENQUEUED)
+        self._q.append(packet)
+        return True
+
+    def dequeue(self, now: int) -> Optional[Packet]:
+        if not self._q:
+            return None
+        p = self._q.popleft()
+        p.add_status(PacketStatus.ROUTER_DEQUEUED)
+        return p
+
+    def peek(self) -> Optional[Packet]:
+        return self._q[0] if self._q else None
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+
+class CoDelQueue(RouterQueue):
+    """Controlled Delay AQM, RFC 8289 (router_queue_codel.c)."""
+
+    def __init__(self, target_ns: int = CODEL_TARGET_NS,
+                 interval_ns: int = CODEL_INTERVAL_NS):
+        self._q: deque[Packet] = deque()
+        self.target = target_ns
+        self.interval = interval_ns
+        # control-law state (5 scalars — the device twin mirrors these,
+        # shadow_tpu/device/netstate.py)
+        self.first_above_time = 0
+        self.drop_next = 0
+        self.count = 0
+        self.lastcount = 0
+        self.dropping = False
+        self.total_dropped = 0
+        self._bytes = 0          # running backlog byte count
+
+    def enqueue(self, packet: Packet, now: int) -> bool:
+        packet.enqueue_time = now
+        packet.add_status(PacketStatus.ROUTER_ENQUEUED)
+        self._q.append(packet)       # infinite hard limit
+        self._bytes += packet.total_size
+        return True
+
+    def _control_law(self, t: int, count: int) -> int:
+        return t + int(self.interval / math.sqrt(max(1, count)))
+
+    def _do_dequeue(self, now: int):
+        """Returns (packet, ok_to_stay_in_drop_state)."""
+        if not self._q:
+            self.first_above_time = 0
+            return None, False
+        p = self._q.popleft()
+        self._bytes -= p.total_size
+        sojourn = now - p.enqueue_time
+        if sojourn < self.target or not self._q_has_backlog():
+            self.first_above_time = 0
+            return p, False
+        if self.first_above_time == 0:
+            self.first_above_time = now + self.interval
+            return p, False
+        return p, now >= self.first_above_time
+
+    def _q_has_backlog(self) -> bool:
+        # the reference checks bytes > MTU; a single small packet
+        # shouldn't hold the queue in the above-target state
+        return self._bytes >= simtime.CONFIG_MTU
+
+    def dequeue(self, now: int) -> Optional[Packet]:
+        p, above = self._do_dequeue(now)
+        if p is None:
+            self.dropping = False
+            return None
+        if self.dropping:
+            if not above:
+                self.dropping = False
+            elif now >= self.drop_next:
+                while now >= self.drop_next and self.dropping:
+                    p.add_status(PacketStatus.ROUTER_DROPPED)
+                    self.total_dropped += 1
+                    self.count += 1
+                    p, above = self._do_dequeue(now)
+                    if p is None:
+                        self.dropping = False
+                        return None
+                    if not above:
+                        self.dropping = False
+                    else:
+                        self.drop_next = self._control_law(
+                            self.drop_next, self.count)
+        elif above and (now - self.drop_next < self.interval
+                        or now - self.first_above_time >= self.interval):
+            p.add_status(PacketStatus.ROUTER_DROPPED)
+            self.total_dropped += 1
+            p, _ = self._do_dequeue(now)
+            if p is None:
+                self.dropping = False
+                return None
+            self.dropping = True
+            if now - self.drop_next < self.interval:
+                self.count = self.count - self.lastcount \
+                    if self.count - self.lastcount > 1 else 1
+            else:
+                self.count = 1
+            self.lastcount = self.count
+            self.drop_next = self._control_law(now, self.count)
+        if p is not None:
+            p.add_status(PacketStatus.ROUTER_DEQUEUED)
+        return p
+
+    def peek(self) -> Optional[Packet]:
+        return self._q[0] if self._q else None
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+
+def make_router_queue(kind: str, static_capacity: int = 1024
+                      ) -> RouterQueue:
+    if kind == "codel":
+        return CoDelQueue()
+    if kind == "single":
+        return SingleQueue()
+    if kind == "static":
+        return StaticQueue(static_capacity)
+    raise ValueError(f"unknown router queue {kind!r}")

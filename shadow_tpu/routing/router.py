@@ -1,0 +1,40 @@
+"""Router: the upstream-ISP buffer in front of a host's interface.
+
+Equivalent of src/main/routing/router.c: arriving packets (after the
+network model's latency/drop decision) enter the router's queue-
+management discipline; the NetworkInterface drains it at the host's
+download bandwidth. `forward` on the egress side hands packets to the
+network model (the reference delegates to worker_sendPacket,
+router.c:95-132).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from shadow_tpu.routing.packet import Packet
+from shadow_tpu.routing.queues import RouterQueue, make_router_queue
+
+
+class Router:
+    def __init__(self, queue: Optional[RouterQueue] = None,
+                 kind: str = "codel", static_capacity: int = 1024):
+        self.queue = queue or make_router_queue(kind, static_capacity)
+        # NIC callback: poked on enqueue so an idle interface starts
+        # its receive loop (router.c:103-121)
+        self.on_enqueue: Optional[Callable[[int], None]] = None
+
+    def enqueue(self, packet: Packet, now: int) -> bool:
+        ok = self.queue.enqueue(packet, now)
+        if ok and self.on_enqueue is not None:
+            self.on_enqueue(now)
+        return ok
+
+    def dequeue(self, now: int) -> Optional[Packet]:
+        return self.queue.dequeue(now)
+
+    def peek(self) -> Optional[Packet]:
+        return self.queue.peek()
+
+    def __len__(self) -> int:
+        return len(self.queue)

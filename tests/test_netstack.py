@@ -1,0 +1,195 @@
+"""Network-stack tests: token buckets, router queues, UDP, TCP.
+
+Modeled on the reference's tcp test matrix (src/test/tcp/: blocking x
+{loopback, lossless, lossy}) at the behavioral level: transfers must
+complete, pace at the configured bandwidth, and survive loss via
+retransmission.
+"""
+
+import pytest
+
+from shadow_tpu import simtime
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.routing.packet import Packet, Protocol
+from shadow_tpu.routing.queues import CoDelQueue, SingleQueue, StaticQueue
+
+MS = simtime.SIMTIME_ONE_MILLISECOND
+
+
+# ---------------------------------------------------------------- queues
+def _pkt(i, size=1400):
+    return Packet(src_host=0, packet_id=i, dst_host=1,
+                  protocol=Protocol.UDP, size=size)
+
+
+def test_single_queue_drops_when_full():
+    q = SingleQueue()
+    assert q.enqueue(_pkt(0), 0)
+    assert not q.enqueue(_pkt(1), 0)
+    assert q.dequeue(0).packet_id == 0
+    assert q.dequeue(0) is None
+
+
+def test_static_queue_drop_tail():
+    q = StaticQueue(capacity=2)
+    assert q.enqueue(_pkt(0), 0)
+    assert q.enqueue(_pkt(1), 0)
+    assert not q.enqueue(_pkt(2), 0)
+    assert q.dequeue(0).packet_id == 0
+    assert q.dequeue(0).packet_id == 1
+
+
+def test_codel_passes_low_delay_traffic():
+    q = CoDelQueue()
+    for i in range(100):
+        now = i * MS
+        q.enqueue(_pkt(i), now)
+        p = q.dequeue(now + 2 * MS)     # 2ms sojourn < 10ms target
+        assert p is not None and p.packet_id == i
+    assert q.total_dropped == 0
+
+
+def test_codel_drops_under_standing_queue():
+    q = CoDelQueue()
+    # build a standing queue: 500 packets arrive at t=0, drain slowly
+    for i in range(500):
+        q.enqueue(_pkt(i), 0)
+    got, now = 0, 0
+    for i in range(500):
+        now += 2 * MS                   # sojourn grows far past target
+        if q.dequeue(now) is not None:
+            got += 1
+    assert q.total_dropped > 0
+    assert got + q.total_dropped <= 500
+
+
+# ---------------------------------------------------------------- e2e
+TCP_YAML = """
+general:
+  stop_time: 60s
+  seed: 1
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ directed 0
+        node [ id 0 bandwidth_down "{bw}" bandwidth_up "{bw}" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss {loss} ] ]
+experimental:
+  scheduler_policy: serial
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - path: model:tgen_tcp_server
+      args: size={size}
+      start_time: 1s
+  client:
+    network_node_id: 0
+    processes:
+    - path: model:tgen_tcp_client
+      args: server=server size={size} count={count}
+      start_time: 2s
+"""
+
+
+def _run_tcp(bw="100 Mbit", loss=0.0, size="100KiB", count=1):
+    cfg = load_config_str(TCP_YAML.format(bw=bw, loss=loss, size=size,
+                                          count=count))
+    c = Controller(cfg)
+    stats = c.run()
+    client = next(h for h in c.sim.hosts if h.name == "client")
+    server = next(h for h in c.sim.hosts if h.name == "server")
+    return stats, client, server
+
+
+def test_tcp_transfer_lossless():
+    stats, client, server = _run_tcp()
+    assert client.app.downloads_done == 1
+    assert client.app.bytes_received == 100 * 1024
+    assert server.app.requests_served == 1
+
+
+def test_tcp_transfer_lossy_retransmits():
+    stats, client, server = _run_tcp(loss=0.05, size="200KiB")
+    assert client.app.downloads_done == 1
+    assert client.app.bytes_received >= 200 * 1024
+
+
+def test_tcp_bandwidth_pacing():
+    # 800 KiB over a 10 Mbit link: ideal ~0.66 s; with handshake,
+    # slow start and 20ms RTT it must take >= the line-rate bound and
+    # finish well under stop_time
+    _, client, _ = _run_tcp(bw="10 Mbit", size="800KiB", count=1)
+    assert client.app.downloads_done == 1
+    dur_s = client.app._last_download_ns / 1e9
+    line_rate_s = (800 * 1024 * 8) / 10e6
+    assert dur_s >= 0.9 * line_rate_s, dur_s
+    assert dur_s <= 3 * line_rate_s, dur_s
+
+
+def test_tcp_multiple_downloads():
+    _, client, server = _run_tcp(size="50KiB", count=3)
+    assert client.app.downloads_done == 3
+    assert server.app.requests_served == 3
+    assert client.app.bytes_received == 3 * 50 * 1024
+
+
+def test_tcp_deterministic():
+    s1, c1, _ = _run_tcp(loss=0.03, size="100KiB")
+    s2, c2, _ = _run_tcp(loss=0.03, size="100KiB")
+    assert c1.trace_checksum == c2.trace_checksum
+    assert s1.events_executed == s2.events_executed
+
+
+UDP_YAML = """
+general:
+  stop_time: 5s
+  seed: 1
+network: {graph: {type: 1_gbit_switch}}
+experimental: {scheduler_policy: serial}
+hosts:
+  a:
+    processes:
+    - {path: "model:udp_echo_client", args: "peer=b n=5", start_time: 1s}
+  b:
+    processes:
+    - {path: "model:udp_echo_server", start_time: 500ms}
+"""
+
+
+def test_udp_echo():
+    from shadow_tpu.models import register_model
+    from shadow_tpu.models.base import ModelApp
+
+    class EchoServer(ModelApp):
+        def boot(self, ctx):
+            ctx.udp_socket(port=9000, on_datagram=self._on)
+
+        def _on(self, ctx, sock, pkt, now):
+            sock.sendto(now, pkt.src_host, pkt.tcp.src_port if pkt.tcp
+                        else pkt.src_port, pkt.size)
+
+    class EchoClient(ModelApp):
+        def __init__(self, args, host_id, n_hosts):
+            super().__init__(args, host_id, n_hosts)
+            self.n = int(args.get("n", 1))
+            self.echoed = 0
+
+        def boot(self, ctx):
+            self.sock = ctx.udp_socket(on_datagram=self._on)
+            for _ in range(self.n):
+                self.sock.sendto(ctx.now, ctx.resolve(
+                    self.args.get("peer", "b")), 9000, 100)
+
+        def _on(self, ctx, sock, pkt, now):
+            self.echoed += 1
+
+    register_model("udp_echo_server", EchoServer)
+    register_model("udp_echo_client", EchoClient)
+    cfg = load_config_str(UDP_YAML)
+    c = Controller(cfg)
+    c.run()
+    client = next(h for h in c.sim.hosts if h.name == "a")
+    assert client.app.echoed == 5
