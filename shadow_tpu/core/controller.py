@@ -54,12 +54,17 @@ class BuiltSimulation:
     netmodel: NetworkModel
     starts: list[tuple[int, int, int]]   # (host_id, start, stop|-1)
     lookahead: int
+    dns: object = None
 
 
 def build(cfg: ConfigOptions) -> BuiltSimulation:
+    from shadow_tpu.host.cpu import Cpu
+    from shadow_tpu.routing.dns import Dns
+
     topology = load_topology(cfg)
     root_rng = SeededRandom(cfg.general.seed)
     attacher = Attacher(topology, root_rng.child("attach"))
+    dns = Dns()
 
     hosts: list[Host] = []
     starts: list[tuple[int, int, int]] = []
@@ -79,7 +84,12 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
             host = Host(host_id=host_id, name=name, vertex=att.vertex,
                         bw_down_bits=att.bw_down_bits,
                         bw_up_bits=att.bw_up_bits,
-                        rng=root_rng.child(f"host:{name}"))
+                        rng=root_rng.child(f"host:{name}"),
+                        pcap_directory=group.pcap_directory)
+            host.cpu = Cpu()
+            host.address = dns.register(host_id, name,
+                                        requested_ip=group.ip_address_hint)
+            host.ip = host.address.ip_str
             for proc in group.processes:
                 for _ in range(proc.quantity):
                     if not is_model_path(proc.path):
@@ -109,7 +119,7 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
                  else topology.min_latency_ns)
     return BuiltSimulation(cfg=cfg, topology=topology, hosts=hosts,
                            netmodel=netmodel, starts=starts,
-                           lookahead=lookahead)
+                           lookahead=lookahead, dns=dns)
 
 
 class Controller:
@@ -148,6 +158,8 @@ class Controller:
 
         m = self.manager
         m.boot_hosts(self.sim.starts)
+        if cfg.general.heartbeat_interval:
+            m.schedule_heartbeats(cfg.general.heartbeat_interval, stop)
         lookahead = max(1, self.sim.lookahead)
         log.info("starting: %d hosts, stop=%s, lookahead=%s",
                  len(self.sim.hosts), simtime.format_time(stop),

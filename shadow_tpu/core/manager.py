@@ -155,13 +155,48 @@ class Manager:
                                            for h in self.hosts)
         if hasattr(self.policy, "shutdown"):
             self.policy.shutdown()
+        for h in self.hosts:
+            if h.net is not None and h.net.pcap is not None:
+                h.net.pcap.close()
         return self.stats
+
+    def schedule_heartbeats(self, interval: int, stop: int) -> None:
+        """Per-host heartbeat chain (tracker_heartbeat, tracker.c:565)."""
+        from shadow_tpu.core.event import KIND_TASK
+        from shadow_tpu.host.tracker import Tracker
+
+        def make_task(host):
+            def task(ctx, ev):
+                host.tracker.heartbeat(ev.time, host)
+                nxt = ev.time + interval
+                if nxt < stop:
+                    self.push_event(Event(
+                        time=nxt, dst_host=host.host_id,
+                        src_host=host.host_id,
+                        seq=host.next_event_seq(), kind=KIND_TASK,
+                        task=task))
+            return task
+
+        for h in self.hosts:
+            h.tracker = Tracker(h.name, interval)
+            self.push_event(Event(time=interval, dst_host=h.host_id,
+                                  src_host=h.host_id,
+                                  seq=h.next_event_seq(),
+                                  kind=KIND_TASK, task=make_task(h)))
 
     def execute_event(self, ev: Event, ctx: SimContext,
                       stats: SimStats) -> None:
         """event_execute analogue (core/work/event.c:64): set the clock
-        and host context, dispatch by kind."""
+        and host context, apply the CPU-delay model, dispatch by kind."""
         host = self.hosts[ev.dst_host]
+        if host.cpu is not None:
+            host.cpu.update_time(ev.time)
+            if host.cpu.is_blocked(ev.time):
+                # defer delivery while the virtual CPU is busy
+                # (event.c:70-87); same seq keeps the total order stable
+                ev.time += host.cpu.delay_until_ready(ev.time)
+                self.policy.push(ev, self._barrier)
+                return
         ctx.now = ev.time
         ctx.host = host
         set_context(ev.time, host.name, host.host_id)
@@ -169,6 +204,8 @@ class Manager:
             host.events_executed += 1
             host.trace_checksum = chk_mix(host.trace_checksum, ev.time,
                                           ev.src_host, ev.kind, ev.seq)
+            if host.tracker is not None:
+                host.tracker.on_event()
             stats.events_executed += 1
             if self.trace is not None:
                 with self._trace_lock:

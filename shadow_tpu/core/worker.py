@@ -112,3 +112,10 @@ class SimContext:
     def udp_socket(self, port=None, on_datagram=None):
         self.host.net.ctx = self
         return self.host.net.udp_socket(port, on_datagram=on_datagram)
+
+    def consume_cpu(self, native_ns: int) -> None:
+        """Model synthetic CPU load: subsequent events on this host are
+        delayed while the virtual CPU works off the backlog
+        (cpu.c cpu_addDelay; phold's cpuload knob)."""
+        if self.host.cpu is not None:
+            self.host.cpu.add_delay(native_ns)

@@ -1,0 +1,52 @@
+"""Virtual CPU delay model.
+
+Equivalent of src/main/host/cpu.c: native execution time is scaled by
+the ratio of the host's configured frequency to the machine's raw
+frequency, and event delivery is deferred while the virtual CPU is
+"busy" past a threshold (cpu.c:16-49, applied around event execution in
+event.c:70-87). Model apps report synthetic load via
+SimContext.consume_cpu().
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from shadow_tpu import simtime
+
+
+@dataclass
+class Cpu:
+    freq_khz: int = 3_000_000          # host's configured frequency
+    raw_freq_khz: int = 3_000_000      # native machine frequency
+    threshold_ns: int = simtime.SIMTIME_ONE_MILLISECOND
+    precision_ns: int = 200 * simtime.SIMTIME_ONE_MICROSECOND
+    now: int = 0
+    _busy_until: int = 0
+
+    def scale(self, native_ns: int) -> int:
+        return native_ns * self.raw_freq_khz // max(1, self.freq_khz)
+
+    def update_time(self, now: int) -> None:
+        self.now = max(self.now, now)
+
+    def add_delay(self, native_ns: int) -> None:
+        """Account virtual execution time (cpu_addDelay)."""
+        base = max(self._busy_until, self.now)
+        self._busy_until = base + self.scale(native_ns)
+
+    def is_blocked(self, now: int) -> bool:
+        """True if event delivery should wait (cpu_isBlocked): the
+        backlog exceeds the threshold."""
+        if self.threshold_ns <= 0:
+            return False
+        return (self._busy_until - now) > self.threshold_ns
+
+    def delay_until_ready(self, now: int) -> int:
+        """How long to defer an event, rounded up to the model
+        precision (cpu_getDelay)."""
+        raw = max(0, self._busy_until - now)
+        if self.precision_ns > 0:
+            steps = (raw + self.precision_ns - 1) // self.precision_ns
+            return steps * self.precision_ns
+        return raw

@@ -26,6 +26,10 @@ class Host:
     rng: SeededRandom
     app: Any = None             # ModelApp instance (interpose=model)
     net: Any = None             # HostNetStack (CPU engines)
+    cpu: Any = None             # host/cpu.py Cpu delay model
+    tracker: Any = None         # host/tracker.py Tracker
+    address: Any = None         # routing/address.py Address (via DNS)
+    pcap_directory: Optional[str] = None
     ip: Optional[str] = None
 
     # deterministic id streams (reference host.c:85-95)

@@ -59,6 +59,16 @@ class HostNetStack:
         # executes on one worker at a time, so this is race-free)
         self.ctx = None
 
+        # pcap capture (network_interface.c:341-377)
+        self.pcap = None
+        if host.pcap_directory:
+            import os
+
+            from shadow_tpu.utils.pcap import PcapWriter
+            os.makedirs(host.pcap_directory, exist_ok=True)
+            self.pcap = PcapWriter(os.path.join(
+                host.pcap_directory, f"{host.name}-eth.pcap"))
+
     # -- registration --------------------------------------------------
     def new_conn_id(self, sock) -> int:
         cid = self._next_conn_id
@@ -103,9 +113,16 @@ class HostNetStack:
         pkt.add_status(PacketStatus.SND_CREATED)
         return pkt
 
+    def _ip_of(self, host_id: int) -> int:
+        addr = self._m.hosts[host_id].address
+        return addr.ip if addr is not None else host_id
+
     # -- egress: interface -> network model -> dst router --------------
     def _transmit(self, packet: Packet, now: int) -> None:
         host = self.host
+        if self.pcap is not None:
+            self.pcap.write(now, packet, self._ip_of(host.host_id),
+                            self._ip_of(packet.dst_host))
         verdict = self._m.netmodel.judge(now, host.host_id,
                                          packet.dst_host,
                                          packet.packet_id)
@@ -128,6 +145,9 @@ class HostNetStack:
                                     packet.tcp.src_port))
         if sock is None:
             sock = self._listeners.get((packet.protocol, packet.dst_port))
+        if self.pcap is not None:
+            self.pcap.write(now, packet, self._ip_of(packet.src_host),
+                            self._ip_of(self.host.host_id))
         if sock is None:
             packet.add_status(PacketStatus.RCV_INTERFACE_DROPPED)
             self.host.packets_dropped += 1

@@ -25,6 +25,10 @@ class PholdApp(ModelApp):
         self.msgload = int(args.get("msgload", 1))
         self.size = int(args.get("size", 64))
         self.selfloop = int(args.get("selfloop", 0))
+        # virtual CPU milliseconds burned per received message (the
+        # reference phold's cpuload knob); CPU engines only — keep 0
+        # for device-twin trace parity until the device CPU model lands
+        self.cpuload_ms = int(args.get("cpuload", 0))
         self.received = 0
 
     def _pick_peer(self, ctx) -> int:
@@ -40,4 +44,6 @@ class PholdApp(ModelApp):
 
     def on_packet(self, ctx, src_host, size, data) -> None:
         self.received += 1
+        if self.cpuload_ms:
+            ctx.consume_cpu(self.cpuload_ms * 1_000_000)
         ctx.send(self._pick_peer(ctx), self.size)

@@ -1,0 +1,33 @@
+"""Addresses: (ip, name, host id) tuples with order helpers.
+
+Equivalent of src/main/routing/address.c: an immutable identity record
+the DNS hands out; IPs are stored as host-order ints with dotted-quad
+helpers (the reference keeps both byte orders cached).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass
+
+
+def ip_to_int(ip: str) -> int:
+    return int(ipaddress.IPv4Address(ip))
+
+
+def int_to_ip(v: int) -> str:
+    return str(ipaddress.IPv4Address(v))
+
+
+@dataclass(frozen=True)
+class Address:
+    host_id: int
+    name: str
+    ip: int               # host byte order
+
+    @property
+    def ip_str(self) -> str:
+        return int_to_ip(self.ip)
+
+    def __str__(self) -> str:
+        return f"{self.name}({self.ip_str})"

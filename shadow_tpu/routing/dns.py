@@ -1,0 +1,82 @@
+"""DNS: the simulation-wide name <-> address registry.
+
+Equivalent of src/main/routing/dns.c: hosts register a unique name and
+get a unique virtual IP — assigned sequentially while skipping reserved
+CIDR ranges (dns.c:40-60) — or keep an explicitly requested IP if it is
+valid and free. `write_hosts_file` emits the /etc/hosts-style file that
+managed (real) processes resolve against.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from shadow_tpu.routing.address import Address, int_to_ip, ip_to_int
+
+_RESERVED = [
+    # (base, mask-bits): loopback, rfc1918, link-local, multicast+
+    (ip_to_int("0.0.0.0"), 8),
+    (ip_to_int("10.0.0.0"), 8),
+    (ip_to_int("100.64.0.0"), 10),
+    (ip_to_int("127.0.0.0"), 8),
+    (ip_to_int("169.254.0.0"), 16),
+    (ip_to_int("172.16.0.0"), 12),
+    (ip_to_int("192.168.0.0"), 16),
+    (ip_to_int("224.0.0.0"), 3),
+]
+
+
+def _is_reserved(ip: int) -> bool:
+    for base, bits in _RESERVED:
+        if (ip >> (32 - bits)) == (base >> (32 - bits)):
+            return True
+    return ip & 0xFF in (0, 255)          # network/broadcast-looking
+
+
+class Dns:
+    def __init__(self):
+        self._by_name: dict[str, Address] = {}
+        self._by_ip: dict[int, Address] = {}
+        self._by_id: dict[int, Address] = {}
+        self._next_ip = ip_to_int("11.0.0.1")
+
+    def _alloc_ip(self) -> int:
+        ip = self._next_ip
+        while _is_reserved(ip) or ip in self._by_ip:
+            ip += 1
+        self._next_ip = ip + 1
+        return ip
+
+    def register(self, host_id: int, name: str,
+                 requested_ip: Optional[str] = None) -> Address:
+        if name in self._by_name:
+            raise ValueError(f"duplicate host name {name!r}")
+        ip = None
+        if requested_ip:
+            cand = ip_to_int(requested_ip)
+            if not _is_reserved(cand) and cand not in self._by_ip:
+                ip = cand
+        if ip is None:
+            ip = self._alloc_ip()
+        addr = Address(host_id=host_id, name=name, ip=ip)
+        self._by_name[name] = addr
+        self._by_ip[ip] = addr
+        self._by_id[host_id] = addr
+        return addr
+
+    def resolve_name(self, name: str) -> Optional[Address]:
+        return self._by_name.get(name)
+
+    def resolve_ip(self, ip) -> Optional[Address]:
+        if isinstance(ip, str):
+            ip = ip_to_int(ip)
+        return self._by_ip.get(ip)
+
+    def address_of(self, host_id: int) -> Optional[Address]:
+        return self._by_id.get(host_id)
+
+    def write_hosts_file(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write("127.0.0.1 localhost\n")
+            for name, addr in sorted(self._by_name.items()):
+                f.write(f"{int_to_ip(addr.ip)} {name}\n")

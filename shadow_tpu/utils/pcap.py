@@ -1,0 +1,51 @@
+"""Pcap capture of simulated packets.
+
+Equivalent of src/main/utility/pcap_writer.c + the interface capture
+hook (network_interface.c:341-377): writes classic pcap files (magic
+0xa1b2c3d4, LINKTYPE_RAW IPv4) with synthesized IP/TCP/UDP headers so
+standard tools (wireshark/tcpdump) can open simulated traces.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from shadow_tpu import simtime
+from shadow_tpu.routing.packet import Packet, Protocol
+
+LINKTYPE_RAW = 101
+
+
+class PcapWriter:
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+        self._f.write(struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0,
+                                  65535, LINKTYPE_RAW))
+
+    def _ip_header(self, packet: Packet, src_ip: int, dst_ip: int,
+                   payload_len: int) -> bytes:
+        proto = 6 if packet.protocol == Protocol.TCP else 17
+        total = 20 + payload_len
+        return struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64,
+                           proto, 0, src_ip, dst_ip)
+
+    def write(self, now: int, packet: Packet, src_ip: int,
+              dst_ip: int) -> None:
+        if packet.protocol == Protocol.TCP and packet.tcp is not None:
+            h = packet.tcp
+            l4 = struct.pack(">HHIIBBHHH", h.src_port, h.dst_port,
+                             h.seq & 0xFFFFFFFF, h.ack & 0xFFFFFFFF,
+                             5 << 4, int(h.flags) & 0x3F,
+                             min(h.window, 65535), 0, 0)
+        else:
+            l4 = struct.pack(">HHHH", packet.src_port, packet.dst_port,
+                             8 + packet.size, 0)
+        body = l4 + b"\x00" * packet.size
+        frame = self._ip_header(packet, src_ip, dst_ip, len(body)) + body
+        sec, ns = divmod(now, simtime.SIMTIME_ONE_SECOND)
+        self._f.write(struct.pack("<IIII", sec, ns // 1000, len(frame),
+                                  len(frame)))
+        self._f.write(frame)
+
+    def close(self) -> None:
+        self._f.close()

@@ -1,0 +1,146 @@
+"""DNS, CPU model, tracker heartbeats, pcap capture."""
+
+import logging
+import os
+import struct
+
+import pytest
+
+from shadow_tpu import simtime
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.host.cpu import Cpu
+from shadow_tpu.routing.dns import Dns
+
+
+def test_dns_assignment():
+    dns = Dns()
+    a = dns.register(0, "alice")
+    b = dns.register(1, "bob")
+    assert a.ip != b.ip
+    assert a.ip_str.startswith("11.")
+    assert dns.resolve_name("alice") is a
+    assert dns.resolve_ip(a.ip_str) is a
+    assert dns.address_of(1) is b
+    with pytest.raises(ValueError):
+        dns.register(2, "alice")
+
+
+def test_dns_requested_ip_and_reserved_skip():
+    dns = Dns()
+    a = dns.register(0, "pinned", requested_ip="100.1.2.3")
+    assert a.ip_str == "100.1.2.3"
+    # reserved ranges are refused -> auto-assign
+    b = dns.register(1, "lan", requested_ip="192.168.1.1")
+    assert not b.ip_str.startswith("192.168.")
+
+
+def test_dns_hosts_file(tmp_path):
+    dns = Dns()
+    dns.register(0, "alice")
+    dns.register(1, "bob")
+    p = tmp_path / "hosts"
+    dns.write_hosts_file(str(p))
+    text = p.read_text()
+    assert "localhost" in text
+    assert "alice" in text and "bob" in text
+
+
+def test_cpu_model_blocks_and_recovers():
+    cpu = Cpu(freq_khz=1_000_000, raw_freq_khz=2_000_000)
+    # scaling: native 1ms at half speed -> 2ms virtual
+    assert cpu.scale(1_000_000) == 2_000_000
+    cpu.update_time(0)
+    assert not cpu.is_blocked(0)
+    cpu.add_delay(5 * simtime.SIMTIME_ONE_MILLISECOND)   # 10ms virtual
+    assert cpu.is_blocked(0)
+    d = cpu.delay_until_ready(0)
+    assert d >= 10 * simtime.SIMTIME_ONE_MILLISECOND
+    assert not cpu.is_blocked(20 * simtime.SIMTIME_ONE_MILLISECOND)
+
+
+PHOLD_CPU_YAML = """
+general:
+  stop_time: 2s
+  seed: 3
+  heartbeat_interval: 500ms
+network: {graph: {type: 1_gbit_switch}}
+experimental: {scheduler_policy: serial}
+hosts:
+  peer:
+    quantity: 4
+    processes:
+    - {path: "model:phold", args: "msgload=1", start_time: 100ms}
+"""
+
+
+def test_heartbeat_lines_emitted(caplog):
+    with caplog.at_level(logging.INFO, logger="shadow_tpu.heartbeat"):
+        Controller(load_config_str(PHOLD_CPU_YAML)).run()
+    lines = [r.getMessage() for r in caplog.records
+             if "shadow-heartbeat" in r.getMessage()]
+    assert any("[node-header]" in ln for ln in lines)
+    node_lines = [ln for ln in lines if "[node]" in ln]
+    # 4 hosts x 3 heartbeats (0.5, 1.0, 1.5s)
+    assert len(node_lines) == 12
+
+
+PCAP_YAML = """
+general: {stop_time: 10s, seed: 1}
+network: {graph: {type: 1_gbit_switch}}
+experimental: {scheduler_policy: serial}
+hosts:
+  server:
+    pcap_directory: "%s"
+    processes:
+    - {path: "model:tgen_tcp_server", args: "size=10KiB", start_time: 1s}
+  client:
+    processes:
+    - {path: "model:tgen_tcp_client",
+       args: "server=server size=10KiB count=1", start_time: 2s}
+"""
+
+
+def test_pcap_capture(tmp_path):
+    cfg = load_config_str(PCAP_YAML % tmp_path)
+    c = Controller(cfg)
+    c.run()
+    pcap = tmp_path / "server-eth.pcap"
+    assert pcap.exists()
+    data = pcap.read_bytes()
+    magic, = struct.unpack("<I", data[:4])
+    assert magic == 0xA1B2C3D4
+    assert len(data) > 24 + 16      # header + at least one record
+
+
+def test_cpu_load_delays_events():
+    yaml = PHOLD_CPU_YAML.replace("msgload=1", "msgload=1 cpuload=1")
+    # without app support for cpuload this is a no-op; drive consume_cpu
+    # directly through a tiny custom app instead
+    from shadow_tpu.models import register_model
+    from shadow_tpu.models.base import ModelApp
+
+    class Burner(ModelApp):
+        def boot(self, ctx):
+            ctx.send((self.host_id + 1) % self.n_hosts, 64)
+
+        def on_packet(self, ctx, src, size, data):
+            ctx.consume_cpu(50 * simtime.SIMTIME_ONE_MILLISECOND)
+            ctx.send((self.host_id + 1) % self.n_hosts, 64)
+
+    register_model("burner", Burner)
+    base = """
+general: {stop_time: 2s, seed: 1}
+network: {graph: {type: 1_gbit_switch}}
+experimental: {scheduler_policy: serial}
+hosts:
+  peer:
+    quantity: 2
+    processes:
+    - {path: "model:burner", start_time: 0ms}
+"""
+    c = Controller(load_config_str(base))
+    stats = c.run()
+    # each hop now costs ~latency + cpu backlog; with 50ms burn per
+    # packet the ring can't exceed ~2s/50ms events per chain
+    assert stats.events_executed < 100
