@@ -1,0 +1,97 @@
+// C API over the native runtime, for ctypes binding
+// (shadow_tpu/native.py). Mirrors the surface the reference exports
+// from its shmem allocator (shmemallocator_globalAlloc/Free,
+// shmemserializer_globalBlockDeserialize) plus the IPC channel ops.
+
+#include <cstring>
+#include <new>
+
+#include "ipc/spinsem.hpp"
+#include "shmem/shmem.hpp"
+
+using shadow_tpu::IpcChannel;
+using shadow_tpu::IpcMessage;
+using shadow_tpu::ShmArena;
+using shadow_tpu::ShmBlockHandle;
+
+extern "C" {
+
+void* shadowtpu_arena_create(const char* name, uint64_t size) {
+  try {
+    return new ShmArena(name, size, /*create=*/true);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void* shadowtpu_arena_open(const char* name) {
+  try {
+    return new ShmArena(name, 0, /*create=*/false);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void shadowtpu_arena_close(void* arena) {
+  delete static_cast<ShmArena*>(arena);
+}
+
+void shadowtpu_arena_unlink(void* arena) {
+  static_cast<ShmArena*>(arena)->unlink();
+}
+
+void* shadowtpu_arena_alloc(void* arena, uint64_t nbytes) {
+  return static_cast<ShmArena*>(arena)->alloc(nbytes);
+}
+
+void shadowtpu_arena_free(void* arena, void* p) {
+  static_cast<ShmArena*>(arena)->free(p);
+}
+
+uint64_t shadowtpu_arena_allocated(void* arena) {
+  return static_cast<ShmArena*>(arena)->allocated_bytes();
+}
+
+uint64_t shadowtpu_arena_offset(void* arena, void* p) {
+  auto* a = static_cast<ShmArena*>(arena);
+  return static_cast<uint8_t*>(p) - a->base();
+}
+
+void* shadowtpu_arena_at(void* arena, uint64_t offset) {
+  auto* a = static_cast<ShmArena*>(arena);
+  return a->base() + offset;
+}
+
+int shadowtpu_cleanup_orphans(const char* prefix) {
+  return ShmArena::cleanup_orphans(prefix);
+}
+
+// ---- IPC channel (lives inside an arena block) ----------------------
+
+uint64_t shadowtpu_ipc_sizeof() { return sizeof(IpcChannel); }
+
+void shadowtpu_ipc_init(void* mem, uint32_t spin_max) {
+  static_cast<IpcChannel*>(mem)->init(spin_max);
+}
+
+void shadowtpu_ipc_send_to_plugin(void* ch, const IpcMessage* m) {
+  static_cast<IpcChannel*>(ch)->send_to_plugin(*m);
+}
+
+int shadowtpu_ipc_recv_from_plugin(void* ch, IpcMessage* out) {
+  return static_cast<IpcChannel*>(ch)->recv_from_plugin(out) ? 1 : 0;
+}
+
+void shadowtpu_ipc_send_to_simulator(void* ch, const IpcMessage* m) {
+  static_cast<IpcChannel*>(ch)->send_to_simulator(*m);
+}
+
+int shadowtpu_ipc_recv_from_simulator(void* ch, IpcMessage* out) {
+  return static_cast<IpcChannel*>(ch)->recv_from_simulator(out) ? 1 : 0;
+}
+
+void shadowtpu_ipc_mark_plugin_exited(void* ch) {
+  static_cast<IpcChannel*>(ch)->mark_plugin_exited();
+}
+
+}  // extern "C"

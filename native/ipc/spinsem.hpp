@@ -1,0 +1,131 @@
+// Binary spinning semaphore + simulator<->plugin IPC channel.
+//
+// The native runtime's equivalent of the reference's shim IPC
+// (src/lib/shim/binary_spinning_sem.cc, ipc.cc, shadow_sem.c): the
+// simulator and a managed process ping-pong strictly (one side runs at
+// a time), so the wake path is a short adaptive spin on a shared
+// atomic (cheap when the partner responds within a few microseconds —
+// the common case for emulated syscalls) followed by a futex sleep.
+//
+// The channel struct lives inside a shared-memory arena; both sides
+// map it at (possibly) different addresses, so everything is
+// position-independent plain data.
+
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+namespace shadow_tpu {
+
+inline long futex_call(std::atomic<uint32_t>* uaddr, int op,
+                       uint32_t val) {
+  return syscall(SYS_futex, reinterpret_cast<uint32_t*>(uaddr), op, val,
+                 nullptr, nullptr, 0);
+}
+
+struct SpinSem {
+  std::atomic<uint32_t> value;
+  uint32_t spin_max;      // preload_spin_max equivalent (default 8096)
+
+  void init(uint32_t spins) {
+    value.store(0, std::memory_order_relaxed);
+    spin_max = spins;
+  }
+
+  void post() {
+    value.store(1, std::memory_order_release);
+    futex_call(&value, FUTEX_WAKE, 1);
+  }
+
+  // Returns false if `abort_flag` (e.g. plugin-exited) became set.
+  bool wait(const std::atomic<uint32_t>* abort_flag = nullptr) {
+    for (;;) {
+      for (uint32_t i = 0; i < spin_max; ++i) {
+        uint32_t one = 1;
+        if (value.compare_exchange_weak(one, 0,
+                                        std::memory_order_acquire))
+          return true;
+        if (abort_flag &&
+            abort_flag->load(std::memory_order_relaxed))
+          return false;
+#if defined(__x86_64__)
+        __builtin_ia32_pause();
+#endif
+      }
+      // sleep until posted (value != 0), then loop to claim it
+      futex_call(&value, FUTEX_WAIT, 0);
+    }
+  }
+};
+
+// Fixed-size message slots: enough for a syscall request (number + 6
+// args + 64 inline bytes) or a response (retval + flags).
+constexpr size_t kIpcMsgBytes = 128;
+
+enum IpcMsgKind : uint32_t {
+  IPC_NONE = 0,
+  IPC_START = 1,          // simulator -> plugin: begin execution
+  IPC_SYSCALL = 2,        // plugin -> simulator: syscall request
+  IPC_SYSCALL_DONE = 3,   // simulator -> plugin: emulated result
+  IPC_SYSCALL_NATIVE = 4, // simulator -> plugin: execute natively
+  IPC_STOP = 5,
+};
+
+struct IpcMessage {
+  uint32_t kind;
+  uint32_t _pad;
+  int64_t number;         // syscall number / return value
+  uint64_t args[6];
+  uint8_t inline_bytes[kIpcMsgBytes - 64];
+};
+static_assert(sizeof(IpcMessage) == kIpcMsgBytes, "message size");
+
+struct IpcChannel {
+  SpinSem to_plugin;
+  SpinSem to_simulator;
+  std::atomic<uint32_t> plugin_exited;
+  IpcMessage msg_to_plugin;
+  IpcMessage msg_to_simulator;
+
+  void init(uint32_t spin_max) {
+    to_plugin.init(spin_max);
+    to_simulator.init(spin_max);
+    plugin_exited.store(0, std::memory_order_relaxed);
+    memset(&msg_to_plugin, 0, sizeof(msg_to_plugin));
+    memset(&msg_to_simulator, 0, sizeof(msg_to_simulator));
+  }
+
+  // simulator side
+  void send_to_plugin(const IpcMessage& m) {
+    msg_to_plugin = m;
+    to_plugin.post();
+  }
+  bool recv_from_plugin(IpcMessage* out) {
+    if (!to_simulator.wait(&plugin_exited)) return false;
+    *out = msg_to_simulator;
+    return true;
+  }
+
+  // plugin side
+  void send_to_simulator(const IpcMessage& m) {
+    msg_to_simulator = m;
+    to_simulator.post();
+  }
+  bool recv_from_simulator(IpcMessage* out) {
+    if (!to_plugin.wait()) return false;
+    *out = msg_to_plugin;
+    return true;
+  }
+
+  void mark_plugin_exited() {
+    plugin_exited.store(1, std::memory_order_release);
+    futex_call(&to_simulator.value, FUTEX_WAKE, 1);
+  }
+};
+
+}  // namespace shadow_tpu

@@ -1,0 +1,231 @@
+#include "shmem.hpp"
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dirent.h>
+#include <fcntl.h>
+#include <stdexcept>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace shadow_tpu {
+
+namespace {
+constexpr uint32_t kMagicUsed = 0x5D10C8ED;
+constexpr uint32_t kMagicFree = 0xF2EEB10C;
+constexpr uint32_t kMinOrder = 6;      // 64-byte smallest block
+constexpr uint64_t kNil = ~0ull;
+
+inline uint32_t order_for(size_t n) {
+  uint32_t o = kMinOrder;
+  while ((1ull << o) < n) ++o;
+  return o;
+}
+}  // namespace
+
+// Every block (free or used) starts with this 24-byte header; the
+// buddy of block at offset `off` (order o) sits at `off ^ (1<<o)`.
+struct BlockHdr {
+  uint32_t magic;
+  uint32_t order;
+  uint64_t next;    // free-list links (offsets; kNil = end)
+  uint64_t prev;
+};
+
+struct ShmArena::BuddyHeader {
+  uint32_t magic;
+  uint32_t top_order;
+  uint64_t data_off;
+  std::atomic_flag lock;
+  uint64_t free_heads[64];   // per-order free lists (offsets)
+  uint64_t allocated;
+};
+
+ShmArena::ShmArena(const std::string& name, size_t size, bool create)
+    : name_(name), size_(size), owner_(create) {
+  int flags = create ? (O_RDWR | O_CREAT | O_EXCL) : O_RDWR;
+  fd_ = shm_open(name.c_str(), flags, 0600);
+  if (fd_ < 0) throw std::runtime_error("shm_open failed: " + name);
+  if (create && ftruncate(fd_, size) != 0) {
+    ::close(fd_);
+    shm_unlink(name.c_str());
+    throw std::runtime_error("ftruncate failed");
+  }
+  if (!create) {
+    struct stat st;
+    fstat(fd_, &st);
+    size_ = size = st.st_size;
+  }
+  base_ = static_cast<uint8_t*>(mmap(nullptr, size,
+                                     PROT_READ | PROT_WRITE,
+                                     MAP_SHARED, fd_, 0));
+  if (base_ == MAP_FAILED) {
+    ::close(fd_);
+    throw std::runtime_error("mmap failed");
+  }
+  hdr_ = reinterpret_cast<BuddyHeader*>(base_);
+
+  if (create) {
+    memset(static_cast<void*>(hdr_), 0, sizeof(BuddyHeader));
+    hdr_->data_off = 4096;
+    // largest power-of-two region that fits after the header page
+    uint32_t top = kMinOrder;
+    while ((1ull << (top + 1)) <= size - hdr_->data_off) ++top;
+    hdr_->top_order = top;
+    for (auto& h : hdr_->free_heads) h = kNil;
+    auto* blk = reinterpret_cast<BlockHdr*>(base_ + hdr_->data_off);
+    blk->magic = kMagicFree;
+    blk->order = top;
+    blk->next = kNil;
+    blk->prev = kNil;
+    hdr_->free_heads[top] = 0;
+    hdr_->magic = kMagicUsed;
+  } else if (hdr_->magic != kMagicUsed) {
+    throw std::runtime_error("arena not initialized: " + name);
+  }
+}
+
+ShmArena::~ShmArena() {
+  if (base_ && base_ != MAP_FAILED) munmap(base_, size_);
+  if (fd_ >= 0) ::close(fd_);
+}
+
+void ShmArena::unlink() { shm_unlink(name_.c_str()); }
+
+size_t ShmArena::allocated_bytes() const { return hdr_->allocated; }
+
+namespace {
+struct SpinGuard {
+  std::atomic_flag& f;
+  explicit SpinGuard(std::atomic_flag& fl) : f(fl) {
+    while (f.test_and_set(std::memory_order_acquire)) {
+#if defined(__x86_64__)
+      __builtin_ia32_pause();
+#endif
+    }
+  }
+  ~SpinGuard() { f.clear(std::memory_order_release); }
+};
+}  // namespace
+
+void* ShmArena::alloc(size_t nbytes) {
+  uint32_t want = order_for(nbytes + sizeof(BlockHdr));
+  if (want > hdr_->top_order) return nullptr;
+  SpinGuard g(hdr_->lock);
+
+  auto blk_at = [&](uint64_t off) {
+    return reinterpret_cast<BlockHdr*>(base_ + hdr_->data_off + off);
+  };
+  auto pop_head = [&](uint32_t o) -> uint64_t {
+    uint64_t off = hdr_->free_heads[o];
+    if (off == kNil) return kNil;
+    BlockHdr* b = blk_at(off);
+    hdr_->free_heads[o] = b->next;
+    if (b->next != kNil) blk_at(b->next)->prev = kNil;
+    return off;
+  };
+  auto push_head = [&](uint32_t o, uint64_t off) {
+    BlockHdr* b = blk_at(off);
+    b->magic = kMagicFree;
+    b->order = o;
+    b->prev = kNil;
+    b->next = hdr_->free_heads[o];
+    if (b->next != kNil) blk_at(b->next)->prev = off;
+    hdr_->free_heads[o] = off;
+  };
+
+  // find the smallest order with a free block, splitting downward
+  uint32_t o = want;
+  while (o <= hdr_->top_order && hdr_->free_heads[o] == kNil) ++o;
+  if (o > hdr_->top_order) return nullptr;
+  uint64_t off = pop_head(o);
+  while (o > want) {
+    --o;
+    push_head(o, off ^ (1ull << o));   // give back the upper half
+  }
+  BlockHdr* b = blk_at(off);
+  b->magic = kMagicUsed;
+  b->order = want;
+  hdr_->allocated += (1ull << want);
+  return reinterpret_cast<uint8_t*>(b) + sizeof(BlockHdr);
+}
+
+void ShmArena::free(void* p) {
+  if (p == nullptr) return;
+  auto* b = reinterpret_cast<BlockHdr*>(
+      static_cast<uint8_t*>(p) - sizeof(BlockHdr));
+  if (b->magic != kMagicUsed) throw std::runtime_error("bad free");
+  SpinGuard g(hdr_->lock);
+
+  auto blk_at = [&](uint64_t off) {
+    return reinterpret_cast<BlockHdr*>(base_ + hdr_->data_off + off);
+  };
+  auto unlink_blk = [&](BlockHdr* fb) {
+    if (fb->prev != kNil) blk_at(fb->prev)->next = fb->next;
+    else hdr_->free_heads[fb->order] = fb->next;
+    if (fb->next != kNil) blk_at(fb->next)->prev = fb->prev;
+  };
+
+  uint64_t off = reinterpret_cast<uint8_t*>(b)
+      - (base_ + hdr_->data_off);
+  uint32_t o = b->order;
+  hdr_->allocated -= (1ull << o);
+
+  // coalesce upward while the buddy is free and the same order
+  while (o < hdr_->top_order) {
+    uint64_t buddy = off ^ (1ull << o);
+    BlockHdr* bb = blk_at(buddy);
+    if (bb->magic != kMagicFree || bb->order != o) break;
+    unlink_blk(bb);
+    off = off < buddy ? off : buddy;
+    ++o;
+  }
+  BlockHdr* fb = blk_at(off);
+  fb->magic = kMagicFree;
+  fb->order = o;
+  fb->prev = kNil;
+  fb->next = hdr_->free_heads[o];
+  if (fb->next != kNil) blk_at(fb->next)->prev = off;
+  hdr_->free_heads[o] = off;
+}
+
+ShmBlockHandle ShmArena::handle_of(void* p, size_t size) const {
+  ShmBlockHandle h;
+  memset(&h, 0, sizeof(h));
+  snprintf(h.file_name, sizeof(h.file_name), "%s", name_.c_str());
+  h.offset = static_cast<uint8_t*>(p) - base_;
+  h.size = size;
+  return h;
+}
+
+void* ShmArena::resolve(const ShmBlockHandle& h) const {
+  if (h.offset + h.size > size_) return nullptr;
+  return base_ + h.offset;
+}
+
+int ShmArena::cleanup_orphans(const char* prefix) {
+  DIR* d = opendir("/dev/shm");
+  if (!d) return 0;
+  int removed = 0;
+  struct dirent* e;
+  size_t plen = strlen(prefix);
+  while ((e = readdir(d)) != nullptr) {
+    if (strncmp(e->d_name, prefix, plen) != 0) continue;
+    // name format: <prefix><pid>_<n>; remove if the pid is dead
+    long pid = atol(e->d_name + plen);
+    if (pid > 0 && kill(static_cast<pid_t>(pid), 0) != 0
+        && errno == ESRCH) {
+      std::string path = "/";
+      path += e->d_name;
+      if (shm_unlink(path.c_str()) == 0) ++removed;
+    }
+  }
+  closedir(d);
+  return removed;
+}
+
+}  // namespace shadow_tpu

@@ -1,0 +1,72 @@
+// Shared-memory arena with buddy allocation and serializable handles.
+//
+// The native runtime's equivalent of the reference's shmem subsystem
+// (src/main/shmem/: shmem_allocator.c, buddy.c, shmem_file.c) —
+// redesigned as a C++ arena object rather than a global singleton, so a
+// simulator process can host several independent arenas (one per
+// managed-process pool). Blocks are identified by serializable handles
+// (file name + offset) that cross process boundaries: the simulator
+// allocates, the shim maps the file and resolves offsets.
+//
+// Used by the syscall-interposition IPC (native/ipc/) and, later, the
+// shim preload library.
+
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+namespace shadow_tpu {
+
+// Serialized block handle: enough for any process to find the bytes.
+struct ShmBlockHandle {
+  char file_name[64];
+  uint64_t offset;
+  uint64_t size;
+};
+
+class ShmArena {
+ public:
+  // Creates (create=true) or maps (create=false) a POSIX shared-memory
+  // file of `size` bytes. `name` must start with '/'.
+  ShmArena(const std::string& name, size_t size, bool create);
+  ~ShmArena();
+
+  ShmArena(const ShmArena&) = delete;
+  ShmArena& operator=(const ShmArena&) = delete;
+
+  // Buddy allocation inside the arena. Returns nullptr when exhausted.
+  void* alloc(size_t nbytes);
+  void free(void* p);
+
+  // Handles for cross-process transport.
+  ShmBlockHandle handle_of(void* p, size_t size) const;
+  void* resolve(const ShmBlockHandle& h) const;
+
+  const std::string& name() const { return name_; }
+  uint8_t* base() const { return base_; }
+  size_t size() const { return size_; }
+  size_t allocated_bytes() const;
+
+  // Unlink the backing file (owner side).
+  void unlink();
+
+  // Remove orphaned arenas from crashed runs (shmem_cleanup.c).
+  static int cleanup_orphans(const char* prefix);
+
+ private:
+  std::string name_;
+  uint8_t* base_ = nullptr;
+  size_t size_ = 0;
+  int fd_ = -1;
+  bool owner_ = false;
+
+  // Buddy state lives at the start of the arena so every mapping
+  // process shares it. Guarded by a process-shared mutex word.
+  struct BuddyHeader;
+  BuddyHeader* hdr_ = nullptr;
+};
+
+}  // namespace shadow_tpu

@@ -193,8 +193,15 @@ class Manager:
             host.cpu.update_time(ev.time)
             if host.cpu.is_blocked(ev.time):
                 # defer delivery while the virtual CPU is busy
-                # (event.c:70-87); same seq keeps the total order stable
-                ev.time += host.cpu.delay_until_ready(ev.time)
+                # (event.c:70-87). Deferral times are forced strictly
+                # increasing per host: precision rounding could
+                # otherwise re-order two deferred events whose original
+                # order the (time,dst,src,seq) key had fixed.
+                new_time = ev.time + host.cpu.delay_until_ready(ev.time)
+                floor = getattr(host, "_cpu_defer_floor", -1)
+                new_time = max(new_time, floor + 1)
+                host._cpu_defer_floor = new_time
+                ev.time = new_time
                 self.policy.push(ev, self._barrier)
                 return
         ctx.now = ev.time

@@ -61,6 +61,14 @@ class DeviceRunner:
                 "equivalence testing")
         self.sim = sim
         cfg = sim.cfg
+        if cfg.general.heartbeat_interval:
+            log.warning("tpu policy: per-host heartbeat CSV lines are "
+                        "not yet emitted by the device engine; "
+                        "aggregate stats are still reported")
+        if any(h.pcap_directory for h in sim.hosts):
+            log.warning("tpu policy: pcap capture requires a CPU "
+                        "scheduler policy (packets are device-resident "
+                        "metadata here)")
         apps = [h.app for h in sim.hosts]
         self.app = device_twin(apps, len(sim.hosts))
         self.engine = DeviceEngine(

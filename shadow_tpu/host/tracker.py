@@ -1,11 +1,12 @@
 """Per-host metrics tracker with heartbeat log lines.
 
 Equivalent of src/main/host/tracker.c: accumulates per-interval
-processing counts and per-interface byte/packet counters (with
-header/payload/retransmit splits, tracker.c:12-50), and emits
-`[shadow-heartbeat] [node]` / `[socket]` CSV lines with a one-time
-header row (tracker.c:418-560) so existing shadow log-parsing
-workflows (docs/parsing_shadow_logs.md) carry over.
+processing counts and per-interface byte/packet counters, and emits
+`[shadow-heartbeat] [node]` and `[socket]` CSV lines with one-time
+header rows (tracker.c:418-560) so existing shadow log-parsing
+workflows (docs/parsing_shadow_logs.md) carry over. Socket lines cover
+the host's live TCP connections with send/retransmit segment counts;
+finer header/payload byte splits land with socket-buffer accounting.
 """
 
 from __future__ import annotations
@@ -29,8 +30,8 @@ class Tracker:
     packets_dropped: int = 0
     bytes_sent: int = 0
     bytes_received: int = 0
-    bytes_retransmitted: int = 0
     _last: dict = field(default_factory=dict)
+    _socket_header_logged: bool = False
 
     def on_event(self) -> None:
         self.events += 1
@@ -60,3 +61,23 @@ class Tracker:
                  self.events, self.packets_sent, self.packets_dropped,
                  self.bytes_sent, self.bytes_received)
         self.events = 0
+        self._heartbeat_sockets(now, host)
+
+    def _heartbeat_sockets(self, now: int, host) -> None:
+        """[socket] lines for live TCP connections (tracker.c socket
+        rows)."""
+        if host.net is None or not host.net._conns:
+            return
+        if not self._socket_header_logged:
+            self._socket_header_logged = True
+            log.info("[shadow-heartbeat] [socket-header] "
+                     "time,name,local-port,peer,peer-port,state,"
+                     "segments-sent,segments-retransmitted,"
+                     "bytes-received")
+        for (lport, peer, pport), sock in sorted(host.net._conns.items()):
+            log.info("[shadow-heartbeat] [socket] %d,%s,%d,%d,%d,%s,"
+                     "%d,%d,%d",
+                     now // simtime.SIMTIME_ONE_SECOND, self.host_name,
+                     lport, peer, pport, sock.state.name,
+                     sock.segments_sent, sock.segments_retransmitted,
+                     sock.bytes_received)

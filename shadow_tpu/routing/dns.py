@@ -49,13 +49,24 @@ class Dns:
 
     def register(self, host_id: int, name: str,
                  requested_ip: Optional[str] = None) -> Address:
+        from shadow_tpu.utils.slog import get_logger
+        log = get_logger("dns")
+
         if name in self._by_name:
             raise ValueError(f"duplicate host name {name!r}")
         ip = None
         if requested_ip:
-            cand = ip_to_int(requested_ip)
+            try:
+                cand = ip_to_int(requested_ip)
+            except Exception:
+                raise ValueError(
+                    f"host {name!r}: invalid ip_address_hint "
+                    f"{requested_ip!r}") from None
             if not _is_reserved(cand) and cand not in self._by_ip:
                 ip = cand
+            else:
+                log.warning("host %s: requested IP %s is reserved or "
+                            "taken; auto-assigning", name, requested_ip)
         if ip is None:
             ip = self._alloc_ip()
         addr = Address(host_id=host_id, name=name, ip=ip)

@@ -11,20 +11,23 @@ defaults (host.c:170-183).
 
 from __future__ import annotations
 
-import ipaddress
 from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
 
+from shadow_tpu.routing.address import ip_to_int
 from shadow_tpu.topology.graph import Topology
 from shadow_tpu.utils.rng import SeededRandom
 
 
 def _ip_to_int(ip: str) -> Optional[int]:
+    """Lenient variant of routing.address.ip_to_int: vertex/hint IPs in
+    GML files may be malformed; an unparsable IP just disables
+    prefix-matching for that vertex."""
     try:
-        return int(ipaddress.IPv4Address(ip))
-    except (ipaddress.AddressValueError, ValueError):
+        return ip_to_int(ip)
+    except Exception:
         return None
 
 

@@ -113,10 +113,18 @@ def test_pcap_capture(tmp_path):
     assert len(data) > 24 + 16      # header + at least one record
 
 
+def test_phold_cpuload_slows_simulation():
+    base = load_config_str(PHOLD_CPU_YAML)
+    loaded = load_config_str(
+        PHOLD_CPU_YAML.replace("msgload=1", "msgload=1 cpuload=100"))
+    s_base = Controller(base).run()
+    s_load = Controller(loaded).run()
+    # 100ms of virtual CPU per received message throttles the event
+    # rate well below the unloaded run
+    assert s_load.events_executed < s_base.events_executed / 2
+
+
 def test_cpu_load_delays_events():
-    yaml = PHOLD_CPU_YAML.replace("msgload=1", "msgload=1 cpuload=1")
-    # without app support for cpuload this is a no-op; drive consume_cpu
-    # directly through a tiny custom app instead
     from shadow_tpu.models import register_model
     from shadow_tpu.models.base import ModelApp
 

@@ -1,0 +1,84 @@
+"""Python-side tests of the native runtime (shmem arena + IPC)."""
+
+import multiprocessing as mp
+import os
+import shutil
+import subprocess
+
+import pytest
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+def test_cpp_unit_tests_pass():
+    native_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    r = subprocess.run(["make", "-C", native_dir, "test"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ALL NATIVE TESTS PASSED" in r.stdout
+
+
+def test_arena_roundtrip():
+    from shadow_tpu import native
+    name = f"/shadowtpu_shm_{os.getpid()}_t1"
+    a = native.ShmArena(name, 1 << 20)
+    try:
+        p1 = a.alloc(1000)
+        p2 = a.alloc(5000)
+        assert a.allocated > 0
+        off = a.offset_of(p2)
+        assert a.at_offset(off) == p2
+        a.free(p1)
+        a.free(p2)
+        assert a.allocated == 0
+        with pytest.raises(MemoryError):
+            a.alloc(1 << 30)
+    finally:
+        a.unlink()
+        a.close()
+
+
+def _plugin_side(name: str, off: int) -> None:
+    from shadow_tpu import native
+    arena = native.ShmArena(name, create=False)
+    ch = native.IpcChannel(arena, ptr=arena.at_offset(off))
+    m = ch.recv_from_simulator()
+    assert m.kind == native.IPC_START
+    for i in range(100):
+        req = native.IpcMessage(kind=native.IPC_SYSCALL, number=39)
+        req.args[0] = i
+        ch.send_to_simulator(req)
+        r = ch.recv_from_simulator()
+        assert r.kind == native.IPC_SYSCALL_DONE
+        assert r.number == i * 3
+    ch.mark_plugin_exited()
+
+
+def test_cross_process_ipc():
+    from shadow_tpu import native
+    name = f"/shadowtpu_shm_{os.getpid()}_t2"
+    arena = native.ShmArena(name, 1 << 20)
+    try:
+        ch = native.IpcChannel(arena)
+        proc = mp.get_context("spawn").Process(
+            target=_plugin_side, args=(name, ch.offset))
+        proc.start()
+        ch.send_to_plugin(native.IpcMessage(kind=native.IPC_START))
+        handled = 0
+        while True:
+            m = ch.recv_from_plugin()
+            if m is None:
+                break
+            assert m.kind == native.IPC_SYSCALL
+            resp = native.IpcMessage(kind=native.IPC_SYSCALL_DONE,
+                                     number=int(m.args[0]) * 3)
+            ch.send_to_plugin(resp)
+            handled += 1
+        proc.join(timeout=30)
+        assert proc.exitcode == 0
+        assert handled == 100
+    finally:
+        arena.unlink()
+        arena.close()
