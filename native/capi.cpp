@@ -14,6 +14,15 @@ using shadow_tpu::IpcMessage;
 using shadow_tpu::ShmArena;
 using shadow_tpu::ShmBlockHandle;
 
+// Pin the channel ABI that the shim (native/shim/shim.c) mirrors with
+// plain C structs; a layout drift here must fail the build, not the
+// plugin at runtime.
+static_assert(sizeof(IpcMessage) == 128, "ipc message abi");
+static_assert(sizeof(IpcChannel) == 280, "ipc channel abi");
+static_assert(offsetof(IpcChannel, plugin_exited) == 16, "ipc abi");
+static_assert(offsetof(IpcChannel, msg_to_plugin) == 24, "ipc abi");
+static_assert(offsetof(IpcChannel, msg_to_simulator) == 152, "ipc abi");
+
 extern "C" {
 
 void* shadowtpu_arena_create(const char* name, uint64_t size) {
@@ -80,6 +89,12 @@ void shadowtpu_ipc_send_to_plugin(void* ch, const IpcMessage* m) {
 
 int shadowtpu_ipc_recv_from_plugin(void* ch, IpcMessage* out) {
   return static_cast<IpcChannel*>(ch)->recv_from_plugin(out) ? 1 : 0;
+}
+
+int shadowtpu_ipc_recv_from_plugin_timed(void* ch, IpcMessage* out,
+                                         uint32_t timeout_ms) {
+  return static_cast<IpcChannel*>(ch)->recv_from_plugin_timed(
+      out, timeout_ms);
 }
 
 void shadowtpu_ipc_send_to_simulator(void* ch, const IpcMessage* m) {

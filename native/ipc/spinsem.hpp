@@ -16,6 +16,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 #include <linux/futex.h>
 #include <sys/syscall.h>
 #include <unistd.h>
@@ -59,6 +60,40 @@ struct SpinSem {
       }
       // sleep until posted (value != 0), then loop to claim it
       futex_call(&value, FUTEX_WAIT, 0);
+    }
+  }
+
+  // Timed variant: 1 = acquired, 0 = abort_flag set, -1 = timed out.
+  int wait_timed(const std::atomic<uint32_t>* abort_flag,
+                 uint32_t timeout_ms) {
+    timespec start;
+    clock_gettime(CLOCK_MONOTONIC, &start);
+    for (;;) {
+      for (uint32_t i = 0; i < spin_max; ++i) {
+        uint32_t one = 1;
+        if (value.compare_exchange_weak(one, 0,
+                                        std::memory_order_acquire))
+          return 1;
+        if (abort_flag &&
+            abort_flag->load(std::memory_order_relaxed))
+          return 0;
+#if defined(__x86_64__)
+        __builtin_ia32_pause();
+#endif
+      }
+      timespec now;
+      clock_gettime(CLOCK_MONOTONIC, &now);
+      uint64_t elapsed_ms =
+          (uint64_t)(now.tv_sec - start.tv_sec) * 1000 +
+          (now.tv_nsec - start.tv_nsec) / 1000000;
+      if (elapsed_ms >= timeout_ms) return -1;
+      // sleep in short slices so abort/timeout stay responsive
+      uint64_t slice = timeout_ms - elapsed_ms;
+      if (slice > 100) slice = 100;
+      timespec ts{(time_t)(slice / 1000),
+                  (long)((slice % 1000) * 1000000)};
+      syscall(SYS_futex, reinterpret_cast<uint32_t*>(&value),
+              FUTEX_WAIT, 0, &ts, nullptr, 0);
     }
   }
 };
@@ -109,6 +144,12 @@ struct IpcChannel {
     if (!to_simulator.wait(&plugin_exited)) return false;
     *out = msg_to_simulator;
     return true;
+  }
+  // 1 = message received, 0 = plugin exited, -1 = timed out
+  int recv_from_plugin_timed(IpcMessage* out, uint32_t timeout_ms) {
+    int r = to_simulator.wait_timed(&plugin_exited, timeout_ms);
+    if (r == 1) *out = msg_to_simulator;
+    return r;
   }
 
   // plugin side

@@ -10,6 +10,7 @@ Manager(s) for the earliest next event between rounds.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -55,6 +56,7 @@ class BuiltSimulation:
     starts: list[tuple[int, int, int]]   # (host_id, start, stop|-1)
     lookahead: int
     dns: object = None
+    runtime: object = None               # ManagedRuntime if real procs
 
 
 def build(cfg: ConfigOptions) -> BuiltSimulation:
@@ -68,6 +70,7 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
 
     hosts: list[Host] = []
     starts: list[tuple[int, int, int]] = []
+    runtime = None
     n_total = cfg.total_hosts()
     for group in cfg.hosts:
         for i in range(group.quantity):
@@ -92,17 +95,37 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
             host.ip = host.address.ip_str
             for proc in group.processes:
                 for _ in range(proc.quantity):
-                    if not is_model_path(proc.path):
-                        raise ValueError(
-                            f"process path {proc.path!r}: real-executable "
-                            "processes need the native runtime "
-                            "(interpose_method preload/ptrace)")
                     if host.app is not None:
                         raise ValueError(
                             f"host {name}: multiple processes per host "
-                            "not yet supported by the model runtime")
-                    host.app = make_app(proc.path, proc.args, host_id,
-                                        n_total)
+                            "not yet supported")
+                    if is_model_path(proc.path):
+                        host.app = make_app(proc.path, proc.args,
+                                            host_id, n_total)
+                    else:
+                        # real executable under syscall interposition
+                        import shutil
+
+                        from shadow_tpu.host.process import (
+                            ManagedProcess,
+                            ManagedRuntime,
+                        )
+                        if runtime is None:
+                            runtime = ManagedRuntime(
+                                dns, cfg.general.data_directory,
+                                cfg.general.seed,
+                                spin_max=cfg.experimental
+                                .preload_spin_max)
+                        path = proc.path
+                        if "/" not in path:
+                            path = shutil.which(path) or path
+                        path = os.path.abspath(path)
+                        if not os.path.exists(path):
+                            raise ValueError(
+                                f"process executable not found: "
+                                f"{proc.path!r}")
+                        host.app = ManagedProcess(
+                            runtime, path, proc.args, proc.environment)
                     starts.append((host_id, proc.start_time,
                                    proc.stop_time
                                    if proc.stop_time is not None else -1))
@@ -117,9 +140,15 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
     lookahead = (cfg.experimental.runahead
                  if cfg.experimental.runahead is not None
                  else topology.min_latency_ns)
+    if runtime is not None:
+        # managed processes resolve names against this file
+        # (dns.c's /etc/hosts-style emission)
+        os.makedirs(cfg.general.data_directory, exist_ok=True)
+        dns.write_hosts_file(os.path.join(cfg.general.data_directory,
+                                          "etc_hosts"))
     return BuiltSimulation(cfg=cfg, topology=topology, hosts=hosts,
                            netmodel=netmodel, starts=starts,
-                           lookahead=lookahead, dns=dns)
+                           lookahead=lookahead, dns=dns, runtime=runtime)
 
 
 class Controller:
@@ -170,6 +199,15 @@ class Controller:
             window_end = min(next_time + lookahead, stop)
             next_time = m.run_window(next_time, window_end)
 
+        if self.sim.runtime is not None:
+            # kill surviving managed processes, release the arena
+            ctx = m._ctx
+            ctx.now = stop
+            for h in m.hosts:
+                if h.app is not None and hasattr(h.app, "on_sim_end"):
+                    ctx.host = h
+                    h.app.on_sim_end(ctx)
+            self.sim.runtime.close()
         m.finalize()
         m.stats.end_time = stop
         return m.stats

@@ -88,6 +88,9 @@ class Manager:
 
         self.rng_key = nprng.seed_key(self.seed)
         self._name_to_id = {h.name: h.host_id for h in self.hosts}
+        # out-of-band TCP payload streams for managed processes,
+        # keyed (src_host, src_port, dst_host, dst_port)
+        self._streams: dict[tuple, object] = {}
         self._barrier = simtime.SIMTIME_INVALID
         self._trace_lock = threading.Lock()
         self._worker_stats: list[SimStats] = []
@@ -104,6 +107,14 @@ class Manager:
         if name not in self._name_to_id:
             raise KeyError(f"unknown host name {name!r}")
         return self._name_to_id[name]
+
+    def stream_channel(self, key: tuple):
+        """Byte channel for one TCP direction (host/descriptors.py)."""
+        ch = self._streams.get(key)
+        if ch is None:
+            from shadow_tpu.host.descriptors import StreamChannel
+            ch = self._streams[key] = StreamChannel()
+        return ch
 
     def push_event(self, ev: Event) -> None:
         self.policy.push(ev, self._barrier)

@@ -1,0 +1,139 @@
+"""Plugin-memory access: the MemoryManager equivalent.
+
+The reference reaches into managed-process address spaces two ways:
+a MemoryCopier over process_vm_readv/writev and a MemoryMapper that
+remaps the plugin heap into Shadow (src/main/host/memory_manager/
+mod.rs:1-17, memory_copier.rs). This is the copier path — sufficient
+because syscall arguments here are small (sockaddrs, timespecs,
+iovecs) or bounded buffers; a shared-memory mapper is a later
+optimization. Works on direct children without privileges (Yama
+ptrace_scope 1 allows parent->child).
+
+Also holds the struct codecs for the kernel ABI types the syscall
+handler marshals (sockaddr_in, timespec, epoll_event, pollfd, iovec,
+utsname) — the kernel_types.h analogue.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+import struct
+
+_libc = ctypes.CDLL(None, use_errno=True)
+
+
+class _IoVec(ctypes.Structure):
+    _fields_ = [("iov_base", ctypes.c_void_p),
+                ("iov_len", ctypes.c_size_t)]
+
+
+def _vm_op(fn, pid: int, local_buf, remote_addr: int, n: int) -> int:
+    local = _IoVec(ctypes.cast(local_buf, ctypes.c_void_p), n)
+    remote = _IoVec(ctypes.c_void_p(remote_addr), n)
+    got = fn(pid, ctypes.byref(local), 1, ctypes.byref(remote), 1, 0)
+    if got < 0:
+        err = ctypes.get_errno()
+        raise OSError(err, os.strerror(err))
+    return got
+
+
+class ProcessMemory:
+    """Read/write a live child process's memory by address."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+
+    def read(self, addr: int, n: int) -> bytes:
+        if n == 0:
+            return b""
+        buf = ctypes.create_string_buffer(n)
+        got = _vm_op(_libc.process_vm_readv, self.pid, buf, addr, n)
+        return buf.raw[:got]
+
+    def write(self, addr: int, data: bytes) -> int:
+        if not data:
+            return 0
+        buf = ctypes.create_string_buffer(data, len(data))
+        return _vm_op(_libc.process_vm_writev, self.pid, buf, addr,
+                      len(data))
+
+    def read_cstr(self, addr: int, max_len: int = 4096) -> bytes:
+        """Read a NUL-terminated string (page-sized probes)."""
+        out = b""
+        while len(out) < max_len:
+            chunk = min(256, max_len - len(out))
+            data = self.read(addr + len(out), chunk)
+            if b"\0" in data:
+                return out + data[: data.index(b"\0")]
+            out += data
+        return out
+
+
+# ---- kernel ABI codecs (host/syscall/kernel_types.h analogue) -------
+
+AF_INET = 2
+
+SOCKADDR_IN = struct.Struct("<HH4s8x")        # family, port(BE), addr
+
+
+def pack_sockaddr_in(ip_be: bytes, port: int) -> bytes:
+    return SOCKADDR_IN.pack(AF_INET, ((port & 0xFF) << 8) | (port >> 8),
+                            ip_be)
+
+
+def unpack_sockaddr_in(data: bytes) -> tuple[int, int, bytes]:
+    """-> (family, host-order port, 4-byte BE ip)."""
+    if len(data) < 8:
+        raise ValueError("short sockaddr")
+    family, port_be = struct.unpack_from("<HH", data)
+    ip = data[4:8]
+    port = ((port_be & 0xFF) << 8) | (port_be >> 8)
+    return family, port, ip
+
+
+TIMESPEC = struct.Struct("<qq")               # tv_sec, tv_nsec
+TIMEVAL = struct.Struct("<qq")                # tv_sec, tv_usec
+
+
+def pack_timespec(ns: int) -> bytes:
+    return TIMESPEC.pack(ns // 1_000_000_000, ns % 1_000_000_000)
+
+
+def unpack_timespec(data: bytes) -> int:
+    sec, nsec = TIMESPEC.unpack_from(data)
+    return sec * 1_000_000_000 + nsec
+
+
+def pack_timeval(ns: int) -> bytes:
+    return TIMEVAL.pack(ns // 1_000_000_000, (ns % 1_000_000_000) // 1000)
+
+
+# epoll_event on x86_64 is packed: u32 events, u64 data
+EPOLL_EVENT = struct.Struct("<IQ")
+EPOLL_EVENT_SIZE = 12
+
+POLLFD = struct.Struct("<ihh")                # fd, events, revents
+
+IOVEC = struct.Struct("<QQ")                  # base, len
+
+
+def read_iovec(mem: ProcessMemory, iov_addr: int,
+               iovcnt: int) -> list[tuple[int, int]]:
+    if iovcnt <= 0 or iovcnt > 1024:
+        return []
+    raw = mem.read(iov_addr, IOVEC.size * iovcnt)
+    return [IOVEC.unpack_from(raw, i * IOVEC.size) for i in range(iovcnt)]
+
+
+UTSNAME_FIELD = 65
+
+
+def pack_utsname(nodename: str) -> bytes:
+    def f(s: str) -> bytes:
+        b = s.encode()[: UTSNAME_FIELD - 1]
+        return b + b"\0" * (UTSNAME_FIELD - len(b))
+
+    return (f("Linux") + f(nodename) + f("5.15.0-shadowtpu")
+            + f("#1 SMP shadow_tpu simulated") + f("x86_64") + f(""))

@@ -1,0 +1,322 @@
+"""Managed (real) process execution.
+
+The rebuild of the reference's process/thread layer for the preload
+interposition path (src/main/host/process.c:457-651 `_process_start` /
+`process_continue`, thread_preload.c's shim-IPC event loop,
+manager.c:386-505 LD_PRELOAD environment construction): a real Linux
+executable is spawned with the shim library preloaded, its stdio
+redirected into the host's data directory, ASLR disabled for
+determinism (main.c:287), and then driven in strict ping-pong over the
+shared-memory spinning-semaphore IPC channel:
+
+    event fires -> resume plugin -> service trapped syscalls until the
+    plugin blocks (park on a Condition) or exits -> return to the
+    event loop.
+
+Every emulated syscall executes at the host's current simulated
+instant; blocking syscalls park on descriptor readiness and/or timer
+deadlines, whose wakeups schedule a continue event — exactly the
+SysCallCondition -> process_continue chain of the reference.
+
+Plugin exits are noticed by a per-process reaper thread (the
+ChildPidWatcher analogue, childpid_watcher.rs) that trips the
+channel's plugin-exited flag so a blocked recv returns immediately.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import threading
+from typing import Optional
+
+from shadow_tpu import native
+from shadow_tpu.core.event import Event, KIND_TASK
+from shadow_tpu.host.descriptors import Condition, DescriptorTable
+from shadow_tpu.host.memory import ProcessMemory
+from shadow_tpu.host.syscalls import NATIVE, Blocked, NR_NAME, SyscallHandler
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("process")
+
+# wall-clock patience for a plugin that neither syscalls nor exits
+# (a real-CPU-bound plugin phase); generous because simulator and
+# plugin never run concurrently
+RECV_TIMEOUT_MS = 120_000
+
+
+class ManagedRuntime:
+    """Per-simulation services shared by all managed processes: the
+    shmem arena the IPC channels live in, the shim library path, and
+    the DNS view. Created lazily by the Controller when a config names
+    a real executable."""
+
+    def __init__(self, dns, data_dir: str, seed: int,
+                 spin_max: int = 8096):
+        self.dns = dns
+        self.data_dir = data_dir
+        self.spin_max = spin_max
+        self.shim_path = native.shim_path()
+        name = f"shadowtpu_shm_{os.getpid()}_{seed}"
+        self.arena = native.ShmArena(name, size=1 << 22, create=True)
+        self._closed = False
+
+    def resolve_ip(self, ip_int: int) -> Optional[int]:
+        addr = self.dns.resolve_ip(ip_int)
+        return addr.host_id if addr is not None else None
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self.arena.unlink()
+            self.arena.close()
+
+
+class ManagedProcess:
+    """One real executable on one simulated host (app-interface
+    compatible with the model runtime: boot / on_stop hooks)."""
+
+    _next_vpid = [1000]
+
+    def __init__(self, runtime: ManagedRuntime, path: str, args,
+                 environment: str = ""):
+        self.runtime = runtime
+        self.path = path
+        if isinstance(args, str):
+            self.args = shlex.split(args)
+        elif isinstance(args, (list, tuple)):
+            self.args = [str(x) for x in args]
+        elif args is None:
+            self.args = []
+        else:
+            self.args = [str(args)]        # YAML scalar (e.g. a port)
+        self.environment = environment
+        self.vpid = ManagedProcess._next_vpid[0]
+        ManagedProcess._next_vpid[0] += 1
+
+        self.host = None
+        self.manager = None
+        self.proc = None                  # subprocess.Popen
+        self.mem: Optional[ProcessMemory] = None
+        self.table: Optional[DescriptorTable] = None
+        self.handler: Optional[SyscallHandler] = None
+        self.channel: Optional[native.IpcChannel] = None
+        self.alive = False
+        self.exiting = False
+        self.exit_code: Optional[int] = None
+        self.parked: Optional[tuple] = None     # (nr, args)
+        self.syscall_state: dict = {}
+        self._reaper: Optional[threading.Thread] = None
+        self._rng_counter = 0
+        self.syscall_counts: dict[str, int] = {}
+
+    # -- app interface -------------------------------------------------
+    def boot(self, ctx) -> None:
+        import subprocess
+
+        self.host = ctx.host
+        self.manager = ctx._m
+        self.mem = None
+        self.table = DescriptorTable(self.manager)
+        self.handler = SyscallHandler(self)
+        self.channel = native.IpcChannel(self.runtime.arena,
+                                         spin_max=self.runtime.spin_max)
+
+        host_dir = os.path.join(self.runtime.data_dir, "hosts",
+                                self.host.name)
+        os.makedirs(host_dir, exist_ok=True)
+        base = os.path.basename(self.path)
+        stdout_f = open(os.path.join(host_dir, f"{base}.{self.vpid}"
+                                     ".stdout"), "wb")
+        stderr_f = open(os.path.join(host_dir, f"{base}.{self.vpid}"
+                                     ".stderr"), "wb")
+
+        env = {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": host_dir,
+            "SHADOWTPU_SHM": self.runtime.arena.name,
+            "SHADOWTPU_IPC_OFFSET": str(self.channel.offset),
+            "LD_PRELOAD": self.runtime.shim_path,
+        }
+        for kv in self.environment.split(";"):
+            kv = kv.strip()
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                env[k] = v
+
+        # determinism: disable ASLR in the child (main.c:287). Using a
+        # setarch wrapper (not preexec_fn) keeps subprocess on the
+        # fork-free posix_spawn path — safe alongside JAX's threads.
+        import shutil
+        argv = [self.path] + self.args
+        setarch = shutil.which("setarch")
+        if setarch:
+            argv = [setarch, "--addr-no-randomize"] + argv
+        self.proc = subprocess.Popen(
+            argv, env=env, cwd=host_dir, stdout=stdout_f,
+            stderr=stderr_f, stdin=subprocess.DEVNULL)
+        stdout_f.close()
+        stderr_f.close()
+        self.mem = ProcessMemory(self.proc.pid)
+        self.alive = True
+        log.debug("spawned %s pid=%d vpid=%d on %s", self.path,
+                  self.proc.pid, self.vpid, self.host.name)
+
+        ch = self.channel
+        proc = self.proc
+
+        def reap():
+            proc.wait()
+            ch.mark_plugin_exited()
+
+        self._reaper = threading.Thread(target=reap, daemon=True)
+        self._reaper.start()
+        self._continue(ctx)
+
+    def on_stop(self, ctx) -> None:
+        self._kill(ctx)
+
+    def on_sim_end(self, ctx) -> None:
+        self._kill(ctx)
+
+    def on_timer(self, ctx, data) -> None:     # unused; timerfds use tasks
+        pass
+
+    def on_packet(self, ctx, src, size, data) -> None:
+        pass
+
+    # -- deterministic service providers -------------------------------
+    def resolve_ip(self, ip_int: int) -> Optional[int]:
+        return self.runtime.resolve_ip(ip_int)
+
+    def deterministic_bytes(self, n: int) -> bytes:
+        """getrandom bytes from the host's seeded stream (the
+        determinism role of the openssl_preload RNG override)."""
+        return self.host.rng.np_rng().bytes(n)
+
+    def begin_exit(self, code: int) -> None:
+        self.exiting = True
+        self.exit_code = code
+
+    # -- timers ---------------------------------------------------------
+    def _push_task(self, when: int, task) -> None:
+        h = self.host
+        self.manager.push_event(Event(
+            time=when, dst_host=h.host_id, src_host=h.host_id,
+            seq=h.next_event_seq(), kind=KIND_TASK, task=task))
+
+    def arm_timerfd(self, ctx, desc, when: int, gen: int) -> None:
+        def task(ctx2, ev):
+            if gen != desc.generation or desc.closed:
+                return
+            desc.expirations += 1
+            if desc.interval_ns > 0:
+                desc.next_expiry = ev.time + desc.interval_ns
+                self.arm_timerfd(ctx2, desc, desc.next_expiry, gen)
+            else:
+                desc.next_expiry = None
+            desc.notify(ctx2)
+
+        self._push_task(max(when, ctx.now), task)
+
+    # -- park / resume (syscall_condition.c semantics) ------------------
+    def schedule_continue(self, ctx) -> None:
+        self._push_task(ctx.now, self._resume_task)
+
+    def _park(self, ctx, b: Blocked, nr: int, args) -> None:
+        self.parked = (nr, args)
+        cond = Condition(self)
+        for d in b.descs:
+            cond.attach(d)
+        if b.deadline is not None:
+            def timeout_task(ctx2, ev):
+                cond.wake(ctx2)
+
+            self._push_task(max(b.deadline, ctx.now), timeout_task)
+
+    def _resume_task(self, ctx, ev) -> None:
+        if not self.alive or self.parked is None:
+            return
+        nr, args = self.parked
+        self.parked = None
+        try:
+            res = self.handler.dispatch(ctx, nr, args)
+        except Blocked as b:
+            self._park(ctx, b, nr, args)
+            return
+        except Exception:
+            log.exception("resumed syscall %s(%s) handler crashed",
+                          NR_NAME.get(nr, nr), args)
+            res = -38              # ENOSYS
+        self._reply(res, nr, args)
+        self.syscall_state = {}
+        self._continue(ctx)
+
+    # -- the IPC ping-pong loop (thread_preload.c event loop) -----------
+    def _reply(self, res, nr: int, args) -> None:
+        msg = native.IpcMessage()
+        if res is NATIVE:
+            msg.kind = native.IPC_SYSCALL_NATIVE
+            msg.number = 0
+        else:
+            msg.kind = native.IPC_SYSCALL_DONE
+            msg.number = int(res)
+        self.channel.send_to_plugin(msg)
+
+    def _continue(self, ctx) -> None:
+        """Service plugin syscalls until it blocks or exits."""
+        while True:
+            status, msg = self.channel.recv_from_plugin_timed(
+                RECV_TIMEOUT_MS)
+            if status == 0:            # plugin exited
+                self._finalize_exit(ctx)
+                return
+            if status == -1:           # wall-clock stall
+                log.warning("%s pid=%s unresponsive for %ds; killing",
+                            self.path, self.proc.pid,
+                            RECV_TIMEOUT_MS // 1000)
+                self._kill(ctx)
+                return
+            if msg.kind != native.IPC_SYSCALL:
+                log.warning("unexpected ipc kind %d", msg.kind)
+                continue
+            nr = int(msg.number)
+            args = tuple(int(msg.args[i]) for i in range(6))
+            name = NR_NAME.get(nr, str(nr))
+            self.syscall_counts[name] = self.syscall_counts.get(name,
+                                                                0) + 1
+            try:
+                res = self.handler.dispatch(ctx, nr, args)
+            except Blocked as b:
+                self._park(ctx, b, nr, args)
+                return
+            except Exception:
+                log.exception("syscall %s(%s) handler crashed", name,
+                              args)
+                res = -38              # ENOSYS
+            self._reply(res, nr, args)
+            self.syscall_state = {}
+
+    # -- teardown -------------------------------------------------------
+    def _finalize_exit(self, ctx) -> None:
+        if not self.alive:
+            return
+        self.alive = False
+        self._reaper.join(timeout=10)
+        rc = self.proc.returncode
+        if self.exit_code is None and rc is not None:
+            self.exit_code = rc
+        log.debug("%s on %s exited code=%s (%d syscalls)", self.path,
+                  self.host.name, self.exit_code,
+                  sum(self.syscall_counts.values()))
+        if self.table is not None:
+            self.table.close_all(ctx)
+
+    def _kill(self, ctx) -> None:
+        if not self.alive or self.proc is None:
+            return
+        try:
+            self.proc.kill()
+        except ProcessLookupError:
+            pass
+        self._finalize_exit(ctx)

@@ -1,0 +1,1138 @@
+"""Syscall emulation for managed (real) processes.
+
+The rebuild of the reference's syscall dispatch (src/main/host/
+syscall_handler.c:247-533 and the per-area handlers in host/syscall/:
+socket.c, epoll.c, poll.c, time.c, unistd.c, uio.c, fcntl.c, ioctl.c),
+re-targeted at the virtual-descriptor layer (host/descriptors.py) and
+the in-simulator network stack. Conventions:
+
+* Handlers return the kernel ABI result: >= 0 on success, -errno on
+  failure. Returning the NATIVE sentinel tells the shim to execute the
+  syscall for real through its raw-syscall escape.
+* A handler that must wait raises `Blocked(descs, deadline)`; the
+  process parks the syscall on a Condition (syscall_condition.c) and
+  the handler is re-entered from scratch when it fires — restart
+  semantics, so handlers keep per-invocation progress in
+  `process.syscall_state` (cleared when the syscall finally replies).
+* Time is simulated: clocks read the host's event clock (+ the
+  2000-01-01 EMULATED_TIME_OFFSET for wall clocks, definitions.h:79);
+  sleeps and timeouts park on timer events, which is what advances
+  the simulation.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from shadow_tpu import simtime
+from shadow_tpu.host import memory as kmem
+from shadow_tpu.host.descriptors import (
+    EPOLLERR,
+    EPOLLIN,
+    EPOLLOUT,
+    ERR,
+    EpollDesc,
+    EventfdDesc,
+    PipeDesc,
+    R,
+    TcpDesc,
+    TcpListenDesc,
+    TimerfdDesc,
+    UdpDesc,
+    VFD_BASE,
+    W,
+)
+
+# ---- x86_64 syscall numbers ----------------------------------------
+
+NR = dict(
+    read=0, write=1, close=3, fstat=5, poll=7, lseek=8, ioctl=16,
+    pread64=17, pwrite64=18, readv=19, writev=20, pipe=22, select=23,
+    dup=32, dup2=33, nanosleep=35, getitimer=36, alarm=37, setitimer=38,
+    getpid=39, socket=41, connect=42, accept=43, sendto=44, recvfrom=45,
+    sendmsg=46, recvmsg=47, shutdown=48, bind=49, listen=50,
+    getsockname=51, getpeername=52, socketpair=53, setsockopt=54,
+    getsockopt=55, clone=56, fork=57, vfork=58, exit=60, uname=63,
+    fcntl=72, gettimeofday=96, getppid=110, time=201, epoll_create=213,
+    clock_gettime=228, clock_nanosleep=230, exit_group=231,
+    epoll_wait=232, epoll_ctl=233, pselect6=270, ppoll=271,
+    epoll_pwait=281, timerfd_create=283, eventfd=284,
+    timerfd_settime=286, timerfd_gettime=287, accept4=288, eventfd2=290,
+    epoll_create1=291, dup3=292, pipe2=293, recvmmsg=299, sendmmsg=307,
+    getrandom=318, newfstatat=262, statx=332,
+)
+NR_NAME = {v: k for k, v in NR.items()}
+
+# errno
+EPERM, ENOENT, EINTR, EBADF, EAGAIN, EFAULT, EINVAL = 1, 2, 4, 9, 11, 14, 22
+ENOTTY, ESPIPE, EPIPE, ENOSYS, ENOTSOCK, EDESTADDRREQ = 25, 29, 32, 38, 88, 89
+EMSGSIZE, ENOPROTOOPT, EPROTONOSUPPORT, EOPNOTSUPP, EAFNOSUPPORT = \
+    90, 92, 93, 95, 97
+EADDRINUSE, ENETUNREACH, ECONNRESET, EISCONN, ENOTCONN = 98, 101, 104, 106, 107
+ETIMEDOUT, ECONNREFUSED, EINPROGRESS, EALREADY = 110, 111, 115, 114
+
+# socket constants
+AF_INET, AF_UNIX = 2, 1
+SOCK_STREAM, SOCK_DGRAM = 1, 2
+SOCK_NONBLOCK, SOCK_CLOEXEC = 0x800, 0x80000
+SOL_SOCKET, SOL_TCP = 1, 6
+SO_ERROR, SO_TYPE, SO_SNDBUF, SO_RCVBUF, SO_ACCEPTCONN = 4, 3, 7, 8, 30
+MSG_DONTWAIT, MSG_PEEK = 0x40, 0x02
+SHUT_RD, SHUT_WR, SHUT_RDWR = 0, 1, 2
+O_NONBLOCK, O_RDWR = 0x800, 0x2
+F_DUPFD, F_GETFD, F_SETFD, F_GETFL, F_SETFL, F_DUPFD_CLOEXEC = \
+    0, 1, 2, 3, 4, 1030
+FIONREAD, FIONBIO = 0x541B, 0x5421
+EPOLL_CTL_ADD, EPOLL_CTL_DEL, EPOLL_CTL_MOD = 1, 2, 3
+CLOCK_REALTIME, CLOCK_MONOTONIC = 0, 1
+TFD_TIMER_ABSTIME = 1
+EFD_SEMAPHORE, EFD_NONBLOCK = 1, 0x800
+
+UDP_MAX_PAYLOAD = simtime.CONFIG_MTU - simtime.CONFIG_HEADER_SIZE_UDPIPETH
+
+NATIVE = object()          # sentinel: shim executes the syscall for real
+
+
+class Blocked(Exception):
+    """Raised by a handler that must wait (SYSCALL_BLOCK analogue)."""
+
+    def __init__(self, descs=(), deadline: Optional[int] = None):
+        super().__init__("blocked")
+        self.descs = list(descs)
+        self.deadline = deadline
+
+
+def _s32(v: int) -> int:
+    """Syscall args arrive as u64; recover signed 32-bit values."""
+    v &= 0xFFFFFFFF
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
+def _s64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+class SyscallHandler:
+    def __init__(self, process):
+        self.p = process
+
+    # -- helpers -------------------------------------------------------
+    @property
+    def mem(self) -> kmem.ProcessMemory:
+        return self.p.mem
+
+    @property
+    def table(self):
+        return self.p.table
+
+    @property
+    def state(self) -> dict:
+        return self.p.syscall_state
+
+    def _desc(self, fd: int):
+        d = self.table.get(fd)
+        if d is None or d.closed:
+            return None
+        return d
+
+    def _self_ip_be(self) -> bytes:
+        return struct.pack(">I", self.p.host.address.ip)
+
+    def _write_sockaddr(self, addr_ptr: int, len_ptr: int, ip_be: bytes,
+                        port: int) -> None:
+        if not addr_ptr or not len_ptr:
+            return
+        cur = struct.unpack("<I", self.mem.read(len_ptr, 4))[0]
+        sa = kmem.pack_sockaddr_in(ip_be, port)
+        self.mem.write(addr_ptr, sa[: min(len(sa), cur)])
+        self.mem.write(len_ptr, struct.pack("<I", len(sa)))
+
+    def _host_ip_be(self, host_id: int) -> bytes:
+        addr = self.p.manager.hosts[host_id].address
+        return struct.pack(">I", addr.ip if addr else host_id)
+
+    def _resolve_dst(self, ip_be: bytes) -> Optional[int]:
+        ip = struct.unpack(">I", ip_be)[0]
+        if ip == 0x7F000001 or ip == 0:          # 127.0.0.1 / INADDR_ANY
+            return self.p.host.host_id
+        if ip == self.p.host.address.ip:
+            return self.p.host.host_id
+        return self.p.resolve_ip(ip)
+
+    def _nonblock(self, desc, flags: int = 0) -> bool:
+        return desc.nonblock or bool(flags & MSG_DONTWAIT)
+
+    # -- dispatch ------------------------------------------------------
+    def dispatch(self, ctx, nr: int, args):
+        self.p.host.net.ctx = ctx
+        name = NR_NAME.get(nr)
+        if name is None:
+            return NATIVE
+        fn = getattr(self, "sys_" + name, None)
+        if fn is None:
+            return -ENOSYS
+        return fn(ctx, args)
+
+    # ==================================================================
+    # time (host/syscall/time.c)
+    # ==================================================================
+    def _now_wall(self, ctx) -> int:
+        return ctx.now + simtime.EMULATED_TIME_OFFSET
+
+    def sys_clock_gettime(self, ctx, a):
+        clk, ts_ptr = _s32(a[0]), a[1]
+        if not ts_ptr:
+            return -EFAULT
+        t = self._now_wall(ctx) if clk in (0, 5, 8) else ctx.now
+        self.mem.write(ts_ptr, kmem.pack_timespec(t))
+        return 0
+
+    def sys_gettimeofday(self, ctx, a):
+        tv_ptr = a[0]
+        if tv_ptr:
+            self.mem.write(tv_ptr, kmem.pack_timeval(self._now_wall(ctx)))
+        return 0
+
+    def sys_time(self, ctx, a):
+        secs = self._now_wall(ctx) // simtime.SIMTIME_ONE_SECOND
+        if a[0]:
+            self.mem.write(a[0], struct.pack("<q", secs))
+        return secs
+
+    def _sleep_until(self, ctx, deadline: int, rem_ptr: int = 0):
+        if ctx.now >= deadline:
+            if rem_ptr:
+                self.mem.write(rem_ptr, kmem.pack_timespec(0))
+            return 0
+        raise Blocked(deadline=deadline)
+
+    def sys_nanosleep(self, ctx, a):
+        st = self.state
+        if "deadline" not in st:
+            ns = kmem.unpack_timespec(self.mem.read(a[0], 16))
+            if ns < 0:
+                return -EINVAL
+            st["deadline"] = ctx.now + ns
+        return self._sleep_until(ctx, st["deadline"], a[1])
+
+    def sys_clock_nanosleep(self, ctx, a):
+        st = self.state
+        clk, flags = _s32(a[0]), _s32(a[1])
+        if "deadline" not in st:
+            ns = kmem.unpack_timespec(self.mem.read(a[2], 16))
+            if flags & TFD_TIMER_ABSTIME:
+                if clk in (0, 5, 8):
+                    ns -= simtime.EMULATED_TIME_OFFSET
+                st["deadline"] = max(ns, ctx.now)
+            else:
+                if ns < 0:
+                    return -EINVAL
+                st["deadline"] = ctx.now + ns
+        return self._sleep_until(ctx, st["deadline"],
+                                 a[3] if not flags & TFD_TIMER_ABSTIME
+                                 else 0)
+
+    def sys_alarm(self, ctx, a):
+        return 0            # accepted, never fires (no signals yet)
+
+    def sys_setitimer(self, ctx, a):
+        return 0
+
+    def sys_getitimer(self, ctx, a):
+        if a[1]:
+            self.mem.write(a[1], b"\0" * 32)
+        return 0
+
+    # ==================================================================
+    # identity / misc (unistd.c, shadow.c)
+    # ==================================================================
+    def sys_getpid(self, ctx, a):
+        return self.p.vpid
+
+    def sys_getppid(self, ctx, a):
+        return 1
+
+    def sys_uname(self, ctx, a):
+        if not a[0]:
+            return -EFAULT
+        self.mem.write(a[0], kmem.pack_utsname(self.p.host.name))
+        return 0
+
+    def sys_getrandom(self, ctx, a):
+        buf, n = a[0], min(int(a[1]), 1 << 20)
+        data = self.p.deterministic_bytes(n)
+        self.mem.write(buf, data)
+        return n
+
+    def sys_exit(self, ctx, a):
+        self.p.begin_exit(_s32(a[0]))
+        return NATIVE
+
+    def sys_exit_group(self, ctx, a):
+        self.p.begin_exit(_s32(a[0]))
+        return NATIVE
+
+    def sys_clone(self, ctx, a):
+        return -ENOSYS      # managed multi-threading: roadmap
+
+    def sys_fork(self, ctx, a):
+        return -ENOSYS
+
+    def sys_vfork(self, ctx, a):
+        return -ENOSYS
+
+    # ==================================================================
+    # sockets (host/syscall/socket.c)
+    # ==================================================================
+    def sys_socket(self, ctx, a):
+        domain, stype = _s32(a[0]), _s32(a[1])
+        base = stype & 0xFF
+        if domain != AF_INET:
+            return -EAFNOSUPPORT
+        if base == SOCK_STREAM:
+            desc = TcpDesc(self.table)
+        elif base == SOCK_DGRAM:
+            desc = UdpDesc(self.table)
+        else:
+            return -EPROTONOSUPPORT
+        desc.nonblock = bool(stype & SOCK_NONBLOCK)
+        return self.table.alloc(desc)
+
+    def sys_bind(self, ctx, a):
+        fd, addr_ptr, addrlen = _s32(a[0]), a[1], int(a[2])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        raw = self.mem.read(addr_ptr, min(addrlen, 16))
+        family, port, _ip = kmem.unpack_sockaddr_in(raw)
+        if family != AF_INET:
+            return -EAFNOSUPPORT
+        if isinstance(desc, UdpDesc):
+            if desc.sock is not None:
+                return -EINVAL
+            desc.ensure_bound(self.p.host.net,
+                              port if port else None)
+            return 0
+        if isinstance(desc, TcpDesc):
+            if desc.sock is not None:
+                return -EINVAL
+            desc.bound_port = port
+            return 0
+        return -ENOTSOCK
+
+    def sys_listen(self, ctx, a):
+        fd, backlog = _s32(a[0]), _s32(a[1])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        if isinstance(desc, TcpListenDesc):
+            return 0
+        if not isinstance(desc, TcpDesc):
+            return -ENOTSOCK
+        net = self.p.host.net
+        port = desc.bound_port if desc.bound_port else net.alloc_port()
+        from shadow_tpu.host.tcp import TcpSocket
+        sock = TcpSocket(net, port)
+        ldesc = TcpListenDesc(self.table, sock,
+                              backlog if backlog > 0 else 128)
+        ldesc.nonblock = desc.nonblock
+        sock.listen()
+        self.table.replace(fd, ldesc)
+        return 0
+
+    def sys_accept(self, ctx, a):
+        return self._accept(ctx, a, flags=0)
+
+    def sys_accept4(self, ctx, a):
+        return self._accept(ctx, a, flags=_s32(a[3]))
+
+    def _accept(self, ctx, a, flags: int):
+        fd = _s32(a[0])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        if not isinstance(desc, TcpListenDesc):
+            return -EINVAL
+        if not desc.accept_queue:
+            if self._nonblock(desc):
+                return -EAGAIN
+            raise Blocked([desc])
+        child = desc.accept_queue.popleft()
+        child.nonblock = bool(flags & SOCK_NONBLOCK)
+        cfd = self.table.alloc(child)
+        peer_host, peer_port = child.sock.peer
+        self._write_sockaddr(a[1], a[2], self._host_ip_be(peer_host),
+                             peer_port)
+        return cfd
+
+    def sys_connect(self, ctx, a):
+        fd, addr_ptr, addrlen = _s32(a[0]), a[1], int(a[2])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        raw = self.mem.read(addr_ptr, min(addrlen, 16))
+        family, port, ip_be = kmem.unpack_sockaddr_in(raw)
+        if family != AF_INET:
+            return -EAFNOSUPPORT
+        if isinstance(desc, UdpDesc):
+            dst = self._resolve_dst(ip_be)
+            if dst is None:
+                return -ENETUNREACH
+            desc.ensure_bound(self.p.host.net)
+            desc.default_peer = (dst, port)
+            return 0
+        if not isinstance(desc, TcpDesc):
+            return -ENOTSOCK
+        if desc.connected:
+            return 0 if self.state.get("started") else -EISCONN
+        if desc.connect_err:
+            err = desc.connect_err
+            desc.connect_err = None
+            return -err
+        if not desc.connecting:
+            dst = self._resolve_dst(ip_be)
+            if dst is None:
+                return -ENETUNREACH
+            net = self.p.host.net
+            from shadow_tpu.host.tcp import TcpSocket
+            lport = desc.bound_port if desc.bound_port else \
+                net.alloc_port()
+            sock = TcpSocket(net, lport)
+            desc._hook(sock)
+            desc.connecting = True
+            self.state["started"] = True
+            sock.connect(ctx.now, dst, port)
+            if desc.nonblock:
+                return -EINPROGRESS
+        if desc.nonblock:
+            return -EALREADY
+        raise Blocked([desc])
+
+    def _dst_for_send(self, desc, addr_ptr, addrlen):
+        if addr_ptr:
+            raw = self.mem.read(addr_ptr, min(int(addrlen), 16))
+            family, port, ip_be = kmem.unpack_sockaddr_in(raw)
+            if family != AF_INET:
+                return None, -EAFNOSUPPORT
+            dst = self._resolve_dst(ip_be)
+            if dst is None:
+                return None, -ENETUNREACH
+            return (dst, port), 0
+        if desc.default_peer is None:
+            return None, -EDESTADDRREQ
+        return desc.default_peer, 0
+
+    def sys_sendto(self, ctx, a):
+        fd, buf, n, flags = _s32(a[0]), a[1], int(a[2]), _s32(a[3])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        if isinstance(desc, UdpDesc):
+            if n > UDP_MAX_PAYLOAD:
+                return -EMSGSIZE
+            dst, err = self._dst_for_send(desc, a[4], a[5])
+            if err:
+                return err
+            desc.ensure_bound(self.p.host.net)
+            payload = self.mem.read(buf, n)
+            desc.sock.sendto(ctx.now, dst[0], dst[1], n, payload=payload)
+            return n
+        if isinstance(desc, TcpDesc):
+            return self._tcp_write(ctx, desc, buf, n, flags)
+        return -ENOTSOCK
+
+    def _tcp_write(self, ctx, desc: TcpDesc, buf: int, n: int,
+                   flags: int):
+        if desc.connect_err:
+            err = desc.connect_err
+            desc.connect_err = None
+            return -err
+        if not desc.connected:
+            return -ENOTCONN if not desc.connecting else -EAGAIN
+        from shadow_tpu.host.tcp import TcpState
+        if desc.sock.state not in (TcpState.ESTABLISHED,
+                                   TcpState.CLOSE_WAIT):
+            return -EPIPE
+        space = desc.send_space()
+        if space <= 0:
+            if self._nonblock(desc, flags):
+                return -EAGAIN
+            raise Blocked([desc])
+        take = min(n, space)
+        data = self.mem.read(buf, take)
+        self.table.send_channel(desc.sock).push(data)
+        desc.sock.send(ctx.now, take)
+        return take
+
+    def sys_recvfrom(self, ctx, a):
+        fd, buf, n, flags = _s32(a[0]), a[1], int(a[2]), _s32(a[3])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        if isinstance(desc, UdpDesc):
+            desc.ensure_bound(self.p.host.net)
+            if not desc.queue:
+                if self._nonblock(desc, flags):
+                    return -EAGAIN
+                raise Blocked([desc])
+            if flags & MSG_PEEK:
+                payload, sh, sp = desc.queue[0]
+            else:
+                payload, sh, sp = desc.queue.popleft()
+            take = min(n, len(payload))
+            self.mem.write(buf, payload[:take])
+            self._write_sockaddr(a[4], a[5], self._host_ip_be(sh), sp)
+            return take
+        if isinstance(desc, TcpDesc):
+            return self._tcp_read(ctx, desc, buf, n, flags,
+                                  a[4], a[5])
+        return -ENOTSOCK
+
+    def _tcp_read(self, ctx, desc: TcpDesc, buf: int, n: int, flags: int,
+                  addr_ptr: int = 0, len_ptr: int = 0):
+        if not desc.recv_stream:
+            if desc.eof:
+                return 0
+            if not desc.connected:
+                return -ENOTCONN
+            if self._nonblock(desc, flags):
+                return -EAGAIN
+            raise Blocked([desc])
+        if flags & MSG_PEEK:
+            data = bytes(desc.recv_stream[:n])
+        else:
+            data = bytes(desc.recv_stream[:n])
+            del desc.recv_stream[:n]
+        self.mem.write(buf, data)
+        if addr_ptr and desc.sock and desc.sock.peer:
+            ph, pp = desc.sock.peer
+            self._write_sockaddr(addr_ptr, len_ptr,
+                                 self._host_ip_be(ph), pp)
+        return len(data)
+
+    def sys_shutdown(self, ctx, a):
+        fd, how = _s32(a[0]), _s32(a[1])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        if isinstance(desc, TcpDesc) and desc.sock is not None:
+            if how in (SHUT_WR, SHUT_RDWR):
+                desc.sock.close(ctx.now)
+            if how in (SHUT_RD, SHUT_RDWR):
+                desc.eof = True
+                desc.notify(ctx)
+            return 0
+        if isinstance(desc, (UdpDesc, TcpListenDesc)):
+            return 0
+        return -ENOTSOCK
+
+    def sys_getsockname(self, ctx, a):
+        desc = self._desc(_s32(a[0]))
+        if desc is None:
+            return -EBADF
+        port = 0
+        if isinstance(desc, UdpDesc):
+            port = desc.bound_port or 0
+        elif isinstance(desc, TcpDesc):
+            port = (desc.sock.local_port if desc.sock
+                    else desc.bound_port or 0)
+        elif isinstance(desc, TcpListenDesc):
+            port = desc.sock.local_port
+        else:
+            return -ENOTSOCK
+        self._write_sockaddr(a[1], a[2], self._self_ip_be(), port)
+        return 0
+
+    def sys_getpeername(self, ctx, a):
+        desc = self._desc(_s32(a[0]))
+        if desc is None:
+            return -EBADF
+        peer = None
+        if isinstance(desc, TcpDesc) and desc.sock is not None:
+            peer = desc.sock.peer
+        elif isinstance(desc, UdpDesc):
+            peer = desc.default_peer
+        if peer is None:
+            return -ENOTCONN
+        self._write_sockaddr(a[1], a[2], self._host_ip_be(peer[0]),
+                             peer[1])
+        return 0
+
+    def sys_getsockopt(self, ctx, a):
+        fd, level, opt = _s32(a[0]), _s32(a[1]), _s32(a[2])
+        val_ptr, len_ptr = a[3], a[4]
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        val = 0
+        if level == SOL_SOCKET:
+            if opt == SO_ERROR:
+                if isinstance(desc, TcpDesc) and desc.connect_err:
+                    val = desc.connect_err
+                    desc.connect_err = None
+            elif opt == SO_TYPE:
+                val = SOCK_DGRAM if isinstance(desc, UdpDesc) \
+                    else SOCK_STREAM
+            elif opt == SO_SNDBUF:
+                val = TcpDesc.SNDBUF
+            elif opt == SO_RCVBUF:
+                val = 174760
+            elif opt == SO_ACCEPTCONN:
+                val = 1 if isinstance(desc, TcpListenDesc) else 0
+        if val_ptr and len_ptr:
+            self.mem.write(val_ptr, struct.pack("<i", val))
+            self.mem.write(len_ptr, struct.pack("<I", 4))
+        return 0
+
+    def sys_setsockopt(self, ctx, a):
+        desc = self._desc(_s32(a[0]))
+        if desc is None:
+            return -EBADF
+        return 0            # accept and ignore (SO_REUSEADDR, NODELAY…)
+
+    def sys_socketpair(self, ctx, a):
+        return -EAFNOSUPPORT        # AF_UNIX: roadmap
+
+    # ==================================================================
+    # generic fd I/O (unistd.c / uio.c)
+    # ==================================================================
+    def sys_read(self, ctx, a):
+        fd, buf, n = _s32(a[0]), a[1], int(a[2])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        if isinstance(desc, TcpDesc):
+            return self._tcp_read(ctx, desc, buf, n, 0)
+        if isinstance(desc, UdpDesc):
+            return self.sys_recvfrom(ctx, (a[0], a[1], a[2], 0, 0, 0))
+        if isinstance(desc, PipeDesc):
+            return self._pipe_read(ctx, desc, buf, n)
+        if isinstance(desc, EventfdDesc):
+            return self._eventfd_read(ctx, desc, buf, n)
+        if isinstance(desc, TimerfdDesc):
+            return self._timerfd_read(ctx, desc, buf, n)
+        return -EINVAL
+
+    def sys_write(self, ctx, a):
+        fd, buf, n = _s32(a[0]), a[1], int(a[2])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        if isinstance(desc, TcpDesc):
+            return self._tcp_write(ctx, desc, buf, n, 0)
+        if isinstance(desc, UdpDesc):
+            return self.sys_sendto(ctx, (a[0], a[1], a[2], 0, 0, 0))
+        if isinstance(desc, PipeDesc):
+            return self._pipe_write(ctx, desc, buf, n)
+        if isinstance(desc, EventfdDesc):
+            return self._eventfd_write(ctx, desc, buf, n)
+        return -EINVAL
+
+    def _gather_iov(self, a):
+        return kmem.read_iovec(self.mem, a[1], _s32(a[2]))
+
+    def _iov_loop(self, ctx, a, op):
+        """Shared readv/writev walk: only the FIRST iov may block (a
+        later Blocked must not discard bytes already transferred —
+        restart semantics would replay them)."""
+        iov = self._gather_iov(a)
+        total = 0
+        for base, ln in iov:
+            if ln == 0:
+                continue
+            try:
+                r = op(ctx, (a[0], base, ln))
+            except Blocked:
+                if total == 0:
+                    raise
+                break
+            if r is NATIVE or (isinstance(r, int) and r < 0):
+                return r if total == 0 else total
+            total += r
+            if r < ln:
+                break
+        return total
+
+    def sys_readv(self, ctx, a):
+        return self._iov_loop(ctx, a, self.sys_read)
+
+    def sys_writev(self, ctx, a):
+        return self._iov_loop(ctx, a, self.sys_write)
+
+    def sys_pread64(self, ctx, a):
+        return -ESPIPE
+
+    def sys_pwrite64(self, ctx, a):
+        return -ESPIPE
+
+    def sys_lseek(self, ctx, a):
+        return -ESPIPE
+
+    def sys_close(self, ctx, a):
+        fd = _s32(a[0])
+        return 0 if self.table.close_fd(ctx, fd) else -EBADF
+
+    def sys_fstat(self, ctx, a):
+        desc = self._desc(_s32(a[0]))
+        if desc is None:
+            return -EBADF
+        st = bytearray(144)
+        mode = 0o140777 if not isinstance(desc, PipeDesc) else 0o10600
+        struct.pack_into("<I", st, 24, mode)
+        struct.pack_into("<Q", st, 16, 1)      # nlink
+        self.mem.write(a[1], bytes(st))
+        return 0
+
+    def sys_newfstatat(self, ctx, a):
+        dirfd = _s32(a[0])
+        if dirfd < VFD_BASE:
+            return NATIVE           # path-relative stat on native dirs
+        # AT_EMPTY_PATH fstat on a virtual fd (glibc's fstat() ABI)
+        path = self.mem.read_cstr(a[1], 8) if a[1] else b""
+        if path:
+            return -ENOENT          # no paths under a socket
+        return self.sys_fstat(ctx, (a[0], a[2]))
+
+    def sys_statx(self, ctx, a):
+        dirfd = _s32(a[0])
+        if dirfd < VFD_BASE:
+            return NATIVE
+        desc = self._desc(dirfd)
+        if desc is None:
+            return -EBADF
+        stx = bytearray(256)
+        struct.pack_into("<I", stx, 0, 0x7FF)          # stx_mask: basic
+        struct.pack_into("<H", stx, 28,
+                         0o140777 if not isinstance(desc, PipeDesc)
+                         else 0o10600)                 # stx_mode
+        self.mem.write(a[4], bytes(stx))
+        return 0
+
+    def sys_fcntl(self, ctx, a):
+        fd, cmd, arg = _s32(a[0]), _s32(a[1]), int(a[2])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        if cmd in (F_DUPFD, F_DUPFD_CLOEXEC):
+            min_fd = arg - VFD_BASE if arg >= VFD_BASE else 0
+            return self.table.dup(fd, min_fd)
+        if cmd == F_GETFD or cmd == F_SETFD:
+            return 0
+        if cmd == F_GETFL:
+            return O_RDWR | (O_NONBLOCK if desc.nonblock else 0)
+        if cmd == F_SETFL:
+            desc.nonblock = bool(arg & O_NONBLOCK)
+            return 0
+        return -EINVAL
+
+    def sys_ioctl(self, ctx, a):
+        fd, req, argp = _s32(a[0]), int(a[1]) & 0xFFFFFFFF, a[2]
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        if req == FIONBIO:
+            val = struct.unpack("<i", self.mem.read(argp, 4))[0]
+            desc.nonblock = bool(val)
+            return 0
+        if req == FIONREAD:
+            n = 0
+            if isinstance(desc, TcpDesc):
+                n = len(desc.recv_stream)
+            elif isinstance(desc, UdpDesc) and desc.queue:
+                n = len(desc.queue[0][0])
+            elif isinstance(desc, PipeDesc):
+                n = len(desc.buf)
+            self.mem.write(argp, struct.pack("<i", n))
+            return 0
+        return -ENOTTY
+
+    def sys_dup(self, ctx, a):
+        fd = _s32(a[0])
+        if self._desc(fd) is None:
+            return -EBADF
+        return self.table.dup(fd)
+
+    def sys_dup2(self, ctx, a):
+        return self._dup_to(ctx, _s32(a[0]), _s32(a[1]))
+
+    def sys_dup3(self, ctx, a):
+        return self._dup_to(ctx, _s32(a[0]), _s32(a[1]))
+
+    def _dup_to(self, ctx, oldfd: int, newfd: int):
+        if self._desc(oldfd) is None:
+            return -EBADF
+        if newfd < VFD_BASE:
+            return -EINVAL          # cannot shadow native kernel fds
+        if newfd == oldfd:
+            return newfd
+        if self.table.get(newfd) is not None:
+            self.table.close_fd(ctx, newfd)
+        self.table.place_at(oldfd, newfd)
+        return newfd
+
+    # ==================================================================
+    # pipes / eventfd / timerfd (pipe.rs, eventd.c, timer.c)
+    # ==================================================================
+    def sys_pipe(self, ctx, a):
+        return self._pipe(ctx, a[0], 0)
+
+    def sys_pipe2(self, ctx, a):
+        return self._pipe(ctx, a[0], _s32(a[1]))
+
+    def _pipe(self, ctx, fds_ptr: int, flags: int):
+        r, w = PipeDesc.make_pair()
+        r.nonblock = w.nonblock = bool(flags & O_NONBLOCK)
+        rfd = self.table.alloc(r)
+        wfd = self.table.alloc(w)
+        self.mem.write(fds_ptr, struct.pack("<ii", rfd, wfd))
+        return 0
+
+    def _pipe_read(self, ctx, desc: PipeDesc, buf: int, n: int):
+        if not desc.readable_end:
+            return -EBADF
+        if not desc.buf:
+            if desc.peer is None or desc.peer.closed:
+                return 0
+            if desc.nonblock:
+                return -EAGAIN
+            raise Blocked([desc])
+        data = bytes(desc.buf[:n])
+        del desc.buf[:n]
+        self.mem.write(buf, data)
+        if desc.peer is not None:
+            desc.peer.notify(ctx)      # writer may proceed
+        return len(data)
+
+    def _pipe_write(self, ctx, desc: PipeDesc, buf: int, n: int):
+        if desc.readable_end:
+            return -EBADF
+        if desc.peer is None or desc.peer.closed:
+            return -EPIPE
+        space = PipeDesc.CAPACITY - len(desc.buf)
+        if space <= 0:
+            if desc.nonblock:
+                return -EAGAIN
+            raise Blocked([desc])
+        take = min(n, space)
+        desc.buf += self.mem.read(buf, take)
+        desc.peer.notify(ctx)
+        return take
+
+    def sys_eventfd(self, ctx, a):
+        return self._eventfd(int(a[0]), 0)
+
+    def sys_eventfd2(self, ctx, a):
+        return self._eventfd(int(a[0]), _s32(a[1]))
+
+    def _eventfd(self, initval: int, flags: int):
+        d = EventfdDesc(initval, bool(flags & EFD_SEMAPHORE))
+        d.nonblock = bool(flags & EFD_NONBLOCK)
+        return self.table.alloc(d)
+
+    def _eventfd_read(self, ctx, d: EventfdDesc, buf: int, n: int):
+        if n < 8:
+            return -EINVAL
+        if d.counter == 0:
+            if d.nonblock:
+                return -EAGAIN
+            raise Blocked([d])
+        val = 1 if d.semaphore else d.counter
+        d.counter -= val
+        self.mem.write(buf, struct.pack("<Q", val))
+        d.notify(ctx)
+        return 8
+
+    def _eventfd_write(self, ctx, d: EventfdDesc, buf: int, n: int):
+        if n < 8:
+            return -EINVAL
+        val = struct.unpack("<Q", self.mem.read(buf, 8))[0]
+        d.counter += val
+        d.notify(ctx)
+        return 8
+
+    def sys_timerfd_create(self, ctx, a):
+        d = TimerfdDesc()
+        d.nonblock = bool(_s32(a[1]) & 0x800)
+        return self.table.alloc(d)
+
+    def sys_timerfd_settime(self, ctx, a):
+        fd, flags = _s32(a[0]), _s32(a[1])
+        d = self._desc(fd)
+        if not isinstance(d, TimerfdDesc):
+            return -EBADF
+        raw = self.mem.read(a[2], 32)
+        interval = kmem.unpack_timespec(raw[:16])
+        value = kmem.unpack_timespec(raw[16:])
+        if a[3]:
+            self._write_itimerspec(a[3], d, ctx)
+        d.generation += 1
+        d.expirations = 0
+        if value == 0:
+            d.next_expiry = None
+            return 0
+        when = value if flags & TFD_TIMER_ABSTIME else ctx.now + value
+        d.interval_ns = interval
+        d.next_expiry = when
+        self.p.arm_timerfd(ctx, d, when, d.generation)
+        return 0
+
+    def sys_timerfd_gettime(self, ctx, a):
+        d = self._desc(_s32(a[0]))
+        if not isinstance(d, TimerfdDesc):
+            return -EBADF
+        self._write_itimerspec(a[1], d, ctx)
+        return 0
+
+    def _write_itimerspec(self, ptr: int, d: TimerfdDesc, ctx) -> None:
+        remaining = max(0, (d.next_expiry or 0) - ctx.now) \
+            if d.next_expiry is not None else 0
+        self.mem.write(ptr, kmem.pack_timespec(d.interval_ns)
+                       + kmem.pack_timespec(remaining))
+
+    def _timerfd_read(self, ctx, d: TimerfdDesc, buf: int, n: int):
+        if n < 8:
+            return -EINVAL
+        if d.expirations == 0:
+            if d.nonblock:
+                return -EAGAIN
+            raise Blocked([d])
+        val = d.expirations
+        d.expirations = 0
+        self.mem.write(buf, struct.pack("<Q", val))
+        return 8
+
+    # ==================================================================
+    # readiness: epoll / poll / select (epoll.c, poll.c)
+    # ==================================================================
+    def sys_epoll_create(self, ctx, a):
+        return self.table.alloc(EpollDesc(self.table))
+
+    def sys_epoll_create1(self, ctx, a):
+        return self.table.alloc(EpollDesc(self.table))
+
+    def sys_epoll_ctl(self, ctx, a):
+        epfd, op, fd = _s32(a[0]), _s32(a[1]), _s32(a[2])
+        ep = self._desc(epfd)
+        if not isinstance(ep, EpollDesc):
+            return -EBADF
+        if fd < VFD_BASE:
+            return -EPERM           # native fds not epollable here
+        target = self._desc(fd)
+        if target is None:
+            return -EBADF
+        if op == EPOLL_CTL_ADD:
+            if fd in ep.interest:
+                return -17          # EEXIST
+            ev, data = kmem.EPOLL_EVENT.unpack(
+                self.mem.read(a[3], kmem.EPOLL_EVENT_SIZE))
+            ep.add(fd, ev, data)
+            return 0
+        if op == EPOLL_CTL_MOD:
+            if fd not in ep.interest:
+                return -ENOENT
+            ev, data = kmem.EPOLL_EVENT.unpack(
+                self.mem.read(a[3], kmem.EPOLL_EVENT_SIZE))
+            ep.modify(fd, ev, data)
+            return 0
+        if op == EPOLL_CTL_DEL:
+            if fd not in ep.interest:
+                return -ENOENT
+            ep.remove(fd)
+            return 0
+        return -EINVAL
+
+    def sys_epoll_wait(self, ctx, a):
+        return self._epoll_wait(ctx, a, _s32(a[3]))
+
+    def sys_epoll_pwait(self, ctx, a):
+        return self._epoll_wait(ctx, a, _s32(a[3]))
+
+    def _epoll_wait(self, ctx, a, timeout_ms: int):
+        ep = self._desc(_s32(a[0]))
+        if not isinstance(ep, EpollDesc):
+            return -EBADF
+        maxevents = _s32(a[2])
+        if maxevents <= 0:
+            return -EINVAL
+        ready = ep.ready()
+        if ready:
+            out = b"".join(kmem.EPOLL_EVENT.pack(ev, data)
+                           for ev, data in ready[:maxevents])
+            self.mem.write(a[1], out)
+            return min(len(ready), maxevents)
+        st = self.state
+        if timeout_ms == 0:
+            return 0
+        if "deadline" not in st:
+            st["deadline"] = (ctx.now + timeout_ms * 1_000_000
+                              if timeout_ms > 0 else None)
+        if st["deadline"] is not None and ctx.now >= st["deadline"]:
+            return 0
+        raise Blocked([ep], deadline=st["deadline"])
+
+    def sys_poll(self, ctx, a):
+        return self._poll(ctx, a[0], int(a[1]), _s32(a[2]))
+
+    def sys_ppoll(self, ctx, a):
+        timeout_ms = -1
+        if a[2]:
+            ns = kmem.unpack_timespec(self.mem.read(a[2], 16))
+            # round up: a sub-ms timeout must still advance sim time
+            # (0 would spin the plugin at one simulated instant)
+            timeout_ms = -(-ns // 1_000_000)
+        return self._poll(ctx, a[0], int(a[1]), timeout_ms)
+
+    def _poll(self, ctx, fds_ptr: int, nfds: int, timeout_ms: int):
+        if nfds > 4096:
+            return -EINVAL
+        raw = bytearray(self.mem.read(fds_ptr, kmem.POLLFD.size * nfds))
+        n_ready = 0
+        virt_descs = []
+        for i in range(nfds):
+            fd, events, _rev = kmem.POLLFD.unpack_from(
+                raw, i * kmem.POLLFD.size)
+            revents = 0
+            if fd < 0:
+                pass
+            elif fd < VFD_BASE:
+                # native fd (regular file / tty): always ready —
+                # blocking on real external input has no simulated
+                # time meaning
+                revents = events & (EPOLLIN | EPOLLOUT)
+            else:
+                d = self._desc(fd)
+                if d is None:
+                    revents = 0x20      # POLLNVAL
+                else:
+                    virt_descs.append(d)
+                    stt = d.status()
+                    if (events & EPOLLIN) and (stt & R):
+                        revents |= EPOLLIN
+                    if (events & EPOLLOUT) and (stt & W):
+                        revents |= EPOLLOUT
+                    if stt & ERR:
+                        revents |= EPOLLERR
+            if revents:
+                n_ready += 1
+            kmem.POLLFD.pack_into(raw, i * kmem.POLLFD.size, fd, events,
+                                  revents)
+        if n_ready:
+            self.mem.write(fds_ptr, bytes(raw))
+            return n_ready
+        st = self.state
+        if timeout_ms == 0:
+            self.mem.write(fds_ptr, bytes(raw))
+            return 0
+        if "deadline" not in st:
+            st["deadline"] = (ctx.now + timeout_ms * 1_000_000
+                              if timeout_ms >= 0 else None)
+        if st["deadline"] is not None and ctx.now >= st["deadline"]:
+            self.mem.write(fds_ptr, bytes(raw))
+            return 0
+        raise Blocked(virt_descs, deadline=st["deadline"])
+
+    def sys_select(self, ctx, a):
+        return self._select(ctx, a, timeval=True)
+
+    def sys_pselect6(self, ctx, a):
+        return self._select(ctx, a, timeval=False)
+
+    def _select(self, ctx, a, timeval: bool):
+        nfds = _s32(a[0])
+        # virtual fds sit far above FD_SETSIZE, so select() can only
+        # ever name native fds here. The portable select-as-sleep idiom
+        # (no fds) is emulated; anything else is unsupported
+        # (poll/epoll are the supported readiness APIs).
+        def fdset_empty(ptr):
+            if not ptr or nfds <= 0:
+                return True
+            nbytes = (nfds + 7) // 8
+            return not any(self.mem.read(ptr, nbytes))
+
+        if fdset_empty(a[1]) and fdset_empty(a[2]) and fdset_empty(a[3]):
+            st = self.state
+            if "deadline" not in st:
+                if not a[4]:
+                    return -EINVAL      # would block forever
+                if timeval:
+                    sec, usec = struct.unpack(
+                        "<qq", self.mem.read(a[4], 16))
+                    ns = sec * 1_000_000_000 + usec * 1000
+                else:
+                    ns = kmem.unpack_timespec(self.mem.read(a[4], 16))
+                st["deadline"] = ctx.now + max(0, ns)
+            if ctx.now >= st["deadline"]:
+                return 0
+            raise Blocked(deadline=st["deadline"])
+        return -EINVAL
+
+    # ==================================================================
+    # msghdr-based I/O (uio.c / socket.c)
+    # ==================================================================
+    def _read_msghdr(self, ptr: int):
+        raw = self.mem.read(ptr, 56)
+        name, namelen = struct.unpack_from("<QI", raw, 0)
+        iov, iovlen = struct.unpack_from("<QQ", raw, 16)
+        return name, namelen, kmem.read_iovec(self.mem, iov, int(iovlen))
+
+    def sys_sendmsg(self, ctx, a):
+        fd, msg_ptr, flags = _s32(a[0]), a[1], _s32(a[2])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        name, namelen, iov = self._read_msghdr(msg_ptr)
+        if isinstance(desc, UdpDesc):
+            data = b"".join(self.mem.read(b, ln) for b, ln in iov)
+            if len(data) > UDP_MAX_PAYLOAD:
+                return -EMSGSIZE
+            dst, err = self._dst_for_send(desc, name, namelen)
+            if err:
+                return err
+            desc.ensure_bound(self.p.host.net)
+            desc.sock.sendto(ctx.now, dst[0], dst[1], len(data),
+                             payload=data)
+            return len(data)
+        if isinstance(desc, TcpDesc):
+            # like _iov_loop: only the first iov may block — a Blocked
+            # after partial progress would replay sent bytes on restart
+            total = 0
+            for base, ln in iov:
+                if ln == 0:
+                    continue
+                try:
+                    r = self._tcp_write(ctx, desc, base, ln, flags)
+                except Blocked:
+                    if total == 0:
+                        raise
+                    break
+                if isinstance(r, int) and r < 0:
+                    return r if total == 0 else total
+                total += r
+                if r < ln:
+                    break
+            return total
+        return -ENOTSOCK
+
+    def sys_recvmsg(self, ctx, a):
+        fd, msg_ptr, flags = _s32(a[0]), a[1], _s32(a[2])
+        desc = self._desc(fd)
+        if desc is None:
+            return -EBADF
+        name, namelen, iov = self._read_msghdr(msg_ptr)
+        if not iov:
+            return -EINVAL
+        base, ln = iov[0]
+        if isinstance(desc, UdpDesc):
+            return self.sys_recvfrom(
+                ctx, (a[0], base, ln, flags, name,
+                      msg_ptr + 8 if name else 0))
+        if isinstance(desc, TcpDesc):
+            return self._tcp_read(ctx, desc, base, ln, flags)
+        return -ENOTSOCK
+
+    def sys_sendmmsg(self, ctx, a):
+        return -ENOSYS
+
+    def sys_recvmmsg(self, ctx, a):
+        return -ENOSYS

@@ -71,6 +71,7 @@ class TcpSocket(BaseSocket):
         self.on_data: Optional[Callable] = None       # (sock, nbytes, now)
         self.on_closed: Optional[Callable] = None
         self.on_accept: Optional[Callable] = None     # listener only
+        self.on_writable: Optional[Callable] = None   # send space freed
 
         # send sequence state (byte space; SYN/FIN consume one each)
         self.iss = 0
@@ -323,6 +324,8 @@ class TcpSocket(BaseSocket):
                     self.cwnd += max(1, MSS * MSS // self.cwnd)
             self._restart_rto(now)
             self._try_send(now)
+            if self.on_writable:
+                self.on_writable(self.net.ctx, self, now)
         elif ack == self.snd_una and self._flight() > 0:
             self.dup_acks += 1
             if self.dup_acks == 3 and not self.in_recovery:

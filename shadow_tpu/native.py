@@ -77,6 +77,9 @@ def load(build_if_missing: bool = True) -> ctypes.CDLL:
     lib.shadowtpu_ipc_recv_from_plugin.restype = ctypes.c_int
     lib.shadowtpu_ipc_recv_from_plugin.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(IpcMessage)]
+    lib.shadowtpu_ipc_recv_from_plugin_timed.restype = ctypes.c_int
+    lib.shadowtpu_ipc_recv_from_plugin_timed.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(IpcMessage), ctypes.c_uint32]
     lib.shadowtpu_ipc_send_to_simulator.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(IpcMessage)]
     lib.shadowtpu_ipc_recv_from_simulator.restype = ctypes.c_int
@@ -156,6 +159,15 @@ class IpcChannel:
             self.ptr, ctypes.byref(out))
         return out if ok else None
 
+    def recv_from_plugin_timed(self, timeout_ms: int
+                               ) -> tuple[int, Optional[IpcMessage]]:
+        """-> (status, msg): 1 = received, 0 = plugin exited,
+        -1 = timed out."""
+        out = IpcMessage()
+        status = self._lib.shadowtpu_ipc_recv_from_plugin_timed(
+            self.ptr, ctypes.byref(out), timeout_ms)
+        return status, (out if status == 1 else None)
+
     def send_to_simulator(self, msg: IpcMessage) -> None:
         self._lib.shadowtpu_ipc_send_to_simulator(self.ptr,
                                                   ctypes.byref(msg))
@@ -172,3 +184,15 @@ class IpcChannel:
 
 def cleanup_orphans(prefix: str = "shadowtpu_shm_") -> int:
     return load().shadowtpu_cleanup_orphans(prefix.encode())
+
+
+_SHIM_PATH = os.path.join(_NATIVE_DIR, "build", "libshadowtpu_shim.so")
+
+
+def shim_path(build_if_missing: bool = True) -> str:
+    """Path to the preload shim injected into managed processes."""
+    if not os.path.exists(_SHIM_PATH) and build_if_missing:
+        subprocess.run(["make", "-C", _NATIVE_DIR,
+                        "build/libshadowtpu_shim.so"],
+                       check=True, capture_output=True)
+    return _SHIM_PATH

@@ -1,0 +1,61 @@
+/* TCP sink server: accepts one connection, reads until EOF, prints
+ * byte count + checksum. The managed analogue of src/test/tcp. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: tcp_server <port>\n");
+    return 2;
+  }
+  int port = atoi(argv[1]);
+  int s = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(s, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in a;
+  memset(&a, 0, sizeof a);
+  a.sin_family = AF_INET;
+  a.sin_port = htons(port);
+  a.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (bind(s, (struct sockaddr *)&a, sizeof a) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(s, 8) != 0) {
+    perror("listen");
+    return 1;
+  }
+  struct sockaddr_in peer;
+  socklen_t pl = sizeof peer;
+  int c = accept(s, (struct sockaddr *)&peer, &pl);
+  if (c < 0) {
+    perror("accept");
+    return 1;
+  }
+  printf("accepted from %s:%d\n", inet_ntoa(peer.sin_addr),
+         ntohs(peer.sin_port));
+  unsigned long total = 0, sum = 0;
+  char buf[16384];
+  for (;;) {
+    ssize_t r = read(c, buf, sizeof buf);
+    if (r < 0) {
+      perror("read");
+      return 1;
+    }
+    if (r == 0)
+      break;
+    for (ssize_t i = 0; i < r; i++)
+      sum = (sum * 31 + (unsigned char)buf[i]) & 0xFFFFFFFFUL;
+    total += (unsigned long)r;
+  }
+  printf("received %lu bytes sum %lu\n", total, sum);
+  close(c);
+  close(s);
+  fflush(stdout);
+  return 0;
+}

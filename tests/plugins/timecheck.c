@@ -1,0 +1,29 @@
+/* Prints simulated clocks before/after a sleep; determinism probe.
+ * Mirrors the role of the reference's src/test/sleep + determinism
+ * suites: under the simulator, the printed times are exact functions
+ * of the config, not of wall time. */
+#include <stdio.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  printf("t0 %ld.%09ld\n", (long)ts.tv_sec, ts.tv_nsec);
+
+  usleep(100000); /* 100 ms simulated */
+
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  printf("t1 %ld.%09ld\n", (long)ts.tv_sec, ts.tv_nsec);
+
+  struct timespec tw;
+  clock_gettime(CLOCK_REALTIME, &tw);
+  printf("wall %ld\n", (long)tw.tv_sec);
+
+  char host[64];
+  gethostname(host, sizeof host);
+  printf("host %s\n", host);
+  printf("pid %d\n", (int)getpid());
+  fflush(stdout);
+  return 0;
+}

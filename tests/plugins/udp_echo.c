@@ -1,0 +1,54 @@
+/* UDP echo server: echoes `count` datagrams then exits.
+ * The managed-process analogue of the reference's src/test/udp suite. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: udp_echo <port> <count>\n");
+    return 2;
+  }
+  int port = atoi(argv[1]);
+  int count = atoi(argv[2]);
+
+  int s = socket(AF_INET, SOCK_DGRAM, 0);
+  if (s < 0) {
+    perror("socket");
+    return 1;
+  }
+  struct sockaddr_in a;
+  memset(&a, 0, sizeof a);
+  a.sin_family = AF_INET;
+  a.sin_port = htons(port);
+  a.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (bind(s, (struct sockaddr *)&a, sizeof a) != 0) {
+    perror("bind");
+    return 1;
+  }
+  char buf[2048];
+  for (int i = 0; i < count; i++) {
+    struct sockaddr_in src;
+    socklen_t sl = sizeof src;
+    ssize_t r = recvfrom(s, buf, sizeof buf, 0, (struct sockaddr *)&src,
+                         &sl);
+    if (r < 0) {
+      perror("recvfrom");
+      return 1;
+    }
+    if (sendto(s, buf, (size_t)r, 0, (struct sockaddr *)&src, sl) != r) {
+      perror("sendto");
+      return 1;
+    }
+    printf("echoed %zd from %s:%d\n", r, inet_ntoa(src.sin_addr),
+           ntohs(src.sin_port));
+  }
+  close(s);
+  printf("done\n");
+  fflush(stdout);
+  return 0;
+}

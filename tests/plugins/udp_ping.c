@@ -1,0 +1,56 @@
+/* UDP ping client: sends `count` datagrams to server, awaits echoes,
+ * prints round-trip times in *simulated* milliseconds. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static long now_ms(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: udp_ping <server_ip> <port> <count>\n");
+    return 2;
+  }
+  const char *ip = argv[1];
+  int port = atoi(argv[2]);
+  int count = atoi(argv[3]);
+
+  int s = socket(AF_INET, SOCK_DGRAM, 0);
+  struct sockaddr_in dst;
+  memset(&dst, 0, sizeof dst);
+  dst.sin_family = AF_INET;
+  dst.sin_port = htons(port);
+  dst.sin_addr.s_addr = inet_addr(ip);
+
+  char buf[512];
+  for (int i = 0; i < count; i++) {
+    int n = snprintf(buf, sizeof buf, "ping %d", i);
+    long t0 = now_ms();
+    if (sendto(s, buf, (size_t)n, 0, (struct sockaddr *)&dst,
+               sizeof dst) != n) {
+      perror("sendto");
+      return 1;
+    }
+    char rbuf[512];
+    ssize_t r = recvfrom(s, rbuf, sizeof rbuf - 1, 0, NULL, NULL);
+    if (r < 0) {
+      perror("recvfrom");
+      return 1;
+    }
+    rbuf[r] = 0;
+    printf("reply %d: '%s' rtt_ms=%ld\n", i, rbuf, now_ms() - t0);
+  }
+  close(s);
+  printf("done\n");
+  fflush(stdout);
+  return 0;
+}
