@@ -447,12 +447,19 @@ class DeviceEngine:
             return _exchange(state, ob, my_shard)
 
         # ---------------- full run ------------------------------------
+        # cross-shard min via all_gather: some TPU AOT toolchains lower
+        # only Sum all-reduces, so pmin is expressed as gather+min
+        # (identical result; the gathered vector is tiny: one scalar
+        # per device)
+        def _axis_min(x):
+            return lax.all_gather(jnp.reshape(x, (1,)), AXIS).min()
+
         def _run_shard(state, host_vertex, lat, rel):
             my_shard = lax.axis_index(AXIS)
             gid = (my_shard * H_loc + hidx).astype(jnp.int32)
 
             def next_time(state):
-                return lax.pmin(state["t"].min(), AXIS)
+                return _axis_min(state["t"].min())
 
             def cond(c):
                 state, nxt, rounds = c
@@ -476,7 +483,7 @@ class DeviceEngine:
             gid = (my_shard * H_loc + hidx).astype(jnp.int32)
             state = _round(state, win_end, gid, my_shard,
                            host_vertex, lat, rel)
-            nxt = lax.pmin(state["t"].min(), AXIS)
+            nxt = _axis_min(state["t"].min())
             return state, nxt
 
         specs = {k: self._shard_spec for k in
