@@ -19,11 +19,13 @@ engine's arrays; they are compile-time constants.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import NamedTuple
 
+import numpy as np
+
 from shadow_tpu._jax import jnp
-from shadow_tpu.core.event import KIND_BOOT, KIND_PACKET
+from shadow_tpu.core.event import KIND_BOOT, KIND_PACKET, KIND_TIMER
 
 
 class AppOut(NamedTuple):
@@ -109,4 +111,128 @@ class PholdDevice(DeviceApp):
             timer_valid=jnp.zeros((H, 0), bool),
             n_draws=n_draws,
             app_state=new_state,
+        )
+
+
+@dataclass
+class TgenDevice(DeviceApp):
+    """Vectorized twin of models/tgen.py: chunked pull-based bulk
+    download with a stateless server. One app covers both roles
+    (branching on the per-host role word), so client/server mixes run
+    on the device without heterogeneous dispatch.
+
+    State words: [role, server_gid, chunk_start, got, downloads_done,
+    req_gen]. Protocol/tag/timer encodings match the CPU twin exactly
+    (REQ d0=TAG_REQ d1=start; DATA d0=TAG_DATA d1=seq; timer d0=-1
+    pause / d0=gen retry), so event traces are bit-identical."""
+
+    roles: np.ndarray = field(repr=False)        # [H] 0=server 1=client
+    server_gid: np.ndarray = field(repr=False)   # [H] client's server
+    size: int = 1 << 20
+    count: int = 1
+    pause_ns: int = 1_000_000_000
+    retry_ns: int = 0
+
+    TAG_REQ = 1
+    TAG_DATA = 2
+
+    def __post_init__(self):
+        from shadow_tpu import simtime
+        self.MSS = simtime.CONFIG_TCP_MAX_SEGMENT_SIZE
+        self.npkts = (self.size + self.MSS - 1) // self.MSS
+        self.last_sz = self.size % self.MSS or self.MSS
+        from shadow_tpu.models.tgen import CHUNK_PKTS
+        self.chunk = CHUNK_PKTS
+        self.n_state_words = 6
+        self.max_sends = self.chunk
+        self.max_timers = 1
+        self.max_draws = 1              # no randomness consumed
+
+    def init_state(self, n_hosts: int) -> jnp.ndarray:
+        # n_hosts may exceed len(roles): shard padding hosts are inert
+        # servers that never receive a REQ
+        st = np.zeros((n_hosts, self.n_state_words), np.int32)
+        n = min(n_hosts, len(self.roles))
+        st[:n, 0] = self.roles[:n]
+        st[:n, 1] = self.server_gid[:n]
+        return jnp.asarray(st)
+
+    def handle(self, gid, now, kind, src, size, d0, d1, app_state, draws
+               ) -> AppOut:
+        H, K = draws.shape[0], self.max_sends
+        role = app_state[:, 0]
+        server = app_state[:, 1]
+        chunk_start = app_state[:, 2]
+        got = app_state[:, 3]
+        done = app_state[:, 4]
+        gen = app_state[:, 5]
+        is_server = role == 0
+        is_client = role == 1
+
+        is_req = is_server & (kind == KIND_PACKET) & (d0 == self.TAG_REQ)
+        is_data = is_client & (kind == KIND_PACKET) & (d0 == self.TAG_DATA)
+        is_boot = is_client & (kind == KIND_BOOT) & (self.count > 0)
+        is_timer = is_client & (kind == KIND_TIMER)
+        timer_pause = is_timer & (d0 < 0)
+        timer_retry = is_timer & (d0 >= 0) & (d0 == gen)
+
+        # ---- client window progress ----
+        new_got = jnp.where(is_data, got + 1, got)
+        chunk_len = jnp.minimum(self.chunk, self.npkts - chunk_start)
+        complete = is_data & (new_got >= chunk_len)
+        next_start = chunk_start + chunk_len
+        dl_done = complete & (next_start >= self.npkts)
+        cont = complete & ~dl_done
+
+        send_req = is_boot | timer_pause | timer_retry | cont
+        req_start = jnp.where(cont, next_start,
+                              jnp.where(timer_retry, chunk_start, 0))
+
+        new_chunk_start = jnp.where(
+            cont, next_start,
+            jnp.where(is_boot | timer_pause | dl_done, 0, chunk_start))
+        new_got = jnp.where(send_req | dl_done, 0, new_got)
+        new_done = done + dl_done.astype(jnp.int32)
+        new_gen = gen + (send_req | dl_done).astype(jnp.int32)
+
+        st = app_state
+        st = st.at[:, 2].set(new_chunk_start)
+        st = st.at[:, 3].set(new_got)
+        st = st.at[:, 4].set(new_done)
+        st = st.at[:, 5].set(new_gen)
+
+        # ---- sends ----
+        ks = jnp.arange(K, dtype=jnp.int32)[None, :]           # [1,K]
+        seqs = d1[:, None] + ks                                # [H,K]
+        srv_valid = is_req[:, None] & (seqs < self.npkts)
+        srv_size = jnp.where(seqs == self.npkts - 1, self.last_sz,
+                             self.MSS)
+        cli_valid = (ks == 0) & send_req[:, None]
+
+        sv = is_server[:, None]
+        send_valid = jnp.where(sv, srv_valid, cli_valid)
+        send_dst = jnp.where(sv, src[:, None],
+                             server[:, None]).astype(jnp.int32)
+        send_size = jnp.where(sv, srv_size, 64).astype(jnp.int32)
+        send_d0 = jnp.where(sv, self.TAG_DATA,
+                            self.TAG_REQ).astype(jnp.int32)
+        send_d1 = jnp.where(sv, seqs,
+                            req_start[:, None]).astype(jnp.int32)
+
+        # ---- timers (pause and retry are mutually exclusive) ----
+        pause_valid = dl_done & (new_done < self.count)
+        retry_valid = send_req & (self.retry_ns > 0)
+        timer_valid = (pause_valid | retry_valid)[:, None]
+        timer_delay = jnp.where(pause_valid, self.pause_ns,
+                                self.retry_ns)[:, None].astype(jnp.int64)
+        timer_d0 = jnp.where(pause_valid, -1,
+                             new_gen)[:, None].astype(jnp.int32)
+
+        return AppOut(
+            send_dst=send_dst, send_size=send_size, send_d0=send_d0,
+            send_d1=send_d1, send_valid=send_valid,
+            timer_delay=timer_delay, timer_d0=timer_d0,
+            timer_valid=timer_valid,
+            n_draws=jnp.zeros((H,), jnp.int32),
+            app_state=st,
         )

@@ -18,27 +18,31 @@ from typing import Optional
 
 import numpy as np
 
+import numpy as _np
+
 from shadow_tpu._jax import jax
 from shadow_tpu.core.manager import SimStats
-from shadow_tpu.device.apps import DeviceApp, PholdDevice
+from shadow_tpu.device.apps import DeviceApp, PholdDevice, TgenDevice
 from shadow_tpu.device.engine import DeviceEngine, EngineConfig
 from shadow_tpu.models.phold import PholdApp
+from shadow_tpu.models.tgen import TgenClientApp, TgenServerApp
 from shadow_tpu.utils.slog import get_logger
 
 log = get_logger("device")
 
 
-def device_twin(apps: list, n_hosts: int) -> DeviceApp:
-    """Map a homogeneous set of CPU model apps to their device twin."""
+def device_twin(sim) -> DeviceApp:
+    """Map the config's CPU model apps to their vectorized device twin.
+    Supported: homogeneous phold; tgen server/client mixes (homogeneous
+    client args)."""
+    apps = [h.app for h in sim.hosts]
+    n_hosts = len(sim.hosts)
     real = [a for a in apps if a is not None]
     if not real:
         raise ValueError("tpu policy: no model apps configured")
-    cls = type(real[0])
-    if not all(type(a) is cls for a in real):
-        raise ValueError(
-            "tpu policy currently requires all hosts to run the same "
-            "model app; use a CPU scheduler policy for mixed configs")
-    if cls is PholdApp:
+    classes = {type(a) for a in real}
+
+    if classes == {PholdApp}:
         first = real[0]
         for a in real:
             if (a.msgload, a.size, a.selfloop) != (first.msgload,
@@ -48,8 +52,37 @@ def device_twin(apps: list, n_hosts: int) -> DeviceApp:
                                  "across hosts")
         return PholdDevice(n_hosts_total=n_hosts, msgload=first.msgload,
                            size=first.size, selfloop=first.selfloop)
-    raise ValueError(f"no device twin registered for {cls.__name__}; "
-                     "available: phold")
+
+    if classes <= {TgenServerApp, TgenClientApp}:
+        name_to_id = {h.name: h.host_id for h in sim.hosts}
+        roles = _np.zeros(n_hosts, _np.int32)
+        server_gid = _np.zeros(n_hosts, _np.int32)
+        clients = [a for a in real if isinstance(a, TgenClientApp)]
+        if not clients:
+            raise ValueError("tpu policy: tgen config has no clients")
+        first = clients[0]
+        for c in clients:
+            if (c.size, c.count, c.pause_ns, c.retry_ns) != (
+                    first.size, first.count, first.pause_ns,
+                    first.retry_ns):
+                raise ValueError("tpu policy: tgen client args must "
+                                 "match across hosts")
+        for h in sim.hosts:
+            if isinstance(h.app, TgenClientApp):
+                roles[h.host_id] = 1
+                if h.app.server_name not in name_to_id:
+                    raise ValueError(
+                        f"tgen client on {h.name}: unknown server "
+                        f"{h.app.server_name!r}")
+                server_gid[h.host_id] = name_to_id[h.app.server_name]
+        return TgenDevice(roles=roles, server_gid=server_gid,
+                          size=first.size, count=first.count,
+                          pause_ns=first.pause_ns,
+                          retry_ns=first.retry_ns)
+
+    names = sorted(c.__name__ for c in classes)
+    raise ValueError(f"no device twin registered for {names}; "
+                     "available: phold, tgen (server+client)")
 
 
 class DeviceRunner:
@@ -69,8 +102,7 @@ class DeviceRunner:
             log.warning("tpu policy: pcap capture requires a CPU "
                         "scheduler policy (packets are device-resident "
                         "metadata here)")
-        apps = [h.app for h in sim.hosts]
-        self.app = device_twin(apps, len(sim.hosts))
+        self.app = device_twin(sim)
         self.engine = DeviceEngine(
             EngineConfig(
                 n_hosts=len(sim.hosts),

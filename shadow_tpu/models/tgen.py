@@ -6,16 +6,26 @@ server for `size` bytes; the server streams them back as MTU-sized
 packets; the client counts arrivals, and after receiving everything
 pauses and repeats, `count` times total.
 
+The transfer is *pull-based and chunked*: the client requests a window
+of at most CHUNK_PKTS packets at a time and the server answers each
+request statelessly (REQ carries the starting packet index; the total
+size is a static client arg). This bounds the per-event send fan-out to
+a compile-time constant, which is exactly what the vectorized device
+twin (device/apps.py TgenDevice) needs — and both twins therefore
+produce identical event traces.
+
 This packet-granularity form runs on the raw network model (latency,
-loss, drops). When the in-simulator TCP stack is selected
-(experimental.transport=tcp, shadow_tpu/host/tcp.py), the same apps run
-over real TCP flows with congestion control and retransmission instead.
+loss, drops). When the in-simulator TCP stack is selected the tgen_tcp
+variants run over real TCP flows with congestion control instead.
 
 client args: server=<hostname>, size=bytes, count=N, pause=ns between
-downloads. server args: none.
+downloads, retry=timeout for re-requesting a chunk on packet loss
+(0 = no retries; leave 0 on lossless paths). server args: none.
 
 Message tags (integers, for device-twin parity):
-  1=REQ(total_size)  2=DATA(seq_no)  3=FIN
+  1=REQ(d0=start packet index, d1=total bytes)   2=DATA(d0=seq_no)
+Timer payload d0: -1 = pause expired (start next download);
+  gen >= 0 = chunk retry, valid only if gen still current.
 """
 
 from __future__ import annotations
@@ -26,23 +36,32 @@ from shadow_tpu.models.base import ModelApp
 
 TAG_REQ = 1
 TAG_DATA = 2
-TAG_FIN = 3
 
 MSS = simtime.CONFIG_TCP_MAX_SEGMENT_SIZE
+CHUNK_PKTS = 32                  # window: packets per REQ round trip
+
+
+def n_packets(total_bytes: int) -> int:
+    return (total_bytes + MSS - 1) // MSS
 
 
 class TgenServerApp(ModelApp):
+    """Stateless chunk server: REQ(start, total) -> up to CHUNK_PKTS
+    DATA packets [start, ...), sizes MSS except the final remainder."""
+
     def on_packet(self, ctx, src_host, size, data) -> None:
         tag = data[0] if data else 0
         if tag != TAG_REQ:
             return
-        total = data[1]
-        n_full, last = divmod(total, MSS)
-        for seq in range(n_full):
-            ctx.send(src_host, MSS, (TAG_DATA, seq))
-        if last:
-            ctx.send(src_host, last, (TAG_DATA, n_full))
-        ctx.send(src_host, 1, (TAG_FIN, n_full + (1 if last else 0)))
+        start, total = data[1], data[2]
+        npkts = n_packets(total)
+        for k in range(CHUNK_PKTS):
+            seq = start + k
+            if seq >= npkts:
+                break
+            sz = MSS if seq < npkts - 1 or total % MSS == 0 \
+                else total % MSS
+            ctx.send(src_host, sz, (TAG_DATA, seq))
 
 
 class TgenClientApp(ModelApp):
@@ -52,36 +71,57 @@ class TgenClientApp(ModelApp):
         self.size = parse_size_bytes(args.get("size", "1 MiB"))
         self.count = int(args.get("count", 1))
         self.pause_ns = parse_time_ns(args.get("pause", "1 s"))
+        self.retry_ns = parse_time_ns(args.get("retry", 0))
         self.downloads_done = 0
         self.bytes_received = 0
-        self._expect_packets = 0
-        self._got_packets = 0
+        self._chunk_start = 0          # first packet index of the chunk
+        self._got = 0                  # packets received in the chunk
+        self._req_gen = 0              # stale-retry guard
         self._server: int | None = None
 
-    def _request(self, ctx) -> None:
+    @property
+    def _npkts(self) -> int:
+        return n_packets(self.size)
+
+    def _request_chunk(self, ctx) -> None:
         if self._server is None:
             self._server = ctx.resolve(self.server_name)
-        self._got_packets = 0
-        self._expect_packets = 0
-        ctx.send(self._server, 64, (TAG_REQ, self.size))
+        self._got = 0
+        self._req_gen += 1
+        ctx.send(self._server, 64, (TAG_REQ, self._chunk_start,
+                                    self.size))
+        if self.retry_ns > 0:
+            ctx.schedule(self.retry_ns, data=(self._req_gen,))
 
     def boot(self, ctx) -> None:
         if self.count > 0:
-            self._request(ctx)
+            self._request_chunk(ctx)
 
     def on_timer(self, ctx, data) -> None:
-        self._request(ctx)
+        d0 = data[0] if data else -1
+        if d0 >= 0:
+            if d0 == self._req_gen:            # chunk still outstanding
+                self._request_chunk(ctx)       # re-request (lost DATA)
+            return
+        self._chunk_start = 0
+        self._request_chunk(ctx)
 
     def on_packet(self, ctx, src_host, size, data) -> None:
         tag = data[0] if data else 0
-        if tag == TAG_DATA:
-            self.bytes_received += size
-            self._got_packets += 1
-        elif tag == TAG_FIN:
-            self._expect_packets = data[1]
-        if (self._expect_packets and
-                self._got_packets >= self._expect_packets):
-            self.downloads_done += 1
-            self._expect_packets = 0
-            if self.downloads_done < self.count:
-                ctx.schedule(self.pause_ns)
+        if tag != TAG_DATA:
+            return
+        self.bytes_received += size
+        self._got += 1
+        chunk_len = min(CHUNK_PKTS, self._npkts - self._chunk_start)
+        if self._got < chunk_len:
+            return
+        self._chunk_start += chunk_len
+        if self._chunk_start < self._npkts:
+            self._request_chunk(ctx)
+            return
+        # download complete
+        self.downloads_done += 1
+        self._chunk_start = 0
+        self._req_gen += 1                     # invalidate pending retry
+        if self.downloads_done < self.count:
+            ctx.schedule(self.pause_ns, data=(-1,))

@@ -1,0 +1,81 @@
+"""tgen device twin vs CPU serial oracle: identical event traces.
+
+Extends the phold equivalence argument (test_device_engine.py) to the
+benchmark-ladder workload: chunked pull-based bulk downloads with a
+client/server role mix on one vectorized device app."""
+
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller
+
+TGEN_YAML = """
+general:
+  stop_time: {stop}
+  seed: {seed}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ directed 0
+        node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss {loss} ]
+        edge [ source 0 target 1 latency "20 ms" packet_loss {loss} ]
+        edge [ source 1 target 1 latency "10 ms" packet_loss {loss} ]
+      ]
+experimental:
+  scheduler_policy: {policy}
+  event_capacity: 192
+  outbox_capacity: 256
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - path: model:tgen_server
+      start_time: 10ms
+  client:
+    quantity: {clients}
+    network_node_id: 1
+    processes:
+    - path: model:tgen_client
+      args: server=server size={size} count={count} pause=200ms {extra}
+      start_time: 100ms
+"""
+
+
+def _run(policy, seed=1, loss=0.0, clients=4, size="200KiB", count=2,
+         stop="10s", extra=""):
+    yaml = TGEN_YAML.format(policy=policy, seed=seed, loss=loss,
+                            clients=clients, size=size, count=count,
+                            stop=stop, extra=extra)
+    c = Controller(load_config_str(yaml))
+    stats = c.run()
+    return stats, c.sim.hosts
+
+
+@pytest.mark.parametrize("loss,extra",
+                         [(0.0, ""), (0.02, "retry=500ms")])
+def test_tgen_device_matches_serial_oracle(loss, extra):
+    s_stats, s_hosts = _run("serial", loss=loss, extra=extra)
+    d_stats, d_hosts = _run("tpu", loss=loss, extra=extra)
+    assert s_stats.events_executed == d_stats.events_executed
+    assert s_stats.packets_sent == d_stats.packets_sent
+    assert s_stats.packets_dropped == d_stats.packets_dropped
+    for sh, dh in zip(s_hosts, d_hosts):
+        assert sh.trace_checksum == dh.trace_checksum, sh.name
+
+
+def test_tgen_cpu_clients_complete_downloads():
+    stats, hosts = _run("serial", clients=3, size="100KiB", count=3)
+    for h in hosts[1:]:
+        assert h.app.downloads_done == 3
+        assert h.app.bytes_received >= 3 * 100 * 1024
+    assert stats.ok
+
+
+def test_tgen_lossy_retry_completes():
+    _, hosts = _run("serial", loss=0.05, clients=2, size="50KiB",
+                    count=1, extra="retry=300ms")
+    for h in hosts[1:]:
+        assert h.app.downloads_done == 1
