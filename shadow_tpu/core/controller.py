@@ -124,8 +124,17 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
                             raise ValueError(
                                 f"process executable not found: "
                                 f"{proc.path!r}")
-                        host.app = ManagedProcess(
-                            runtime, path, proc.args, proc.environment)
+                        if cfg.experimental.interpose_method == "ptrace":
+                            from shadow_tpu.host.ptrace import (
+                                PtraceProcess,
+                            )
+                            host.app = PtraceProcess(
+                                runtime, path, proc.args,
+                                proc.environment)
+                        else:
+                            host.app = ManagedProcess(
+                                runtime, path, proc.args,
+                                proc.environment)
                     starts.append((host_id, proc.start_time,
                                    proc.stop_time
                                    if proc.stop_time is not None else -1))

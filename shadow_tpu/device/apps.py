@@ -122,9 +122,13 @@ class TgenDevice(DeviceApp):
     on the device without heterogeneous dispatch.
 
     State words: [role, server_gid, chunk_start, got, downloads_done,
-    req_gen]. Protocol/tag/timer encodings match the CPU twin exactly
-    (REQ d0=TAG_REQ d1=start; DATA d0=TAG_DATA d1=seq; timer d0=-1
-    pause / d0=gen retry), so event traces are bit-identical."""
+    req_gen, seq_mask]. Protocol/tag/timer encodings match the CPU twin
+    exactly (REQ d0=TAG_REQ d1=start; DATA d0=TAG_DATA d1=seq; timer
+    d0=-1 pause / d0=gen retry), so event traces are bit-identical.
+    seq_mask is the received-seq bitmask within the current window:
+    only fresh in-window DATA advances it, so duplicates from a
+    premature retry never complete a chunk (same rule as the CPU
+    twin's _mask)."""
 
     roles: np.ndarray = field(repr=False)        # [H] 0=server 1=client
     server_gid: np.ndarray = field(repr=False)   # [H] client's server
@@ -143,7 +147,7 @@ class TgenDevice(DeviceApp):
         self.last_sz = self.size % self.MSS or self.MSS
         from shadow_tpu.models.tgen import CHUNK_PKTS
         self.chunk = CHUNK_PKTS
-        self.n_state_words = 6
+        self.n_state_words = 7
         self.max_sends = self.chunk
         self.max_timers = 1
         self.max_draws = 1              # no randomness consumed
@@ -166,6 +170,7 @@ class TgenDevice(DeviceApp):
         got = app_state[:, 3]
         done = app_state[:, 4]
         gen = app_state[:, 5]
+        mask = app_state[:, 6]
         is_server = role == 0
         is_client = role == 1
 
@@ -176,10 +181,16 @@ class TgenDevice(DeviceApp):
         timer_pause = is_timer & (d0 < 0)
         timer_retry = is_timer & (d0 >= 0) & (d0 == gen)
 
-        # ---- client window progress ----
-        new_got = jnp.where(is_data, got + 1, got)
+        # ---- client window progress (fresh in-window DATA only) ----
         chunk_len = jnp.minimum(self.chunk, self.npkts - chunk_start)
-        complete = is_data & (new_got >= chunk_len)
+        off = d1 - chunk_start
+        in_window = is_data & (off >= 0) & (off < chunk_len)
+        bit = jnp.left_shift(jnp.int32(1),
+                             jnp.clip(off, 0, self.chunk - 1))
+        fresh = in_window & ((mask & bit) == 0)
+        new_mask = jnp.where(fresh, mask | bit, mask)
+        new_got = jnp.where(fresh, got + 1, got)
+        complete = fresh & (new_got >= chunk_len)
         next_start = chunk_start + chunk_len
         dl_done = complete & (next_start >= self.npkts)
         cont = complete & ~dl_done
@@ -192,6 +203,7 @@ class TgenDevice(DeviceApp):
             cont, next_start,
             jnp.where(is_boot | timer_pause | dl_done, 0, chunk_start))
         new_got = jnp.where(send_req | dl_done, 0, new_got)
+        new_mask = jnp.where(send_req | dl_done, 0, new_mask)
         new_done = done + dl_done.astype(jnp.int32)
         new_gen = gen + (send_req | dl_done).astype(jnp.int32)
 
@@ -200,6 +212,7 @@ class TgenDevice(DeviceApp):
         st = st.at[:, 3].set(new_got)
         st = st.at[:, 4].set(new_done)
         st = st.at[:, 5].set(new_gen)
+        st = st.at[:, 6].set(new_mask)
 
         # ---- sends ----
         ks = jnp.arange(K, dtype=jnp.int32)[None, :]           # [1,K]

@@ -18,7 +18,6 @@ from typing import Optional
 
 import numpy as np
 
-import numpy as _np
 
 from shadow_tpu._jax import jax
 from shadow_tpu.core.manager import SimStats
@@ -55,8 +54,8 @@ def device_twin(sim) -> DeviceApp:
 
     if classes <= {TgenServerApp, TgenClientApp}:
         name_to_id = {h.name: h.host_id for h in sim.hosts}
-        roles = _np.zeros(n_hosts, _np.int32)
-        server_gid = _np.zeros(n_hosts, _np.int32)
+        roles = np.zeros(n_hosts, np.int32)
+        server_gid = np.zeros(n_hosts, np.int32)
         clients = [a for a in real if isinstance(a, TgenClientApp)]
         if not clients:
             raise ValueError("tpu policy: tgen config has no clients")

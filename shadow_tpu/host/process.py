@@ -56,10 +56,24 @@ class ManagedRuntime:
         self.dns = dns
         self.data_dir = data_dir
         self.spin_max = spin_max
-        self.shim_path = native.shim_path()
-        name = f"shadowtpu_shm_{os.getpid()}_{seed}"
-        self.arena = native.ShmArena(name, size=1 << 22, create=True)
-        self._closed = False
+        self.seed = seed
+        self._shim_path: Optional[str] = None
+        self._arena = None          # built on first preload use only:
+        self._closed = False        # the ptrace backend needs neither
+
+    @property
+    def shim_path(self) -> str:
+        if self._shim_path is None:
+            self._shim_path = native.shim_path()
+        return self._shim_path
+
+    @property
+    def arena(self):
+        if self._arena is None:
+            name = f"shadowtpu_shm_{os.getpid()}_{self.seed}"
+            self._arena = native.ShmArena(name, size=1 << 22,
+                                          create=True)
+        return self._arena
 
     def resolve_ip(self, ip_int: int) -> Optional[int]:
         addr = self.dns.resolve_ip(ip_int)
@@ -68,8 +82,9 @@ class ManagedRuntime:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
-            self.arena.unlink()
-            self.arena.close()
+            if self._arena is not None:
+                self._arena.unlink()
+                self._arena.close()
 
 
 class ManagedProcess:

@@ -1,0 +1,505 @@
+"""Ptrace interposition backend (PTRACE_SYSEMU).
+
+The rebuild of the reference's second interposition method
+(src/main/host/thread_ptrace.c): instead of a preloaded shim funneling
+trapped syscalls over shared-memory IPC, the simulator ptrace-attaches
+to the managed process and drives it with PTRACE_SYSEMU — every
+syscall stops the tracee *before* execution and the kernel suppresses
+it, so the simulator can emulate it (poke the result into %rax) or
+re-execute it natively (rewind %rip over the 2-byte `syscall`
+instruction and step through with PTRACE_SYSCALL — the reference's
+"deliver to native" path, thread_ptrace.c:1074 onward).
+
+Linux requires every ptrace request (and the waitpid noticing tracee
+stops) to come from the tracer task itself, so each PtraceProcess owns
+a dedicated tracer thread holding the fork/exec, the SYSEMU loop, and
+all register access; the simulation threads talk to it over a command
+queue. This mirrors the reference's per-worker fork-proxy +
+tracer-affinity workarounds (thread_ptrace.c:39-56,
+utility/fork_proxy.c).
+
+TSC emulation (src/lib/tsc/tsc.c): the child sets
+prctl(PR_SET_TSC, PR_TSC_SIGSEGV) before exec (the flag survives
+execve), so `rdtsc`/`rdtscp` raise SIGSEGV; the tracer decodes the
+instruction at %rip (0F 31 / 0F 01 F9), writes a deterministic
+cycle count derived from simulated time into %edx:%eax (nominal
+1 GHz ⇒ cycles == nanoseconds), advances %rip, and resumes — plugin
+time reads are pure functions of sim time, like the reference's
+Tsc_emulateRdtsc.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import signal
+import struct
+import threading
+from typing import Optional
+
+from shadow_tpu.host.process import ManagedProcess, RECV_TIMEOUT_MS
+from shadow_tpu.host.memory import ProcessMemory
+from shadow_tpu.host.syscalls import NATIVE, NR_NAME, Blocked
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("ptrace")
+
+_libc = ctypes.CDLL(None, use_errno=True)
+_libc.ptrace.restype = ctypes.c_long
+_libc.ptrace.argtypes = [ctypes.c_long, ctypes.c_long,
+                         ctypes.c_void_p, ctypes.c_void_p]
+
+# ptrace requests
+TRACEME = 0
+CONT = 7
+GETREGS = 12
+SETREGS = 13
+SETOPTIONS = 0x4200
+SYSCALL = 24
+SYSEMU = 31
+
+OPT_SYSGOOD = 0x1           # PTRACE_O_TRACESYSGOOD
+OPT_EXITKILL = 0x00100000   # PTRACE_O_EXITKILL
+
+SYSCALL_TRAP = signal.SIGTRAP | 0x80     # sysgood syscall stop
+
+POKEDATA = 5
+
+# vDSO fast paths bypass the syscall instruction entirely, so SYSEMU
+# never sees them; like rr, overwrite each exported vDSO function with
+# an 8-byte real-syscall stub (mov eax, NR; syscall; ret) so plugin
+# time reads become trappable syscalls. (The preload backend doesn't
+# need this: LD_PRELOAD beats the libc symbols that call the vDSO.)
+_VDSO_STUBS = {
+    b"__vdso_clock_gettime": 228,
+    b"__vdso_gettimeofday": 96,
+    b"__vdso_time": 201,
+    b"__vdso_clock_getres": 229,
+    b"__vdso_getcpu": 309,
+    b"clock_gettime": 228,
+    b"gettimeofday": 96,
+    b"time": 201,
+    b"clock_getres": 229,
+    b"getcpu": 309,
+}
+
+PR_SET_TSC, PR_TSC_SIGSEGV = 26, 2
+ADDR_NO_RANDOMIZE = 0x0040000
+
+NOMINAL_TSC_HZ = 1_000_000_000           # 1 GHz: cycles == sim ns
+
+
+class UserRegs(ctypes.Structure):
+    _fields_ = [(n, ctypes.c_ulonglong) for n in (
+        "r15", "r14", "r13", "r12", "rbp", "rbx", "r11", "r10",
+        "r9", "r8", "rax", "rcx", "rdx", "rsi", "rdi", "orig_rax",
+        "rip", "cs", "eflags", "rsp", "ss", "fs_base", "gs_base",
+        "ds", "es", "fs", "gs")]
+
+
+def _ptrace(req: int, pid: int, addr=None, data=None) -> int:
+    ctypes.set_errno(0)
+    r = _libc.ptrace(req, pid, addr, data)
+    if r == -1:
+        err = ctypes.get_errno()
+        if err:
+            raise OSError(err, f"ptrace({req}, {pid}): "
+                          f"{os.strerror(err)}")
+    return r
+
+
+class _TraceeExited(Exception):
+    def __init__(self, code: int):
+        self.code = code
+
+
+class _Tracer(threading.Thread):
+    """Owns all ptrace operations for one tracee.
+
+    Commands (cmd, payload) on self.cmds; replies on self.replies:
+      spawn  -> ("pid", pid) | ("error", msg)
+      step   -> payload (result|None, native: bool, sim_ns) ; applies
+                the pending syscall result, resumes, and replies
+                ("syscall", nr, args) | ("exit", code)
+      kill   -> ("exit", code)
+    """
+
+    def __init__(self, argv, env, cwd, stdout_path, stderr_path,
+                 emulate_tsc: bool = True):
+        super().__init__(daemon=True)
+        self.argv = argv
+        self.env = env
+        self.cwd = cwd
+        self.stdout_path = stdout_path
+        self.stderr_path = stderr_path
+        self.emulate_tsc = emulate_tsc
+        self.cmds: queue.Queue = queue.Queue()
+        self.replies: queue.Queue = queue.Queue()
+        self.pid: Optional[int] = None
+        self.exited = threading.Event()
+        self.sim_ns = 0
+
+    # -- child setup (between fork and exec; async-signal-safe-ish) ----
+    def _child(self) -> None:
+        try:
+            _libc.ptrace(TRACEME, 0, None, None)
+            _libc.personality(ADDR_NO_RANDOMIZE)
+            if self.emulate_tsc:
+                _libc.prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0)
+            out = os.open(self.stdout_path,
+                          os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            err = os.open(self.stderr_path,
+                          os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            devnull = os.open(os.devnull, os.O_RDONLY)
+            os.dup2(devnull, 0)
+            os.dup2(out, 1)
+            os.dup2(err, 2)
+            os.chdir(self.cwd)
+            os.execve(self.argv[0], self.argv, self.env)
+        except BaseException:
+            pass
+        os._exit(127)
+
+    # -- vDSO patching (tracer thread, at the exec stop) ----------------
+    def _patch_vdso(self) -> None:
+        try:
+            self._patch_vdso_inner()
+        except Exception as e:     # malformed ELF must not kill the
+            log.warning("vdso patch skipped: %s", e)   # tracer thread
+
+    def _patch_vdso_inner(self) -> None:
+        base = size = None
+        try:
+            with open(f"/proc/{self.pid}/maps") as f:
+                for line in f:
+                    if "[vdso]" in line:
+                        lo, hi = line.split()[0].split("-")
+                        base, size = int(lo, 16), \
+                            int(hi, 16) - int(lo, 16)
+                        break
+        except OSError:
+            return
+        if base is None:
+            return
+        try:
+            img = ProcessMemory(self.pid).read(base, size)
+        except OSError:
+            return
+        if img[:4] != b"\x7fELF":
+            return
+        # locate .dynsym / .dynstr via the section headers
+        e_shoff, = struct.unpack_from("<Q", img, 0x28)
+        e_shentsize, e_shnum = struct.unpack_from("<HH", img, 0x3A)
+        dynsym = dynstr = None
+        for i in range(e_shnum):
+            off = e_shoff + i * e_shentsize
+            if off + 64 > len(img):
+                return
+            sh_type, = struct.unpack_from("<I", img, off + 4)
+            sh_offset, sh_size = struct.unpack_from("<QQ", img,
+                                                    off + 0x18)
+            sh_entsize, = struct.unpack_from("<Q", img, off + 0x38)
+            if sh_type == 11:                      # SHT_DYNSYM
+                dynsym = (sh_offset, sh_size, sh_entsize)
+                sh_link, = struct.unpack_from("<I", img, off + 0x28)
+                loff = e_shoff + sh_link * e_shentsize
+                dynstr, = struct.unpack_from("<Q", img, loff + 0x18)
+        if dynsym is None or dynstr is None:
+            return
+        soff, ssize, sent = dynsym
+        patched = 0
+        for off in range(soff, soff + ssize, sent or 24):
+            st_name, = struct.unpack_from("<I", img, off)
+            st_value, = struct.unpack_from("<Q", img, off + 8)
+            if not st_name or not st_value:
+                continue
+            end = img.index(b"\0", dynstr + st_name)
+            name = img[dynstr + st_name:end]
+            nr = _VDSO_STUBS.get(name)
+            if nr is None:
+                continue
+            stub = bytes([0xB8]) + struct.pack("<I", nr) \
+                + b"\x0f\x05\xc3"
+            word, = struct.unpack("<q", stub)
+            try:
+                _ptrace(POKEDATA, self.pid,
+                        ctypes.c_void_p(base + st_value),
+                        ctypes.c_void_p(word & (2**64 - 1)))
+                patched += 1
+            except OSError as e:
+                log.debug("vdso patch %s failed: %s", name, e)
+        log.debug("patched %d vDSO entries", patched)
+
+    # -- tracee helpers (tracer thread only) ----------------------------
+    def _getregs(self) -> UserRegs:
+        regs = UserRegs()
+        _ptrace(GETREGS, self.pid, None, ctypes.byref(regs))
+        return regs
+
+    def _setregs(self, regs: UserRegs) -> None:
+        _ptrace(SETREGS, self.pid, None, ctypes.byref(regs))
+
+    def _wait(self) -> int:
+        """waitpid; raises _TraceeExited on termination."""
+        _, status = os.waitpid(self.pid, 0)
+        if os.WIFEXITED(status):
+            raise _TraceeExited(os.WEXITSTATUS(status))
+        if os.WIFSIGNALED(status):
+            raise _TraceeExited(128 + os.WTERMSIG(status))
+        return os.WSTOPSIG(status)
+
+    def _try_emulate_tsc(self) -> bool:
+        """At a SIGSEGV stop: if %rip is rdtsc/rdtscp, emulate it."""
+        regs = self._getregs()
+        try:
+            code = ProcessMemory(self.pid).read(regs.rip, 3)
+        except OSError:
+            return False
+        cycles = self.sim_ns  # 1 GHz nominal
+        if code[:2] == b"\x0f\x31":                    # rdtsc
+            regs.rip += 2
+        elif code[:3] == b"\x0f\x01\xf9":              # rdtscp
+            regs.rip += 3
+            regs.rcx = 0                               # IA32_TSC_AUX
+        else:
+            return False
+        regs.rax = cycles & 0xFFFFFFFF
+        regs.rdx = (cycles >> 32) & 0xFFFFFFFF
+        self._setregs(regs)
+        return True
+
+    def _resume_to_syscall(self, first_sig: int = 0):
+        """SYSEMU-resume until the next syscall-entry stop; emulate
+        rdtsc SIGSEGVs and forward other signals along the way."""
+        deliver = first_sig
+        while True:
+            _ptrace(SYSEMU, self.pid, None,
+                    ctypes.c_void_p(deliver) if deliver else None)
+            deliver = 0
+            sig = self._wait()
+            if sig == SYSCALL_TRAP:
+                regs = self._getregs()
+                nr = ctypes.c_long(regs.orig_rax).value
+                args = (regs.rdi, regs.rsi, regs.rdx, regs.r10,
+                        regs.r8, regs.r9)
+                return nr, args
+            if sig == signal.SIGSEGV and self.emulate_tsc \
+                    and self._try_emulate_tsc():
+                continue
+            if sig == signal.SIGTRAP:
+                continue                       # exec stop etc.
+            deliver = sig                      # forward to the tracee
+
+    def _run_native(self) -> None:
+        """Re-execute the suppressed syscall natively (rewind %rip to
+        the `syscall` instruction, then two PTRACE_SYSCALL hops:
+        entry stop, real execution, exit stop)."""
+        regs = self._getregs()
+        regs.rax = regs.orig_rax
+        regs.rip -= 2
+        self._setregs(regs)
+        for _ in range(2):
+            deliver = 0
+            while True:
+                _ptrace(SYSCALL, self.pid, None,
+                        ctypes.c_void_p(deliver) if deliver else None)
+                deliver = 0
+                sig = self._wait()
+                if sig == SYSCALL_TRAP:
+                    break
+                if sig == signal.SIGSEGV and self.emulate_tsc \
+                        and self._try_emulate_tsc():
+                    continue
+                if sig == signal.SIGTRAP:
+                    continue
+                deliver = sig              # forward real faults/signals
+
+    # -- thread main ----------------------------------------------------
+    def run(self) -> None:
+        while True:
+            cmd, payload = self.cmds.get()
+            try:
+                if cmd == "spawn":
+                    pid = os.fork()
+                    if pid == 0:
+                        self._child()           # never returns
+                    self.pid = pid
+                    sig = self._wait()          # exec SIGTRAP stop
+                    if sig != signal.SIGTRAP:
+                        log.warning("unexpected first stop sig=%d", sig)
+                    _ptrace(SETOPTIONS, pid, None,
+                            ctypes.c_void_p(OPT_SYSGOOD | OPT_EXITKILL))
+                    self._patch_vdso()
+                    self.replies.put(("pid", pid))
+                elif cmd == "step":
+                    result, native, sim_ns = payload
+                    self.sim_ns = sim_ns
+                    if native:
+                        self._run_native()
+                    elif result is not None:
+                        regs = self._getregs()
+                        regs.rax = result & 0xFFFFFFFFFFFFFFFF
+                        self._setregs(regs)
+                    nr, args = self._resume_to_syscall()
+                    self.replies.put(("syscall", nr, args))
+                elif cmd == "kill":
+                    if self.pid is not None and not self.exited.is_set():
+                        try:
+                            os.kill(self.pid, signal.SIGKILL)
+                        except ProcessLookupError:
+                            pass
+                        try:
+                            while True:
+                                self._wait()
+                        except _TraceeExited as e:
+                            self.exited.set()
+                            self.replies.put(("exit", e.code))
+                            continue
+                    self.replies.put(("exit", -1))
+                elif cmd == "quit":
+                    return
+            except _TraceeExited as e:
+                self.exited.set()
+                self.replies.put(("exit", e.code))
+            except OSError as e:
+                self.exited.set()
+                self.replies.put(("error", str(e)))
+
+
+class PtraceProcess(ManagedProcess):
+    """A real executable driven by PTRACE_SYSEMU instead of the
+    preload shim (same app interface, same SyscallHandler)."""
+
+    def __init__(self, runtime, path: str, args, environment: str = ""):
+        super().__init__(runtime, path, args, environment)
+        self.tracer: Optional[_Tracer] = None
+        self._pending: Optional[tuple] = None   # (result, native)
+
+    # -- boot -----------------------------------------------------------
+    def boot(self, ctx) -> None:
+        from shadow_tpu.host.descriptors import DescriptorTable
+        from shadow_tpu.host.syscalls import SyscallHandler
+
+        self.host = ctx.host
+        self.manager = ctx._m
+        self.table = DescriptorTable(self.manager)
+        self.handler = SyscallHandler(self)
+
+        host_dir = os.path.join(self.runtime.data_dir, "hosts",
+                                self.host.name)
+        os.makedirs(host_dir, exist_ok=True)
+        base = os.path.basename(self.path)
+        env = {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": host_dir,
+        }
+        for kv in self.environment.split(";"):
+            kv = kv.strip()
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                env[k] = v
+
+        self.tracer = _Tracer(
+            argv=[self.path] + self.args, env=env, cwd=host_dir,
+            stdout_path=os.path.join(host_dir,
+                                     f"{base}.{self.vpid}.stdout"),
+            stderr_path=os.path.join(host_dir,
+                                     f"{base}.{self.vpid}.stderr"))
+        self.tracer.start()
+        self.tracer.cmds.put(("spawn", None))
+        kind, *rest = self.tracer.replies.get(timeout=30)
+        if kind != "pid":
+            raise RuntimeError(f"ptrace spawn failed: {rest}")
+        pid = rest[0]
+        self.mem = ProcessMemory(pid)
+        self._native_pid = pid
+        self.alive = True
+        self._pending = (None, False)
+        log.debug("ptrace-spawned %s pid=%d vpid=%d on %s", self.path,
+                  pid, self.vpid, self.host.name)
+        self._continue(ctx)
+
+    # -- transport ------------------------------------------------------
+    def _reply(self, res, nr: int, args) -> None:
+        if res is NATIVE:
+            self._pending = (None, True)
+        else:
+            self._pending = (int(res), False)
+
+    def _continue(self, ctx) -> None:
+        while True:
+            result, native = self._pending or (None, False)
+            self._pending = None
+            self.tracer.cmds.put(("step", (result, native, ctx.now)))
+            try:
+                reply = self.tracer.replies.get(
+                    timeout=RECV_TIMEOUT_MS / 1000)
+            except queue.Empty:
+                log.warning("%s pid=%s unresponsive for %ds; killing",
+                            self.path, self._native_pid,
+                            RECV_TIMEOUT_MS // 1000)
+                self._kill(ctx)
+                return
+            kind = reply[0]
+            if kind == "exit":
+                self.tracer.exited.set()
+                if self.exit_code is None:
+                    self.exit_code = reply[1]
+                self._finalize_exit(ctx)
+                return
+            if kind == "error":
+                log.warning("tracer error on %s: %s", self.path,
+                            reply[1])
+                self._kill(ctx)
+                return
+            _, nr, args = reply
+            name = NR_NAME.get(nr, str(nr))
+            self.syscall_counts[name] = \
+                self.syscall_counts.get(name, 0) + 1
+            try:
+                res = self.handler.dispatch(ctx, nr, args)
+            except Blocked as b:
+                self._pending = (None, False)
+                self._park(ctx, b, nr, args)
+                return
+            except Exception:
+                log.exception("syscall %s(%s) handler crashed", name,
+                              args)
+                res = -38
+            self._reply(res, nr, args)
+            self.syscall_state = {}
+
+    # (_resume_task is inherited: the parent's park/resume logic calls
+    # our _reply/_continue overrides.)
+
+    # -- teardown -------------------------------------------------------
+    def _finalize_exit(self, ctx) -> None:
+        if not self.alive:
+            return
+        self.alive = False
+        log.debug("%s on %s exited code=%s (%d syscalls, ptrace)",
+                  self.path, self.host.name, self.exit_code,
+                  sum(self.syscall_counts.values()))
+        if self.table is not None:
+            self.table.close_all(ctx)
+        if self.tracer is not None:
+            self.tracer.cmds.put(("quit", None))
+
+    def _kill(self, ctx) -> None:
+        if not self.alive or self.tracer is None:
+            return
+        # kill(2) is not a ptrace request: send it directly so a tracee
+        # spinning in userspace (tracer blocked in waitpid) still dies.
+        try:
+            os.kill(self._native_pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        self.tracer.cmds.put(("kill", None))
+        try:
+            reply = self.tracer.replies.get(timeout=10)
+            if self.exit_code is None and reply[0] == "exit":
+                self.exit_code = reply[1]
+        except queue.Empty:
+            pass
+        self._finalize_exit(ctx)

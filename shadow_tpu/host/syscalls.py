@@ -130,6 +130,16 @@ class SyscallHandler:
     def state(self) -> dict:
         return self.p.syscall_state
 
+
+    def _no_desc(self, fd: int):
+        """fd is not one of our virtual descriptors. Under ptrace every
+        syscall traps, so stdio / real-file fds legitimately reach the
+        handler: hand them back to the kernel (the preload shim's
+        fd>=VFD_BASE gate, native/shim/shim.c, does this client-side;
+        the reference's equivalent is its native-syscall list,
+        syscall_handler.c:225-229)."""
+        return NATIVE if 0 <= fd < VFD_BASE else -EBADF
+
     def _desc(self, fd: int):
         d = self.table.get(fd)
         if d is None or d.closed:
@@ -303,7 +313,7 @@ class SyscallHandler:
         fd, addr_ptr, addrlen = _s32(a[0]), a[1], int(a[2])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         raw = self.mem.read(addr_ptr, min(addrlen, 16))
         family, port, _ip = kmem.unpack_sockaddr_in(raw)
         if family != AF_INET:
@@ -325,7 +335,7 @@ class SyscallHandler:
         fd, backlog = _s32(a[0]), _s32(a[1])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         if isinstance(desc, TcpListenDesc):
             return 0
         if not isinstance(desc, TcpDesc):
@@ -351,7 +361,7 @@ class SyscallHandler:
         fd = _s32(a[0])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         if not isinstance(desc, TcpListenDesc):
             return -EINVAL
         if not desc.accept_queue:
@@ -370,7 +380,7 @@ class SyscallHandler:
         fd, addr_ptr, addrlen = _s32(a[0]), a[1], int(a[2])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         raw = self.mem.read(addr_ptr, min(addrlen, 16))
         family, port, ip_be = kmem.unpack_sockaddr_in(raw)
         if family != AF_INET:
@@ -427,7 +437,7 @@ class SyscallHandler:
         fd, buf, n, flags = _s32(a[0]), a[1], int(a[2]), _s32(a[3])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         if isinstance(desc, UdpDesc):
             if n > UDP_MAX_PAYLOAD:
                 return -EMSGSIZE
@@ -469,7 +479,7 @@ class SyscallHandler:
         fd, buf, n, flags = _s32(a[0]), a[1], int(a[2]), _s32(a[3])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         if isinstance(desc, UdpDesc):
             desc.ensure_bound(self.p.host.net)
             if not desc.queue:
@@ -515,7 +525,7 @@ class SyscallHandler:
         fd, how = _s32(a[0]), _s32(a[1])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         if isinstance(desc, TcpDesc) and desc.sock is not None:
             if how in (SHUT_WR, SHUT_RDWR):
                 desc.sock.close(ctx.now)
@@ -528,9 +538,10 @@ class SyscallHandler:
         return -ENOTSOCK
 
     def sys_getsockname(self, ctx, a):
-        desc = self._desc(_s32(a[0]))
+        fd = _s32(a[0])
+        desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         port = 0
         if isinstance(desc, UdpDesc):
             port = desc.bound_port or 0
@@ -545,9 +556,10 @@ class SyscallHandler:
         return 0
 
     def sys_getpeername(self, ctx, a):
-        desc = self._desc(_s32(a[0]))
+        fd = _s32(a[0])
+        desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         peer = None
         if isinstance(desc, TcpDesc) and desc.sock is not None:
             peer = desc.sock.peer
@@ -564,7 +576,7 @@ class SyscallHandler:
         val_ptr, len_ptr = a[3], a[4]
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         val = 0
         if level == SOL_SOCKET:
             if opt == SO_ERROR:
@@ -586,9 +598,10 @@ class SyscallHandler:
         return 0
 
     def sys_setsockopt(self, ctx, a):
-        desc = self._desc(_s32(a[0]))
+        fd = _s32(a[0])
+        desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         return 0            # accept and ignore (SO_REUSEADDR, NODELAY…)
 
     def sys_socketpair(self, ctx, a):
@@ -601,7 +614,7 @@ class SyscallHandler:
         fd, buf, n = _s32(a[0]), a[1], int(a[2])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         if isinstance(desc, TcpDesc):
             return self._tcp_read(ctx, desc, buf, n, 0)
         if isinstance(desc, UdpDesc):
@@ -618,7 +631,7 @@ class SyscallHandler:
         fd, buf, n = _s32(a[0]), a[1], int(a[2])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         if isinstance(desc, TcpDesc):
             return self._tcp_write(ctx, desc, buf, n, 0)
         if isinstance(desc, UdpDesc):
@@ -655,28 +668,41 @@ class SyscallHandler:
         return total
 
     def sys_readv(self, ctx, a):
+        if self._desc(_s32(a[0])) is None:
+            return self._no_desc(_s32(a[0]))
         return self._iov_loop(ctx, a, self.sys_read)
 
     def sys_writev(self, ctx, a):
+        if self._desc(_s32(a[0])) is None:
+            return self._no_desc(_s32(a[0]))
         return self._iov_loop(ctx, a, self.sys_write)
 
     def sys_pread64(self, ctx, a):
+        if self._desc(_s32(a[0])) is None:
+            return self._no_desc(_s32(a[0]))
         return -ESPIPE
 
     def sys_pwrite64(self, ctx, a):
+        if self._desc(_s32(a[0])) is None:
+            return self._no_desc(_s32(a[0]))
         return -ESPIPE
 
     def sys_lseek(self, ctx, a):
+        if self._desc(_s32(a[0])) is None:
+            return self._no_desc(_s32(a[0]))
         return -ESPIPE
 
     def sys_close(self, ctx, a):
         fd = _s32(a[0])
+        if self.table.get(fd) is None:
+            return self._no_desc(fd)
         return 0 if self.table.close_fd(ctx, fd) else -EBADF
 
     def sys_fstat(self, ctx, a):
-        desc = self._desc(_s32(a[0]))
+        fd = _s32(a[0])
+        desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         st = bytearray(144)
         mode = 0o140777 if not isinstance(desc, PipeDesc) else 0o10600
         struct.pack_into("<I", st, 24, mode)
@@ -713,7 +739,7 @@ class SyscallHandler:
         fd, cmd, arg = _s32(a[0]), _s32(a[1]), int(a[2])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         if cmd in (F_DUPFD, F_DUPFD_CLOEXEC):
             min_fd = arg - VFD_BASE if arg >= VFD_BASE else 0
             return self.table.dup(fd, min_fd)
@@ -730,7 +756,7 @@ class SyscallHandler:
         fd, req, argp = _s32(a[0]), int(a[1]) & 0xFFFFFFFF, a[2]
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         if req == FIONBIO:
             val = struct.unpack("<i", self.mem.read(argp, 4))[0]
             desc.nonblock = bool(val)
@@ -750,7 +776,7 @@ class SyscallHandler:
     def sys_dup(self, ctx, a):
         fd = _s32(a[0])
         if self._desc(fd) is None:
-            return -EBADF
+            return self._no_desc(fd)
         return self.table.dup(fd)
 
     def sys_dup2(self, ctx, a):
@@ -761,7 +787,7 @@ class SyscallHandler:
 
     def _dup_to(self, ctx, oldfd: int, newfd: int):
         if self._desc(oldfd) is None:
-            return -EBADF
+            return self._no_desc(oldfd)
         if newfd < VFD_BASE:
             return -EINVAL          # cannot shadow native kernel fds
         if newfd == oldfd:
@@ -1080,7 +1106,7 @@ class SyscallHandler:
         fd, msg_ptr, flags = _s32(a[0]), a[1], _s32(a[2])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         name, namelen, iov = self._read_msghdr(msg_ptr)
         if isinstance(desc, UdpDesc):
             data = b"".join(self.mem.read(b, ln) for b, ln in iov)
@@ -1118,7 +1144,7 @@ class SyscallHandler:
         fd, msg_ptr, flags = _s32(a[0]), a[1], _s32(a[2])
         desc = self._desc(fd)
         if desc is None:
-            return -EBADF
+            return self._no_desc(fd)
         name, namelen, iov = self._read_msghdr(msg_ptr)
         if not iov:
             return -EINVAL

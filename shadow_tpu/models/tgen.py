@@ -76,6 +76,7 @@ class TgenClientApp(ModelApp):
         self.bytes_received = 0
         self._chunk_start = 0          # first packet index of the chunk
         self._got = 0                  # packets received in the chunk
+        self._mask = 0                 # bitmask of chunk seqs received
         self._req_gen = 0              # stale-retry guard
         self._server: int | None = None
 
@@ -87,6 +88,7 @@ class TgenClientApp(ModelApp):
         if self._server is None:
             self._server = ctx.resolve(self.server_name)
         self._got = 0
+        self._mask = 0
         self._req_gen += 1
         ctx.send(self._server, 64, (TAG_REQ, self._chunk_start,
                                     self.size))
@@ -110,9 +112,19 @@ class TgenClientApp(ModelApp):
         tag = data[0] if data else 0
         if tag != TAG_DATA:
             return
+        # count only fresh in-window packets: a premature retry can put
+        # duplicate DATA in flight, which must not advance the window
+        seq = data[1] if len(data) > 1 else -1
+        chunk_len = min(CHUNK_PKTS, self._npkts - self._chunk_start)
+        off = seq - self._chunk_start
+        if off < 0 or off >= chunk_len:
+            return                     # stale chunk / out of window
+        bit = 1 << off
+        if self._mask & bit:
+            return                     # duplicate within the window
+        self._mask |= bit
         self.bytes_received += size
         self._got += 1
-        chunk_len = min(CHUNK_PKTS, self._npkts - self._chunk_start)
         if self._got < chunk_len:
             return
         self._chunk_start += chunk_len

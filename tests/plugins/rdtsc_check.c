@@ -1,0 +1,31 @@
+/* rdtsc/rdtscp determinism probe for the ptrace TSC emulation
+ * (reference: src/lib/tsc/tsc_test.c). Under the simulator the
+ * counter is a pure function of simulated time (nominal 1 GHz), so
+ * the printed deltas are exact. */
+#include <stdint.h>
+#include <stdio.h>
+#include <unistd.h>
+
+static inline uint64_t rdtsc(void) {
+  uint32_t lo, hi;
+  __asm__ __volatile__("rdtsc" : "=a"(lo), "=d"(hi));
+  return ((uint64_t)hi << 32) | lo;
+}
+
+static inline uint64_t rdtscp(void) {
+  uint32_t lo, hi, aux;
+  __asm__ __volatile__("rdtscp" : "=a"(lo), "=d"(hi), "=c"(aux));
+  return ((uint64_t)hi << 32) | lo;
+}
+
+int main(void) {
+  uint64_t t0 = rdtsc();
+  usleep(50000); /* 50 ms simulated */
+  uint64_t t1 = rdtsc();
+  uint64_t t2 = rdtscp();
+  printf("t0 %llu\n", (unsigned long long)t0);
+  printf("dt %llu\n", (unsigned long long)(t1 - t0));
+  printf("p_ge %d\n", t2 >= t1);
+  fflush(stdout);
+  return 0;
+}
