@@ -182,6 +182,7 @@ static int is_fd_gated(long nr) {
   case SYS_pwrite64:
   case SYS_newfstatat: /* glibc's fstat(fd) path; dirfd-gated */
   case SYS_statx:
+  case SYS_sendfile:   /* out_fd-gated: emulated only toward our sockets */
     return 1;
   default:
     return 0;
@@ -225,18 +226,41 @@ static long shim_do_syscall(long nr, const long args[6]) {
 
 /* ---- SIGSYS handler ------------------------------------------------ */
 
+static volatile int g_in_handler = 0;
+
 static void sigsys_handler(int sig, siginfo_t *info, void *vctx) {
   (void)sig;
   ucontext_t *ctx = (ucontext_t *)vctx;
   greg_t *g = ctx->uc_mcontext.gregs;
+  if (g_in_handler) {
+    /* A syscall made by the shim itself was trapped: filter/config bug
+     * (e.g. a stacked stale filter from a wrapper process). Without
+     * this guard the kernel force-kills on the doubly-nested SIGSYS
+     * with no diagnostics. Report once, then die via SIGKILL (kill is
+     * never in the trap lists, so it passes any stacked filter). */
+    if (g_in_handler == 1) {
+      g_in_handler = 2;
+      char buf[96];
+      int n = snprintf(buf, sizeof buf,
+                       "shadowtpu-shim: nested seccomp trap nr=%lld "
+                       "ip=%llx\n", (long long)g[REG_RAX],
+                       (unsigned long long)g[REG_RIP]);
+      shim_rawsyscall(SYS_write, 2, (long)buf, n, 0, 0, 0);
+    }
+    long pid = shim_rawsyscall(SYS_getpid, 0, 0, 0, 0, 0, 0);
+    shim_rawsyscall(SYS_kill, pid, 9 /* SIGKILL */, 0, 0, 0, 0);
+    return;
+  }
   if (info->si_code != SYS_SECCOMP)
     return;
+  g_in_handler = 1;
   long nr = (long)g[REG_RAX];
   long args[6] = {(long)g[REG_RDI], (long)g[REG_RSI], (long)g[REG_RDX],
                   (long)g[REG_R10], (long)g[REG_R8],  (long)g[REG_R9]};
   long saved_errno = errno;
   g[REG_RAX] = shim_do_syscall(nr, args);
   errno = saved_errno;
+  g_in_handler = 0;
 }
 
 /* ---- seccomp filter ------------------------------------------------ */
@@ -261,14 +285,15 @@ static const int kTrapSyscalls[] = {
     SYS_pipe2,        SYS_getrandom,    SYS_uname,
     SYS_getpid,       SYS_getppid,      SYS_exit,
     SYS_exit_group,   SYS_clone,        SYS_fork,
-    SYS_vfork,
+    SYS_vfork,        SYS_futex,        SYS_sysinfo,
+    SYS_gettid,
 };
 
 static const int kFdGatedSyscalls[] = {
     SYS_read,  SYS_write, SYS_readv,   SYS_writev,   SYS_close,
     SYS_fstat, SYS_lseek, SYS_ioctl,   SYS_fcntl,    SYS_dup,
     SYS_dup2,  SYS_dup3,  SYS_pread64, SYS_pwrite64, SYS_newfstatat,
-    SYS_statx,
+    SYS_statx, SYS_sendfile,
 };
 
 enum { TGT_NONE = 0, TGT_ALLOW, TGT_TRAP, TGT_KILL, TGT_NRCHK, TGT_FDGATE };
@@ -476,7 +501,7 @@ __attribute__((constructor)) static void shim_init(void) {
   struct sigaction sa;
   memset(&sa, 0, sizeof(sa));
   sa.sa_sigaction = sigsys_handler;
-  sa.sa_flags = SA_SIGINFO;
+  sa.sa_flags = SA_SIGINFO | SA_NODEFER;
   sigemptyset(&sa.sa_mask);
   if (sigaction(SIGSYS, &sa, NULL) != 0) {
     shim_log_fail("shadowtpu-shim: sigaction(SIGSYS) failed\n");

@@ -146,6 +146,8 @@ class TgenDevice(DeviceApp):
         self.npkts = (self.size + self.MSS - 1) // self.MSS
         self.last_sz = self.size % self.MSS or self.MSS
         from shadow_tpu.models.tgen import CHUNK_PKTS
+        assert CHUNK_PKTS <= 32, \
+            "seq_mask is one int32 word: CHUNK_PKTS must stay <= 32"
         self.chunk = CHUNK_PKTS
         self.n_state_words = 7
         self.max_sends = self.chunk

@@ -74,6 +74,36 @@ class Condition:
         self.process.schedule_continue(ctx)
 
 
+class Futex:
+    """One futex word (futex.c): a wait queue keyed by the word's
+    plugin address, woken explicitly by FUTEX_WAKE rather than by a
+    status bit. Reuses the Condition wiring so blocked FUTEX_WAITs
+    park exactly like blocked descriptor I/O. The per-process table
+    (futex_table.c) lives in ManagedProcess.futexes."""
+
+    def __init__(self, addr: int):
+        self.addr = addr
+        self.conditions: set[Condition] = set()
+        self.watchers: set = set()       # never epolled; protocol compat
+        self.closed = False
+        self.nonblock = False
+
+    def status(self) -> int:
+        return 0
+
+    def wake(self, ctx, n: int) -> int:
+        woken = 0
+        for cond in list(self.conditions):
+            if woken >= n:
+                break
+            cond.wake(ctx)
+            woken += 1
+        return woken
+
+    def notify(self, ctx) -> None:
+        pass                             # only explicit wakes
+
+
 class Descriptor:
     def __init__(self):
         self.fd = -1

@@ -45,6 +45,23 @@ log = get_logger("process")
 RECV_TIMEOUT_MS = 120_000
 
 
+_ASLR_OFF = [False]
+
+
+def _disable_aslr_inheritable() -> None:
+    """personality(ADDR_NO_RANDOMIZE) on this process; children inherit
+    it across fork+exec (the reference's disable_aslr.c mechanism)."""
+    if _ASLR_OFF[0]:
+        return
+    import ctypes
+    ADDR_NO_RANDOMIZE = 0x0040000
+    libc = ctypes.CDLL(None, use_errno=True)
+    cur = libc.personality(0xFFFFFFFF)
+    if cur != -1:
+        libc.personality(cur | ADDR_NO_RANDOMIZE)
+    _ASLR_OFF[0] = True
+
+
 class ManagedRuntime:
     """Per-simulation services shared by all managed processes: the
     shmem arena the IPC channels live in, the shim library path, and
@@ -121,9 +138,40 @@ class ManagedProcess:
         self.exit_code: Optional[int] = None
         self.parked: Optional[tuple] = None     # (nr, args)
         self.syscall_state: dict = {}
+        self.futexes: dict[int, object] = {}    # addr -> Futex
         self._reaper: Optional[threading.Thread] = None
         self._rng_counter = 0
         self.syscall_counts: dict[str, int] = {}
+
+    @property
+    def native_pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    # -- spawn plumbing shared by the preload and ptrace backends -------
+    def _host_paths(self) -> tuple[str, str, str]:
+        """(host_dir, stdout_path, stderr_path) under the host data dir
+        (process.c:69-77 working dir, :465-478 stdio redirect)."""
+        host_dir = os.path.join(self.runtime.data_dir, "hosts",
+                                self.host.name)
+        os.makedirs(host_dir, exist_ok=True)
+        base = os.path.basename(self.path)
+        return (host_dir,
+                os.path.join(host_dir, f"{base}.{self.vpid}.stdout"),
+                os.path.join(host_dir, f"{base}.{self.vpid}.stderr"))
+
+    def _child_env(self, host_dir: str) -> dict:
+        """Base child environment + the config's ';'-separated
+        `environment` entries (manager.c:386-505 equivalent)."""
+        env = {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": host_dir,
+        }
+        for kv in self.environment.split(";"):
+            kv = kv.strip()
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                env[k] = v
+        return env
 
     # -- app interface -------------------------------------------------
     def boot(self, ctx) -> None:
@@ -137,36 +185,25 @@ class ManagedProcess:
         self.channel = native.IpcChannel(self.runtime.arena,
                                          spin_max=self.runtime.spin_max)
 
-        host_dir = os.path.join(self.runtime.data_dir, "hosts",
-                                self.host.name)
-        os.makedirs(host_dir, exist_ok=True)
-        base = os.path.basename(self.path)
-        stdout_f = open(os.path.join(host_dir, f"{base}.{self.vpid}"
-                                     ".stdout"), "wb")
-        stderr_f = open(os.path.join(host_dir, f"{base}.{self.vpid}"
-                                     ".stderr"), "wb")
+        host_dir, stdout_path, stderr_path = self._host_paths()
+        stdout_f = open(stdout_path, "wb")
+        stderr_f = open(stderr_path, "wb")
 
-        env = {
-            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
-            "HOME": host_dir,
-            "SHADOWTPU_SHM": self.runtime.arena.name,
-            "SHADOWTPU_IPC_OFFSET": str(self.channel.offset),
-            "LD_PRELOAD": self.runtime.shim_path,
-        }
-        for kv in self.environment.split(";"):
-            kv = kv.strip()
-            if "=" in kv:
-                k, v = kv.split("=", 1)
-                env[k] = v
+        env = self._child_env(host_dir)
+        env["SHADOWTPU_SHM"] = self.runtime.arena.name
+        env["SHADOWTPU_IPC_OFFSET"] = str(self.channel.offset)
+        env["LD_PRELOAD"] = self.runtime.shim_path
 
-        # determinism: disable ASLR in the child (main.c:287). Using a
-        # setarch wrapper (not preexec_fn) keeps subprocess on the
-        # fork-free posix_spawn path — safe alongside JAX's threads.
-        import shutil
+        # determinism: disable ASLR in the child (main.c:287,
+        # disable_aslr.c). Like the reference, set ADDR_NO_RANDOMIZE on
+        # the SIMULATOR process — the personality is inherited by every
+        # child, which keeps subprocess on the fork-free posix_spawn
+        # path AND avoids a wrapper binary. (A setarch wrapper would be
+        # LD_PRELOADed too: its shim instance installs a seccomp filter
+        # whose instruction-pointer escape dies at execve, and stacked
+        # filters then kill the shim's own raw syscalls.)
+        _disable_aslr_inheritable()
         argv = [self.path] + self.args
-        setarch = shutil.which("setarch")
-        if setarch:
-            argv = [setarch, "--addr-no-randomize"] + argv
         self.proc = subprocess.Popen(
             argv, env=env, cwd=host_dir, stdout=stdout_f,
             stderr=stderr_f, stdin=subprocess.DEVNULL)

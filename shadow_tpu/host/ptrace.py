@@ -321,6 +321,16 @@ class _Tracer(threading.Thread):
             cmd, payload = self.cmds.get()
             try:
                 if cmd == "spawn":
+                    # fork (not posix_spawn) because the tracer must be
+                    # the tracee's parent AND the same thread for every
+                    # later ptrace request. Known caveat: forking a
+                    # multithreaded process is only safe if the child
+                    # sticks to async-signal-safe work — _child() does
+                    # raw execve plumbing only, but a malloc-holding
+                    # thread at fork time could in principle deadlock
+                    # the pre-exec child (the reference isolates this
+                    # with a dedicated ForkProxy thread created before
+                    # threads proliferate, utility/fork_proxy.c).
                     pid = os.fork()
                     if pid == 0:
                         self._child()           # never returns
@@ -375,6 +385,11 @@ class PtraceProcess(ManagedProcess):
         super().__init__(runtime, path, args, environment)
         self.tracer: Optional[_Tracer] = None
         self._pending: Optional[tuple] = None   # (result, native)
+        self._native_pid: Optional[int] = None
+
+    @property
+    def native_pid(self):
+        return self._native_pid
 
     # -- boot -----------------------------------------------------------
     def boot(self, ctx) -> None:
@@ -386,26 +401,12 @@ class PtraceProcess(ManagedProcess):
         self.table = DescriptorTable(self.manager)
         self.handler = SyscallHandler(self)
 
-        host_dir = os.path.join(self.runtime.data_dir, "hosts",
-                                self.host.name)
-        os.makedirs(host_dir, exist_ok=True)
-        base = os.path.basename(self.path)
-        env = {
-            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
-            "HOME": host_dir,
-        }
-        for kv in self.environment.split(";"):
-            kv = kv.strip()
-            if "=" in kv:
-                k, v = kv.split("=", 1)
-                env[k] = v
+        host_dir, stdout_path, stderr_path = self._host_paths()
+        env = self._child_env(host_dir)
 
         self.tracer = _Tracer(
             argv=[self.path] + self.args, env=env, cwd=host_dir,
-            stdout_path=os.path.join(host_dir,
-                                     f"{base}.{self.vpid}.stdout"),
-            stderr_path=os.path.join(host_dir,
-                                     f"{base}.{self.vpid}.stderr"))
+            stdout_path=stdout_path, stderr_path=stderr_path)
         self.tracer.start()
         self.tracer.cmds.put(("spawn", None))
         kind, *rest = self.tracer.replies.get(timeout=30)

@@ -34,6 +34,7 @@ from shadow_tpu.host.descriptors import (
     ERR,
     EpollDesc,
     EventfdDesc,
+    Futex,
     PipeDesc,
     R,
     TcpDesc,
@@ -61,6 +62,8 @@ NR = dict(
     timerfd_settime=286, timerfd_gettime=287, accept4=288, eventfd2=290,
     epoll_create1=291, dup3=292, pipe2=293, recvmmsg=299, sendmmsg=307,
     getrandom=318, newfstatat=262, statx=332,
+    sched_yield=24, gettid=186, sysinfo=99, futex=202,
+    set_tid_address=218, sendfile=40,
 )
 NR_NAME = {v: k for k, v in NR.items()}
 
@@ -1158,7 +1161,221 @@ class SyscallHandler:
         return -ENOTSOCK
 
     def sys_sendmmsg(self, ctx, a):
-        return -ENOSYS
+        """Vector of sendmsg calls (socket.c's sendmmsg shape): stop at
+        the first message that would block — if nothing was sent yet,
+        block; otherwise report the partial count."""
+        fd, vec_ptr, vlen, flags = _s32(a[0]), a[1], int(a[2]), _s32(a[3])
+        if self._desc(fd) is None:
+            return self._no_desc(fd)
+        sent = 0
+        for i in range(min(vlen, 1024)):
+            mm = vec_ptr + i * 64          # struct mmsghdr = msghdr + len
+            try:
+                r = self.sys_sendmsg(ctx, (a[0], mm, flags))
+            except Blocked:
+                if sent == 0:
+                    raise
+                break
+            if isinstance(r, int) and r < 0:
+                return r if sent == 0 else sent
+            self.mem.write(mm + 56, struct.pack("<I", r))
+            sent += 1
+        return sent
 
     def sys_recvmmsg(self, ctx, a):
+        fd, vec_ptr, vlen, flags = _s32(a[0]), a[1], int(a[2]), _s32(a[3])
+        if self._desc(fd) is None:
+            return self._no_desc(fd)
+        st = self.state
+        if "deadline" not in st:
+            st["deadline"] = None
+            if a[4]:        # struct timespec *timeout (relative)
+                ns = kmem.unpack_timespec(self.mem.read(a[4], 16))
+                st["deadline"] = ctx.now + max(0, ns)
+        got = 0
+        for i in range(min(vlen, 1024)):
+            mm = vec_ptr + i * 64
+            try:
+                r = self.sys_recvmsg(ctx, (a[0], mm, flags))
+            except Blocked as b:
+                if got == 0:
+                    if st["deadline"] is not None and \
+                            ctx.now >= st["deadline"]:
+                        return -EAGAIN
+                    raise Blocked(b.descs, deadline=st["deadline"])
+                break
+            if isinstance(r, int) and r < 0:
+                return r if got == 0 else got
+            self.mem.write(mm + 56, struct.pack("<I", r))
+            got += 1
+            if got < vlen and not self._more_readable(fd):
+                break
+        return got
+
+    def _more_readable(self, fd: int) -> bool:
+        d = self._desc(fd)
+        return d is not None and bool(d.status() & R)
+
+    # ==================================================================
+    # scheduling / identity odds and ends (unistd.c, sysinfo.c)
+    # ==================================================================
+    def sys_sched_yield(self, ctx, a):
+        return 0
+
+    def sys_gettid(self, ctx, a):
+        return self.p.vpid          # single-threaded: tid == pid
+
+    def sys_set_tid_address(self, ctx, a):
+        return self.p.vpid
+
+    def sys_sysinfo(self, ctx, a):
+        """struct sysinfo with simulated uptime; memory fields report a
+        fixed plausible machine (the plugin's view must not depend on
+        the real host — determinism)."""
+        if not a[0]:
+            return -EFAULT
+        si = bytearray(112)
+        struct.pack_into("<q", si, 0,
+                         ctx.now // simtime.SIMTIME_ONE_SECOND)
+        gb = 1 << 32
+        struct.pack_into("<QQ", si, 32, gb, gb // 2)   # totalram freeram
+        struct.pack_into("<H", si, 80, 1)              # procs
+        struct.pack_into("<I", si, 104, 1)             # mem_unit
+        self.mem.write(a[0], bytes(si))
+        return 0
+
+    # ==================================================================
+    # futex (futex.c, futex_table.c)
+    # ==================================================================
+    FUTEX_WAIT, FUTEX_WAKE = 0, 1
+    FUTEX_WAIT_BITSET, FUTEX_WAKE_BITSET = 9, 10
+    FUTEX_CLOCK_REALTIME = 256
+
+    def sys_futex(self, ctx, a):
+        uaddr, op, val = a[0], _s32(a[1]), _s32(a[2]) & 0xFFFFFFFF
+        cmd = op & 0x7F
+        table = self.p.futexes
+        if cmd in (self.FUTEX_WAIT, self.FUTEX_WAIT_BITSET):
+            st = self.state
+            if "parked" in st:           # re-entered: wake or timeout
+                fx = table.get(uaddr)
+                if fx is not None and not fx.conditions:
+                    del table[uaddr]     # timed-out entries must not leak
+                if st["deadline"] is not None and \
+                        ctx.now >= st["deadline"]:
+                    return -ETIMEDOUT
+                return 0
+            cur = struct.unpack("<I", self.mem.read(uaddr, 4))[0]
+            if cur != val:
+                return -EAGAIN
+            st["deadline"] = None
+            if a[3]:
+                ns = kmem.unpack_timespec(self.mem.read(a[3], 16))
+                if cmd == self.FUTEX_WAIT_BITSET:
+                    # bitset waits take an absolute deadline
+                    if op & self.FUTEX_CLOCK_REALTIME:
+                        ns -= simtime.EMULATED_TIME_OFFSET
+                    st["deadline"] = max(ns, ctx.now)
+                else:
+                    st["deadline"] = ctx.now + max(0, ns)
+            fx = table.get(uaddr)
+            if fx is None:
+                fx = table[uaddr] = Futex(uaddr)
+            st["parked"] = True
+            raise Blocked([fx], deadline=st["deadline"])
+        if cmd in (self.FUTEX_WAKE, self.FUTEX_WAKE_BITSET):
+            fx = table.get(uaddr)
+            if fx is None:
+                return 0
+            n = fx.wake(ctx, max(0, val))
+            if not fx.conditions:
+                table.pop(uaddr, None)
+            return n
         return -ENOSYS
+
+    def sys_sendfile(self, ctx, a):
+        """sendfile(out_fd=virtual socket, in_fd=native file): the
+        kernel can't see our socket, so stream the file bytes through
+        the host-side view of the plugin's fd (/proc/pid/fd/N)."""
+        out_fd, in_fd, off_ptr = _s32(a[0]), _s32(a[1]), a[2]
+        count = int(a[3])
+        out = self._desc(out_fd)
+        if out is None:
+            return self._no_desc(out_fd)
+        if not isinstance(out, TcpDesc):
+            return -EINVAL
+        if in_fd >= VFD_BASE:
+            return -EINVAL          # in_fd must be a real file
+        # same connection-state gate as _tcp_write
+        if out.connect_err:
+            err = out.connect_err
+            out.connect_err = None
+            return -err
+        if not out.connected:
+            return -ENOTCONN if not out.connecting else -EAGAIN
+        from shadow_tpu.host.tcp import TcpState
+        if out.sock.state not in (TcpState.ESTABLISHED,
+                                  TcpState.CLOSE_WAIT):
+            return -EPIPE
+        st = self.state
+        if "sf_sent" not in st:
+            st["sf_sent"] = 0
+            if off_ptr:
+                st["sf_off"] = struct.unpack(
+                    "<q", self.mem.read(off_ptr, 8))[0]
+            else:
+                # NULL offset: stream from the fd's current position.
+                # Snapshot it ONCE — on a Blocked restart the plugin's
+                # own fd offset is unchanged (the syscall was
+                # suppressed), so progress lives in sf_sent. The fd
+                # position is left where it was: supported scope is the
+                # send-whole-file-then-close pattern (the reference has
+                # no sendfile at all, syscall_handler.c:434).
+                st["sf_off"] = None
+                st["sf_base"] = self._native_file_offset(in_fd) or 0
+        space = out.send_space()
+        if space <= 0:
+            if out.nonblock:
+                return self._sendfile_finish(ctx, off_ptr) \
+                    if st["sf_sent"] else -EAGAIN
+            raise Blocked([out])
+        try:
+            with open(f"/proc/{self.p.native_pid}/fd/{in_fd}",
+                      "rb") as f:
+                base = st["sf_off"] if st["sf_off"] is not None \
+                    else st["sf_base"]
+                f.seek(base + st["sf_sent"])
+                # read only what this pass can push: a blocked 100 MB
+                # transfer must not re-read the whole tail every wake
+                data = f.read(min(count - st["sf_sent"], space))
+        except OSError:
+            return -EBADF
+        if not data:
+            return self._sendfile_finish(ctx, off_ptr)
+        take = min(len(data), space)
+        self.table.send_channel(out.sock).push(data[:take])
+        out.sock.send(ctx.now, take)
+        st["sf_sent"] += take
+        if st["sf_sent"] >= count or take == len(data):
+            return self._sendfile_finish(ctx, off_ptr)
+        if out.nonblock:
+            return self._sendfile_finish(ctx, off_ptr)
+        raise Blocked([out])
+
+    def _sendfile_finish(self, ctx, off_ptr: int):
+        st = self.state
+        sent = st["sf_sent"]
+        if off_ptr and st["sf_off"] is not None:
+            self.mem.write(off_ptr,
+                           struct.pack("<q", st["sf_off"] + sent))
+        return sent
+
+    def _native_file_offset(self, in_fd: int):
+        try:
+            with open(f"/proc/{self.p.native_pid}/fdinfo/{in_fd}") as f:
+                for line in f:
+                    if line.startswith("pos:"):
+                        return int(line.split()[1])
+        except OSError:
+            pass
+        return None

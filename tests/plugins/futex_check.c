@@ -1,0 +1,42 @@
+/* Futex emulation check (reference: src/main/host/syscall/futex.c):
+ * 1. FUTEX_WAIT with a mismatched expected value -> EAGAIN instantly.
+ * 2. FUTEX_WAKE with no waiters -> 0.
+ * 3. FUTEX_WAIT with a 50 ms timeout -> ETIMEDOUT, and the *simulated*
+ *    clock must have advanced by exactly that timeout.
+ */
+#include <errno.h>
+#include <linux/futex.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+static uint32_t word = 42;
+
+static long fut(int op, uint32_t val, const struct timespec *to) {
+    return syscall(SYS_futex, &word, op, val, to, NULL, 0);
+}
+
+static int64_t now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (int64_t)ts.tv_sec * 1000000000 + ts.tv_nsec;
+}
+
+int main(void) {
+    long r = fut(FUTEX_WAIT, 41, NULL);      /* value mismatch */
+    printf("mismatch: r=%ld errno=%d\n", r, r < 0 ? errno : 0);
+
+    r = fut(FUTEX_WAKE, 128, NULL);          /* nobody waiting */
+    printf("wake: r=%ld\n", r);
+
+    int64_t t0 = now_ns();
+    struct timespec to = {0, 50 * 1000000};  /* 50 ms */
+    r = fut(FUTEX_WAIT, 42, &to);
+    int64_t dt = now_ns() - t0;
+    printf("wait: r=%ld errno=%d dt_ms=%lld\n", r, r < 0 ? errno : 0,
+           (long long)(dt / 1000000));
+    fflush(stdout);
+    return 0;
+}

@@ -1,0 +1,84 @@
+/* sendfile(2) source: writes a deterministic pattern file in its cwd
+ * (the host data dir), then streams it to the server with
+ * sendfile(out=socket, in=file) and prints the expected checksum.
+ * Exercises the emulated sendfile path (the reference leaves sendfile
+ * unimplemented, syscall_handler.c:434 — this framework emulates it by
+ * streaming the file bytes through the in-simulator TCP socket). */
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: sendfile_client <ip> <port> <nbytes>\n");
+    return 2;
+  }
+  const char *ip = argv[1];
+  int port = atoi(argv[2]);
+  long nbytes = atol(argv[3]);
+
+  /* build the pattern file */
+  int f = open("payload.bin", O_CREAT | O_TRUNC | O_WRONLY, 0644);
+  if (f < 0) {
+    perror("open w");
+    return 1;
+  }
+  unsigned long sum = 0;
+  char buf[8192];
+  for (long off = 0; off < nbytes;) {
+    long chunk = nbytes - off;
+    if (chunk > (long)sizeof buf)
+      chunk = (long)sizeof buf;
+    for (long i = 0; i < chunk; i++) {
+      buf[i] = (char)((off + i) * 131 + 7);
+      sum = (sum * 31 + (unsigned char)buf[i]) & 0xFFFFFFFFUL;
+    }
+    if (write(f, buf, chunk) != chunk) {
+      perror("write");
+      return 1;
+    }
+    off += chunk;
+  }
+  close(f);
+
+  int s = socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in dst;
+  memset(&dst, 0, sizeof dst);
+  dst.sin_family = AF_INET;
+  dst.sin_port = htons(port);
+  dst.sin_addr.s_addr = inet_addr(ip);
+  if (connect(s, (struct sockaddr *)&dst, sizeof dst) != 0) {
+    perror("connect");
+    return 1;
+  }
+
+  int in = open("payload.bin", O_RDONLY);
+  if (in < 0) {
+    perror("open r");
+    return 1;
+  }
+  off_t off = 0;
+  long sent = 0;
+  while (sent < nbytes) {
+    ssize_t r = sendfile(s, in, &off, (size_t)(nbytes - sent));
+    if (r < 0) {
+      perror("sendfile");
+      return 1;
+    }
+    if (r == 0)
+      break;
+    sent += r;
+  }
+  printf("sendfile sent %ld bytes sum %lu off %ld\n", sent, sum,
+         (long)off);
+  close(in);
+  close(s);
+  fflush(stdout);
+  return 0;
+}

@@ -156,6 +156,61 @@ def test_udp_ping_is_bit_deterministic(plugins, tmp_path):
     assert outs[0] == outs[1]
 
 
+def test_futex_wait_timeout_advances_sim_time(plugins, tmp_path):
+    """FUTEX_WAIT value-mismatch -> EAGAIN; unwaited WAKE -> 0; a 50 ms
+    WAIT timeout -> ETIMEDOUT with the simulated monotonic clock
+    advanced by exactly 50 ms (futex.c semantics)."""
+    data = str(tmp_path / "shadow.data")
+    cfg = base_cfg(data) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['futex_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    lines = read_stdout(data, "alice", "futex_check").splitlines()
+    assert lines[0] == "mismatch: r=-1 errno=11"      # EAGAIN
+    assert lines[1] == "wake: r=0"
+    assert lines[2] == "wait: r=-1 errno=110 dt_ms=50"  # ETIMEDOUT
+    assert stats.ok
+
+
+def test_sendfile_to_virtual_socket(plugins, tmp_path):
+    """sendfile(out=virtual TCP socket, in=real file) streams the file
+    through the in-simulator stack; the server's checksum must match.
+    260 KB > the send buffer, so the emulation's Blocked/restart
+    bookkeeping (no duplicated or dropped spans) is exercised."""
+    data = str(tmp_path / "shadow.data")
+    nbytes = 260_000
+    cfg = base_cfg(data, stop="60s") + f"""
+  server:
+    network_node_id: 0
+    processes:
+    - path: {plugins['tcp_server']}
+      args: 8080
+      start_time: 1s
+  client:
+    network_node_id: 1
+    processes:
+    - path: {plugins['sendfile_client']}
+      args: 11.0.0.1 8080 {nbytes}
+      start_time: 2s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    server_out = read_stdout(data, "server", "tcp_server")
+    client_out = read_stdout(data, "client", "sendfile_client")
+    sent = [line for line in client_out.splitlines()
+            if line.startswith("sendfile sent ")][0].split()
+    recv = [line for line in server_out.splitlines()
+            if line.startswith("received ")][0].split()
+    assert sent[2] == str(nbytes)           # sent all bytes
+    assert sent[7] == str(nbytes)           # offset advanced
+    assert recv[1] == str(nbytes)
+    assert recv[4] == sent[5]               # checksums match
+    assert stats.ok
+
+
 def test_tcp_transfer_checksum(plugins, tmp_path):
     data = str(tmp_path / "shadow.data")
     nbytes = 300_000          # > the 128 KiB sndbuf: exercises blocking
