@@ -6,9 +6,19 @@ before any tracing, while jax-free paths (CLI --show-config, config
 parsing, the pure-Python engine) never pay the jax import cost.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 jax.config.update("jax_enable_x64", True)
+
+# honor an explicit JAX_PLATFORMS=cpu request: this environment's TPU
+# PJRT plugin force-writes jax_platforms to "axon,cpu" at import,
+# overriding the env var, so the request must be re-applied via config
+# (the tunneled TPU admits one client at a time — accidental dials from
+# tests or CPU-mesh runs would block on the claim)
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 __all__ = ["jax", "jnp"]

@@ -56,6 +56,7 @@ class BuiltSimulation:
     starts: list[tuple[int, int, int]]   # (host_id, start, stop|-1)
     lookahead: int
     dns: object = None
+    groups: dict = None                  # group name -> [host ids]
     runtime: object = None               # ManagedRuntime if real procs
 
 
@@ -70,12 +71,14 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
 
     hosts: list[Host] = []
     starts: list[tuple[int, int, int]] = []
+    groups: dict[str, list[int]] = {}
     runtime = None
     n_total = cfg.total_hosts()
     for group in cfg.hosts:
         for i in range(group.quantity):
             name = group.name if group.quantity == 1 else f"{group.name}{i}"
             host_id = len(hosts)
+            groups.setdefault(group.name, []).append(host_id)
             att = attacher.attach(
                 network_node_id=group.network_node_id,
                 ip_hint=group.ip_address_hint,
@@ -157,7 +160,8 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
                                           "etc_hosts"))
     return BuiltSimulation(cfg=cfg, topology=topology, hosts=hosts,
                            netmodel=netmodel, starts=starts,
-                           lookahead=lookahead, dns=dns, runtime=runtime)
+                           lookahead=lookahead, dns=dns, runtime=runtime,
+                           groups=groups)
 
 
 class Controller:
@@ -179,6 +183,7 @@ class Controller:
                 netmodel=self.sim.netmodel,
                 seed=cfg.general.seed,
                 trace=trace,
+                groups=self.sim.groups,
                 net_opts=NetOptions(
                     qdisc=cfg.experimental.interface_qdisc,
                     router_queue=cfg.experimental.router_queue,

@@ -39,6 +39,25 @@ from shadow_tpu.utils.slog import get_logger, set_context, clear_context
 log = get_logger("manager")
 
 
+def resolve_host_ref(name_to_id: dict, groups: dict, name: str,
+                     asker_id: int) -> int:
+    """Hostname OR host-group reference -> host id. A `quantity: N`
+    group named `g` expands to hosts g0..gN-1 (controller.py, which
+    also records the explicit member list in BuiltSimulation.groups —
+    no name-pattern guessing, so a group `web` never absorbs a
+    sibling group `web2`). A bare group reference resolves to one
+    member chosen deterministically by the asking host (asker_id
+    modulo group size) so client fleets spread over server groups
+    identically on the CPU and device engines."""
+    hid = name_to_id.get(name)
+    if hid is not None:
+        return hid
+    members = (groups or {}).get(name)
+    if members:
+        return members[asker_id % len(members)]
+    raise KeyError(f"unknown host name {name!r}")
+
+
 @dataclass
 class SimStats:
     ok: bool = True
@@ -82,6 +101,7 @@ class Manager:
     trace: Optional[list] = None    # (time, dst, src, kind) if recording
     on_event_hook: Optional[Callable] = None
     net_opts: NetOptions = field(default_factory=NetOptions)
+    groups: Optional[dict] = None   # group name -> [host ids]
 
     def __post_init__(self):
         from shadow_tpu.host.netstack import HostNetStack
@@ -107,6 +127,10 @@ class Manager:
         if name not in self._name_to_id:
             raise KeyError(f"unknown host name {name!r}")
         return self._name_to_id[name]
+
+    def resolve_ref(self, name: str, asker_id: int) -> int:
+        return resolve_host_ref(self._name_to_id, self.groups, name,
+                                asker_id)
 
     def stream_channel(self, key: tuple):
         """Byte channel for one TCP direction (host/descriptors.py)."""

@@ -38,8 +38,10 @@ class SimContext:
         return len(self._m.hosts)
 
     def resolve(self, name: str) -> int:
-        """Hostname -> host id (DNS-lite; full DNS in host/dns.py)."""
-        return self._m.resolve(name)
+        """Hostname or group reference -> host id (DNS-lite; full DNS
+        in host/dns.py). Group refs pick a member keyed by the asking
+        host (manager.resolve_ref)."""
+        return self._m.resolve_ref(name, self.host.host_id)
 
     # -- randomness ----------------------------------------------------
     def app_bits(self) -> int:

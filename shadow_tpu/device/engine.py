@@ -182,6 +182,12 @@ class DeviceEngine:
         LOOKAHEAD = np.int64(max(1, cfg.lookahead))
         BOOT_END = np.int64(cfg.bootstrap_end)
 
+        if OB < K:
+            raise ValueError(
+                f"outbox_capacity ({OB}) must be >= the app's max "
+                f"sends per event ({K}): one event's burst must fit "
+                "or the flow-control phase loop cannot make progress")
+
         hidx = jnp.arange(H_loc)
 
         def key2_of(src, seq):
@@ -196,7 +202,13 @@ class DeviceEngine:
             tie = t == min_t[:, None]
             k2 = jnp.where(tie, key2_of(state["src"], state["seq"]), IMAX)
             slot = jnp.argmin(k2, axis=-1)                      # [H]
-            runnable = min_t < win_end
+            # flow control: a host only pops while its outbox has
+            # headroom for a full K-send burst; a blocked host's events
+            # stay heaped and run in the next phase of the SAME window
+            # (outer phase loop in _round), so bursty apps never lose
+            # packets to a fixed outbox (SURVEY hard-part #2: ragged
+            # all_to_all under static shapes)
+            runnable = (min_t < win_end) & (ob_cnt + K <= OB)
 
             def g(f):
                 return state[f][hidx, slot]
@@ -223,6 +235,20 @@ class DeviceEngine:
                 seed_pair, PURPOSE_APP, gid[:, None], draw_seqs))
             out = app.handle(gid, pt, jnp.where(runnable, pkind, -1),
                              psrc, psize, pd0, pd1, state["app"], draws)
+            # apps may return [H,1] columns that broadcast over K/T
+            # (e.g. a role-constant dst); materialize full shapes once
+            out = out._replace(
+                send_dst=jnp.broadcast_to(out.send_dst, (H_loc, K)),
+                send_size=jnp.broadcast_to(out.send_size, (H_loc, K)),
+                send_d0=jnp.broadcast_to(out.send_d0, (H_loc, K)),
+                send_d1=jnp.broadcast_to(out.send_d1, (H_loc, K)),
+                send_valid=jnp.broadcast_to(out.send_valid, (H_loc, K)),
+                timer_delay=jnp.broadcast_to(out.timer_delay,
+                                             (H_loc, T)),
+                timer_d0=jnp.broadcast_to(out.timer_d0, (H_loc, T)),
+                timer_valid=jnp.broadcast_to(out.timer_valid,
+                                             (H_loc, T)),
+            )
             state["app"] = jnp.where(runnable[:, None], out.app_state,
                                      state["app"])
             state["app_seq"] = state["app_seq"] + \
@@ -288,55 +314,63 @@ class DeviceEngine:
             # self-destined sends insert into the local heap immediately
             # (like the CPU engine's push): with a runahead override
             # larger than a self-path latency they must be runnable in
-            # this same window, in timestamp order
+            # this same window, in timestamp order. Timers likewise.
+            # Both go through ONE batched insert: rank the heap's free
+            # slots once and scatter every item to its own slot —
+            # O(E log E) instead of (K+T) sequential full-heap scans
+            # (slot choice doesn't affect semantics; pops order by
+            # (t, src, seq), never by slot index).
             to_self = delivered & ~cross
-            for si in range(K):
-                want = to_self[:, si]
-                free = state["t"] == INF
-                has = free.any(-1)
-                fslot = jnp.argmax(free, axis=-1)
-                do = want & has
-                state["overflow"] = state["overflow"] + (want & ~has)
-
-                def sins(f, val):
-                    old = state[f][hidx, fslot]
-                    state[f] = state[f].at[hidx, fslot].set(
-                        jnp.where(do, val, old))
-
-                sins("t", deliver_t[:, si])
-                sins("src", gid)
-                sins("seq", ev_seq[:, si].astype(jnp.int32))
-                sins("kind", jnp.full((H_loc,), KIND_PACKET, jnp.int32))
-                sins("size", out.send_size[:, si])
-                sins("d0", out.send_d0[:, si])
-                sins("d1", out.send_d1[:, si])
-
-            # timers (self events, may run this round); seq after sends
             timer_valid = out.timer_valid & runnable[:, None]   # [H,T]
             trank = jnp.cumsum(timer_valid, axis=-1) - timer_valid
             tseq = state["event_seq"][:, None] + n_del[:, None] + trank
             state["event_seq"] = state["event_seq"] + n_del + \
                 timer_valid.sum(-1).astype(jnp.int32)
-            for ti in range(T):
-                want = timer_valid[:, ti]
-                free = state["t"] == INF
-                has = free.any(-1)
-                fslot = jnp.argmax(free, axis=-1)
-                do = want & has
-                state["overflow"] = state["overflow"] + (want & ~has)
 
-                def ins(f, val):
-                    old = state[f][hidx, fslot]
-                    state[f] = state[f].at[hidx, fslot].set(
-                        jnp.where(do, val, old))
+            ins_valid = jnp.concatenate([to_self, timer_valid], axis=1)
+            ins = {
+                "t": jnp.concatenate(
+                    [deliver_t, pt[:, None] + out.timer_delay], axis=1),
+                "seq": jnp.concatenate([ev_seq, tseq],
+                                       axis=1).astype(jnp.int32),
+                "kind": jnp.concatenate(
+                    [jnp.full((H_loc, K), KIND_PACKET, jnp.int32),
+                     jnp.full((H_loc, T), KIND_TIMER, jnp.int32)],
+                    axis=1),
+                "size": jnp.concatenate(
+                    [out.send_size, jnp.zeros((H_loc, T), jnp.int32)],
+                    axis=1),
+                "d0": jnp.concatenate([out.send_d0, out.timer_d0],
+                                      axis=1),
+                "d1": jnp.concatenate(
+                    [out.send_d1, jnp.zeros((H_loc, T), jnp.int32)],
+                    axis=1),
+            }
+            M = K + T
+            free = state["t"] == INF                            # [H,E]
+            slot_order = jnp.argsort(
+                jnp.where(free, 0, E) + jnp.arange(E)[None, :],
+                axis=-1)                                        # [H,E]
+            n_free = free.sum(-1)                               # [H]
+            irank = jnp.cumsum(ins_valid, axis=-1) - ins_valid  # [H,M]
+            ok = ins_valid & (irank < n_free[:, None]) & (irank < E)
+            state["overflow"] = state["overflow"] + \
+                (ins_valid & ~ok).sum(-1).astype(jnp.int32)
+            dest = jnp.take_along_axis(
+                slot_order, jnp.minimum(irank, E - 1), axis=1)  # [H,M]
+            dest = jnp.where(ok, dest, E)       # E = out-of-bounds drop
 
-                ins("t", pt + out.timer_delay[:, ti])
-                ins("src", gid)
-                ins("seq", tseq[:, ti].astype(jnp.int32))
-                ins("kind", jnp.full((H_loc,), KIND_TIMER, jnp.int32))
-                ins("size", jnp.zeros((H_loc,), jnp.int32))
-                ins("d0", out.timer_d0[:, ti])
-                ins("d1", jnp.zeros((H_loc,), jnp.int32))
+            def bscat(f, vals):
+                state[f] = state[f].at[hidx[:, None], dest].set(
+                    vals, mode="drop")
+
+            bscat("t", ins["t"])
+            bscat("src", jnp.broadcast_to(gid[:, None], (H_loc, M)))
+            bscat("seq", ins["seq"])
+            bscat("kind", ins["kind"])
+            bscat("size", ins["size"])
+            bscat("d0", ins["d0"])
+            bscat("d1", ins["d1"])
 
             return state, ob, ob_cnt, runnable.any()
 
@@ -425,26 +459,44 @@ class DeviceEngine:
             return state
 
         # ---------------- one round (window) ---------------------------
+        # A window may take several phases: each phase pops until every
+        # host is drained below win_end OR outbox-blocked, exchanges,
+        # and the window only advances when no host has events left
+        # under the barrier. Phase count is data-dependent but the
+        # predicate is a collective, so all shards agree.
         def _round(state, win_end, gid, my_shard, host_vertex, lat, rel):
-            ob = {
-                "t": jnp.full((H_loc, OB), INF, jnp.int64),
-                "dst": jnp.zeros((H_loc, OB), jnp.int32),
-                "src": jnp.zeros((H_loc, OB), jnp.int32),
-                "seq": jnp.zeros((H_loc, OB), jnp.int32),
-                "size": jnp.zeros((H_loc, OB), jnp.int32),
-                "d0": jnp.zeros((H_loc, OB), jnp.int32),
-                "d1": jnp.zeros((H_loc, OB), jnp.int32),
-            }
-            ob_cnt = jnp.zeros((H_loc,), jnp.int32)
+            def _phase(state):
+                ob = {
+                    "t": jnp.full((H_loc, OB), INF, jnp.int64),
+                    "dst": jnp.zeros((H_loc, OB), jnp.int32),
+                    "src": jnp.zeros((H_loc, OB), jnp.int32),
+                    "seq": jnp.zeros((H_loc, OB), jnp.int32),
+                    "size": jnp.zeros((H_loc, OB), jnp.int32),
+                    "d0": jnp.zeros((H_loc, OB), jnp.int32),
+                    "d1": jnp.zeros((H_loc, OB), jnp.int32),
+                }
+                ob_cnt = jnp.zeros((H_loc,), jnp.int32)
+                carry = (state, ob, ob_cnt,
+                         (state["t"].min(axis=-1) < win_end).any())
+                carry = lax.while_loop(
+                    lambda c: c[3],
+                    lambda c: _step(c, win_end, gid, host_vertex, lat,
+                                    rel),
+                    carry)
+                state2, ob, _, _ = carry
+                return _exchange(state2, ob, my_shard)
 
-            carry = (state, ob, ob_cnt,
-                     (state["t"].min(axis=-1) < win_end).any())
-            carry = lax.while_loop(
-                lambda c: c[3],
-                lambda c: _step(c, win_end, gid, host_vertex, lat, rel),
-                carry)
-            state, ob, _, _ = carry
-            return _exchange(state, ob, my_shard)
+            def more(state):
+                return _axis_min(
+                    jnp.where(state["t"].min(axis=-1) < win_end,
+                              jnp.int64(0), jnp.int64(1)).min()) == 0
+
+            state = _phase(state)
+            state, _ = lax.while_loop(
+                lambda c: c[1],
+                lambda c: (lambda s: (s, more(s)))(_phase(c[0])),
+                (state, more(state)))
+            return state
 
         # ---------------- full run ------------------------------------
         # cross-shard min via all_gather: some TPU AOT toolchains lower

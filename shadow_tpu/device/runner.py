@@ -20,7 +20,7 @@ import numpy as np
 
 
 from shadow_tpu._jax import jax
-from shadow_tpu.core.manager import SimStats
+from shadow_tpu.core.manager import SimStats, resolve_host_ref
 from shadow_tpu.device.apps import DeviceApp, PholdDevice, TgenDevice
 from shadow_tpu.device.engine import DeviceEngine, EngineConfig
 from shadow_tpu.models.phold import PholdApp
@@ -69,11 +69,15 @@ def device_twin(sim) -> DeviceApp:
         for h in sim.hosts:
             if isinstance(h.app, TgenClientApp):
                 roles[h.host_id] = 1
-                if h.app.server_name not in name_to_id:
+                try:
+                    # same name-or-group rule as the CPU ctx.resolve
+                    server_gid[h.host_id] = resolve_host_ref(
+                        name_to_id, getattr(sim, "groups", None),
+                        h.app.server_name, h.host_id)
+                except KeyError:
                     raise ValueError(
                         f"tgen client on {h.name}: unknown server "
                         f"{h.app.server_name!r}")
-                server_gid[h.host_id] = name_to_id[h.app.server_name]
         return TgenDevice(roles=roles, server_gid=server_gid,
                           size=first.size, count=first.count,
                           pause_ns=first.pause_ns,
@@ -102,11 +106,21 @@ class DeviceRunner:
                         "scheduler policy (packets are device-resident "
                         "metadata here)")
         self.app = device_twin(sim)
+        # flow control blocks a host's pops when the outbox lacks a
+        # full-burst (max_sends) of headroom; at OB == K that means one
+        # event per phase, paying one collective exchange per event.
+        # Give bursty apps 8 bursts of room unless the config asks for
+        # more.
+        outbox = max(cfg.experimental.outbox_capacity,
+                     8 * self.app.max_sends)
+        if outbox != cfg.experimental.outbox_capacity:
+            log.info("outbox_capacity raised %d -> %d (8x app burst)",
+                     cfg.experimental.outbox_capacity, outbox)
         self.engine = DeviceEngine(
             EngineConfig(
                 n_hosts=len(sim.hosts),
                 event_capacity=cfg.experimental.event_capacity,
-                outbox_capacity=cfg.experimental.outbox_capacity,
+                outbox_capacity=outbox,
                 lookahead=max(1, sim.lookahead),
                 stop_time=cfg.general.stop_time,
                 bootstrap_end=cfg.general.bootstrap_end_time,

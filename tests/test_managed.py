@@ -156,6 +156,36 @@ def test_udp_ping_is_bit_deterministic(plugins, tmp_path):
     assert outs[0] == outs[1]
 
 
+def test_tcp_transfer_is_bit_deterministic(plugins, tmp_path):
+    """The reference's determinism gate (src/test/determinism/,
+    determinism1_compare.cmake): run the identical config twice and
+    byte-compare every host's stdout. TCP exercises the full stack —
+    handshake timing, windows, retransmit timers — so any
+    nondeterminism (RNG, map ordering, wall-clock leak) shows up."""
+    outs = []
+    for run in range(2):
+        data = str(tmp_path / f"run{run}" / "shadow.data")
+        cfg = base_cfg(data, stop="60s") + f"""
+  server:
+    network_node_id: 0
+    processes:
+    - path: {plugins['tcp_server']}
+      args: 8080
+      start_time: 1s
+  client:
+    network_node_id: 1
+    processes:
+    - path: {plugins['tcp_client']}
+      args: 11.0.0.1 8080 200000
+      start_time: 2s
+"""
+        stats, _ = run_sim(cfg, tmp_path / f"run{run}")
+        assert stats.ok
+        outs.append(read_stdout(data, "server", "tcp_server")
+                    + read_stdout(data, "client", "tcp_client"))
+    assert outs[0] == outs[1]
+
+
 def test_futex_wait_timeout_advances_sim_time(plugins, tmp_path):
     """FUTEX_WAIT value-mismatch -> EAGAIN; unwaited WAKE -> 0; a 50 ms
     WAIT timeout -> ETIMEDOUT with the simulated monotonic clock
