@@ -109,6 +109,10 @@ enum IpcMsgKind : uint32_t {
   IPC_SYSCALL_DONE = 3,   // simulator -> plugin: emulated result
   IPC_SYSCALL_NATIVE = 4, // simulator -> plugin: execute natively
   IPC_STOP = 5,
+  IPC_CLONE_GO = 6,       // simulator -> plugin: clone approved;
+                          // number = child vtid, args[0] = channel off
+  IPC_THREAD_START = 7,   // child thread -> simulator on its channel
+  IPC_THREAD_FAIL = 8,    // child channel: native clone failed
 };
 
 struct IpcMessage {

@@ -69,6 +69,10 @@ enum {
   IPC_SYSCALL_DONE = 3,
   IPC_SYSCALL_NATIVE = 4,
   IPC_STOP = 5,
+  IPC_CLONE_GO = 6,     /* sim -> plugin: clone approved; number = child
+                         * vtid, args[0] = child channel arena offset */
+  IPC_THREAD_START = 7, /* child -> sim on its own channel: alive */
+  IPC_THREAD_FAIL = 8,  /* child channel: native clone failed */
 };
 
 /* ---- IPC ABI: byte-compatible with native/ipc/spinsem.hpp ---------- */
@@ -105,18 +109,47 @@ _Static_assert(__builtin_offsetof(ShimChannel, msg_to_simulator) == 152,
 /* ---- state --------------------------------------------------------- */
 
 static int g_enabled = 0;
-static ShimChannel *g_ch = NULL;
+static int g_trace_traps = 0;
+static ShimChannel *g_ch = NULL;     /* main thread's channel */
+static char *g_arena_base = NULL;
 
-/* ---- the one natively-allowed syscall instruction ------------------ */
-/* (long nr, a, b, c, d, e, f) — args map SysV->kernel registers; the
- * 7th argument arrives on the stack. */
+/* Per-thread IPC channel: the main thread uses g_ch; clone children
+ * get their own channel from the simulator (one thread of a process
+ * runs at a time, each in strict ping-pong on its own channel).
+ * initial-exec TLS so accessing it never allocates (signal context). */
+static __thread ShimChannel *t_ch
+    __attribute__((tls_model("initial-exec"))) = NULL;
+
+static inline ShimChannel *cur_ch(void) { return t_ch ? t_ch : g_ch; }
+
+#define SHIM_CLONE_SCRATCH (64 * 1024)
+/* this thread's clone scratch stack (freed at thread exit, once we're
+ * running on the app's pthread stack) */
+static __thread void *t_scratch
+    __attribute__((tls_model("initial-exec"))) = NULL;
+
+/* ---- the natively-allowed syscall instructions --------------------- */
+/* All raw syscall insns live between shim_syscall_insn_start/end; the
+ * seccomp filter allows any syscall whose post-insn ip falls in that
+ * range (the reference's shadow_vreal_raw_syscall escape).
+ * shim_rawsyscall: (long nr, a, b, c, d, e, f) — args map SysV->kernel
+ * registers; the 7th argument arrives on the stack.
+ * shim_clone_raw: raw clone where the CHILD starts on a scratch stack
+ * whose top word is a CloneBoot pointer; the child pops it and enters
+ * shim_child_start (never returns), while the parent returns the
+ * kernel result. */
 
 long shim_rawsyscall(long nr, long a, long b, long c, long d, long e,
                      long f);
+long shim_clone_raw(long flags, long child_sp, long ptid, long ctid,
+                    long tls);
+void shim_child_start(void *boot);
 extern const char shim_syscall_insn_start[];
 extern const char shim_syscall_insn_end[];
 
 __asm__(".text\n"
+        ".globl shim_syscall_insn_start\n"
+        "shim_syscall_insn_start:\n"
         ".globl shim_rawsyscall\n"
         ".type shim_rawsyscall,@function\n"
         "shim_rawsyscall:\n"
@@ -127,13 +160,47 @@ __asm__(".text\n"
         "  mov %r8,%r10\n"
         "  mov %r9,%r8\n"
         "  mov 8(%rsp),%r9\n"
-        ".globl shim_syscall_insn_start\n"
-        "shim_syscall_insn_start:\n"
         "  syscall\n"
-        ".globl shim_syscall_insn_end\n"
-        "shim_syscall_insn_end:\n"
         "  ret\n"
-        ".size shim_rawsyscall,.-shim_rawsyscall\n");
+        ".size shim_rawsyscall,.-shim_rawsyscall\n"
+        ".globl shim_clone_raw\n"
+        ".type shim_clone_raw,@function\n"
+        "shim_clone_raw:\n"
+        "  mov %rcx,%r10\n"          /* ctid: SysV rcx -> kernel r10 */
+        "  mov $56,%eax\n"           /* SYS_clone */
+        "  syscall\n"
+        "  test %rax,%rax\n"
+        "  jnz 1f\n"
+        "  pop %rdi\n"               /* child: scratch top = CloneBoot* */
+        "  call shim_child_start\n"  /* never returns */
+        "1: ret\n"
+        ".size shim_clone_raw,.-shim_clone_raw\n"
+        ".globl shim_restore_context\n"
+        ".type shim_restore_context,@function\n"
+        "shim_restore_context:\n"    /* (CloneBoot*) — jump into app */
+        "  mov %rdi,%rax\n"
+        "  mov 8(%rax),%rsp\n"       /* app child_stack */
+        "  mov 16(%rax),%rcx\n"      /* app rip (post-syscall insn) */
+        "  push %rcx\n"
+        "  mov 24(%rax),%rbx\n"
+        "  mov 32(%rax),%rbp\n"
+        "  mov 40(%rax),%r12\n"
+        "  mov 48(%rax),%r13\n"
+        "  mov 56(%rax),%r14\n"
+        "  mov 64(%rax),%r15\n"
+        "  mov 72(%rax),%rsi\n"
+        "  mov 80(%rax),%rdx\n"
+        "  mov 88(%rax),%r8\n"
+        "  mov 96(%rax),%r9\n"
+        "  mov 104(%rax),%r10\n"
+        "  mov 112(%rax),%r11\n"
+        "  mov 120(%rax),%rcx\n"
+        "  mov 128(%rax),%rdi\n"
+        "  xor %eax,%eax\n"          /* child's clone() returns 0 */
+        "  ret\n"
+        ".size shim_restore_context,.-shim_restore_context\n"
+        ".globl shim_syscall_insn_end\n"
+        "shim_syscall_insn_end:\n");
 
 /* ---- spinning semaphore (plugin side) ------------------------------ */
 
@@ -189,22 +256,41 @@ static int is_fd_gated(long nr) {
   }
 }
 
-/* Forward one syscall to the simulator; returns the kernel-convention
- * result (negative errno on failure). Safe in signal context: only
- * futexes + the raw syscall instruction. */
-static long shim_emulated_syscall(long nr, const long args[6]) {
-  ShimMsg *out = (ShimMsg *)&g_ch->msg_to_simulator;
+/* Forward one syscall to the simulator over the calling thread's
+ * channel; returns the kernel-convention result (negative errno on
+ * failure) or the raw reply message for multi-step protocols (clone).
+ * Safe in signal context: only futexes + the raw syscall instruction. */
+static ShimMsg *shim_roundtrip(long nr, const long args[6]) {
+  ShimChannel *ch = cur_ch();
+  ShimMsg *out = (ShimMsg *)&ch->msg_to_simulator;
   out->kind = IPC_SYSCALL;
   out->number = nr;
   for (int i = 0; i < 6; i++)
     out->args[i] = (uint64_t)args[i];
-  sem_post(&g_ch->to_simulator.value);
-  sem_wait(&g_ch->to_plugin);
-  ShimMsg *in = (ShimMsg *)&g_ch->msg_to_plugin;
+  sem_post(&ch->to_simulator.value);
+  sem_wait(&ch->to_plugin);
+  return (ShimMsg *)&ch->msg_to_plugin;
+}
+
+static long shim_emulated_syscall(long nr, const long args[6]) {
+  ShimMsg *in = shim_roundtrip(nr, args);
   switch (in->kind) {
   case IPC_SYSCALL_DONE:
     return (long)in->number;
   case IPC_SYSCALL_NATIVE:
+    if (nr == SYS_exit || nr == SYS_exit_group) {
+      /* die HERE, not by unwinding through glibc: it keeps the
+       * window between the simulator's joiner wakeup and this
+       * thread's true death to a handful of instructions, and lets
+       * us free the clone scratch stack (we run on the app stack) */
+      if (nr == SYS_exit && t_scratch) {
+        void *sc = t_scratch;
+        t_scratch = NULL;
+        shim_rawsyscall(SYS_munmap, (long)sc, SHIM_CLONE_SCRATCH, 0, 0,
+                        0, 0);
+      }
+      shim_rawsyscall(nr, args[0], 0, 0, 0, 0, 0);
+    }
     return shim_rawsyscall(nr, args[0], args[1], args[2], args[3],
                            args[4], args[5]);
   case IPC_STOP:
@@ -215,18 +301,156 @@ static long shim_emulated_syscall(long nr, const long args[6]) {
   }
 }
 
+/* ---- clone: managed thread creation -------------------------------- */
+/* The simulator approves the clone and hands us a fresh IPC channel
+ * for the child (IPC_CLONE_GO). We then execute the REAL clone, but
+ * point the child at a scratch stack running shim_child_start: it
+ * adopts its channel, announces itself, waits for the simulator to
+ * schedule it, and only then restores the app's register context
+ * (kernel clone child semantics: parent's registers, RAX=0, RSP=the
+ * app's child_stack) and resumes app code. One thread runs at a time,
+ * controlled by the simulator (reference thread model: clone.c +
+ * shim.c's clone handshake). */
+
+typedef struct {
+  ShimChannel *ch;        /* 0  */
+  uint64_t rsp;           /* 8  — app child_stack */
+  uint64_t rip;           /* 16 — post-syscall-insn ip */
+  uint64_t rbx, rbp, r12, r13, r14, r15;  /* 24..64 */
+  uint64_t rsi, rdx, r8, r9, r10, r11;    /* 72..112 */
+  uint64_t rcx, rdi;      /* 120, 128 */
+} CloneBoot;
+
+void shim_restore_context(CloneBoot *b);
+
+static __thread ucontext_t *t_trap_ctx
+    __attribute__((tls_model("initial-exec"))) = NULL;
+
+#ifndef CLONE_PARENT_SETTID
+#define CLONE_PARENT_SETTID 0x00100000
+#endif
+#ifndef CLONE_CHILD_CLEARTID
+#define CLONE_CHILD_CLEARTID 0x00200000
+#endif
+#ifndef CLONE_CHILD_SETTID
+#define CLONE_CHILD_SETTID 0x01000000
+#endif
+
+void shim_child_start(void *bootv) {
+  CloneBoot *b = (CloneBoot *)bootv;
+  t_ch = b->ch;
+  t_scratch = (void *)b;
+  /* make sure SIGSYS is deliverable in this thread no matter what
+   * mask the clone inherited */
+  uint64_t unblock = 1ULL << (SIGSYS - 1);
+  shim_rawsyscall(SYS_rt_sigprocmask, 1 /* SIG_UNBLOCK */,
+                  (long)&unblock, 0, 8, 0, 0);
+  ShimMsg *out = (ShimMsg *)&t_ch->msg_to_simulator;
+  out->kind = IPC_THREAD_START;
+  out->number = 0;
+  sem_post(&t_ch->to_simulator.value);
+  sem_wait(&t_ch->to_plugin);   /* IPC_START: simulator scheduled us */
+  shim_restore_context(b);      /* never returns */
+}
+
+static long shim_handle_clone(const long args[6]) {
+  ShimMsg *in = shim_roundtrip(SYS_clone, args);
+  if (in->kind == IPC_SYSCALL_DONE)
+    return (long)in->number;    /* refused (-errno) */
+  if (in->kind != IPC_CLONE_GO)
+    return -ENOSYS;
+  long vtid = (long)in->number;
+  uint64_t ch_off = in->args[0];
+
+  void *scratch = (void *)shim_rawsyscall(
+      SYS_mmap, 0, SHIM_CLONE_SCRATCH, 0x3 /* RW */,
+      0x22 /* PRIVATE|ANON */, -1, 0);
+  if ((long)scratch < 0)
+    return (long)scratch;
+  CloneBoot *b = (CloneBoot *)scratch;
+  b->ch = (ShimChannel *)(g_arena_base + ch_off);
+  b->rsp = (uint64_t)args[1];
+  ucontext_t *uc = t_trap_ctx;
+  greg_t *g = uc->uc_mcontext.gregs;
+  b->rip = (uint64_t)g[REG_RIP];
+  b->rbx = (uint64_t)g[REG_RBX];
+  b->rbp = (uint64_t)g[REG_RBP];
+  b->r12 = (uint64_t)g[REG_R12];
+  b->r13 = (uint64_t)g[REG_R13];
+  b->r14 = (uint64_t)g[REG_R14];
+  b->r15 = (uint64_t)g[REG_R15];
+  b->rsi = (uint64_t)g[REG_RSI];
+  b->rdx = (uint64_t)g[REG_RDX];
+  b->r8 = (uint64_t)g[REG_R8];
+  b->r9 = (uint64_t)g[REG_R9];
+  b->r10 = (uint64_t)g[REG_R10];
+  b->r11 = (uint64_t)g[REG_R11];
+  b->rcx = (uint64_t)g[REG_RCX];
+  b->rdi = (uint64_t)g[REG_RDI];
+
+  /* child scratch stack: 16-aligned top holding the boot pointer */
+  uint64_t top = ((uint64_t)scratch + SHIM_CLONE_SCRATCH - 64) & ~15ULL;
+  *(uint64_t *)(top - 8) = (uint64_t)b;
+
+  /* tid bookkeeping is emulated with VIRTUAL ids (below + simulator
+   * exit handling), so the kernel must not write real tids */
+  long nflags = args[0] &
+      ~(long)(CLONE_PARENT_SETTID | CLONE_CHILD_SETTID |
+              CLONE_CHILD_CLEARTID);
+  long r = shim_clone_raw(nflags, (long)(top - 8), args[2], args[3],
+                          args[4]);
+  if (r < 0) {
+    ShimMsg *fm = (ShimMsg *)&b->ch->msg_to_simulator;
+    fm->kind = IPC_THREAD_FAIL;
+    fm->number = r;
+    sem_post(&b->ch->to_simulator.value);
+    return r;
+  }
+  if ((args[0] & CLONE_PARENT_SETTID) && args[2])
+    *(int *)args[2] = (int)vtid;
+  if ((args[0] & CLONE_CHILD_SETTID) && args[3])
+    *(int *)args[3] = (int)vtid;    /* shared VM: child sees it */
+  return vtid;
+}
+
+/* rt_sigprocmask with SIGSYS stripped from block requests: if the app
+ * (glibc blocks ALL signals around pthread_create's clone) could mask
+ * SIGSYS, the next seccomp trap would be force-killed instead of
+ * handled. Runs entirely shim-side — no simulator round trip. */
+static long shim_sigprocmask(const long a[6]) {
+  const uint64_t *set = (const uint64_t *)a[1];
+  if (set && a[0] != 1 /* != SIG_UNBLOCK */ && a[3] == 8) {
+    uint64_t copy = *set & ~(1ULL << (SIGSYS - 1));
+    return shim_rawsyscall(SYS_rt_sigprocmask, a[0], (long)&copy, a[2],
+                           8, 0, 0);
+  }
+  return shim_rawsyscall(SYS_rt_sigprocmask, a[0], a[1], a[2], a[3],
+                         0, 0);
+}
+
 static long shim_do_syscall(long nr, const long args[6]) {
   uint32_t fd0 = (uint32_t)args[0];
   if (is_fd_gated(nr) &&
       (fd0 < SHADOWTPU_VFD_BASE || fd0 >= SHADOWTPU_VFD_END))
     return shim_rawsyscall(nr, args[0], args[1], args[2], args[3],
                            args[4], args[5]);
+  if (nr == SYS_clone)
+    return shim_handle_clone(args);
+  if (nr == SYS_rt_sigprocmask)
+    return shim_sigprocmask(args);
   return shim_emulated_syscall(nr, args);
 }
 
+_Static_assert(__builtin_offsetof(CloneBoot, rsp) == 8, "boot abi");
+_Static_assert(__builtin_offsetof(CloneBoot, rip) == 16, "boot abi");
+_Static_assert(__builtin_offsetof(CloneBoot, rsi) == 72, "boot abi");
+_Static_assert(__builtin_offsetof(CloneBoot, rcx) == 120, "boot abi");
+_Static_assert(__builtin_offsetof(CloneBoot, rdi) == 128, "boot abi");
+
 /* ---- SIGSYS handler ------------------------------------------------ */
 
-static volatile int g_in_handler = 0;
+static __thread volatile int g_in_handler
+    __attribute__((tls_model("initial-exec"))) = 0;
 
 static void sigsys_handler(int sig, siginfo_t *info, void *vctx) {
   (void)sig;
@@ -254,12 +478,19 @@ static void sigsys_handler(int sig, siginfo_t *info, void *vctx) {
   if (info->si_code != SYS_SECCOMP)
     return;
   g_in_handler = 1;
+  t_trap_ctx = ctx;
   long nr = (long)g[REG_RAX];
+  if (g_trace_traps) {
+    char tb[48];
+    int tn = snprintf(tb, sizeof tb, "[trap %ld]", nr);
+    shim_rawsyscall(SYS_write, 2, (long)tb, tn, 0, 0, 0);
+  }
   long args[6] = {(long)g[REG_RDI], (long)g[REG_RSI], (long)g[REG_RDX],
                   (long)g[REG_R10], (long)g[REG_R8],  (long)g[REG_R9]};
   long saved_errno = errno;
   g[REG_RAX] = shim_do_syscall(nr, args);
   errno = saved_errno;
+  t_trap_ctx = NULL;
   g_in_handler = 0;
 }
 
@@ -286,7 +517,11 @@ static const int kTrapSyscalls[] = {
     SYS_getpid,       SYS_getppid,      SYS_exit,
     SYS_exit_group,   SYS_clone,        SYS_fork,
     SYS_vfork,        SYS_futex,        SYS_sysinfo,
-    SYS_gettid,
+    SYS_gettid,       SYS_set_tid_address, SYS_tgkill,
+    SYS_rt_sigprocmask,
+#ifdef SYS_clone3
+    SYS_clone3,       /* refused with ENOSYS: glibc falls back to clone */
+#endif
 };
 
 static const int kFdGatedSyscalls[] = {
@@ -496,7 +731,9 @@ __attribute__((constructor)) static void shim_init(void) {
     shim_log_fail("shadowtpu-shim: cannot map shm arena\n");
     return;
   }
-  g_ch = (ShimChannel *)((char *)base + strtoull(off_s, NULL, 10));
+  g_trace_traps = getenv("SHADOWTPU_TRACE_TRAPS") != NULL;
+  g_arena_base = (char *)base;
+  g_ch = (ShimChannel *)(g_arena_base + strtoull(off_s, NULL, 10));
 
   struct sigaction sa;
   memset(&sa, 0, sizeof(sa));

@@ -50,6 +50,10 @@ class HostNetStack:
 
         self._listeners: dict[tuple[Protocol, int], BaseSocket] = {}
         self._conns: dict[tuple[int, int, int], TcpSocket] = {}
+        # cumulative TCP counters, surviving socket teardown (the
+        # tracker's retransmit split, tracker.c:12-50)
+        self.tcp_segments_sent = 0
+        self.tcp_segments_retransmitted = 0
         self._by_conn_id: dict[int, TcpSocket] = {}
         self._next_conn_id = 0
         self._next_ephemeral = EPHEMERAL_PORT_START

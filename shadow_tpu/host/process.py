@@ -34,7 +34,13 @@ from shadow_tpu import native
 from shadow_tpu.core.event import Event, KIND_TASK
 from shadow_tpu.host.descriptors import Condition, DescriptorTable
 from shadow_tpu.host.memory import ProcessMemory
-from shadow_tpu.host.syscalls import NATIVE, Blocked, NR_NAME, SyscallHandler
+from shadow_tpu.host.syscalls import (
+    NATIVE,
+    Blocked,
+    CloneGo,
+    NR_NAME,
+    SyscallHandler,
+)
 from shadow_tpu.utils.slog import get_logger
 
 log = get_logger("process")
@@ -104,11 +110,35 @@ class ManagedRuntime:
                 self._arena.close()
 
 
+class ManagedThread:
+    """One thread of a managed process (thread.c / thread.rs): its IPC
+    channel, its parked-syscall state, and its virtual tid. Exactly one
+    thread of a process executes at a time; the simulator drives each
+    over its own channel in strict ping-pong."""
+
+    def __init__(self, process: "ManagedProcess", vtid: int, channel):
+        self.p = process
+        self.vtid = vtid
+        self.channel = channel
+        self.alive = True
+        self.parked: Optional[tuple] = None      # (nr, args)
+        self.syscall_state: dict = {}
+        self.clear_ctid = 0         # CLONE_CHILD_CLEARTID address
+
+    def schedule_continue(self, ctx) -> None:
+        """Condition wakeup target: resume THIS thread's parked
+        syscall (syscall_condition.c -> process_continue, per thread)."""
+        self.p._push_task(ctx.now,
+                          lambda ctx2, ev: self.p._resume_thread(
+                              ctx2, self))
+
+
 class ManagedProcess:
     """One real executable on one simulated host (app-interface
     compatible with the model runtime: boot / on_stop hooks)."""
 
     _next_vpid = [1000]
+    supports_threads = True        # preload backend handles clone
 
     def __init__(self, runtime: ManagedRuntime, path: str, args,
                  environment: str = ""):
@@ -136,12 +166,22 @@ class ManagedProcess:
         self.alive = False
         self.exiting = False
         self.exit_code: Optional[int] = None
-        self.parked: Optional[tuple] = None     # (nr, args)
-        self.syscall_state: dict = {}
         self.futexes: dict[int, object] = {}    # addr -> Futex
+        self.threads: dict[int, ManagedThread] = {}
+        self.current: Optional[ManagedThread] = None
         self._reaper: Optional[threading.Thread] = None
         self._rng_counter = 0
         self.syscall_counts: dict[str, int] = {}
+
+    # the syscall handler's per-invocation restart state lives on the
+    # thread being serviced (SysCallHandler->blockedSyscallNR analogue)
+    @property
+    def syscall_state(self) -> dict:
+        return self.current.syscall_state
+
+    @syscall_state.setter
+    def syscall_state(self, v: dict) -> None:
+        self.current.syscall_state = v
 
     @property
     def native_pid(self) -> Optional[int]:
@@ -211,19 +251,24 @@ class ManagedProcess:
         stderr_f.close()
         self.mem = ProcessMemory(self.proc.pid)
         self.alive = True
+        main = ManagedThread(self, self.vpid, self.channel)
+        self.threads = {self.vpid: main}
+        self.current = main
         log.debug("spawned %s pid=%d vpid=%d on %s", self.path,
                   self.proc.pid, self.vpid, self.host.name)
 
-        ch = self.channel
+        me = self
         proc = self.proc
 
         def reap():
             proc.wait()
-            ch.mark_plugin_exited()
+            # the whole thread group died: every channel must unblock
+            for th in list(me.threads.values()):
+                th.channel.mark_plugin_exited()
 
         self._reaper = threading.Thread(target=reap, daemon=True)
         self._reaper.start()
-        self._continue(ctx)
+        self._continue(ctx, main)
 
     def on_stop(self, ctx) -> None:
         self._kill(ctx)
@@ -273,11 +318,16 @@ class ManagedProcess:
 
     # -- park / resume (syscall_condition.c semantics) ------------------
     def schedule_continue(self, ctx) -> None:
-        self._push_task(ctx.now, self._resume_task)
+        """Back-compat wakeup target (single-thread callers): resume
+        the current thread."""
+        th = self.current
+        self._push_task(ctx.now,
+                        lambda ctx2, ev: self._resume_thread(ctx2, th))
 
     def _park(self, ctx, b: Blocked, nr: int, args) -> None:
-        self.parked = (nr, args)
-        cond = Condition(self)
+        th = self.current
+        th.parked = (nr, args)
+        cond = Condition(th)
         for d in b.descs:
             cond.attach(d)
         if b.deadline is not None:
@@ -286,11 +336,12 @@ class ManagedProcess:
 
             self._push_task(max(b.deadline, ctx.now), timeout_task)
 
-    def _resume_task(self, ctx, ev) -> None:
-        if not self.alive or self.parked is None:
+    def _resume_thread(self, ctx, th: ManagedThread) -> None:
+        if not self.alive or not th.alive or th.parked is None:
             return
-        nr, args = self.parked
-        self.parked = None
+        nr, args = th.parked
+        th.parked = None
+        self.current = th
         try:
             res = self.handler.dispatch(ctx, nr, args)
         except Blocked as b:
@@ -300,27 +351,104 @@ class ManagedProcess:
             log.exception("resumed syscall %s(%s) handler crashed",
                           NR_NAME.get(nr, nr), args)
             res = -38              # ENOSYS
-        self._reply(res, nr, args)
-        self.syscall_state = {}
-        self._continue(ctx)
+        self._reply(res, nr, args)      # overridable (ptrace backend)
+        th.syscall_state = {}
+        self._continue(ctx, th)
+
+    def _resume_task(self, ctx, ev) -> None:    # legacy alias
+        self._resume_thread(ctx, self.current)
+
+    # -- managed threads (clone.c / thread_clone) -----------------------
+    def spawn_thread(self, ctx, flags: int, args) -> "CloneGo":
+        """Approve a clone: allocate the child's IPC channel + vtid and
+        schedule its first run. The shim performs the native clone and
+        the child announces itself on the new channel."""
+        vtid = ManagedProcess._next_vpid[0]
+        ManagedProcess._next_vpid[0] += 1
+        ch = native.IpcChannel(self.runtime.arena,
+                               spin_max=self.runtime.spin_max)
+        th = ManagedThread(self, vtid, ch)
+        CLONE_CHILD_CLEARTID = 0x00200000
+        if flags & CLONE_CHILD_CLEARTID:
+            th.clear_ctid = args[3]
+        self.threads[vtid] = th
+        self._push_task(ctx.now,
+                        lambda ctx2, ev: self._start_child(ctx2, th))
+        log.debug("clone: new thread vtid=%d on %s", vtid,
+                  self.host.name)
+        return CloneGo(vtid, ch.offset)
+
+    def _start_child(self, ctx, th: ManagedThread) -> None:
+        """First scheduling of a cloned thread: wait for its
+        THREAD_START announcement, then release it into app code."""
+        if not self.alive or not th.alive:
+            return
+        status, msg = th.channel.recv_from_plugin_timed(RECV_TIMEOUT_MS)
+        if status != 1:
+            log.warning("cloned thread vtid=%d never started", th.vtid)
+            th.alive = False
+            return
+        if msg.kind == native.IPC_THREAD_FAIL:
+            log.warning("native clone failed for vtid=%d: %d",
+                        th.vtid, int(msg.number))
+            th.alive = False
+            return
+        if msg.kind != native.IPC_THREAD_START:
+            log.warning("unexpected first message kind=%d from "
+                        "vtid=%d", msg.kind, th.vtid)
+        go = native.IpcMessage()
+        go.kind = native.IPC_START
+        go.number = 0
+        th.channel.send_to_plugin(go)
+        self._continue(ctx, th)
+
+    def thread_exit(self, ctx, th: ManagedThread, code: int) -> bool:
+        """SYS_exit from one thread: CLEARTID wake for joiners, then
+        let the native thread die. Returns True if this was the last
+        thread (the process is exiting)."""
+        th.alive = False
+        if th.clear_ctid:
+            import struct as _s
+            try:
+                self.mem.write(th.clear_ctid, _s.pack("<I", 0))
+            except OSError:
+                pass
+            fx = self.futexes.get(th.clear_ctid)
+            if fx is not None:
+                fx.wake(ctx, 1 << 30)
+        alive = [t for t in self.threads.values() if t.alive]
+        if not alive:
+            self.begin_exit(code)
+            return True
+        return False
 
     # -- the IPC ping-pong loop (thread_preload.c event loop) -----------
-    def _reply(self, res, nr: int, args) -> None:
+    def _reply_to(self, th: ManagedThread, res) -> None:
         msg = native.IpcMessage()
         if res is NATIVE:
             msg.kind = native.IPC_SYSCALL_NATIVE
             msg.number = 0
+        elif isinstance(res, CloneGo):
+            msg.kind = native.IPC_CLONE_GO
+            msg.number = res.vtid
+            msg.args[0] = res.channel_offset
         else:
             msg.kind = native.IPC_SYSCALL_DONE
             msg.number = int(res)
-        self.channel.send_to_plugin(msg)
+        th.channel.send_to_plugin(msg)
 
-    def _continue(self, ctx) -> None:
-        """Service plugin syscalls until it blocks or exits."""
+    def _reply(self, res, nr: int, args) -> None:   # legacy signature
+        self._reply_to(self.current, res)
+
+    def _continue(self, ctx, th: Optional[ManagedThread] = None) -> None:
+        """Service one thread's syscalls until it blocks, exits, or
+        hands control back (one thread of the process runs at a time)."""
+        if th is None:
+            th = self.current
         while True:
-            status, msg = self.channel.recv_from_plugin_timed(
+            status, msg = th.channel.recv_from_plugin_timed(
                 RECV_TIMEOUT_MS)
-            if status == 0:            # plugin exited
+            if status == 0:            # plugin (thread group) exited
                 self._finalize_exit(ctx)
                 return
             if status == -1:           # wall-clock stall
@@ -337,6 +465,7 @@ class ManagedProcess:
             name = NR_NAME.get(nr, str(nr))
             self.syscall_counts[name] = self.syscall_counts.get(name,
                                                                 0) + 1
+            self.current = th
             try:
                 res = self.handler.dispatch(ctx, nr, args)
             except Blocked as b:
@@ -346,14 +475,31 @@ class ManagedProcess:
                 log.exception("syscall %s(%s) handler crashed", name,
                               args)
                 res = -38              # ENOSYS
-            self._reply(res, nr, args)
-            self.syscall_state = {}
+            self._reply_to(th, res)
+            th.syscall_state = {}
+            if not th.alive:           # replied to an exiting thread
+                if any(t.alive for t in self.threads.values()):
+                    return             # others keep the process alive
+                # last thread: the reply lets the native process die;
+                # wait for the reaper's exited flag so sockets close
+                # and the exit code lands NOW, not at sim end
+                status, _ = th.channel.recv_from_plugin_timed(
+                    RECV_TIMEOUT_MS)
+                if status == 0:
+                    self._finalize_exit(ctx)
+                else:
+                    log.warning("%s: exit did not complete; killing",
+                                self.path)
+                    self._kill(ctx)
+                return
 
     # -- teardown -------------------------------------------------------
     def _finalize_exit(self, ctx) -> None:
         if not self.alive:
             return
         self.alive = False
+        for th in self.threads.values():
+            th.alive = False
         self._reaper.join(timeout=10)
         rc = self.proc.returncode
         if self.exit_code is None and rc is not None:

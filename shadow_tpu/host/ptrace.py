@@ -381,6 +381,8 @@ class PtraceProcess(ManagedProcess):
     """A real executable driven by PTRACE_SYSEMU instead of the
     preload shim (same app interface, same SyscallHandler)."""
 
+    supports_threads = False       # SYSEMU multi-tracee: roadmap
+
     def __init__(self, runtime, path: str, args, environment: str = ""):
         super().__init__(runtime, path, args, environment)
         self.tracer: Optional[_Tracer] = None
@@ -416,6 +418,12 @@ class PtraceProcess(ManagedProcess):
         self.mem = ProcessMemory(pid)
         self._native_pid = pid
         self.alive = True
+        # single pseudo-thread: park/resume and per-syscall state flow
+        # through the same thread objects as the preload backend
+        from shadow_tpu.host.process import ManagedThread
+        main = ManagedThread(self, self.vpid, None)
+        self.threads = {self.vpid: main}
+        self.current = main
         self._pending = (None, False)
         log.debug("ptrace-spawned %s pid=%d vpid=%d on %s", self.path,
                   pid, self.vpid, self.host.name)
@@ -428,7 +436,7 @@ class PtraceProcess(ManagedProcess):
         else:
             self._pending = (int(res), False)
 
-    def _continue(self, ctx) -> None:
+    def _continue(self, ctx, th=None) -> None:
         while True:
             result, native = self._pending or (None, False)
             self._pending = None

@@ -63,7 +63,7 @@ NR = dict(
     epoll_create1=291, dup3=292, pipe2=293, recvmmsg=299, sendmmsg=307,
     getrandom=318, newfstatat=262, statx=332,
     sched_yield=24, gettid=186, sysinfo=99, futex=202,
-    set_tid_address=218, sendfile=40,
+    set_tid_address=218, sendfile=40, tgkill=234, clone3=435,
 )
 NR_NAME = {v: k for k, v in NR.items()}
 
@@ -95,6 +95,18 @@ EFD_SEMAPHORE, EFD_NONBLOCK = 1, 0x800
 UDP_MAX_PAYLOAD = simtime.CONFIG_MTU - simtime.CONFIG_HEADER_SIZE_UDPIPETH
 
 NATIVE = object()          # sentinel: shim executes the syscall for real
+
+
+class CloneGo:
+    """sys_clone's approval value: the process layer replies
+    IPC_CLONE_GO (child vtid + child channel offset) instead of a
+    plain DONE result (clone.c's thread_clone handshake)."""
+
+    __slots__ = ("vtid", "channel_offset")
+
+    def __init__(self, vtid: int, channel_offset: int):
+        self.vtid = vtid
+        self.channel_offset = channel_offset
 
 
 class Blocked(Exception):
@@ -279,20 +291,61 @@ class SyscallHandler:
         return n
 
     def sys_exit(self, ctx, a):
-        self.p.begin_exit(_s32(a[0]))
+        """Thread exit: only the calling thread dies (clone.c model);
+        the process exits when its last thread does."""
+        code = _s32(a[0])
+        cur = getattr(self.p, "current", None)
+        if cur is not None and hasattr(self.p, "thread_exit"):
+            self.p.thread_exit(ctx, cur, code)
+        else:
+            self.p.begin_exit(code)
         return NATIVE
 
     def sys_exit_group(self, ctx, a):
         self.p.begin_exit(_s32(a[0]))
+        for th in getattr(self.p, "threads", {}).values():
+            th.alive = False        # _continue replies, then stops
         return NATIVE
 
+    # clone flag bits (uapi)
+    CLONE_VM, CLONE_FS, CLONE_FILES = 0x100, 0x200, 0x400
+    CLONE_SIGHAND, CLONE_THREAD = 0x800, 0x10000
+    CLONE_SYSVSEM, CLONE_SETTLS = 0x40000, 0x80000
+
     def sys_clone(self, ctx, a):
-        return -ENOSYS      # managed multi-threading: roadmap
+        """Managed thread creation (clone.c:30: CLONE_THREAD-style
+        clones only; anything else is refused). The heavy lifting —
+        child IPC channel, scheduling, the shim's two-stack native
+        clone — lives in ManagedProcess.spawn_thread."""
+        flags = int(a[0])
+        required = (self.CLONE_VM | self.CLONE_FS | self.CLONE_FILES |
+                    self.CLONE_SIGHAND | self.CLONE_THREAD |
+                    self.CLONE_SYSVSEM | self.CLONE_SETTLS)
+        if (flags & required) != required:
+            return -EOPNOTSUPP
+        if not getattr(self.p, "supports_threads", False):
+            return -ENOSYS      # ptrace backend: threads on roadmap
+        return self.p.spawn_thread(ctx, flags, a)
+
+    def sys_clone3(self, ctx, a):
+        # glibc falls back to classic clone on ENOSYS
+        return -ENOSYS
 
     def sys_fork(self, ctx, a):
         return -ENOSYS
 
     def sys_vfork(self, ctx, a):
+        return -ENOSYS
+
+    def sys_tgkill(self, ctx, a):
+        """Existence checks against virtual tids; actual cross-thread
+        signal delivery is not modeled yet."""
+        tid, sig = _s32(a[1]), _s32(a[2])
+        threads = getattr(self.p, "threads", {})
+        if tid not in threads or not threads[tid].alive:
+            return -3           # ESRCH
+        if sig == 0:
+            return 0
         return -ENOSYS
 
     # ==================================================================
@@ -1223,10 +1276,14 @@ class SyscallHandler:
         return 0
 
     def sys_gettid(self, ctx, a):
-        return self.p.vpid          # single-threaded: tid == pid
+        cur = getattr(self.p, "current", None)
+        return cur.vtid if cur is not None else self.p.vpid
 
     def sys_set_tid_address(self, ctx, a):
-        return self.p.vpid
+        cur = getattr(self.p, "current", None)
+        if cur is not None:
+            cur.clear_ctid = a[0]
+        return cur.vtid if cur is not None else self.p.vpid
 
     def sys_sysinfo(self, ctx, a):
         """struct sysinfo with simulated uptime; memory fields report a

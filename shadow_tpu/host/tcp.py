@@ -10,8 +10,10 @@ layer:
   socket, FIN teardown with TIME_WAIT (60 s, definitions.h:195)
 * byte-sequence send space with MSS segmentation, a retransmit queue,
   cumulative ACKs, duplicate-ACK fast retransmit (3 dupacks) with
-  NewReno-style partial-ACK recovery, and RFC 6298 RTO estimation from
-  RFC 7323-style timestamps
+  NewReno-style partial-ACK recovery, RFC 6298 RTO estimation from
+  RFC 7323-style timestamps, and SACK: the receiver reports up to 4
+  out-of-order blocks per ACK and the sender's RetransmitTally skips
+  selectively-acked spans when picking retransmission holes
 * Reno congestion window: slow start to ssthresh, +MSS*MSS/cwnd per ACK
   in congestion avoidance, halving on loss, cwnd=1 MSS on RTO
 * receive-side reordering buffer with cumulative ACK generation and a
@@ -44,6 +46,49 @@ MIN_RTO_NS = 200 * simtime.SIMTIME_ONE_MILLISECOND
 MAX_RTO_NS = 60 * simtime.SIMTIME_ONE_SECOND
 TIME_WAIT_NS = simtime.CONFIG_TCP_TIMEWAIT_SECONDS \
     * simtime.SIMTIME_ONE_SECOND
+
+
+class RetransmitTally:
+    """Sender-side record of which byte ranges the peer has selectively
+    acknowledged — the role of the reference's C++ retransmit tally
+    (tcp_retransmit_tally.cc:10-30, a ranges structure driving which
+    blocks get retransmitted). Kept as a sorted list of disjoint
+    [start, end) spans above the cumulative ACK point."""
+
+    def __init__(self):
+        self.sacked: list[list[int]] = []     # sorted disjoint [s, e)
+
+    def mark_sacked(self, start: int, end: int) -> None:
+        if end <= start:
+            return
+        merged = []
+        placed = False
+        for s, e in self.sacked:
+            if e < start or s > end:          # disjoint
+                merged.append([s, e])
+            else:                             # overlap/adjacent: fuse
+                start, end = min(s, start), max(e, end)
+        for i, (s, _) in enumerate(merged):
+            if s > start:
+                merged.insert(i, [start, end])
+                placed = True
+                break
+        if not placed:
+            merged.append([start, end])
+        self.sacked = merged
+
+    def clear_below(self, ack: int) -> None:
+        self.sacked = [[max(s, ack), e] for s, e in self.sacked
+                       if e > ack]
+
+    def is_sacked(self, start: int, end: int) -> bool:
+        """True if [start, end) lies fully inside one sacked span."""
+        for s, e in self.sacked:
+            if s <= start and end <= e:
+                return True
+            if s > start:
+                break
+        return False
 
 
 class TcpState(enum.Enum):
@@ -82,6 +127,7 @@ class TcpSocket(BaseSocket):
         self.fin_sent_seq: Optional[int] = None
         self.retx: list[list] = []     # [seq, len, n_tx, ts_staged, flags]
         self.peer_window = DEFAULT_RECV_WINDOW
+        self.tally = RetransmitTally()  # peer-SACKed spans
 
         # congestion control (tcp_cong_reno.c)
         self.cwnd = INIT_CWND_SEGMENTS * MSS
@@ -145,18 +191,34 @@ class TcpSocket(BaseSocket):
     def _flight(self) -> int:
         return self.snd_nxt - self.snd_una
 
+    def _sack_blocks(self) -> tuple:
+        """Up to 4 selective-ack blocks from the reorder buffer
+        (receiver side of packet.h:20-33's selective ACK list)."""
+        if not self.reorder:
+            return ()
+        spans = []
+        for seq in sorted(self.reorder):
+            end = seq + self.reorder[seq]
+            if spans and seq <= spans[-1][1]:
+                spans[-1][1] = max(spans[-1][1], end)
+            else:
+                spans.append([seq, end])
+        return tuple((s, e) for s, e in spans[:4])
+
     def _emit(self, now: int, flags: TcpFlags, seq: int, size: int = 0,
               track: bool = True) -> None:
         dst_host, dst_port = self.peer
         hdr = TcpHeader(flags=int(flags), seq=seq, ack=self.rcv_nxt,
                         window=self.recv_window,
                         src_port=self.local_port, dst_port=dst_port,
+                        sack=self._sack_blocks(),
                         ts_val=now, ts_echo=self._ts_echo)
         pkt = self.net.new_packet(dst_host=dst_host, protocol=Protocol.TCP,
                                   size=size, src_port=self.local_port,
                                   dst_port=dst_port)
         pkt.tcp = hdr
         self.segments_sent += 1
+        self.net.tcp_segments_sent += 1
         if track and (size > 0 or flags & (TcpFlags.SYN | TcpFlags.FIN)):
             self.retx.append([seq, size, 1, now, int(flags)])
         self._stage(pkt, now)
@@ -222,14 +284,23 @@ class TcpSocket(BaseSocket):
         self._arm_rto(now)
 
     def _retransmit_first(self, now: int) -> None:
+        """Retransmit the lowest outstanding hole the peer has NOT
+        selectively acknowledged (the tally's job in the reference:
+        SACKed blocks are never resent)."""
         if not self.retx:
             return
-        seq, size, n_tx, _, flags = min(self.retx, key=lambda e: e[0])
+        candidates = [e for e in self.retx
+                      if not self.tally.is_sacked(e[0],
+                                                  e[0] + max(e[1], 1))]
+        if not candidates:
+            return
+        seq, size, n_tx, _, flags = min(candidates, key=lambda e: e[0])
         for e in self.retx:
             if e[0] == seq:
                 e[2] += 1
                 e[3] = now
         self.segments_retransmitted += 1
+        self.net.tcp_segments_retransmitted += 1
         self._emit(now, TcpFlags(flags), seq=seq, size=size, track=False)
 
     # ------------------------------------------------------------------
@@ -302,11 +373,14 @@ class TcpSocket(BaseSocket):
         ack = hdr.ack
         if ack > self.snd_nxt:
             return
+        for s, e in hdr.sack:
+            self.tally.mark_sacked(s, e)
         if ack > self.snd_una:
             acked = ack - self.snd_una
             self.snd_una = ack
             self.bytes_acked += acked
             self.retx = [e for e in self.retx if e[0] + max(e[1], 1) > ack]
+            self.tally.clear_below(ack)
             self._sample_rtt(now, hdr.ts_echo)
             if self.in_recovery:
                 if ack >= self.recover:

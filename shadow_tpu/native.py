@@ -37,6 +37,9 @@ IPC_SYSCALL = 2
 IPC_SYSCALL_DONE = 3
 IPC_SYSCALL_NATIVE = 4
 IPC_STOP = 5
+IPC_CLONE_GO = 6       # sim->plugin: clone approved (vtid + chan offset)
+IPC_THREAD_START = 7   # child thread announcing itself on its channel
+IPC_THREAD_FAIL = 8    # native clone failed after approval
 
 
 def load(build_if_missing: bool = True) -> ctypes.CDLL:

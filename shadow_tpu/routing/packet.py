@@ -58,8 +58,7 @@ class TcpHeader:
     window: int = 0           # advertised receive window (bytes)
     src_port: int = 0
     dst_port: int = 0
-    # SACK blocks [(start, end), ...] and timestamps land with the
-    # full SACK implementation
+    sack: tuple = ()          # selective-ack blocks ((start, end), ...)
     ts_val: int = 0
     ts_echo: int = 0
 

@@ -117,6 +117,33 @@ def test_tcp_transfer_lossy_retransmits():
     assert client.app.bytes_received >= 200 * 1024
 
 
+def test_tcp_sack_suppresses_spurious_retransmits():
+    """On a 5% lossy link the receiver SACKs its out-of-order blocks
+    and the sender must never resend a span the peer already holds
+    (tcp_retransmit_tally.cc role). With ~140 data segments a blind
+    go-back-N would resend far more than the ~dozen actually lost."""
+    stats, client, server = _run_tcp(loss=0.05, size="200KiB")
+    retrans = server.net.tcp_segments_retransmitted
+    sent = server.net.tcp_segments_sent
+    lost_est = int(0.05 * sent * 3)  # generous bound on real losses
+    assert 0 < retrans <= max(lost_est, 30), (retrans, sent)
+
+
+def test_retransmit_tally_ranges():
+    from shadow_tpu.host.tcp import RetransmitTally
+    t = RetransmitTally()
+    t.mark_sacked(100, 200)
+    t.mark_sacked(300, 400)
+    assert t.is_sacked(100, 200) and t.is_sacked(150, 180)
+    assert not t.is_sacked(90, 110) and not t.is_sacked(200, 300)
+    t.mark_sacked(150, 350)          # bridges the gap
+    assert t.sacked == [[100, 400]]
+    t.clear_below(250)
+    assert t.sacked == [[250, 400]]
+    t.mark_sacked(400, 500)          # adjacent fuses
+    assert t.sacked == [[250, 500]]
+
+
 def test_tcp_bandwidth_pacing():
     # 800 KiB over a 10 Mbit link: ideal ~0.66 s; with handshake,
     # slow start and 20ms RTT it must take >= the line-rate bound and
