@@ -55,6 +55,16 @@ class SimContext:
             seq)
         return int(nprng.random_bits32(key))
 
+    def pure_bits(self, purpose: int, a: int, b: int) -> int:
+        """32 deterministic bits from a STATELESS key (purpose, a, b) —
+        no per-host draw counter consumed, so any host can recompute
+        the same value (e.g. an onion route as a pure function of the
+        client id). Identical on CPU and device engines."""
+        key = nprng.fold_in(
+            nprng.fold_in(
+                nprng.fold_in(self._m.rng_key, purpose), a), b)
+        return int(nprng.random_bits32(key))
+
     def app_uniform(self) -> float:
         seq = self.host.next_app_seq()
         key = nprng.fold_in(

@@ -26,6 +26,8 @@ import numpy as np
 
 from shadow_tpu._jax import jnp
 from shadow_tpu.core.event import KIND_BOOT, KIND_PACKET, KIND_TIMER
+from shadow_tpu.device import prng
+from shadow_tpu.utils.rng import PURPOSE_TOR_ROUTE
 
 
 class AppOut(NamedTuple):
@@ -235,6 +237,189 @@ class TgenDevice(DeviceApp):
                             req_start[:, None]).astype(jnp.int32)
 
         # ---- timers (pause and retry are mutually exclusive) ----
+        pause_valid = dl_done & (new_done < self.count)
+        retry_valid = send_req & (self.retry_ns > 0)
+        timer_valid = (pause_valid | retry_valid)[:, None]
+        timer_delay = jnp.where(pause_valid, self.pause_ns,
+                                self.retry_ns)[:, None].astype(jnp.int64)
+        timer_d0 = jnp.where(pause_valid, -1,
+                             new_gen)[:, None].astype(jnp.int32)
+
+        return AppOut(
+            send_dst=send_dst, send_size=send_size, send_d0=send_d0,
+            send_d1=send_d1, send_valid=send_valid,
+            timer_delay=timer_delay, timer_d0=timer_d0,
+            timer_valid=timer_valid,
+            n_draws=jnp.zeros((H,), jnp.int32),
+            app_state=st,
+        )
+
+
+@dataclass
+class TorDevice(DeviceApp):
+    """Vectorized twin of models/tor.py: onion circuits as pure
+    functions of the client id (counter-RNG keyed (TOR_ROUTE, circ,
+    hop)), so relays are completely stateless and every hop decision is
+    one batched branch — the design reason the CPU model keeps no
+    per-relay circuit tables.
+
+    State words (clients; relays only use word 0):
+    [role, chunk_start, got, done, gen, mask].
+    d1 packs (circ << SEQ_BITS) | (start-or-seq)."""
+
+    roles: np.ndarray = field(repr=False)       # [H] 0=relay 1=client
+    relay_gids: np.ndarray = field(repr=False)  # [R] sorted
+    seed: int = 1
+    cells: int = 64
+    count: int = 1
+    pause_ns: int = 1_000_000_000
+    retry_ns: int = 0
+
+    TAG_REQ = 3
+    TAG_DATA = 4
+
+    def __post_init__(self):
+        from shadow_tpu.models.tor import (
+            CELL_BYTES, CHUNK_CELLS, SEQ_BITS, SEQ_MASK)
+        assert len(self.relay_gids) >= 3, "tor model needs >= 3 relays"
+        assert self.cells <= SEQ_MASK
+        assert CHUNK_CELLS <= 32, "client mask is one int32 word"
+        self.CELL = CELL_BYTES
+        self.chunk = CHUNK_CELLS
+        self.SEQ_BITS = SEQ_BITS
+        self.SEQ_MASK = SEQ_MASK
+        self.n_state_words = 6
+        self.max_sends = self.chunk
+        self.max_timers = 1
+        self.max_draws = 1              # no stateful randomness
+        self.seed_pair = prng.seed_key(self.seed)
+
+    def init_state(self, n_hosts: int) -> jnp.ndarray:
+        st = np.zeros((n_hosts, self.n_state_words), np.int32)
+        n = min(n_hosts, len(self.roles))
+        st[:n, 0] = self.roles[:n]
+        return jnp.asarray(st)
+
+    def _route(self, circ):
+        """(guard, middle, exit) gids — models/tor.py pick_route in
+        vector form, bit-identical draws."""
+        R = len(self.relay_gids)
+        def bits(j):
+            return prng.random_bits32(prng.chain_key(
+                self.seed_pair, PURPOSE_TOR_ROUTE, circ,
+                jnp.full_like(circ, j)))
+        g = (bits(0) % jnp.uint32(R)).astype(jnp.int32)
+        m = (bits(1) % jnp.uint32(R - 1)).astype(jnp.int32)
+        m = jnp.where(m >= g, m + 1, m)
+        lo = jnp.minimum(g, m)
+        hi = jnp.maximum(g, m)
+        e = (bits(2) % jnp.uint32(R - 2)).astype(jnp.int32)
+        e = jnp.where(e >= lo, e + 1, e)
+        e = jnp.where(e >= hi, e + 1, e)
+        gids = jnp.asarray(self.relay_gids.astype(np.int32))
+        return gids[g], gids[m], gids[e]
+
+    def handle(self, gid, now, kind, src, size, d0, d1, app_state, draws
+               ) -> AppOut:
+        H, K = draws.shape[0], self.max_sends
+        role = app_state[:, 0]
+        chunk_start = app_state[:, 1]
+        got = app_state[:, 2]
+        done = app_state[:, 3]
+        gen = app_state[:, 4]
+        mask = app_state[:, 5]
+        is_relay = role == 0
+        is_client = role == 1
+
+        is_pkt = kind == KIND_PACKET
+        circ = jnp.right_shift(d1, self.SEQ_BITS)
+        field_ = d1 & self.SEQ_MASK
+        G, M, E = self._route(circ)
+        me = gid
+
+        # ---- relay branches (stateless) ----
+        r_req = is_relay & is_pkt & (d0 == self.TAG_REQ)
+        r_data = is_relay & is_pkt & (d0 == self.TAG_DATA)
+        fwd_req_g = r_req & (me == G)        # -> M
+        fwd_req_m = r_req & (me == M)        # -> E
+        serve = r_req & (me == E)            # exit: emit DATA chunk
+        fwd_data_m = r_data & (me == M)      # -> G
+        fwd_data_g = r_data & (me == G)      # -> client (circ)
+
+        fwd = fwd_req_g | fwd_req_m | fwd_data_m | fwd_data_g
+        fwd_dst = jnp.where(
+            fwd_req_g, M, jnp.where(
+                fwd_req_m, E, jnp.where(fwd_data_m, G, circ)))
+
+        # ---- client window progress (tgen dedup rules) ----
+        my_route = self._route(me)
+        my_guard = my_route[0]
+        c_data = is_client & is_pkt & (d0 == self.TAG_DATA)
+        c_boot = is_client & (kind == KIND_BOOT) & (self.count > 0)
+        c_timer = is_client & (kind == KIND_TIMER)
+        timer_pause = c_timer & (d0 < 0)
+        timer_retry = c_timer & (d0 >= 0) & (d0 == gen)
+
+        chunk_len = jnp.minimum(self.chunk, self.cells - chunk_start)
+        off = field_ - chunk_start
+        in_win = c_data & (off >= 0) & (off < chunk_len)
+        bit = jnp.left_shift(jnp.int32(1),
+                             jnp.clip(off, 0, self.chunk - 1))
+        fresh = in_win & ((mask & bit) == 0)
+        new_mask = jnp.where(fresh, mask | bit, mask)
+        new_got = jnp.where(fresh, got + 1, got)
+        complete = fresh & (new_got >= chunk_len)
+        nxt = chunk_start + chunk_len
+        dl_done = complete & (nxt >= self.cells)
+        cont = complete & ~dl_done
+
+        send_req = c_boot | timer_pause | timer_retry | cont
+        req_start = jnp.where(cont, nxt,
+                              jnp.where(timer_retry, chunk_start, 0))
+        new_chunk_start = jnp.where(
+            cont, nxt,
+            jnp.where(c_boot | timer_pause | dl_done, 0, chunk_start))
+        new_got = jnp.where(send_req | dl_done, 0, new_got)
+        new_mask = jnp.where(send_req | dl_done, 0, new_mask)
+        new_done = done + dl_done.astype(jnp.int32)
+        new_gen = gen + (send_req | dl_done).astype(jnp.int32)
+
+        st = app_state
+        st = st.at[:, 1].set(new_chunk_start)
+        st = st.at[:, 2].set(new_got)
+        st = st.at[:, 3].set(new_done)
+        st = st.at[:, 4].set(new_done * 0 + new_gen)
+        st = st.at[:, 5].set(new_mask)
+
+        # ---- sends ----
+        ks = jnp.arange(K, dtype=jnp.int32)[None, :]       # [1,K]
+        # exit chunk service: cells start..start+chunk-1 toward M
+        seqs = field_[:, None] + ks
+        srv_valid = serve[:, None] & (seqs < self.cells)
+        # slot 0: relay forward (1 cell) or client REQ
+        slot0 = (fwd | send_req)[:, None] & (ks == 0)
+        send_valid = srv_valid | slot0
+
+        req_d1 = jnp.left_shift(me, self.SEQ_BITS) | req_start
+        data_d1 = jnp.left_shift(circ[:, None], self.SEQ_BITS) | \
+            (seqs & self.SEQ_MASK)
+        send_dst = jnp.where(
+            serve[:, None], M[:, None],
+            jnp.where(fwd[:, None], fwd_dst[:, None],
+                      my_guard[:, None])).astype(jnp.int32)
+        send_size = jnp.where(
+            serve[:, None], self.CELL,
+            jnp.where(fwd[:, None], size[:, None], 64)).astype(jnp.int32)
+        send_d0 = jnp.where(
+            serve[:, None], self.TAG_DATA,
+            jnp.where(fwd[:, None], d0[:, None],
+                      self.TAG_REQ)).astype(jnp.int32)
+        send_d1 = jnp.where(
+            serve[:, None], data_d1,
+            jnp.where(fwd[:, None], d1[:, None],
+                      req_d1[:, None])).astype(jnp.int32)
+
+        # ---- timers ----
         pause_valid = dl_done & (new_done < self.count)
         retry_valid = send_req & (self.retry_ns > 0)
         timer_valid = (pause_valid | retry_valid)[:, None]

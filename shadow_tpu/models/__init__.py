@@ -17,6 +17,7 @@ from shadow_tpu.models.base import ModelApp, parse_kv_args
 from shadow_tpu.models.phold import PholdApp
 from shadow_tpu.models.tgen import TgenClientApp, TgenServerApp
 from shadow_tpu.models.tgen_tcp import TgenTcpClientApp, TgenTcpServerApp
+from shadow_tpu.models.tor import TorClientApp, TorRelayApp
 
 _REGISTRY = {
     "phold": PholdApp,
@@ -24,6 +25,8 @@ _REGISTRY = {
     "tgen_server": TgenServerApp,
     "tgen_tcp_client": TgenTcpClientApp,
     "tgen_tcp_server": TgenTcpServerApp,
+    "tor_relay": TorRelayApp,
+    "tor_client": TorClientApp,
 }
 
 

@@ -1,0 +1,200 @@
+"""Onion-routing (Tor-like) workload: clients stream cells through
+3-hop relay circuits.
+
+The reference's flagship scale target is Tor simulation (README.md:66-69,
+run_tor.yml CI; BASELINE configs #4/#5: 50 relays + 200 clients and
+~6k relays + 50k clients). Real Tor runs as managed processes; this
+model is the scripted twin of its *traffic shape* — guard/middle/exit
+forwarding, cell quantization, chunked end-to-end pulls — built
+TPU-first:
+
+**Relays are stateless.** A circuit is a pure function of the client id
+(three distinct relays drawn from counter-RNG keyed by
+(TOR_ROUTE, client, hop)), so any relay can recompute route position
+and next hop from the cell's circuit id alone — no per-relay circuit
+tables, which is exactly what lets the device twin (TorDevice) run
+every relay as one vectorized branch with zero dynamic state.
+
+Cells: REQ (64 B) travels client -> guard -> middle -> exit carrying a
+chunk-start index; the exit answers with up to CHUNK_CELLS DATA cells
+(CELL_BYTES each) flowing exit -> middle -> guard -> client. The client
+windows chunks exactly like the tgen model (received-mask, retry
+generation, pause between downloads).
+
+client args: cells=N per download, count=downloads, pause=ns,
+retry=ns (0 disables). relay args: none.
+
+Tags (device-twin parity): 3=TOR_REQ, 4=TOR_DATA. d1 packs
+(circ << SEQ_BITS) | seq for DATA and (circ << SEQ_BITS) | chunk_start
+for REQ; circuits are client gids.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.config.units import parse_time_ns
+from shadow_tpu.models.base import ModelApp
+from shadow_tpu.utils.rng import PURPOSE_TOR_ROUTE
+
+TAG_TOR_REQ = 3
+TAG_TOR_DATA = 4
+
+CELL_BYTES = 512                # Tor cell payload quantum
+CHUNK_CELLS = 16                # cells per REQ round trip (window)
+SEQ_BITS = 12                   # seq field width inside d1
+SEQ_MASK = (1 << SEQ_BITS) - 1
+
+
+def pick_route(bits3: tuple[int, int, int], n_relays: int
+               ) -> tuple[int, int, int]:
+    """Three DISTINCT relay indices from three independent draws —
+    pure integer math shared verbatim with the device twin."""
+    r = n_relays
+    g = bits3[0] % r
+    m = bits3[1] % (r - 1)
+    if m >= g:
+        m += 1
+    lo, hi = (g, m) if g < m else (m, g)
+    e = bits3[2] % (r - 2)
+    if e >= lo:
+        e += 1
+    if e >= hi:
+        e += 1
+    return g, m, e
+
+
+class TorMixin:
+    """Shared route computation over the config's relay group."""
+
+    def _relay_gids(self, ctx) -> list[int]:
+        if getattr(self, "_relays", None) is None:
+            # every host whose app is a relay, in gid order — the
+            # device twin derives the identical list from roles
+            self._relays = [h.host_id for h in ctx._m.hosts
+                            if isinstance(h.app, TorRelayApp)]
+            if len(self._relays) < 3:
+                raise ValueError("tor model needs >= 3 relays")
+        return self._relays
+
+    def _route(self, ctx, circ: int) -> tuple[int, int, int]:
+        relays = self._relay_gids(ctx)
+        bits = tuple(ctx.pure_bits(PURPOSE_TOR_ROUTE, circ, j)
+                     for j in range(3))
+        g, m, e = pick_route(bits, len(relays))
+        return relays[g], relays[m], relays[e]
+
+
+class TorRelayApp(ModelApp, TorMixin):
+    """Stateless onion relay: recomputes the circuit route from the
+    cell's circuit id and forwards one hop; the exit answers REQ chunks
+    itself (the 'server' role is folded into the exit hop)."""
+
+    def __init__(self, args, host_id, n_hosts):
+        super().__init__(args, host_id, n_hosts)
+        self.cells_relayed = 0
+        self.cells_served = 0
+
+    def on_packet(self, ctx, src_host, size, data) -> None:
+        tag = data[0] if data else 0
+        if tag == TAG_TOR_REQ:
+            circ, start = data[1], data[2]
+            g, m, e = self._route(ctx, circ)
+            me = ctx.host_id
+            if me == g:
+                self.cells_relayed += 1
+                ctx.send(m, size, tuple(data))
+            elif me == m:
+                self.cells_relayed += 1
+                ctx.send(e, size, tuple(data))
+            elif me == e:
+                # exit: serve the chunk back toward the client
+                n_cells = data[3]
+                for k in range(CHUNK_CELLS):
+                    seq = start + k
+                    if seq >= n_cells:
+                        break
+                    self.cells_served += 1
+                    ctx.send(m, CELL_BYTES, (TAG_TOR_DATA, circ, seq))
+        elif tag == TAG_TOR_DATA:
+            circ, seq = data[1], data[2]
+            g, m, e = self._route(ctx, circ)
+            me = ctx.host_id
+            if me == m:
+                self.cells_relayed += 1
+                ctx.send(g, size, (TAG_TOR_DATA, circ, seq))
+            elif me == g:
+                self.cells_relayed += 1
+                ctx.send(circ, size, (TAG_TOR_DATA, circ, seq))
+
+
+class TorClientApp(ModelApp, TorMixin):
+    """Chunked cell puller through its circuit (window/mask/retry state
+    identical in shape to the tgen client, so the device twin reuses
+    the proven dedup rules)."""
+
+    def __init__(self, args, host_id, n_hosts):
+        super().__init__(args, host_id, n_hosts)
+        self.cells = int(args.get("cells", 64))
+        if self.cells > SEQ_MASK:
+            raise ValueError(f"cells > {SEQ_MASK} not encodable")
+        self.count = int(args.get("count", 1))
+        self.pause_ns = parse_time_ns(args.get("pause", "1 s"))
+        self.retry_ns = parse_time_ns(args.get("retry", 0))
+        self.downloads_done = 0
+        self.cells_received = 0
+        self._chunk_start = 0
+        self._got = 0
+        self._mask = 0
+        self._gen = 0
+
+    def _request_chunk(self, ctx) -> None:
+        g, _m, _e = self._route(ctx, ctx.host_id)
+        self._got = 0
+        self._mask = 0
+        self._gen += 1
+        ctx.send(g, 64, (TAG_TOR_REQ, ctx.host_id, self._chunk_start,
+                         self.cells))
+        if self.retry_ns > 0:
+            ctx.schedule(self.retry_ns, data=(self._gen,))
+
+    def boot(self, ctx) -> None:
+        if self.count > 0:
+            self._request_chunk(ctx)
+
+    def on_timer(self, ctx, data) -> None:
+        d0 = data[0] if data else -1
+        if d0 >= 0:
+            if d0 == self._gen:           # chunk still outstanding
+                self._request_chunk(ctx)
+            return
+        self._chunk_start = 0
+        self._request_chunk(ctx)
+
+    def on_packet(self, ctx, src_host, size, data) -> None:
+        tag = data[0] if data else 0
+        if tag != TAG_TOR_DATA:
+            return
+        seq = data[2]
+        chunk_len = min(CHUNK_CELLS, self.cells - self._chunk_start)
+        off = seq - self._chunk_start
+        if off < 0 or off >= chunk_len:
+            return
+        bit = 1 << off
+        if self._mask & bit:
+            return                        # duplicate from a retry
+        self._mask |= bit
+        self._got += 1
+        self.cells_received += 1
+        if self._got < chunk_len:
+            return
+        nxt = self._chunk_start + chunk_len
+        if nxt < self.cells:
+            self._chunk_start = nxt
+            self._request_chunk(ctx)
+            return
+        self.downloads_done += 1
+        self._chunk_start = 0
+        self._got = 0
+        self._mask = 0
+        self._gen += 1                    # invalidate pending retries
+        if self.downloads_done < self.count:
+            ctx.schedule(self.pause_ns, data=(-1,))

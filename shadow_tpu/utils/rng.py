@@ -30,6 +30,7 @@ PURPOSE_PACKET_DROP = 1
 PURPOSE_HOST_BOOT = 2
 PURPOSE_APP = 3
 PURPOSE_JITTER = 4
+PURPOSE_TOR_ROUTE = 5
 
 
 def _derive(seed: int, label: str) -> int:
