@@ -109,4 +109,12 @@ void shadowtpu_ipc_mark_plugin_exited(void* ch) {
   static_cast<IpcChannel*>(ch)->mark_plugin_exited();
 }
 
+// 1 while the cloned native thread is alive (shim arms the guard before
+// its raw clone; the kernel clears it via CLONE_CHILD_CLEARTID at true
+// thread death). 0 once dead or never armed.
+uint32_t shadowtpu_ipc_native_thread_alive(void* ch) {
+  return static_cast<IpcChannel*>(ch)->native_thread_alive.load(
+      std::memory_order_acquire);
+}
+
 }  // extern "C"

@@ -128,6 +128,14 @@ struct IpcChannel {
   SpinSem to_plugin;
   SpinSem to_simulator;
   std::atomic<uint32_t> plugin_exited;
+  // Thread-death guard (was implicit struct padding, so the ABI is
+  // unchanged): the shim arms it to 1 before the native clone and
+  // passes its address as CLONE_CHILD_CLEARTID, so the KERNEL clears
+  // it when the native thread has truly died. The simulator polls it
+  // before waking pthread_join'ers (glibc frees the joined thread's
+  // stack on join return; waking early would let it free a stack the
+  // dying thread still runs its signal epilogue on).
+  std::atomic<uint32_t> native_thread_alive;
   IpcMessage msg_to_plugin;
   IpcMessage msg_to_simulator;
 
@@ -135,6 +143,7 @@ struct IpcChannel {
     to_plugin.init(spin_max);
     to_simulator.init(spin_max);
     plugin_exited.store(0, std::memory_order_relaxed);
+    native_thread_alive.store(0, std::memory_order_relaxed);
     memset(&msg_to_plugin, 0, sizeof(msg_to_plugin));
     memset(&msg_to_simulator, 0, sizeof(msg_to_simulator));
   }

@@ -94,7 +94,11 @@ typedef struct {
   ShimSem to_plugin;
   ShimSem to_simulator;
   volatile uint32_t plugin_exited;
-  uint32_t _pad;
+  /* Armed to 1 before the native clone; the KERNEL clears it to 0 when
+   * the native thread truly dies (CLONE_CHILD_CLEARTID pointed here).
+   * The simulator polls it before waking pthread_join'ers, so glibc
+   * never reuses a stack the dying thread is still running on. */
+  volatile uint32_t native_thread_alive;
   ShimMsg msg_to_plugin;
   ShimMsg msg_to_simulator;
 } ShimChannel;
@@ -393,12 +397,17 @@ static long shim_handle_clone(const long args[6]) {
   *(uint64_t *)(top - 8) = (uint64_t)b;
 
   /* tid bookkeeping is emulated with VIRTUAL ids (below + simulator
-   * exit handling), so the kernel must not write real tids */
-  long nflags = args[0] &
-      ~(long)(CLONE_PARENT_SETTID | CLONE_CHILD_SETTID |
-              CLONE_CHILD_CLEARTID);
-  long r = shim_clone_raw(nflags, (long)(top - 8), args[2], args[3],
-                          args[4]);
+   * exit handling), so the kernel must not write real tids into the
+   * app's words. CLEARTID is retargeted — not stripped — at the
+   * channel's native_thread_alive guard, so the kernel itself reports
+   * true thread death to the simulator (which must not wake joiners
+   * before then: glibc reuses the joined thread's stack). */
+  b->ch->native_thread_alive = 1;
+  long nflags = (args[0] &
+      ~(long)(CLONE_PARENT_SETTID | CLONE_CHILD_SETTID)) |
+      CLONE_CHILD_CLEARTID;
+  long r = shim_clone_raw(nflags, (long)(top - 8), args[2],
+                          (long)&b->ch->native_thread_alive, args[4]);
   if (r < 0) {
     ShimMsg *fm = (ShimMsg *)&b->ch->msg_to_simulator;
     fm->kind = IPC_THREAD_FAIL;

@@ -403,10 +403,33 @@ class ManagedProcess:
         self._continue(ctx, th)
 
     def thread_exit(self, ctx, th: ManagedThread, code: int) -> bool:
-        """SYS_exit from one thread: CLEARTID wake for joiners, then
-        let the native thread die. Returns True if this was the last
-        thread (the process is exiting)."""
+        """SYS_exit from one thread. Marks the thread dead; the
+        CLEARTID write + futex wake for pthread_join'ers is deferred to
+        _finish_thread_exit, AFTER the kernel confirms the native
+        thread died (waking early lets glibc free a stack the dying
+        thread's signal epilogue still runs on). Returns True if this
+        was the last thread (the process is exiting)."""
         th.alive = False
+        alive = [t for t in self.threads.values() if t.alive]
+        if not alive:
+            self.begin_exit(code)
+            return True
+        return False
+
+    def _finish_thread_exit(self, ctx, th: ManagedThread) -> None:
+        """After replying to an exiting (non-last) thread: wait for the
+        kernel-cleared death guard (native_thread_alive, armed by the
+        shim's clone), then publish CLEARTID and wake joiners."""
+        import time as _time
+        deadline = _time.monotonic() + RECV_TIMEOUT_MS / 1000.0
+        ch = th.channel
+        while ch.native_thread_alive():
+            if _time.monotonic() > deadline:
+                log.warning("vtid=%d: native thread did not exit "
+                            "within %ds; waking joiners anyway",
+                            th.vtid, RECV_TIMEOUT_MS // 1000)
+                break
+            _time.sleep(0)          # yield; death follows within µs
         if th.clear_ctid:
             import struct as _s
             try:
@@ -416,11 +439,6 @@ class ManagedProcess:
             fx = self.futexes.get(th.clear_ctid)
             if fx is not None:
                 fx.wake(ctx, 1 << 30)
-        alive = [t for t in self.threads.values() if t.alive]
-        if not alive:
-            self.begin_exit(code)
-            return True
-        return False
 
     # -- the IPC ping-pong loop (thread_preload.c event loop) -----------
     def _reply_to(self, th: ManagedThread, res) -> None:
@@ -479,6 +497,9 @@ class ManagedProcess:
             th.syscall_state = {}
             if not th.alive:           # replied to an exiting thread
                 if any(t.alive for t in self.threads.values()):
+                    # wake pthread_join'ers only after the kernel
+                    # confirms the native thread died
+                    self._finish_thread_exit(ctx, th)
                     return             # others keep the process alive
                 # last thread: the reply lets the native process die;
                 # wait for the reaper's exited flag so sockets close

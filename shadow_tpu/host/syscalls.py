@@ -22,6 +22,7 @@ the in-simulator network stack. Conventions:
 
 from __future__ import annotations
 
+import os
 import struct
 from typing import Optional
 
@@ -44,6 +45,20 @@ from shadow_tpu.host.descriptors import (
     VFD_BASE,
     W,
 )
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("syscalls")
+
+_libc_handle = None
+
+
+def _libc():
+    global _libc_handle
+    if _libc_handle is None:
+        import ctypes
+        _libc_handle = ctypes.CDLL(None, use_errno=True)
+    return _libc_handle
+
 
 # ---- x86_64 syscall numbers ----------------------------------------
 
@@ -1235,39 +1250,58 @@ class SyscallHandler:
             sent += 1
         return sent
 
+    MSG_WAITFORONE = 0x10000
+
     def sys_recvmmsg(self, ctx, a):
+        """Kernel-faithful recvmmsg (net/socket.c do_recvmmsg shape):
+        a blocking socket waits per message until vlen is filled or the
+        timeout expires — and the timeout is only consulted AFTER each
+        received datagram (the documented man-page quirk), so an empty
+        blocking socket waits for its first datagram regardless of
+        timeout. MSG_WAITFORONE drains nonblocking after the first.
+        Nonblocking sockets surface -EAGAIN from recvmsg itself."""
         fd, vec_ptr, vlen, flags = _s32(a[0]), a[1], int(a[2]), _s32(a[3])
         if self._desc(fd) is None:
             return self._no_desc(fd)
         st = self.state
         if "deadline" not in st:
             st["deadline"] = None
+            st["mm_got"] = 0
             if a[4]:        # struct timespec *timeout (relative)
                 ns = kmem.unpack_timespec(self.mem.read(a[4], 16))
                 st["deadline"] = ctx.now + max(0, ns)
-        got = 0
-        for i in range(min(vlen, 1024)):
+        got = st["mm_got"]
+        expired = (st["deadline"] is not None and
+                   ctx.now >= st["deadline"])
+        for i in range(got, min(vlen, 1024)):
             mm = vec_ptr + i * 64
             try:
-                r = self.sys_recvmsg(ctx, (a[0], mm, flags))
+                r = self.sys_recvmsg(
+                    ctx, (a[0], mm, flags & ~self.MSG_WAITFORONE))
             except Blocked as b:
-                if got == 0:
-                    if st["deadline"] is not None and \
-                            ctx.now >= st["deadline"]:
-                        return -EAGAIN
+                if got > 0 and (flags & self.MSG_WAITFORONE or expired):
+                    break
+                if got > 0 and st["deadline"] is None:
+                    # no timeout: keep blocking for the next message
+                    st["mm_got"] = got
+                    raise Blocked(b.descs)
+                if got > 0:
+                    st["mm_got"] = got
                     raise Blocked(b.descs, deadline=st["deadline"])
-                break
+                # first message: wait with no deadline even when the
+                # timeout already expired (kernel quirk — the timeout
+                # is only consulted after a datagram; a blocking empty
+                # socket waits regardless, nonblocking ones surfaced
+                # -EAGAIN from recvmsg above)
+                st["mm_got"] = 0
+                raise Blocked(b.descs)
             if isinstance(r, int) and r < 0:
                 return r if got == 0 else got
             self.mem.write(mm + 56, struct.pack("<I", r))
             got += 1
-            if got < vlen and not self._more_readable(fd):
-                break
+            if st["deadline"] is not None and ctx.now >= st["deadline"]:
+                break           # timeout checked after each datagram
         return got
-
-    def _more_readable(self, fd: int) -> bool:
-        d = self._desc(fd)
-        return d is not None and bool(d.status() & R)
 
     # ==================================================================
     # scheduling / identity odds and ends (unistd.c, sysinfo.c)
@@ -1384,18 +1418,18 @@ class SyscallHandler:
                 # NULL offset: stream from the fd's current position.
                 # Snapshot it ONCE — on a Blocked restart the plugin's
                 # own fd offset is unchanged (the syscall was
-                # suppressed), so progress lives in sf_sent. The fd
-                # position is left where it was: supported scope is the
-                # send-whole-file-then-close pattern (the reference has
-                # no sendfile at all, syscall_handler.c:434).
+                # suppressed), so progress lives in sf_sent; the
+                # plugin's real fd position is advanced at finish via
+                # pidfd_getfd+lseek (shared file description).
                 st["sf_off"] = None
                 st["sf_base"] = self._native_file_offset(in_fd) or 0
         space = out.send_space()
         if space <= 0:
             if out.nonblock:
-                return self._sendfile_finish(ctx, off_ptr) \
+                return self._sendfile_finish(ctx, off_ptr, in_fd) \
                     if st["sf_sent"] else -EAGAIN
             raise Blocked([out])
+        want = min(count - st["sf_sent"], space)
         try:
             with open(f"/proc/{self.p.native_pid}/fd/{in_fd}",
                       "rb") as f:
@@ -1404,28 +1438,59 @@ class SyscallHandler:
                 f.seek(base + st["sf_sent"])
                 # read only what this pass can push: a blocked 100 MB
                 # transfer must not re-read the whole tail every wake
-                data = f.read(min(count - st["sf_sent"], space))
+                data = f.read(want)
         except OSError:
             return -EBADF
         if not data:
-            return self._sendfile_finish(ctx, off_ptr)
-        take = min(len(data), space)
-        self.table.send_channel(out.sock).push(data[:take])
-        out.sock.send(ctx.now, take)
-        st["sf_sent"] += take
-        if st["sf_sent"] >= count or take == len(data):
-            return self._sendfile_finish(ctx, off_ptr)
+            return self._sendfile_finish(ctx, off_ptr, in_fd)
+        self.table.send_channel(out.sock).push(data)
+        out.sock.send(ctx.now, len(data))
+        st["sf_sent"] += len(data)
+        if st["sf_sent"] >= count or len(data) < want:   # done or EOF
+            return self._sendfile_finish(ctx, off_ptr, in_fd)
         if out.nonblock:
-            return self._sendfile_finish(ctx, off_ptr)
-        raise Blocked([out])
+            return self._sendfile_finish(ctx, off_ptr, in_fd)
+        raise Blocked([out])        # blocking: push the rest next wake
 
-    def _sendfile_finish(self, ctx, off_ptr: int):
+    def _sendfile_finish(self, ctx, off_ptr: int, in_fd: int):
         st = self.state
         sent = st["sf_sent"]
         if off_ptr and st["sf_off"] is not None:
             self.mem.write(off_ptr,
                            struct.pack("<q", st["sf_off"] + sent))
+        elif sent and st["sf_off"] is None:
+            # NULL offset: the plugin's own fd position must advance by
+            # `sent`. /proc/pid/fd opens a NEW description, so seek the
+            # plugin's actual one via pidfd_getfd (shares the offset).
+            self._advance_plugin_fd(in_fd, st["sf_base"] + sent)
         return sent
+
+    _warned_pidfd = False
+
+    def _advance_plugin_fd(self, in_fd: int, new_pos: int) -> None:
+        libc = _libc()
+        pidfd = libc.syscall(434, self.p.native_pid, 0)  # pidfd_open
+        dup = -1
+        if pidfd >= 0:
+            dup = libc.syscall(438, pidfd, in_fd, 0)     # pidfd_getfd
+        if dup < 0:
+            if not SyscallHandler._warned_pidfd:
+                SyscallHandler._warned_pidfd = True
+                log.warning(
+                    "pidfd_getfd unavailable (kernel < 5.6 or no "
+                    "ptrace permission): NULL-offset sendfile cannot "
+                    "advance the plugin's fd position; repeated reads "
+                    "of the same fd will see a stale offset")
+            if pidfd >= 0:
+                os.close(pidfd)
+            return
+        try:
+            os.lseek(dup, new_pos, os.SEEK_SET)
+        except OSError:
+            pass
+        finally:
+            os.close(dup)
+            os.close(pidfd)
 
     def _native_file_offset(self, in_fd: int):
         try:

@@ -280,6 +280,12 @@ class TcpSocket(BaseSocket):
         self.dup_acks = 0
         self.in_recovery = False
         self.rto = min(self.rto * 2, MAX_RTO_NS)
+        # RFC 2018 §8 renege safety: after an RTO the sender must
+        # discard SACK state and retransmit from the cumulative ACK
+        # point — otherwise a fully-SACKed-but-reneged flight leaves
+        # _retransmit_first with no candidate and progress stalls
+        # until the peer volunteers a new cumulative ACK.
+        self.tally.sacked.clear()
         self._retransmit_first(now)
         self._arm_rto(now)
 

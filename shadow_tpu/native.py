@@ -89,6 +89,8 @@ def load(build_if_missing: bool = True) -> ctypes.CDLL:
     lib.shadowtpu_ipc_recv_from_simulator.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(IpcMessage)]
     lib.shadowtpu_ipc_mark_plugin_exited.argtypes = [ctypes.c_void_p]
+    lib.shadowtpu_ipc_native_thread_alive.restype = ctypes.c_uint32
+    lib.shadowtpu_ipc_native_thread_alive.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -183,6 +185,11 @@ class IpcChannel:
 
     def mark_plugin_exited(self) -> None:
         self._lib.shadowtpu_ipc_mark_plugin_exited(self.ptr)
+
+    def native_thread_alive(self) -> bool:
+        """True while the cloned native thread behind this channel is
+        alive (kernel-cleared CLEARTID guard; see spinsem.hpp)."""
+        return bool(self._lib.shadowtpu_ipc_native_thread_alive(self.ptr))
 
 
 def cleanup_orphans(prefix: str = "shadowtpu_shm_") -> int:
