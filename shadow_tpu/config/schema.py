@@ -32,7 +32,9 @@ SCHEDULER_POLICIES = (
     "threadXthread",  # thread_perthread
     "threadXhost",   # thread_perhost
     "serial",        # single-threaded reference oracle (new)
-    "tpu",           # JAX device engine (new)
+    "tpu",           # JAX device engine; falls back to hybrid when the
+                     # apps have no vectorized twin (new)
+    "hybrid",        # CPU host emulation + device network judgment (new)
 )
 
 INTERPOSE_METHODS = ("preload", "ptrace", "model")
@@ -229,8 +231,12 @@ class ExperimentalOptions:
     event_capacity: int = 64        # device event slots per host
     outbox_capacity: int = 32       # device packet sends per host per round
     exchange: str = "all_gather"    # all_gather | all_to_all
+    exchange_capacity: int = 0      # per shard-pair rows; 0 = auto-size
     mesh_axis: str = "hosts"
     device_batch_rounds: int = 64   # rounds fused into one device while_loop
+    # hybrid mode: which CPU policy drives host emulation while the
+    # network model runs on device
+    hybrid_cpu_policy: str = "serial"
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -260,6 +266,10 @@ class ExperimentalOptions:
                       out.router_queue, ("codel", "single", "static"))
         _check_choice("experimental", "exchange",
                       out.exchange, ("all_gather", "all_to_all"))
+        _check_choice("experimental", "hybrid_cpu_policy",
+                      out.hybrid_cpu_policy,
+                      [p for p in SCHEDULER_POLICIES
+                       if p not in ("tpu", "hybrid")])
         return out
 
 

@@ -169,29 +169,45 @@ class Controller:
         self.cfg = cfg
         self.sim = build(cfg)
         policy_name = cfg.experimental.scheduler_policy
+        self.runner = None
+        self.manager = None
+        net_judge = None
         if policy_name == "tpu":
-            from shadow_tpu.device.runner import DeviceRunner
-            self.runner = DeviceRunner(self.sim, trace=trace)
-            self.manager = None
-        else:
-            self.runner = None
-            from shadow_tpu.core.manager import NetOptions
-            self.manager = Manager(
-                hosts=self.sim.hosts,
-                policy=make_policy(policy_name,
-                                   cfg.general.parallelism),
-                netmodel=self.sim.netmodel,
-                seed=cfg.general.seed,
-                trace=trace,
-                groups=self.sim.groups,
-                net_opts=NetOptions(
-                    qdisc=cfg.experimental.interface_qdisc,
-                    router_queue=cfg.experimental.router_queue,
-                    router_static_capacity=cfg.experimental
-                    .router_static_capacity,
-                    bootstrap_end=cfg.general.bootstrap_end_time,
-                ),
-            )
+            from shadow_tpu.device.runner import DeviceRunner, NoDeviceTwin
+            try:
+                self.runner = DeviceRunner(self.sim, trace=trace)
+                return
+            except NoDeviceTwin as e:
+                log.info("tpu policy -> hybrid: %s", e)
+                policy_name = "hybrid"
+        if policy_name == "hybrid":
+            # CPU host emulation + batched device network judgment
+            # (worker.c:520-579's hot path on the accelerator)
+            from shadow_tpu.device.judge import DeviceJudge
+            net_judge = DeviceJudge(
+                self.sim.topology,
+                self.sim.netmodel.host_vertex,
+                cfg.general.seed,
+                bootstrap_end=cfg.general.bootstrap_end_time)
+            policy_name = cfg.experimental.hybrid_cpu_policy
+        from shadow_tpu.core.manager import NetOptions
+        self.manager = Manager(
+            hosts=self.sim.hosts,
+            policy=make_policy(policy_name,
+                               cfg.general.parallelism),
+            netmodel=self.sim.netmodel,
+            seed=cfg.general.seed,
+            trace=trace,
+            groups=self.sim.groups,
+            net_judge=net_judge,
+            net_opts=NetOptions(
+                qdisc=cfg.experimental.interface_qdisc,
+                router_queue=cfg.experimental.router_queue,
+                router_static_capacity=cfg.experimental
+                .router_static_capacity,
+                bootstrap_end=cfg.general.bootstrap_end_time,
+            ),
+        )
 
     def run(self) -> SimStats:
         cfg = self.cfg

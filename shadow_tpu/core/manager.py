@@ -102,6 +102,10 @@ class Manager:
     on_event_hook: Optional[Callable] = None
     net_opts: NetOptions = field(default_factory=NetOptions)
     groups: Optional[dict] = None   # group name -> [host ids]
+    # hybrid mode: when set, packet judgments (drop roll + latency) are
+    # deferred per round and computed on the device in one batch
+    # (device/judge.py); None = judge synchronously on CPU
+    net_judge: Optional[object] = None
 
     def __post_init__(self):
         from shadow_tpu.host.netstack import HostNetStack
@@ -114,6 +118,10 @@ class Manager:
         self._barrier = simtime.SIMTIME_INVALID
         self._trace_lock = threading.Lock()
         self._worker_stats: list[SimStats] = []
+        # egress packets awaiting the batched device judgment:
+        # (now, src_host, dst_host, pkt_seq, ev_seq, kind, data)
+        self._pending: list[tuple] = []
+        self._pending_lock = threading.Lock()
         self._ctx = SimContext(self, self.stats)
         no = self.net_opts
         for h in self.hosts:
@@ -164,15 +172,92 @@ class Manager:
                                       seq=h.next_event_seq(),
                                       kind=KIND_STOP))
 
+    def _apply_verdict(self, rec: tuple, delivered: bool,
+                       deliver_time: int) -> None:
+        """Single place where a judged packet becomes stats + an event
+        (or a drop) — used by both the synchronous fallback and the
+        batched device path, so their bookkeeping cannot diverge."""
+        from shadow_tpu.routing.packet import PacketStatus
+
+        _, src_h, dst_h, _, ev_seq, kind, data = rec
+        host = self.hosts[src_h]
+        host.packets_sent += 1
+        pkt = data[0] if kind == KIND_ROUTER_ARRIVAL else None
+        if not delivered:
+            host.packets_dropped += 1
+            if pkt is not None:
+                pkt.add_status(PacketStatus.INET_DROPPED)
+            return
+        if pkt is not None:
+            pkt.add_status(PacketStatus.INET_SENT)
+        self.push_event(Event(time=int(deliver_time), dst_host=dst_h,
+                              src_host=src_h, seq=ev_seq, kind=kind,
+                              data=data))
+
+    def defer_judgment(self, now: int, host, dst_host: int, pkt_seq: int,
+                       ev_seq: int, kind: int, data: tuple) -> None:
+        """Hybrid mode: queue one egress packet for the end-of-round
+        device batch. The event seq was already consumed by the caller
+        so later seq allocations are unaffected by the deferral.
+
+        Self-destined packets are judged synchronously instead: they
+        are exempt from the causality bump (SchedulerPolicy
+        .apply_barrier), so one below the barrier must enter the queue
+        NOW to run this round in per-host time order (possible when a
+        runahead override exceeds the self-path latency). The verdict
+        is a pure function of (seed, src, pkt_seq) either way, so sync
+        and batched rolls agree bit-for-bit."""
+        rec = (now, host.host_id, dst_host, pkt_seq, ev_seq, kind, data)
+        if dst_host == host.host_id:
+            v = self.netmodel.judge(now, host.host_id, dst_host, pkt_seq)
+            self._apply_verdict(rec, v.delivered, v.deliver_time)
+            return
+        with self._pending_lock:
+            self._pending.append(rec)
+
+    def flush_judgments(self) -> None:
+        """Judge every pending cross-host packet in one device batch
+        and push the delivery events. Verdicts are bit-identical to the
+        synchronous CPU path (same threefry chain, same latency
+        matrices), so hybrid traces equal pure-CPU traces."""
+        from collections import Counter
+
+        import numpy as np
+
+        with self._pending_lock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return
+        now = np.fromiter((p[0] for p in pending), np.int64, len(pending))
+        src = np.fromiter((p[1] for p in pending), np.int32, len(pending))
+        dst = np.fromiter((p[2] for p in pending), np.int32, len(pending))
+        seq = np.fromiter((p[3] for p in pending), np.int32, len(pending))
+        delivered, deliver_time = self.net_judge.judge_batch(
+            now, src, dst, seq)
+        nm = self.netmodel
+        nm.record_paths(Counter(
+            (int(nm.host_vertex[r[1]]), int(nm.host_vertex[r[2]]))
+            for r in pending))
+        for i, rec in enumerate(pending):
+            self._apply_verdict(rec, bool(delivered[i]), deliver_time[i])
+
     def run_window(self, window_start: int, window_end: int) -> int:
         """Execute all events in [window_start, window_end); return the
-        earliest remaining event time (scheduler_awaitNextRound)."""
+        earliest remaining event time (scheduler_awaitNextRound).
+
+        In hybrid mode the round's cross-host egress packets are judged
+        in one device batch after the drain; every verdict lands at or
+        after the barrier (cross-host events get the causality bump,
+        self-destined ones were judged synchronously), so one flush per
+        round suffices."""
         self._barrier = window_end
         if hasattr(self.policy, "run_parallel"):
             self.policy.run_parallel(self, window_end)
         else:
             while (ev := self.policy.pop(window_end)) is not None:
                 self.execute_event(ev, self._ctx, self.stats)
+        if self.net_judge is not None:
+            self.flush_judgments()
         self.stats.rounds += 1
         return self.policy.next_event_time()
 

@@ -53,6 +53,13 @@ class NetworkModel:
     def min_latency_ns(self) -> int:
         return self.topology.min_latency_ns
 
+    def record_paths(self, counts: dict) -> None:
+        """Merge a batch of per-(src_vertex, dst_vertex) packet counts
+        (one lock take per batch; the hybrid flush path)."""
+        with self._lock:
+            for key, n in counts.items():
+                self.path_packets[key] = self.path_packets.get(key, 0) + n
+
     def judge(self, now: int, src_host: int, dst_host: int,
               pkt_seq: int) -> PacketVerdict:
         sv = int(self.host_vertex[src_host])

@@ -78,9 +78,21 @@ class SimContext:
     def send(self, dst_host: int, size: int, data: tuple = ()) -> bool:
         """Send a packet through the network model. Returns False if the
         drop roll discarded it (the caller — like a real app — cannot
-        observe this directly; returned for stats/tests only)."""
+        observe this directly; returned for stats/tests only). In
+        hybrid mode cross-host judgments are deferred to the round's
+        device batch, so the verdict is not yet known and True is
+        returned unconditionally — apps must not branch on it."""
         host = self.host
         pkt_seq = host.next_packet_seq()
+        # the event seq is consumed for every send, delivered or not, so
+        # the network judgment can be deferred (batched to the device in
+        # hybrid mode) without perturbing any later seq allocation
+        ev_seq = host.next_event_seq()
+        if self._m.net_judge is not None:
+            self._m.defer_judgment(self.now, host, dst_host, pkt_seq,
+                                   ev_seq, KIND_PACKET,
+                                   (size,) + tuple(data))
+            return True
         verdict = self._m.netmodel.judge(self.now, host.host_id, dst_host,
                                          pkt_seq)
         # per-host counters are the single source of truth for packet
@@ -90,7 +102,7 @@ class SimContext:
             host.packets_dropped += 1
             return False
         ev = Event(time=verdict.deliver_time, dst_host=dst_host,
-                   src_host=host.host_id, seq=host.next_event_seq(),
+                   src_host=host.host_id, seq=ev_seq,
                    kind=KIND_PACKET, data=(size,) + tuple(data))
         self._m.push_event(ev)
         return True

@@ -277,9 +277,11 @@ class DeviceEngine:
             state["n_drop"] = state["n_drop"] + \
                 dropped.sum(-1).astype(jnp.int32)
 
-            drank = jnp.cumsum(delivered, axis=-1) - delivered
-            ev_seq = state["event_seq"][:, None] + drank
-            n_del = delivered.sum(-1).astype(jnp.int32)
+            # event seq consumed per SEND (delivered or dropped alike),
+            # matching the CPU engines — lets the CPU side defer drop
+            # judgment to a batched device call without perturbing seqs
+            ev_seq = state["event_seq"][:, None] + vrank
+            n_snt = send_valid.sum(-1).astype(jnp.int32)
 
             deliver_t = pt[:, None] + latv
             cross = dst != gid[:, None]
@@ -323,8 +325,8 @@ class DeviceEngine:
             to_self = delivered & ~cross
             timer_valid = out.timer_valid & runnable[:, None]   # [H,T]
             trank = jnp.cumsum(timer_valid, axis=-1) - timer_valid
-            tseq = state["event_seq"][:, None] + n_del[:, None] + trank
-            state["event_seq"] = state["event_seq"] + n_del + \
+            tseq = state["event_seq"][:, None] + n_snt[:, None] + trank
+            state["event_seq"] = state["event_seq"] + n_snt + \
                 timer_valid.sum(-1).astype(jnp.int32)
 
             ins_valid = jnp.concatenate([to_self, timer_valid], axis=1)

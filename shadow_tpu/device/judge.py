@@ -1,0 +1,95 @@
+"""Batched device network judgment for hybrid execution.
+
+Hybrid mode keeps host emulation (syscall interposition, TCP/UDP
+stacks, NIC token buckets) on the CPU and lifts the inter-host network
+model — the hot path of the reference's worker_sendPacket
+(src/main/core/worker.c:520-579: reliability lookup -> drop roll ->
+latency lookup) — onto the device as one batched call per scheduling
+round. The CPU drains egress packet metadata (now, src, dst, pkt_seq)
+into arrays, the device gathers latency/reliability from the topology
+matrices and rolls counter-RNG drops for the whole batch at once, and
+the verdicts come back as (delivered, deliver_time) for the CPU to
+schedule delivery events.
+
+Determinism: the drop roll is the identical threefry chain used by the
+CPU NetworkModel (utils/nprng.py) and the full device engine
+(device/engine.py), keyed by stable (src_host, pkt_seq) — so a hybrid
+run's event trace is bit-identical to a pure-CPU run of the same
+config.
+
+Batches are padded to power-of-two buckets so XLA compiles a handful of
+shapes, not one per round.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shadow_tpu._jax import jax, jnp
+from shadow_tpu.device import prng
+from shadow_tpu.utils.rng import PURPOSE_PACKET_DROP
+
+_MIN_BUCKET = 256
+
+
+def _bucket(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+class DeviceJudge:
+    """Holds the topology matrices on device and a jitted batch-judge."""
+
+    def __init__(self, topology, host_vertex: np.ndarray, seed: int,
+                 bootstrap_end: int = 0):
+        if (topology.latency_ns > np.iinfo(np.int64).max // 2).any():
+            raise ValueError("latency overflow")
+        self._hv = jnp.asarray(host_vertex.astype(np.int32))
+        self._lat = jnp.asarray(topology.latency_ns.astype(np.int64))
+        self._rel = jnp.asarray(topology.reliability.astype(np.float32))
+        self._seed_pair = prng.seed_key(seed)
+        boot_end = np.int64(bootstrap_end)
+        seed_pair = self._seed_pair
+
+        def _judge(now, src, dst, pseq, hv, lat, rel):
+            sv = hv[src]
+            dv = hv[dst]
+            latency = lat[sv, dv]
+            reliability = rel[sv, dv]
+            u = prng.uniform01(prng.chain_key(
+                seed_pair, PURPOSE_PACKET_DROP, src, pseq))
+            lossy = reliability < 1.0
+            not_boot = now >= boot_end
+            dropped = lossy & not_boot & (u >= reliability)
+            return ~dropped, now + latency
+
+        self._judge = jax.jit(_judge)
+        # rounds-trip counters for observability (perf-timer analogue)
+        self.batches = 0
+        self.packets = 0
+
+    def judge_batch(self, now: np.ndarray, src: np.ndarray,
+                    dst: np.ndarray, pkt_seq: np.ndarray
+                    ) -> tuple[np.ndarray, np.ndarray]:
+        """All arrays shape [N] -> (delivered bool[N], deliver_time
+        i64[N]). One device dispatch per power-of-two bucket size."""
+        n = len(now)
+        b = _bucket(n)
+        pad = b - n
+
+        def p(a, dtype):
+            a = np.asarray(a, dtype=dtype)
+            return np.pad(a, (0, pad)) if pad else a
+
+        delivered, deliver_time = self._judge(
+            jnp.asarray(p(now, np.int64)), jnp.asarray(p(src, np.int32)),
+            jnp.asarray(p(dst, np.int32)),
+            jnp.asarray(p(pkt_seq, np.int32)),
+            self._hv, self._lat, self._rel)
+        delivered = np.asarray(delivered)[:n]
+        deliver_time = np.asarray(deliver_time)[:n]
+        self.batches += 1
+        self.packets += n
+        return delivered, deliver_time

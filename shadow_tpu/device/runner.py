@@ -30,6 +30,12 @@ from shadow_tpu.utils.slog import get_logger
 log = get_logger("device")
 
 
+class NoDeviceTwin(ValueError):
+    """The config's apps have no fully-vectorized device twin; the tpu
+    policy falls back to hybrid execution (CPU host emulation + device
+    network judgment, core/manager.py flush_judgments)."""
+
+
 def device_twin(sim) -> DeviceApp:
     """Map the config's CPU model apps to their vectorized device twin.
     Supported: homogeneous phold; tgen server/client mixes (homogeneous
@@ -38,7 +44,7 @@ def device_twin(sim) -> DeviceApp:
     n_hosts = len(sim.hosts)
     real = [a for a in apps if a is not None]
     if not real:
-        raise ValueError("tpu policy: no model apps configured")
+        raise NoDeviceTwin("tpu policy: no model apps configured")
     classes = {type(a) for a in real}
 
     if classes == {PholdApp}:
@@ -84,12 +90,14 @@ def device_twin(sim) -> DeviceApp:
                           retry_ns=first.retry_ns)
 
     names = sorted(c.__name__ for c in classes)
-    raise ValueError(f"no device twin registered for {names}; "
-                     "available: phold, tgen (server+client)")
+    raise NoDeviceTwin(f"no device twin registered for {names}; "
+                       "available: phold, tgen (server+client) — "
+                       "running hybrid (CPU hosts + device net model)")
 
 
 class DeviceRunner:
     def __init__(self, sim, trace: Optional[list] = None, mesh=None):
+        self.app = device_twin(sim)     # raises NoDeviceTwin -> hybrid
         if trace is not None:
             raise ValueError(
                 "the tpu policy does not record python event traces; "
@@ -105,7 +113,6 @@ class DeviceRunner:
             log.warning("tpu policy: pcap capture requires a CPU "
                         "scheduler policy (packets are device-resident "
                         "metadata here)")
-        self.app = device_twin(sim)
         # flow control blocks a host's pops when the outbox lacks a
         # full-burst (max_sends) of headroom; at OB == K that means one
         # event per phase, paying one collective exchange per event.

@@ -127,6 +127,15 @@ class HostNetStack:
         if self.pcap is not None:
             self.pcap.write(now, packet, self._ip_of(host.host_id),
                             self._ip_of(packet.dst_host))
+        # seq consumed per send (delivered or not) so the judgment can
+        # be deferred to the batched device path without changing any
+        # later seq allocation on this host
+        ev_seq = host.next_event_seq()
+        if self._m.net_judge is not None:
+            self._m.defer_judgment(now, host, packet.dst_host,
+                                   packet.packet_id, ev_seq,
+                                   KIND_ROUTER_ARRIVAL, (packet,))
+            return
         verdict = self._m.netmodel.judge(now, host.host_id,
                                          packet.dst_host,
                                          packet.packet_id)
@@ -137,7 +146,7 @@ class HostNetStack:
             return
         packet.add_status(PacketStatus.INET_SENT)
         ev = Event(time=verdict.deliver_time, dst_host=packet.dst_host,
-                   src_host=host.host_id, seq=host.next_event_seq(),
+                   src_host=host.host_id, seq=ev_seq,
                    kind=KIND_ROUTER_ARRIVAL, data=(packet,))
         self._m.push_event(ev)
 
