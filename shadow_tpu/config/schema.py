@@ -230,7 +230,7 @@ class ExperimentalOptions:
     # --- TPU engine knobs (new; absent from the reference) ---
     event_capacity: int = 64        # device event slots per host
     outbox_capacity: int = 32       # device packet sends per host per round
-    exchange: str = "all_gather"    # all_gather | all_to_all
+    exchange: str = "all_to_all"    # all_to_all | all_gather
     exchange_capacity: int = 0      # per shard-pair rows; 0 = auto-size
     mesh_axis: str = "hosts"
     device_batch_rounds: int = 64   # rounds fused into one device while_loop
@@ -270,6 +270,14 @@ class ExperimentalOptions:
                       out.hybrid_cpu_policy,
                       [p for p in SCHEDULER_POLICIES
                        if p not in ("tpu", "hybrid")])
+        for name, minimum in (("event_capacity", 1),
+                              ("outbox_capacity", 1),
+                              ("exchange_capacity", 0),
+                              ("device_batch_rounds", 1),
+                              ("preload_spin_max", 0)):
+            if getattr(out, name) < minimum:
+                raise ValueError(
+                    f"experimental.{name} must be >= {minimum}")
         return out
 
 

@@ -287,6 +287,12 @@ class Manager:
 
         def make_task(host):
             def task(ctx, ev):
+                # hybrid: settle this round's pending drop verdicts so
+                # the CSV counters match the pure-CPU oracle's interval
+                # attribution (drop rolls are pure functions of
+                # (seed, src, pkt_seq) — flushing mid-round is safe)
+                if self.net_judge is not None:
+                    self.flush_judgments()
                 host.tracker.heartbeat(ev.time, host)
                 nxt = ev.time + interval
                 if nxt < stop:

@@ -48,7 +48,8 @@ from shadow_tpu.core.event import (
 )
 from shadow_tpu.device import prng
 from shadow_tpu.device.apps import DeviceApp
-from shadow_tpu.utils.rng import PURPOSE_APP, PURPOSE_PACKET_DROP
+from shadow_tpu.device.netsem import packet_drop_mask
+from shadow_tpu.utils.rng import PURPOSE_APP
 
 from shadow_tpu.utils.checksum import (
     CHK_KIND,
@@ -76,6 +77,16 @@ class EngineConfig:
     bootstrap_end: int = 0
     seed: int = 1
     max_rounds: int = 1 << 62    # safety valve
+    # cross-shard packet exchange: "all_to_all" moves only each
+    # (src shard, dst shard) pair's rows over ICI (two-phase: sort by
+    # destination shard, then lax.all_to_all on [n_shards, CAP]
+    # buffers); "all_gather" replicates every shard's whole outbox
+    # (simple, bandwidth ∝ H_pad*OB per device)
+    exchange: str = "all_to_all"
+    # per (src shard, dst shard) row capacity; 0 = auto-size from the
+    # outbox volume with 4x headroom for skewed traffic. Overflow is
+    # counted per source host and fails the run, never silently lost.
+    exchange_capacity: int = 0
 
 
 class DeviceEngine:
@@ -266,11 +277,9 @@ class DeviceEngine:
             dstv = host_vertex[jnp.clip(dst, 0, H_pad - 1)]
             latv = lat[srcv, dstv].astype(jnp.int64)             # [H,K]
             relv = rel[srcv, dstv]
-            u = prng.uniform01(prng.chain_key(
-                seed_pair, PURPOSE_PACKET_DROP, gid[:, None], pkt_seq))
-            lossy = relv < 1.0
-            not_boot = (pt >= BOOT_END)[:, None]
-            dropped = send_valid & lossy & not_boot & (u >= relv)
+            dropped = send_valid & packet_drop_mask(
+                seed_pair, BOOT_END, pt[:, None], gid[:, None],
+                pkt_seq, relv)
             delivered = send_valid & ~dropped
             state["n_sent"] = state["n_sent"] + \
                 send_valid.sum(-1).astype(jnp.int32)
@@ -377,30 +386,99 @@ class DeviceEngine:
             return state, ob, ob_cnt, runnable.any()
 
         # ---------------- end-of-round exchange + merge ----------------
-        def _exchange(state, ob, my_shard):
+        # Two exchange strategies produce the same multiset of rows in
+        # the same deterministic arrival order — keyed by
+        # (dst_local, okey) where okey = src_gid*OB + outbox slot:
+        #
+        # all_gather: every shard replicates its whole outbox
+        # (bandwidth ∝ H_pad*OB rows per device, (n-1)/n discarded).
+        #
+        # all_to_all (default): two-phase — sort the local outbox by
+        # destination shard, pack each shard's rows into a
+        # [n_shards, CAP] buffer, and lax.all_to_all it so each pair
+        # of shards exchanges only its own rows (bandwidth ∝ traffic).
+        # CAP is derived from the outbox volume (4x headroom for skew);
+        # rows beyond CAP are counted per source host in `overflow`
+        # and fail the run — never silently lost (SURVEY hard-part #2).
+        R = H_loc * OB
+        SPAN = H_pad * OB              # exclusive upper bound on okey
+        if cfg.exchange == "all_to_all":
+            CAP = cfg.exchange_capacity or \
+                min(R, max(64, (4 * R + n_shards - 1) // n_shards))
+        else:
+            CAP = 0
+        XFIELDS = ("t", "dst", "src", "seq", "size", "d0", "d1")
+
+        def _rows_all_gather(state, ob):
             G = H_pad * OB
+            rows = {f: lax.all_gather(ob[f], AXIS).reshape(G)
+                    for f in XFIELDS}
+            # gather order is gid-major: row index == src_gid*OB + slot
+            return state, rows, jnp.arange(G, dtype=jnp.int64)
 
-            def gat(x):
-                return lax.all_gather(x, AXIS).reshape(G)
+        def _rows_all_to_all(state, ob, my_shard):
+            slot = jnp.broadcast_to(
+                jnp.arange(OB, dtype=jnp.int64)[None, :], (H_loc, OB))
+            flat = {f: ob[f].reshape(R) for f in XFIELDS}
+            flat["okey"] = (ob["src"].astype(jnp.int64) * OB
+                            + slot).reshape(R)
+            valid = flat["t"] < INF
+            ds = jnp.where(valid, flat["dst"] // H_loc, n_shards)
+            perm = jnp.argsort(ds.astype(jnp.int64) * SPAN
+                               + jnp.where(valid, flat["okey"], 0))
+            sds = ds[perm]
+            idx = jnp.arange(R, dtype=jnp.int64)
+            is_new = jnp.concatenate([jnp.array([True]),
+                                      sds[1:] != sds[:-1]])
+            seg_start = lax.associative_scan(
+                jnp.maximum, jnp.where(is_new, idx, 0))
+            rank = idx - seg_start
+            ok = (sds < n_shards) & (rank < CAP)
+            lost = (sds < n_shards) & (rank >= CAP)
+            # overflow attributed to the SENDING host (it owns sizing)
+            src_loc = (flat["okey"][perm] // OB).astype(jnp.int32) \
+                - my_shard * H_loc
+            state["overflow"] = state["overflow"] + \
+                jnp.zeros((H_loc,), jnp.int32).at[
+                    jnp.where(lost, src_loc, H_loc)].add(1, mode="drop")
 
-            gt = gat(ob["t"])
-            gdst = gat(ob["dst"])
-            gsrc = gat(ob["src"])
-            gseq = gat(ob["seq"])
-            gkindsize = gat(ob["size"])
-            gd0 = gat(ob["d0"])
-            gd1 = gat(ob["d1"])
+            row = jnp.where(ok, sds, n_shards)   # n_shards = drop row
+            col = jnp.where(ok, rank, 0).astype(jnp.int32)
 
+            def pack(f, fillv, dtype):
+                base = jnp.full((n_shards, CAP), fillv, dtype)
+                return base.at[row, col].set(
+                    flat[f][perm].astype(dtype), mode="drop")
+
+            send = {"t": pack("t", INF, jnp.int64),
+                    "okey": pack("okey", 0, jnp.int64)}
+            for f in ("dst", "src", "seq", "size", "d0", "d1"):
+                send[f] = pack(f, 0, jnp.int32)
+            rows = {f: lax.all_to_all(v, AXIS, split_axis=0,
+                                      concat_axis=0)
+                    .reshape(n_shards * CAP)
+                    for f, v in send.items()}
+            return state, rows, rows.pop("okey")
+
+        def _exchange(state, ob, my_shard):
+            if cfg.exchange == "all_to_all":
+                state, rows, okey = _rows_all_to_all(state, ob, my_shard)
+                G = n_shards * CAP
+            else:
+                state, rows, okey = _rows_all_gather(state, ob)
+                G = H_pad * OB
+
+            gt = rows["t"]
+            gdst = rows["dst"]
             valid = gt < INF
             dshard = gdst // H_loc
             mine = valid & (dshard == my_shard)
             dloc = gdst % H_loc
 
             # deterministic arrival order: (dst, src_gid*OB + slot) —
-            # independent of mesh shape because gather order is gid-major
-            order = jnp.arange(G, dtype=jnp.int64)
+            # independent of mesh shape AND exchange strategy
             skey = jnp.where(mine,
-                             dloc.astype(jnp.int64) * G + order, IMAX)
+                             dloc.astype(jnp.int64) * SPAN + okey, IMAX)
             perm = jnp.argsort(skey)
             sdloc = dloc[perm]
             smine = mine[perm]
@@ -422,21 +500,21 @@ class DeviceEngine:
             row = jnp.where(keep, sdloc, H_loc)       # H_loc = drop row
             col = jnp.where(keep, rank, 0).astype(jnp.int32)
 
-            def scatter_in(gathered, fill, dtype):
+            def scatter_in(f, fill, dtype):
                 base = jnp.full((H_loc, IN), fill, dtype)
                 return base.at[row, col].set(
-                    gathered[perm].astype(dtype), mode="drop")
+                    rows[f][perm].astype(dtype), mode="drop")
 
-            inc_t = scatter_in(gt, INF, jnp.int64)
+            inc_t = scatter_in("t", INF, jnp.int64)
             inc = {
                 "t": inc_t,
-                "src": scatter_in(gsrc, 0, jnp.int32),
-                "seq": scatter_in(gseq, 0, jnp.int32),
+                "src": scatter_in("src", 0, jnp.int32),
+                "seq": scatter_in("seq", 0, jnp.int32),
                 "kind": jnp.where(inc_t < INF, jnp.int32(KIND_PACKET),
                                   jnp.int32(0)),
-                "size": scatter_in(gkindsize, 0, jnp.int32),
-                "d0": scatter_in(gd0, 0, jnp.int32),
-                "d1": scatter_in(gd1, 0, jnp.int32),
+                "size": scatter_in("size", 0, jnp.int32),
+                "d0": scatter_in("d0", 0, jnp.int32),
+                "d1": scatter_in("d1", 0, jnp.int32),
             }
 
             # merge: lexicographic sort of [heap | incoming] rows by

@@ -27,7 +27,7 @@ import numpy as np
 
 from shadow_tpu._jax import jax, jnp
 from shadow_tpu.device import prng
-from shadow_tpu.utils.rng import PURPOSE_PACKET_DROP
+from shadow_tpu.device.netsem import packet_drop_mask
 
 _MIN_BUCKET = 256
 
@@ -56,14 +56,9 @@ class DeviceJudge:
         def _judge(now, src, dst, pseq, hv, lat, rel):
             sv = hv[src]
             dv = hv[dst]
-            latency = lat[sv, dv]
-            reliability = rel[sv, dv]
-            u = prng.uniform01(prng.chain_key(
-                seed_pair, PURPOSE_PACKET_DROP, src, pseq))
-            lossy = reliability < 1.0
-            not_boot = now >= boot_end
-            dropped = lossy & not_boot & (u >= reliability)
-            return ~dropped, now + latency
+            dropped = packet_drop_mask(seed_pair, boot_end, now, src,
+                                       pseq, rel[sv, dv])
+            return ~dropped, now + lat[sv, dv]
 
         self._judge = jax.jit(_judge)
         # rounds-trip counters for observability (perf-timer analogue)

@@ -1,0 +1,31 @@
+"""The one device-side definition of the packet-drop rule.
+
+Semantics of the reference's worker_sendPacket drop roll
+(src/main/core/worker.c:542-548): a packet from `src` with per-source
+sequence `pkt_seq` is dropped iff the path is lossy (reliability < 1),
+the simulation is past the bootstrap phase (the reference never drops
+while bootstrapping so initial connections always form), and the
+counter-RNG roll lands at or above the reliability.
+
+Both device consumers — the full device engine (device/engine.py) and
+the hybrid batch judge (device/judge.py) — call this; the CPU twin is
+NetworkModel.judge (core/netmodel.py). Keep all three in lockstep: the
+trace-equality contract depends on it.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.device import prng
+from shadow_tpu.utils.rng import PURPOSE_PACKET_DROP
+
+
+def packet_drop_mask(seed_pair, boot_end, now, src, pkt_seq,
+                     reliability):
+    """Elementwise drop decision; all args broadcastable arrays.
+    `now` is the send time (i64), `reliability` the gathered per-path
+    value (f32). Returns a bool mask, True = dropped."""
+    u = prng.uniform01(prng.chain_key(
+        seed_pair, PURPOSE_PACKET_DROP, src, pkt_seq))
+    lossy = reliability < 1.0
+    not_boot = now >= boot_end
+    return lossy & not_boot & (u >= reliability)

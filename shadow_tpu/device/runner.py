@@ -132,6 +132,8 @@ class DeviceRunner:
                 stop_time=cfg.general.stop_time,
                 bootstrap_end=cfg.general.bootstrap_end_time,
                 seed=cfg.general.seed,
+                exchange=cfg.experimental.exchange,
+                exchange_capacity=cfg.experimental.exchange_capacity,
             ),
             self.app,
             host_vertex=sim.netmodel.host_vertex.astype(np.int32),

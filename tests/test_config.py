@@ -71,5 +71,5 @@ def test_defaults():
     cfg = load_config_str("general: {stop_time: 1}")
     assert cfg.network.graph_type == "1_gbit_switch"
     assert cfg.experimental.router_queue == "codel"
-    assert cfg.experimental.exchange == "all_gather"
+    assert cfg.experimental.exchange == "all_to_all"
     assert cfg.hosts == []

@@ -142,6 +142,35 @@ hosts:
         c.run()
 
 
+def test_exchange_modes_identical_traces():
+    """all_to_all exchanges only each shard pair's rows; all_gather
+    replicates everything. Same rows, same deterministic arrival order
+    -> bit-identical traces on the 8-device mesh."""
+    yaml = PHOLD_YAML.format(policy="tpu", seed=6, loss=0.05, q=8,
+                             msgload=2)
+    out = {}
+    for mode in ("all_gather", "all_to_all"):
+        c = Controller(load_config_str(
+            yaml.replace("experimental:",
+                         f"experimental:\n  exchange: {mode}")))
+        stats = c.run()
+        assert stats.ok, mode
+        out[mode] = [h.trace_checksum for h in c.sim.hosts]
+    assert out["all_gather"] == out["all_to_all"]
+
+
+def test_exchange_capacity_overflow_detected():
+    """A deliberately tiny per-pair capacity must fail the run loudly
+    (overflow counted per source host), never silently drop rows."""
+    yaml = PHOLD_YAML.format(policy="tpu", seed=6, loss=0.0, q=8,
+                             msgload=4)
+    c = Controller(load_config_str(
+        yaml.replace("experimental:",
+                     "experimental:\n  exchange_capacity: 1")))
+    stats = c.run()
+    assert not stats.ok
+
+
 def test_device_deterministic_across_runs():
     _, h1 = _run("tpu", seed=9)
     _, h2 = _run("tpu", seed=9)
