@@ -122,6 +122,7 @@ class Manager:
         # (now, src_host, dst_host, pkt_seq, ev_seq, kind, data)
         self._pending: list[tuple] = []
         self._pending_lock = threading.Lock()
+        self._last_hb_flush = simtime.SIMTIME_INVALID
         self._ctx = SimContext(self, self.stats)
         no = self.net_opts
         for h in self.hosts:
@@ -290,8 +291,16 @@ class Manager:
                 # hybrid: settle this round's pending drop verdicts so
                 # the CSV counters match the pure-CPU oracle's interval
                 # attribution (drop rolls are pure functions of
-                # (seed, src, pkt_seq) — flushing mid-round is safe)
-                if self.net_judge is not None:
+                # (seed, src, pkt_seq) — flushing mid-round is safe).
+                # Serial policies only: under threaded policies a flush
+                # from a worker would race other workers' counter
+                # updates, and threaded heartbeat attribution is
+                # unordered in pure-CPU mode anyway. One flush per
+                # heartbeat tick, not per host.
+                if (self.net_judge is not None
+                        and not hasattr(self.policy, "run_parallel")
+                        and self._last_hb_flush != ev.time):
+                    self._last_hb_flush = ev.time
                     self.flush_judgments()
                 host.tracker.heartbeat(ev.time, host)
                 nxt = ev.time + interval

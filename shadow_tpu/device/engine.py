@@ -168,6 +168,7 @@ class DeviceEngine:
             "n_drop": zeros_i32.copy(),
             "n_deliv": zeros_i32.copy(),
             "overflow": zeros_i32.copy(),
+            "x_overflow": zeros_i32.copy(),
             "chk": np.zeros(H, dtype=np.int64),
         }
         shard = NamedSharding(self.mesh, self._shard_spec)
@@ -403,8 +404,13 @@ class DeviceEngine:
         R = H_loc * OB
         SPAN = H_pad * OB              # exclusive upper bound on okey
         if cfg.exchange == "all_to_all":
+            # auto-size for 4x-skewed traffic, floored at one full
+            # event-capacity burst toward a single shard; hub-heavy
+            # configs that concentrate a whole outbox on one shard
+            # should set exchange_capacity (or exchange: all_gather) —
+            # overflow is loud, counted separately, and names the knob
             CAP = cfg.exchange_capacity or \
-                min(R, max(64, (4 * R + n_shards - 1) // n_shards))
+                min(R, max(64, E, (4 * R + n_shards - 1) // n_shards))
         else:
             CAP = 0
         XFIELDS = ("t", "dst", "src", "seq", "size", "d0", "d1")
@@ -435,10 +441,11 @@ class DeviceEngine:
             rank = idx - seg_start
             ok = (sds < n_shards) & (rank < CAP)
             lost = (sds < n_shards) & (rank >= CAP)
-            # overflow attributed to the SENDING host (it owns sizing)
+            # overflow attributed to the SENDING host (it owns sizing),
+            # in its own counter so the failure names the right knob
             src_loc = (flat["okey"][perm] // OB).astype(jnp.int32) \
                 - my_shard * H_loc
-            state["overflow"] = state["overflow"] + \
+            state["x_overflow"] = state["x_overflow"] + \
                 jnp.zeros((H_loc,), jnp.int32).at[
                     jnp.where(lost, src_loc, H_loc)].add(1, mode="drop")
 
@@ -622,7 +629,7 @@ class DeviceEngine:
                  ("t", "src", "seq", "kind", "size", "d0", "d1",
                   "event_seq", "packet_seq", "app_seq", "app",
                   "n_exec", "n_sent", "n_drop", "n_deliv", "overflow",
-                  "chk")}
+                  "x_overflow", "chk")}
         repl = self._repl_spec
         self._run = jax.jit(jax.shard_map(
             _run_shard, mesh=self.mesh,

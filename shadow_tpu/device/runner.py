@@ -21,10 +21,16 @@ import numpy as np
 
 from shadow_tpu._jax import jax
 from shadow_tpu.core.manager import SimStats, resolve_host_ref
-from shadow_tpu.device.apps import DeviceApp, PholdDevice, TgenDevice
+from shadow_tpu.device.apps import (
+    DeviceApp,
+    PholdDevice,
+    TgenDevice,
+    TorDevice,
+)
 from shadow_tpu.device.engine import DeviceEngine, EngineConfig
 from shadow_tpu.models.phold import PholdApp
 from shadow_tpu.models.tgen import TgenClientApp, TgenServerApp
+from shadow_tpu.models.tor import TorClientApp, TorRelayApp
 from shadow_tpu.utils.slog import get_logger
 
 log = get_logger("device")
@@ -89,9 +95,37 @@ def device_twin(sim) -> DeviceApp:
                           pause_ns=first.pause_ns,
                           retry_ns=first.retry_ns)
 
+    if classes <= {TorRelayApp, TorClientApp}:
+        clients = [a for a in real if isinstance(a, TorClientApp)]
+        if not clients:
+            raise ValueError("tpu policy: tor config has no clients")
+        first = clients[0]
+        for c in clients:
+            if (c.cells, c.count, c.pause_ns, c.retry_ns) != (
+                    first.cells, first.count, first.pause_ns,
+                    first.retry_ns):
+                raise ValueError("tpu policy: tor client args must "
+                                 "match across hosts")
+        roles = np.zeros(n_hosts, np.int32)
+        relay_gids = []
+        for h in sim.hosts:
+            if isinstance(h.app, TorClientApp):
+                roles[h.host_id] = 1
+            elif isinstance(h.app, TorRelayApp):
+                relay_gids.append(h.host_id)
+        if len(relay_gids) < 3:
+            raise ValueError("tor model needs >= 3 relays")
+        return TorDevice(roles=roles,
+                         relay_gids=np.array(relay_gids, np.int64),
+                         seed=sim.cfg.general.seed,
+                         cells=first.cells, count=first.count,
+                         pause_ns=first.pause_ns,
+                         retry_ns=first.retry_ns)
+
     names = sorted(c.__name__ for c in classes)
     raise NoDeviceTwin(f"no device twin registered for {names}; "
-                       "available: phold, tgen (server+client) — "
+                       "available: phold, tgen (server+client), "
+                       "tor (relay+client) — "
                        "running hybrid (CPU hosts + device net model)")
 
 
@@ -163,6 +197,13 @@ class DeviceRunner:
             log.error("device engine overflow: %d events lost — raise "
                       "experimental.event_capacity/outbox_capacity",
                       overflow)
+        x_overflow = int(final["x_overflow"][:H].sum())
+        if x_overflow:
+            stats.ok = False
+            log.error("exchange overflow: %d rows exceeded the per-"
+                      "shard-pair capacity — raise experimental."
+                      "exchange_capacity (or use exchange: all_gather "
+                      "for hub-concentrated traffic)", x_overflow)
 
         # reflect per-host results back onto the Host objects
         for h in self.sim.hosts:

@@ -1,0 +1,123 @@
+"""Tor model: CPU-vs-device equivalence, determinism, route math.
+
+The reference's flagship workload (README.md:66-69, src/test/tor/,
+.github/workflows/run_tor.yml) is Tor network simulation. Our model
+twin: clients pull cells through 3-hop onion circuits; relays are
+stateless because circuits are pure functions of the client id —
+which is what makes the device form one vectorized branch.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from shadow_tpu.config import load_config_str, load_config
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.models.tor import TorClientApp, TorRelayApp, pick_route
+
+TOR_YAML = """
+general:
+  stop_time: {stop}
+  seed: {seed}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        edge [ source 0 target 0 latency "20 ms" packet_loss {loss} ]
+        edge [ source 0 target 1 latency "40 ms" packet_loss {loss} ]
+        edge [ source 1 target 1 latency "20 ms" packet_loss {loss} ]
+      ]
+experimental:
+  scheduler_policy: {policy}
+  event_capacity: 96
+  outbox_capacity: 48
+hosts:
+  relay:
+    quantity: {relays}
+    network_node_id: 0
+    processes: [{{path: model:tor_relay, start_time: 100ms}}]
+  client:
+    quantity: {clients}
+    network_node_id: 1
+    processes:
+    - {{path: model:tor_client, args: cells={cells} count=2 pause=500ms{retry}, start_time: 1s}}
+"""
+
+
+def _run(policy, seed=1, loss=0.0, relays=8, clients=16, cells=48,
+         stop="20s", retry=""):
+    yaml = TOR_YAML.format(policy=policy, seed=seed, loss=loss,
+                           relays=relays, clients=clients, cells=cells,
+                           stop=stop, retry=retry)
+    c = Controller(load_config_str(yaml))
+    stats = c.run()
+    return stats, c.sim.hosts
+
+
+def test_pick_route_distinct():
+    rng = np.random.RandomState(0)
+    for _ in range(500):
+        bits = tuple(int(x) for x in rng.randint(0, 2**32, 3,
+                                                 dtype=np.uint32))
+        for r in (3, 4, 7, 50):
+            g, m, e = pick_route(bits, r)
+            assert len({g, m, e}) == 3
+            assert all(0 <= x < r for x in (g, m, e))
+
+
+def test_tor_clients_complete_downloads_cpu():
+    stats, hosts = _run("serial")
+    clients = [h for h in hosts if isinstance(h.app, TorClientApp)]
+    relays = [h for h in hosts if isinstance(h.app, TorRelayApp)]
+    assert all(h.app.downloads_done == 2 for h in clients), \
+        [h.app.downloads_done for h in clients]
+    assert all(h.app.cells_received == 2 * 48 for h in clients)
+    assert sum(h.app.cells_relayed for h in relays) > 0
+    assert stats.ok
+
+
+@pytest.mark.parametrize("loss,retry", [(0.0, ""), (0.05, " retry=2s")],
+                         ids=["lossless", "lossy_retry"])
+def test_tor_device_matches_serial_oracle(loss, retry):
+    s_stats, s_hosts = _run("serial", loss=loss, retry=retry)
+    d_stats, d_hosts = _run("tpu", loss=loss, retry=retry)
+    assert d_stats.ok
+    assert s_stats.events_executed == d_stats.events_executed
+    assert s_stats.packets_sent == d_stats.packets_sent
+    assert s_stats.packets_dropped == d_stats.packets_dropped
+    for sh, dh in zip(s_hosts, d_hosts):
+        assert sh.trace_checksum == dh.trace_checksum, sh.name
+
+
+def test_tor_device_deterministic_and_seed_sensitive():
+    _, h1 = _run("tpu", seed=11)
+    _, h2 = _run("tpu", seed=11)
+    _, h3 = _run("tpu", seed=12)
+    assert [h.trace_checksum for h in h1] == \
+        [h.trace_checksum for h in h2]
+    assert [h.trace_checksum for h in h1] != \
+        [h.trace_checksum for h in h3]
+
+
+def test_tor_small_example_loads_and_maps_to_device():
+    """examples/tor_small.yaml (BASELINE #4 shape) builds a device twin
+    with the right roles; a short-stop run executes events on device."""
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "tor_small.yaml")
+    cfg = load_config(path)
+    cfg.general.stop_time = 2_000_000_000      # trim for test runtime
+    cfg.general.bootstrap_end_time = 500_000_000
+    c = Controller(cfg)
+    assert c.runner is not None, "tor_small must map to the device twin"
+    app = c.runner.app
+    assert int(app.roles.sum()) == 200          # clients
+    assert len(app.relay_gids) == 50
+    stats = c.run()
+    assert stats.ok
+    assert stats.events_executed > 0
+    assert stats.packets_sent > 0
