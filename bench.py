@@ -1,16 +1,24 @@
-"""Benchmark: device engine vs CPU serial scheduler on PHOLD.
+"""Benchmark: the tgen ladder on the device engine vs the CPU thread
+policy (BASELINE.md's target comparison).
 
 Prints ONE JSON line:
   {"metric": "packets_routed_per_sec_per_chip", "value": N,
-   "unit": "packets/s", "vs_baseline": ratio}
+   "unit": "packets/s", "vs_baseline": R, ...extras}
 
-The workload is the PHOLD PDES benchmark (the reference's own perf
-probe, src/test/phold/): H hosts on a 2-vertex lossy topology, msgload
-messages per host in steady state. `value` is packets routed per wall
-second by the device engine on the available accelerator; `vs_baseline`
-is the speedup over the single-threaded CPU reference policy running
-the identical simulation (the stand-in for the reference's CPU
-scheduler on this machine).
+Method (honest-numbers rules):
+* Workload: the repo's tgen ladder — examples/tgen_100.yaml,
+  tgen_1000.yaml and the 10k-host tgen_10000.yaml (the BASELINE.md
+  north-star config), unmodified except stop_time for the bounded
+  slices below.
+* Baseline: the CPU `thread` scheduler policy (thread-per-core; on
+  this machine's core count), NOT the serial oracle.
+* vs_baseline: device wall-clock vs thread-policy wall-clock on the
+  IDENTICAL config and sim interval (a bounded slice so the CPU run
+  finishes); reported per rung, headline ratio is the 10k rung's.
+* value: device packets routed per wall second over the FULL 30 s
+  tgen_10000 run (steady state included), divided by chip count.
+* Overflow or backend failure => nonzero exit; the JSON line is still
+  emitted (with an "error" field) so the driver always gets a record.
 """
 
 from __future__ import annotations
@@ -19,110 +27,170 @@ import json
 import sys
 import time
 
-import numpy as np
+RUNGS = [
+    # (name, config, slice_stop_s) — slice bounds the CPU baseline run
+    ("tgen_100", "examples/tgen_100.yaml", 10.0),
+    ("tgen_1000", "examples/tgen_1000.yaml", 4.0),
+    ("tgen_10000", "examples/tgen_10000.yaml", 2.5),
+]
+HEADLINE = "tgen_10000"
+FULL_STOP_S = 30.0
 
-# Keep bench runs honest: one process, whatever platform jax selects
-# (TPU under the driver, CPU elsewhere).
-
-GML = """graph [ directed 0
-  node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
-  node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
-  edge [ source 0 target 0 latency "10 ms" packet_loss 0.01 ]
-  edge [ source 0 target 1 latency "5 ms" packet_loss 0.01 ]
-  edge [ source 1 target 1 latency "10 ms" packet_loss 0.01 ]
-]"""
-
-H = 1024           # hosts
-MSGLOAD = 4        # steady-state messages per host
-DEV_STOP_S = 2.0   # simulated seconds on device
-CPU_STOP_S = 0.25  # simulated seconds for the CPU baseline slice
+if __import__("os").environ.get("BENCH_SMOKE"):
+    # mechanics-validation mode for CI/local runs (tiny ladder, no
+    # full-length run); the driver's real benchmark never sets this
+    RUNGS = [("tgen_100", "examples/tgen_100.yaml", 5.0)]
+    HEADLINE = "tgen_100"
+    FULL_STOP_S = 8.0
 
 
-def yaml_cfg(policy: str, stop_s: float) -> str:
-    return f"""
-general:
-  stop_time: {stop_s}s
-  seed: 1
-network:
-  graph:
-    type: gml
-    inline: |
-{_indent(GML, 6)}
-experimental:
-  scheduler_policy: {policy}
-  event_capacity: 64
-  outbox_capacity: 32
-hosts:
-  left:
-    quantity: {H // 2}
-    network_node_id: 0
-    processes:
-    - path: model:phold
-      args: msgload={MSGLOAD} size=64
-      start_time: 10ms
-  right:
-    quantity: {H // 2}
-    network_node_id: 1
-    processes:
-    - path: model:phold
-      args: msgload={MSGLOAD} size=64
-      start_time: 10ms
-"""
+def log(msg: str) -> None:
+    print(f"bench: {msg}", file=sys.stderr, flush=True)
 
 
-def _indent(text: str, n: int) -> str:
-    pad = " " * n
-    return "\n".join(pad + line for line in text.splitlines())
+def init_backend():
+    """Guarded backend init: retry once, then fall back to the CPU
+    platform — the JSON line must always be emitted. Returns
+    (devices, fell_back): a fallback run still records numbers but the
+    bench exits nonzero and marks the JSON, so a CPU-vs-CPU ratio can
+    never masquerade as a device benchmark."""
+    from shadow_tpu._jax import jax
+
+    last = None
+    for attempt in range(2):
+        try:
+            devs = jax.devices()
+            log(f"backend: {devs[0].platform} x{len(devs)}")
+            return devs, False
+        except Exception as e:          # noqa: BLE001 — report & retry
+            last = e
+            log(f"backend init attempt {attempt + 1} failed: {e}")
+            time.sleep(5)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        log(f"backend: fell back to cpu x{len(devs)} after: {last}")
+        return devs, True
+    except Exception as e:              # noqa: BLE001
+        raise RuntimeError(f"no jax backend: {e}") from last
 
 
-def run_policy(policy: str, stop_s: float) -> tuple[float, int, float]:
-    """Returns (wall_seconds, packets_routed, sim_seconds)."""
-    from shadow_tpu.config import load_config_str
+def load(config_path: str, policy: str, stop_s: float):
+    from shadow_tpu import simtime
+    from shadow_tpu.config import load_config
+
+    cfg = load_config(config_path)
+    cfg.experimental.scheduler_policy = policy
+    cfg.general.stop_time = simtime.from_seconds(stop_s)
+    return cfg
+
+
+def run_device(config_path: str, stop_s: float,
+               engine_cache: dict) -> tuple[float, int, float]:
+    """Warm-compiled device run: (wall_s, packets, sim_s). Raises on
+    overflow — a failed capacity plan must fail the bench. stop_time
+    is a runtime scalar of the compiled program, so one short warm-up
+    run per config covers every slice length."""
+    from shadow_tpu import simtime
     from shadow_tpu.core.controller import Controller
 
-    cfg = load_config_str(yaml_cfg(policy, stop_s))
+    cfg = load(config_path, "tpu", stop_s)
     c = Controller(cfg)
-    if policy == "tpu":
-        # warm-up: compile once on a throwaway run of the same shapes
-        t0 = time.perf_counter()
-        c.run()
-        compile_and_run = time.perf_counter() - t0
-        c2 = Controller(cfg)
-        c2.runner.engine = c.runner.engine      # reuse compiled program
-        t0 = time.perf_counter()
-        stats = c2.run()
-        wall = time.perf_counter() - t0
-        print(f"bench: device compile+first run {compile_and_run:.1f}s, "
-              f"steady run {wall:.2f}s", file=sys.stderr)
+    if config_path in engine_cache:
+        c.runner.engine = engine_cache[config_path]
     else:
         t0 = time.perf_counter()
-        stats = c.run()
-        wall = time.perf_counter() - t0
+        # compile + a minimal-length run (boot only) to warm the cache
+        st = c.runner.engine.init_state(c.sim.starts)
+        c.runner.engine.run(st, stop=simtime.from_seconds(0.001))
+        log(f"  compile+warm {time.perf_counter() - t0:.1f}s")
+        engine_cache[config_path] = c.runner.engine
+    t0 = time.perf_counter()
+    stats = c.run()
+    wall = time.perf_counter() - t0
     if not stats.ok:
-        print(f"bench: WARNING {policy} run not ok (overflow?)",
-              file=sys.stderr)
+        raise RuntimeError(
+            f"device run of {config_path} (stop={stop_s}s) overflowed "
+            "— the capacity plan is wrong; see log for the knob")
+    return wall, stats.packets_sent, stop_s
+
+
+def run_cpu_thread(config_path: str, stop_s: float
+                   ) -> tuple[float, int, float]:
+    from shadow_tpu.core.controller import Controller
+
+    cfg = load(config_path, "thread", stop_s)
+    t0 = time.perf_counter()
+    stats = Controller(cfg).run()
+    wall = time.perf_counter() - t0
+    if not stats.ok:
+        raise RuntimeError(f"cpu thread run of {config_path} failed")
     return wall, stats.packets_sent, stop_s
 
 
 def main() -> int:
-    dev_wall, dev_packets, dev_sim_s = run_policy("tpu", DEV_STOP_S)
-    dev_rate = dev_packets / dev_wall
-
-    cpu_wall, cpu_packets, cpu_sim_s = run_policy("serial", CPU_STOP_S)
-    cpu_rate = cpu_packets / cpu_wall
-
-    print(f"bench: device {dev_packets} pkts in {dev_wall:.2f}s "
-          f"({dev_rate:,.0f}/s; {dev_sim_s / dev_wall:.2f} sim-s/wall-s) | "
-          f"cpu {cpu_packets} pkts in {cpu_wall:.2f}s "
-          f"({cpu_rate:,.0f}/s)", file=sys.stderr)
-
-    print(json.dumps({
+    result = {
         "metric": "packets_routed_per_sec_per_chip",
-        "value": round(dev_rate, 1),
+        "value": 0.0,
         "unit": "packets/s",
-        "vs_baseline": round(dev_rate / cpu_rate, 3),
-    }))
-    return 0
+        "vs_baseline": 0.0,
+    }
+    rc = 0
+    try:
+        devs, fell_back = init_backend()
+        n_chips = len({d.id for d in devs})
+        result["platform"] = devs[0].platform
+        if fell_back:
+            result["error"] = ("tpu backend unavailable; numbers are "
+                               "from the cpu jax platform")
+            rc = 1
+        engine_cache: dict = {}
+        ladder = {}
+        for name, path, slice_s in RUNGS:
+            log(f"{name}: device slice ({slice_s}s sim)")
+            d_wall, d_pkts, _ = run_device(path, slice_s, engine_cache)
+            log(f"  device: {d_pkts} pkts in {d_wall:.2f}s "
+                f"({d_pkts / d_wall:,.0f}/s)")
+            log(f"{name}: cpu thread slice ({slice_s}s sim)")
+            c_wall, c_pkts, _ = run_cpu_thread(path, slice_s)
+            log(f"  cpu: {c_pkts} pkts in {c_wall:.2f}s "
+                f"({c_pkts / c_wall:,.0f}/s)")
+            if d_pkts != c_pkts:
+                # identical config+seed must route identical traffic;
+                # a mismatch means the engines diverged — not a number
+                # worth publishing
+                raise RuntimeError(
+                    f"{name}: device routed {d_pkts} packets but cpu "
+                    f"routed {c_pkts} on the same config/seed")
+            ratio = (d_pkts / d_wall) / (c_pkts / c_wall)
+            ladder[name] = {
+                "slice_sim_s": slice_s,
+                "device_pkts_per_s": round(d_pkts / d_wall, 1),
+                "cpu_thread_pkts_per_s": round(c_pkts / c_wall, 1),
+                "speedup": round(ratio, 2),
+            }
+            log(f"  speedup vs thread policy: {ratio:.2f}x")
+
+        log(f"{HEADLINE}: device full run ({FULL_STOP_S}s sim)")
+        headline_path = dict((n, p) for n, p, _ in RUNGS)[HEADLINE]
+        f_wall, f_pkts, f_sim = run_device(
+            headline_path, FULL_STOP_S, engine_cache)
+        sim_per_wall = f_sim / f_wall
+        log(f"  full: {f_pkts} pkts in {f_wall:.2f}s "
+            f"({f_pkts / f_wall:,.0f}/s; {sim_per_wall:.2f} "
+            "sim-s/wall-s)")
+
+        result["value"] = round(f_pkts / f_wall / n_chips, 1)
+        result["vs_baseline"] = ladder[HEADLINE]["speedup"]
+        result["sim_s_per_wall_s"] = round(sim_per_wall, 3)
+        result["n_chips"] = n_chips
+        result["ladder"] = ladder
+    except Exception as e:              # noqa: BLE001
+        result["error"] = str(e)
+        log(f"FAILED: {e}")
+        rc = 1
+    print(json.dumps(result), flush=True)
+    return rc
 
 
 if __name__ == "__main__":

@@ -190,7 +190,6 @@ class DeviceEngine:
         H_loc, H_pad = self.H_loc, self.H_pad
         n_shards = self.n_shards
         seed_pair = self.seed_pair
-        STOP = np.int64(cfg.stop_time)
         LOOKAHEAD = np.int64(max(1, cfg.lookahead))
         BOOT_END = np.int64(cfg.bootstrap_end)
 
@@ -593,7 +592,9 @@ class DeviceEngine:
         def _axis_min(x):
             return lax.all_gather(jnp.reshape(x, (1,)), AXIS).min()
 
-        def _run_shard(state, host_vertex, lat, rel):
+        def _run_shard(state, host_vertex, lat, rel, stop):
+            # `stop` is a traced scalar so one compiled program serves
+            # every stop_time for a given config/shape
             my_shard = lax.axis_index(AXIS)
             gid = (my_shard * H_loc + hidx).astype(jnp.int32)
 
@@ -602,11 +603,11 @@ class DeviceEngine:
 
             def cond(c):
                 state, nxt, rounds = c
-                return (nxt < STOP) & (rounds < cfg.max_rounds)
+                return (nxt < stop) & (rounds < cfg.max_rounds)
 
             def body(c):
                 state, nxt, rounds = c
-                win_end = jnp.minimum(nxt + LOOKAHEAD, STOP)
+                win_end = jnp.minimum(nxt + LOOKAHEAD, stop)
                 state = _round(state, win_end, gid, my_shard,
                                host_vertex, lat, rel)
                 return state, next_time(state), rounds + 1
@@ -633,7 +634,7 @@ class DeviceEngine:
         repl = self._repl_spec
         self._run = jax.jit(jax.shard_map(
             _run_shard, mesh=self.mesh,
-            in_specs=(specs, repl, repl, repl),
+            in_specs=(specs, repl, repl, repl, repl),
             out_specs=(specs, repl),
             check_vma=False,
         ))
@@ -645,10 +646,14 @@ class DeviceEngine:
         ))
 
     # ------------------------------------------------------------------
-    def run(self, state: dict):
-        """Run to stop_time; returns (final_state, rounds) on device."""
+    def run(self, state: dict, stop: Optional[int] = None):
+        """Run to `stop` (default config.stop_time); returns
+        (final_state, rounds) on device. `stop` is a runtime scalar —
+        different stop times reuse the same compiled program."""
         repl = NamedSharding(self.mesh, self._repl_spec)
         hv = jax.device_put(jnp.asarray(self.host_vertex), repl)
         lat = jax.device_put(jnp.asarray(self.latency), repl)
         rel = jax.device_put(jnp.asarray(self.reliability), repl)
-        return self._run(state, hv, lat, rel)
+        stop_v = jnp.int64(self.config.stop_time if stop is None
+                           else stop)
+        return self._run(state, hv, lat, rel, stop_v)

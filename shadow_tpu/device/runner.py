@@ -179,7 +179,9 @@ class DeviceRunner:
 
     def run(self, stop: int) -> SimStats:
         state = self.engine.init_state(self.sim.starts)
-        final, rounds = self.engine.run(state)
+        # pass stop explicitly: a cached/reused engine may have been
+        # built for a different stop_time (it's a runtime scalar)
+        final, rounds = self.engine.run(state, stop=stop)
         final = jax.device_get(final)
         self.final_state = final
         H = len(self.sim.hosts)
