@@ -419,17 +419,29 @@ class ManagedProcess:
     def _finish_thread_exit(self, ctx, th: ManagedThread) -> None:
         """After replying to an exiting (non-last) thread: wait for the
         kernel-cleared death guard (native_thread_alive, armed by the
-        shim's clone), then publish CLEARTID and wake joiners."""
+        shim's clone as the kernel's CLEARTID word), then publish the
+        virtual CLEARTID and wake joiners.
+
+        The wait is authoritative: joiners are NEVER woken while the
+        kernel still reports the native thread alive — glibc frees the
+        thread stack on join, and a not-yet-dead thread's exit epilogue
+        still runs on it (the round-1 crash). A thread that outlives
+        the hard deadline fails the simulation loudly instead of
+        degrading to that race."""
         import time as _time
         deadline = _time.monotonic() + RECV_TIMEOUT_MS / 1000.0
         ch = th.channel
+        spins = 0
         while ch.native_thread_alive():
             if _time.monotonic() > deadline:
-                log.warning("vtid=%d: native thread did not exit "
-                            "within %ds; waking joiners anyway",
-                            th.vtid, RECV_TIMEOUT_MS // 1000)
-                break
-            _time.sleep(0)          # yield; death follows within µs
+                raise RuntimeError(
+                    f"managed thread vtid={th.vtid} (pid "
+                    f"{self.native_pid}) did not die within "
+                    f"{RECV_TIMEOUT_MS // 1000}s of its exit syscall; "
+                    "refusing to wake joiners onto a live stack")
+            spins += 1
+            # death normally follows within µs; back off if not
+            _time.sleep(0 if spins < 10_000 else 0.0005)
         if th.clear_ctid:
             import struct as _s
             try:

@@ -1,0 +1,76 @@
+/* recvmmsg(2) semantics check (receiver side). Peer: udp_burst.
+ *
+ * Three scenarios against the simulated clock (deterministic, so the
+ * printed sim-time deltas are exact):
+ *  a) MSG_WAITFORONE on an empty blocking socket: waits for the first
+ *     datagram, then drains without blocking again (2 arrive together
+ *     -> n=2).
+ *  b) 100 ms timeout, socket empty until one datagram arrives AFTER
+ *     the timeout would have expired: the kernel only consults the
+ *     timeout after each received datagram, so the call still returns
+ *     that first datagram (n=1) at its arrival time.
+ *  c) 600 ms timeout with one datagram mid-window: returns n=1 at the
+ *     DEADLINE (timeout expiry ends the wait for more). */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static double now_s(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec / 1e9;
+}
+
+static int do_recvmmsg(int s, int vlen, int flags,
+                       struct timespec *timeout) {
+  static char bufs[8][256];
+  struct mmsghdr msgs[8];
+  struct iovec iovs[8];
+  memset(msgs, 0, sizeof msgs);
+  for (int i = 0; i < vlen; i++) {
+    iovs[i].iov_base = bufs[i];
+    iovs[i].iov_len = sizeof bufs[i];
+    msgs[i].msg_hdr.msg_iov = &iovs[i];
+    msgs[i].msg_hdr.msg_iovlen = 1;
+  }
+  return recvmmsg(s, msgs, vlen, flags, timeout);
+}
+
+int main(int argc, char **argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 9000;
+  int s = socket(AF_INET, SOCK_DGRAM, 0);
+  struct sockaddr_in a;
+  memset(&a, 0, sizeof a);
+  a.sin_family = AF_INET;
+  a.sin_port = htons(port);
+  a.sin_addr.s_addr = INADDR_ANY;
+  if (bind(s, (struct sockaddr *)&a, sizeof a) != 0) {
+    perror("bind");
+    return 1;
+  }
+  /* a) WAITFORONE with d1+d2 already queued (sleep past their
+   * arrival): returns both without blocking */
+  usleep(700 * 1000);
+  double ta0 = now_s();
+  int n = do_recvmmsg(s, 8, MSG_WAITFORONE, NULL);
+  printf("a n=%d dt=%.3f\n", n, now_s() - ta0);
+
+  /* b) empty socket, 100 ms timeout, next datagram later than that */
+  struct timespec tb = {0, 100 * 1000 * 1000};
+  double tb0 = now_s();
+  n = do_recvmmsg(s, 4, 0, &tb);
+  printf("b n=%d dt=%.3f\n", n, now_s() - tb0);
+
+  /* c) 600 ms window, one datagram mid-window: returns at deadline */
+  struct timespec tc = {0, 600 * 1000 * 1000};
+  double tc0 = now_s();
+  n = do_recvmmsg(s, 4, 0, &tc);
+  printf("c n=%d dt=%.3f\n", n, now_s() - tc0);
+  return 0;
+}

@@ -1,0 +1,59 @@
+/* NULL-offset sendfile(2) must advance the file description's offset
+ * (kernel semantics) — a subsequent read(2) on the SAME fd continues
+ * where sendfile stopped. Non-NULL offset must leave it untouched. */
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: sendfile_offset_check <ip> <port>\n");
+    return 2;
+  }
+  /* pattern file: byte i = i & 0xff */
+  int f = open("sfoff.bin", O_CREAT | O_TRUNC | O_RDWR, 0644);
+  char buf[8192];
+  for (int i = 0; i < (int)sizeof buf; i++)
+    buf[i] = (char)(i & 0xff);
+  if (write(f, buf, sizeof buf) != (long)sizeof buf) {
+    perror("write");
+    return 1;
+  }
+  lseek(f, 0, SEEK_SET);
+
+  int s = socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in d;
+  memset(&d, 0, sizeof d);
+  d.sin_family = AF_INET;
+  d.sin_port = htons(atoi(argv[2]));
+  d.sin_addr.s_addr = inet_addr(argv[1]);
+  if (connect(s, (struct sockaddr *)&d, sizeof d) != 0) {
+    perror("connect");
+    return 1;
+  }
+
+  /* NULL offset: stream 4096 from position 0, fd offset must advance */
+  long n = sendfile(s, f, NULL, 4096);
+  printf("sf1 n=%ld\n", n);
+  long pos = lseek(f, 0, SEEK_CUR);
+  printf("pos after null-offset sendfile: %ld\n", pos);
+  char probe[4];
+  long r = read(f, probe, sizeof probe);
+  printf("read n=%ld bytes %d %d %d %d\n", r, probe[0] & 0xff,
+         probe[1] & 0xff, probe[2] & 0xff, probe[3] & 0xff);
+
+  /* explicit offset: fd position must NOT move further */
+  off_t off = 0;
+  long before = lseek(f, 0, SEEK_CUR);
+  n = sendfile(s, f, &off, 1024);
+  printf("sf2 n=%ld off=%ld moved=%ld\n", n, (long)off,
+         lseek(f, 0, SEEK_CUR) - before);
+  close(s);
+  return 0;
+}

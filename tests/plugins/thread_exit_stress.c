@@ -1,0 +1,33 @@
+/* Joiner-vs-exit stress: threads exit the instant they start while the
+ * main thread joins immediately — maximizing pressure on the window
+ * between a thread's exit syscall and its real death, where waking the
+ * joiner early lets glibc free a stack the dying thread still runs on
+ * (the CLEARTID death-guard race). Each joined thread's stack is
+ * immediately reused by the next create. */
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+static void *worker(void *arg) {
+  return (void *)((long)arg * 3 + 1);
+}
+
+int main(int argc, char **argv) {
+  int rounds = argc > 1 ? atoi(argv[1]) : 64;
+  long acc = 0;
+  for (int i = 0; i < rounds; i++) {
+    pthread_t t;
+    if (pthread_create(&t, NULL, worker, (void *)(long)i) != 0) {
+      perror("pthread_create");
+      return 1;
+    }
+    void *ret;
+    if (pthread_join(t, &ret) != 0) {
+      perror("pthread_join");
+      return 1;
+    }
+    acc += (long)ret;
+  }
+  printf("acc %ld\n", acc);
+  return 0;
+}

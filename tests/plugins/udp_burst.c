@@ -1,0 +1,31 @@
+/* Scripted UDP sender for recvmmsg_check: two datagrams back-to-back,
+ * then one after 300 ms, then one after a further 500 ms. */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: udp_burst <ip> <port>\n");
+    return 2;
+  }
+  int s = socket(AF_INET, SOCK_DGRAM, 0);
+  struct sockaddr_in d;
+  memset(&d, 0, sizeof d);
+  d.sin_family = AF_INET;
+  d.sin_port = htons(atoi(argv[2]));
+  d.sin_addr.s_addr = inet_addr(argv[1]);
+  const struct sockaddr *da = (const struct sockaddr *)&d;
+  sendto(s, "d1", 2, 0, da, sizeof d);
+  sendto(s, "d2", 2, 0, da, sizeof d);
+  usleep(300 * 1000);
+  sendto(s, "d3", 2, 0, da, sizeof d);
+  usleep(500 * 1000);
+  sendto(s, "d4", 2, 0, da, sizeof d);
+  printf("sent 4\n");
+  return 0;
+}

@@ -1,0 +1,242 @@
+"""Regression tests for the round-2 semantic fixes, each designed to
+fail on the pre-fix code:
+
+* recvmmsg: MSG_WAITFORONE drain, the consult-timeout-only-after-a-
+  datagram kernel quirk, and the expired-deadline restart path
+  (host/syscalls.py sys_recvmmsg).
+* NULL-offset sendfile advances the shared file description; explicit
+  offset does not (host/syscalls.py sys_sendfile).
+* RTO on a fully-SACKed flight reneges the SACK state and retransmits
+  (RFC 2018 §8; host/tcp.py on_timer).
+* joiner-vs-exit stress on the kernel-cleared thread-death guard
+  (host/process.py _finish_thread_exit).
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.host.tcp import TcpFlags, TcpSocket, TcpState
+from shadow_tpu.routing.packet import Packet, Protocol
+
+PLUGIN_DIR = os.path.join(os.path.dirname(__file__), "plugins")
+
+GML = """graph [ directed 0
+  node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+  node [ id 1 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+  edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ]
+  edge [ source 0 target 1 latency "25 ms" packet_loss 0.0 ]
+  edge [ source 1 target 1 latency "10 ms" packet_loss 0.0 ]
+]"""
+
+
+def _indent(text: str, n: int) -> str:
+    return "\n".join(" " * n + line for line in text.splitlines())
+
+
+@pytest.fixture(scope="module")
+def bins(tmp_path_factory):
+    out = tmp_path_factory.mktemp("plugins")
+    built = {}
+    for name in ("recvmmsg_check", "udp_burst", "sendfile_offset_check",
+                 "thread_exit_stress", "tcp_server"):
+        exe = out / name
+        subprocess.run(
+            ["cc", "-O1", "-pthread", "-o", str(exe),
+             os.path.join(PLUGIN_DIR, f"{name}.c")],
+            check=True, capture_output=True)
+        built[name] = str(exe)
+    return built
+
+
+def run_sim(hosts_yaml: str, data: str, stop: str = "30s"):
+    cfg = load_config_str(f"""
+general:
+  stop_time: {stop}
+  seed: 1
+  data_directory: {data}
+network:
+  graph:
+    type: gml
+    inline: |
+{_indent(GML, 6)}
+hosts:
+{hosts_yaml}
+""")
+    c = Controller(cfg)
+    return c.run()
+
+
+def stdout_of(data: str, host: str, exe: str) -> str:
+    d = os.path.join(data, "hosts", host)
+    for f in sorted(os.listdir(d)):
+        if f.startswith(exe) and f.endswith(".stdout"):
+            with open(os.path.join(d, f)) as fh:
+                return fh.read()
+    raise FileNotFoundError(f"no stdout for {exe} in {d}")
+
+
+# ---------------------------------------------------------------------
+# recvmmsg
+# ---------------------------------------------------------------------
+def test_recvmmsg_waitforone_timeout_and_restart(bins, tmp_path):
+    """Receiver on node 0 (starts 1s), scripted burst sender on node 1
+    (starts 1.5s; 25 ms one-way). Deterministic sim clocks pin each
+    scenario's return count AND return time:
+      a) WAITFORONE at 1.7 with d1+d2 queued since 1.525 -> drains
+         both instantly (n=2, dt=0)
+      b) 100 ms timeout expires while empty; d3 arrives 1.825 -> the
+         timeout is only consulted after a datagram, so n=1 at arrival
+         (dt=0.125 from the 1.7 call time)
+      c) 600 ms window, d4 arrives mid-window at 2.325 -> n=1 at the
+         2.425 deadline (exercises the Blocked-with-deadline restart)
+    """
+    data = str(tmp_path / "shadow.data")
+    stats = run_sim(f"""
+  recv:
+    network_node_id: 0
+    processes:
+    - path: {bins['recvmmsg_check']}
+      args: 9000
+      start_time: 1s
+  send:
+    network_node_id: 1
+    processes:
+    - path: {bins['udp_burst']}
+      args: 11.0.0.1 9000
+      start_time: 1.5s
+""", data, stop="10s")
+    assert stats.ok
+    out = stdout_of(data, "recv", "recvmmsg_check").splitlines()
+    assert out[0] == "a n=2 dt=0.000"
+    assert out[1] == "b n=1 dt=0.125"
+    assert out[2] == "c n=1 dt=0.600"
+
+
+# ---------------------------------------------------------------------
+# sendfile
+# ---------------------------------------------------------------------
+def test_sendfile_null_offset_advances_fd(bins, tmp_path):
+    """After sendfile(sock, f, NULL, 4096) the same fd's read must see
+    bytes 4096.. (shared file description advanced); an explicit-offset
+    sendfile must leave the fd position alone."""
+    data = str(tmp_path / "shadow.data")
+    stats = run_sim(f"""
+  server:
+    network_node_id: 0
+    processes:
+    - path: {bins['tcp_server']}
+      args: 8080
+      start_time: 1s
+  client:
+    network_node_id: 1
+    processes:
+    - path: {bins['sendfile_offset_check']}
+      args: 11.0.0.1 8080
+      start_time: 2s
+""", data)
+    assert stats.ok
+    out = stdout_of(data, "client", "sendfile_offset_check").splitlines()
+    assert out[0] == "sf1 n=4096"
+    assert out[1] == "pos after null-offset sendfile: 4096"
+    # bytes at offset 4096: 4096&0xff=0, then 1 2 3
+    assert out[2] == "read n=4 bytes 0 1 2 3"
+    assert out[3] == "sf2 n=1024 off=1024 moved=0"
+
+
+# ---------------------------------------------------------------------
+# RTO on a fully-SACKed flight
+# ---------------------------------------------------------------------
+class _FakeIface:
+    def wants_send(self, sock, now):
+        pass
+
+
+class _FakeNet:
+    """Minimal HostNetStack stand-in for driving TcpSocket directly."""
+
+    def __init__(self):
+        self.tcp_segments_sent = 0
+        self.tcp_segments_retransmitted = 0
+        self.timers = []
+        self.ctx = None
+        self._iface = _FakeIface()
+
+    def new_conn_id(self, sock):
+        return 1
+
+    def register(self, sock):
+        pass
+
+    def unregister(self, sock):
+        pass
+
+    def interface_for(self, dst):
+        return self._iface
+
+    def new_packet(self, dst_host, protocol, size, src_port=0,
+                   dst_port=0, payload=None):
+        return Packet(src_host=0, packet_id=0, dst_host=dst_host,
+                      protocol=protocol, size=size, src_port=src_port,
+                      dst_port=dst_port, payload=payload)
+
+    def schedule_tcp_timer(self, conn_id, gen, when):
+        self.timers.append((when, conn_id, gen))
+
+
+def test_rto_on_fully_sacked_flight_reneges_and_retransmits():
+    """RFC 2018 §8: after an RTO the sender must discard SACK state.
+    Pre-fix, a flight whose every segment was SACKed (but never
+    cumulatively ACKed — renege) left _retransmit_first with no
+    candidate: no retransmission, no progress. Post-fix the tally is
+    cleared and the first segment goes out again."""
+    net = _FakeNet()
+    s = TcpSocket(net, 1234)
+    s.state = TcpState.ESTABLISHED
+    s.peer = (1, 80)
+    # a 3-segment flight, all selectively acked, none cumulatively
+    for seq, size in ((0, 1000), (1000, 1000), (2000, 1000)):
+        s.retx.append([seq, size, 1, 0, int(TcpFlags.ACK)])
+        s.tally.mark_sacked(seq, seq + size)
+    assert s.tally.is_sacked(0, 3000)
+    s._rto_armed = True
+    gen = s._timer_gen
+    before = s.segments_retransmitted
+    s.on_timer(1_000_000, gen)
+    assert s.tally.sacked == []                 # renege: SACK discarded
+    assert s.segments_retransmitted == before + 1
+    assert s._rto_armed                          # timer re-armed
+
+
+def test_rto_without_sack_still_retransmits():
+    net = _FakeNet()
+    s = TcpSocket(net, 1234)
+    s.state = TcpState.ESTABLISHED
+    s.peer = (1, 80)
+    s.retx.append([0, 1000, 1, 0, int(TcpFlags.ACK)])
+    s._rto_armed = True
+    s.on_timer(1_000_000, s._timer_gen)
+    assert s.segments_retransmitted == 1
+
+
+# ---------------------------------------------------------------------
+# joiner-vs-exit stress
+# ---------------------------------------------------------------------
+def test_thread_exit_join_stress(bins, tmp_path):
+    """64 create/exit/join cycles, each reusing the previous thread's
+    stack: any early joiner wake-up (before the kernel-cleared death
+    guard) corrupts a live stack. acc = sum(3i+1, i<64) = 6112."""
+    data = str(tmp_path / "shadow.data")
+    stats = run_sim(f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {bins['thread_exit_stress']}
+      args: 64
+      start_time: 1s
+""", data)
+    assert stats.ok
+    assert stdout_of(data, "alice", "thread_exit_stress") == "acc 6112\n"
