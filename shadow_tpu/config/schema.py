@@ -226,6 +226,10 @@ class ExperimentalOptions:
     socket_send_autotune: bool = True
     router_queue: str = "codel"             # codel | single | static
     router_static_capacity: int = 1024      # packets, for `static` queue
+    # bandwidth + CoDel for RAW model-app sends (the socket path always
+    # models bandwidth): the vectorizable fluid NIC that exists on both
+    # the CPU and device engines (host/model_nic.py)
+    model_bandwidth: bool = False
 
     # --- TPU engine knobs (new; absent from the reference) ---
     event_capacity: int = 64        # device event slots per host

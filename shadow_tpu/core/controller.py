@@ -93,6 +93,10 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
                         rng=root_rng.child(f"host:{name}"),
                         pcap_directory=group.pcap_directory)
             host.cpu = Cpu()
+            if cfg.experimental.model_bandwidth:
+                from shadow_tpu.host.model_nic import ModelNic
+                host.model_nic = ModelNic(att.bw_up_bits,
+                                          att.bw_down_bits)
             host.address = dns.register(host_id, name,
                                         requested_ip=group.ip_address_hint)
             host.ip = host.address.ip_str

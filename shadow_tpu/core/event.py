@@ -30,6 +30,12 @@ KIND_TASK = 4     # CPU-only: run the attached task closure
 KIND_ROUTER_ARRIVAL = 5   # packet arrived at dst's upstream router
 KIND_NIC_WAKE = 6         # token-bucket refill wakeup (data: (side,))
 KIND_TCP_TIMER = 7        # TCP timer (data: (conn_id, generation))
+# model-NIC path (experimental.model_bandwidth): a raw-send packet
+# event first passes the destination's RX bandwidth/CoDel stage
+# (KIND_PACKET), then re-fires as KIND_PACKET_READY at its post-
+# serialization delivery time — on both engines (host/model_nic.py,
+# device/engine.py)
+KIND_PACKET_READY = 8
 
 
 class EventKey(NamedTuple):

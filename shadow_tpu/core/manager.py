@@ -23,6 +23,7 @@ from shadow_tpu.core.event import (
     KIND_BOOT,
     KIND_NIC_WAKE,
     KIND_PACKET,
+    KIND_PACKET_READY,
     KIND_ROUTER_ARRIVAL,
     KIND_STOP,
     KIND_TCP_TIMER,
@@ -362,6 +363,31 @@ class Manager:
                              KIND_TCP_TIMER):
                 host.net.handle_event(ev, ev.time, ctx)
             elif ev.kind == KIND_PACKET:
+                nic = host.model_nic
+                if nic is not None:
+                    # model-NIC RX stage: CoDel may drop; otherwise the
+                    # payload re-fires as KIND_PACKET_READY after the
+                    # download-bandwidth serialization. Pushed without
+                    # the causality bump: it is this host's own future
+                    # (the device engine inserts into the local heap
+                    # the same way).
+                    size = ev.data[0] if ev.data else 0
+                    deliver = nic.rx_deliver(ev.time, size)
+                    if deliver < 0:
+                        host.packets_dropped += 1
+                    else:
+                        self.policy.push(
+                            Event(time=deliver, dst_host=ev.dst_host,
+                                  src_host=ev.src_host, seq=ev.seq,
+                                  kind=KIND_PACKET_READY, data=ev.data),
+                            simtime.SIMTIME_INVALID)
+                else:
+                    host.packets_delivered += 1
+                    if app is not None:
+                        size = ev.data[0] if ev.data else 0
+                        app.on_packet(ctx, ev.src_host, size,
+                                      ev.data[1:])
+            elif ev.kind == KIND_PACKET_READY:
                 host.packets_delivered += 1
                 if app is not None:
                     size = ev.data[0] if ev.data else 0

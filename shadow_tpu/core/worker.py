@@ -88,6 +88,24 @@ class SimContext:
         # the network judgment can be deferred (batched to the device in
         # hybrid mode) without perturbing any later seq allocation
         ev_seq = host.next_event_seq()
+        if host.model_nic is not None:
+            # bandwidth-modeled raw send: serialize on the TX bucket,
+            # drop-gate at the SEND time (device parity), arrive at
+            # depart+latency. Judged synchronously even in hybrid mode
+            # (the TX state is inherently sequential per host).
+            depart = host.model_nic.tx_depart(self.now, size)
+            verdict = self._m.netmodel.judge(self.now, host.host_id,
+                                             dst_host, pkt_seq)
+            host.packets_sent += 1
+            if not verdict.delivered:
+                host.packets_dropped += 1
+                return False
+            ev = Event(time=depart + verdict.latency_ns,
+                       dst_host=dst_host, src_host=host.host_id,
+                       seq=ev_seq, kind=KIND_PACKET,
+                       data=(size,) + tuple(data))
+            self._m.push_event(ev)
+            return True
         if self._m.net_judge is not None:
             self._m.defer_judgment(self.now, host, dst_host, pkt_seq,
                                    ev_seq, KIND_PACKET,

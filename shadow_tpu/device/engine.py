@@ -65,6 +65,8 @@ IMAX = np.int64(np.iinfo(np.int64).max)
 AXIS = "hosts"
 
 HEAP_FIELDS = ("t", "src", "seq", "kind", "size", "d0", "d1")
+NIC_KEYS = ("tx_free", "rx_free", "cd_fa", "cd_next", "cd_cnt",
+            "cd_last", "cd_drop")
 
 
 @dataclass
@@ -87,6 +89,10 @@ class EngineConfig:
     # outbox volume with 4x headroom for skewed traffic. Overflow is
     # counted per source host and fails the run, never silently lost.
     exchange_capacity: int = 0
+    # bandwidth + CoDel for raw sends (host/model_nic.py's fluid NIC):
+    # TX serialization at send, RX serialization + event-driven CoDel
+    # at delivery via a KIND_PACKET -> KIND_PACKET_READY two-stage pop
+    model_bandwidth: bool = False
 
 
 class DeviceEngine:
@@ -95,7 +101,9 @@ class DeviceEngine:
     def __init__(self, config: EngineConfig, app: DeviceApp,
                  host_vertex: np.ndarray, latency_ns: np.ndarray,
                  reliability: np.ndarray,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 bw_up_bits: Optional[np.ndarray] = None,
+                 bw_down_bits: Optional[np.ndarray] = None):
         self.config = config
         self.app = app
         if mesh is None:
@@ -115,6 +123,14 @@ class DeviceEngine:
         self.latency = latency_ns.astype(np.int32)
         self.reliability = reliability.astype(np.float32)
         self.seed_pair = prng.seed_key(config.seed)
+        # model-NIC bandwidths (bits/s), padded; 1 Gbit default keeps
+        # the padded hosts' arithmetic harmless
+        self.bw_up = np.full(self.H_pad, 10**9, dtype=np.int64)
+        self.bw_down = np.full(self.H_pad, 10**9, dtype=np.int64)
+        if bw_up_bits is not None:
+            self.bw_up[:H] = np.maximum(1, bw_up_bits)
+        if bw_down_bits is not None:
+            self.bw_down[:H] = np.maximum(1, bw_down_bits)
 
         self._shard_spec = P(AXIS)
         self._repl_spec = P()
@@ -171,6 +187,10 @@ class DeviceEngine:
             "x_overflow": zeros_i32.copy(),
             "chk": np.zeros(H, dtype=np.int64),
         }
+        if self.config.model_bandwidth:
+            # model-NIC scalars (host/model_nic.py ModelNic twin)
+            for k in NIC_KEYS:
+                state[k] = np.zeros(H, dtype=np.int64)
         shard = NamedSharding(self.mesh, self._shard_spec)
         return {k: jax.device_put(jnp.asarray(v), shard)
                 for k, v in state.items()}
@@ -198,6 +218,22 @@ class DeviceEngine:
                 f"outbox_capacity ({OB}) must be >= the app's max "
                 f"sends per event ({K}): one event's burst must fit "
                 "or the flow-control phase loop cannot make progress")
+
+        MB = bool(cfg.model_bandwidth)
+        # model-NIC constants (host/model_nic.py twins; keep in
+        # lockstep with its arithmetic — trace equality depends on it)
+        from shadow_tpu.host.model_nic import (
+            CODEL_INTERVAL_NS as CD_INT,
+            CODEL_TARGET_NS as CD_TGT,
+            LAW,
+            LAW_SIZE,
+            MAX_SER_BYTES as MAX_SER,
+        )
+        from shadow_tpu.core.event import KIND_PACKET_READY
+        law_t = jnp.asarray(LAW)                       # [1024] i64
+        bw_up_t = jnp.asarray(self.bw_up)              # [H_pad] i64
+        bw_down_t = jnp.asarray(self.bw_down)
+        NSx8 = np.int64(8) * np.int64(1_000_000_000)
 
         hidx = jnp.arange(H_loc)
 
@@ -230,7 +266,13 @@ class DeviceEngine:
             state["t"] = t.at[hidx, slot].set(jnp.where(runnable, INF, pt))
 
             state["n_exec"] = state["n_exec"] + runnable
-            is_pkt = runnable & (pkind == KIND_PACKET)
+            # with the model NIC, a packet pops twice: the RX stage
+            # (KIND_PACKET: bandwidth+CoDel, no app) and the delivery
+            # (KIND_PACKET_READY). Deliveries are the READY pops then.
+            is_rx = runnable & (pkind == KIND_PACKET) if MB else \
+                jnp.zeros_like(runnable)
+            is_pkt = runnable & (pkind == (KIND_PACKET_READY if MB
+                                           else KIND_PACKET))
             state["n_deliv"] = state["n_deliv"] + is_pkt
             mix = (pt ^ (psrc.astype(jnp.int64) * CHK_SRC)
                    ^ (pkind.astype(jnp.int64) * CHK_KIND)
@@ -239,13 +281,26 @@ class DeviceEngine:
                 runnable, (state["chk"] * CHK_MUL + mix) & MASK63,
                 state["chk"])
 
-            # app dispatch (batched); masked hosts see kind=-1
+            # app dispatch (batched); masked hosts see kind=-1. Under
+            # the model NIC the RX stage is engine-internal (app sees
+            # -1) and READY pops present as KIND_PACKET to the app.
             draw_seqs = state["app_seq"][:, None] + jnp.arange(D,
                                                               dtype=jnp.int32)
             draws = prng.random_bits32(prng.chain_key(
                 seed_pair, PURPOSE_APP, gid[:, None], draw_seqs))
-            out = app.handle(gid, pt, jnp.where(runnable, pkind, -1),
+            if MB:
+                app_kind = jnp.where(pkind == KIND_PACKET_READY,
+                                     jnp.int32(KIND_PACKET), pkind)
+                app_kind = jnp.where(runnable & ~is_rx, app_kind, -1)
+            else:
+                app_kind = jnp.where(runnable, pkind, -1)
+            out = app.handle(gid, pt, app_kind,
                              psrc, psize, pd0, pd1, state["app"], draws)
+            # commit app outputs only for pops the app really handled:
+            # RX-stage pops are engine-internal, and the engine (not
+            # each app's kind=-1 behavior) enforces that their outputs
+            # are discarded
+            app_on = runnable & ~is_rx if MB else runnable
             # apps may return [H,1] columns that broadcast over K/T
             # (e.g. a role-constant dst); materialize full shapes once
             out = out._replace(
@@ -260,13 +315,13 @@ class DeviceEngine:
                 timer_valid=jnp.broadcast_to(out.timer_valid,
                                              (H_loc, T)),
             )
-            state["app"] = jnp.where(runnable[:, None], out.app_state,
+            state["app"] = jnp.where(app_on[:, None], out.app_state,
                                      state["app"])
             state["app_seq"] = state["app_seq"] + \
-                jnp.where(runnable, out.n_draws, 0)
+                jnp.where(app_on, out.n_draws, 0)
 
             # sends -> network judgment (worker_sendPacket semantics)
-            send_valid = out.send_valid & runnable[:, None]     # [H,K]
+            send_valid = out.send_valid & app_on[:, None]       # [H,K]
             vrank = jnp.cumsum(send_valid, axis=-1) - send_valid
             pkt_seq = state["packet_seq"][:, None] + vrank
             state["packet_seq"] = state["packet_seq"] + \
@@ -280,6 +335,23 @@ class DeviceEngine:
             dropped = send_valid & packet_drop_mask(
                 seed_pair, BOOT_END, pt[:, None], gid[:, None],
                 pkt_seq, relv)
+            if MB:
+                # TX fluid bucket (ModelNic.tx_depart): a burst's sends
+                # serialize in slot order; drop-rolled packets still
+                # consume uplink time (the network drops them later)
+                ser_up = jnp.where(
+                    send_valid,
+                    (jnp.clip(out.send_size, 1,
+                              MAX_SER).astype(jnp.int64)
+                     * NSx8) // bw_up_t[gid][:, None],
+                    jnp.int64(0))                                # [H,K]
+                tx_base = jnp.maximum(pt, state["tx_free"])      # [H]
+                cum = jnp.cumsum(ser_up, axis=-1)
+                depart = tx_base[:, None] + (cum - ser_up)
+                state["tx_free"] = jnp.where(
+                    runnable, tx_base + cum[:, -1], state["tx_free"])
+            else:
+                depart = pt[:, None]
             delivered = send_valid & ~dropped
             state["n_sent"] = state["n_sent"] + \
                 send_valid.sum(-1).astype(jnp.int32)
@@ -292,7 +364,7 @@ class DeviceEngine:
             ev_seq = state["event_seq"][:, None] + vrank
             n_snt = send_valid.sum(-1).astype(jnp.int32)
 
-            deliver_t = pt[:, None] + latv
+            deliver_t = depart + latv
             cross = dst != gid[:, None]
             # cross-host causality bump (host_single.c:174-220); self
             # packets keep their true time — they may run this round
@@ -322,6 +394,64 @@ class DeviceEngine:
             ob["d1"] = scat(ob["d1"], out.send_d1)
             ob_cnt = ob_cnt + to_outbox.sum(-1).astype(jnp.int32)
 
+            # model-NIC RX stage (ModelNic.rx_deliver twin): the popped
+            # KIND_PACKET row passes the download bucket + event-driven
+            # CoDel; survivors re-enter the local heap as READY rows at
+            # their post-serialization delivery time (same src/seq)
+            if MB:
+                rxf = state["rx_free"]
+                dq = jnp.maximum(pt, rxf)                       # [H]
+                soj = dq - pt
+                below = soj < CD_TGT
+                fa = state["cd_fa"]
+                fa0 = fa == 0
+                above = ~below & ~fa0 & (dq >= fa)
+                in_drop = state["cd_drop"] != 0
+                drop_now = above & in_drop & (dq >= state["cd_next"])
+                drop_first = above & ~in_drop
+                rx_drop = is_rx & (drop_now | drop_first)
+                rx_keep = is_rx & ~(drop_now | drop_first)
+
+                delta = state["cd_cnt"] - state["cd_last"]
+                first_cnt = jnp.where(
+                    (dq - state["cd_next"] < CD_INT) & (delta > 1),
+                    delta, jnp.int64(1))
+                new_cnt = jnp.where(
+                    drop_now, state["cd_cnt"] + 1,
+                    jnp.where(drop_first, first_cnt, state["cd_cnt"]))
+                law = law_t[jnp.clip(new_cnt, 0, LAW_SIZE - 1)]
+                new_next = jnp.where(
+                    drop_now, state["cd_next"] + law,
+                    jnp.where(drop_first, dq + law, state["cd_next"]))
+                new_last = jnp.where(drop_first, first_cnt,
+                                     state["cd_last"])
+                new_fa = jnp.where(below, jnp.int64(0),
+                                   jnp.where(fa0, dq + CD_INT, fa))
+                new_cd_drop = jnp.where(
+                    below, jnp.int64(0),
+                    jnp.where(fa0, state["cd_drop"],
+                              jnp.where(above,
+                                        jnp.where(in_drop,
+                                                  state["cd_drop"],
+                                                  jnp.int64(1)),
+                                        jnp.int64(0))))
+
+                ser_down = (jnp.clip(psize, 1, MAX_SER)
+                            .astype(jnp.int64) * NSx8) \
+                    // bw_down_t[gid]
+                rx_deliver = dq + ser_down
+                for f_, v_ in (("cd_cnt", new_cnt),
+                               ("cd_next", new_next),
+                               ("cd_last", new_last),
+                               ("cd_fa", new_fa),
+                               ("cd_drop", new_cd_drop)):
+                    state[f_] = jnp.where(is_rx, v_, state[f_])
+                state["rx_free"] = jnp.where(rx_keep, rx_deliver, rxf)
+                state["n_drop"] = state["n_drop"] + rx_drop
+            else:
+                rx_keep = jnp.zeros_like(runnable)
+                rx_deliver = pt
+
             # self-destined sends insert into the local heap immediately
             # (like the CPU engine's push): with a runahead override
             # larger than a self-path latency they must be runnable in
@@ -332,32 +462,42 @@ class DeviceEngine:
             # (slot choice doesn't affect semantics; pops order by
             # (t, src, seq), never by slot index).
             to_self = delivered & ~cross
-            timer_valid = out.timer_valid & runnable[:, None]   # [H,T]
+            timer_valid = out.timer_valid & app_on[:, None]     # [H,T]
             trank = jnp.cumsum(timer_valid, axis=-1) - timer_valid
             tseq = state["event_seq"][:, None] + n_snt[:, None] + trank
             state["event_seq"] = state["event_seq"] + n_snt + \
                 timer_valid.sum(-1).astype(jnp.int32)
 
-            ins_valid = jnp.concatenate([to_self, timer_valid], axis=1)
+            # column layout: K sends | T timers | (MB only) 1 READY
+            # reinsert, which keeps its ORIGINAL sender/seq
+            def cols(*parts):
+                return jnp.concatenate(
+                    parts[:2 + (1 if MB else 0)], axis=1)
+
+            ins_valid = cols(to_self, timer_valid, rx_keep[:, None])
             ins = {
-                "t": jnp.concatenate(
-                    [deliver_t, pt[:, None] + out.timer_delay], axis=1),
-                "seq": jnp.concatenate([ev_seq, tseq],
-                                       axis=1).astype(jnp.int32),
-                "kind": jnp.concatenate(
-                    [jnp.full((H_loc, K), KIND_PACKET, jnp.int32),
-                     jnp.full((H_loc, T), KIND_TIMER, jnp.int32)],
-                    axis=1),
-                "size": jnp.concatenate(
-                    [out.send_size, jnp.zeros((H_loc, T), jnp.int32)],
-                    axis=1),
-                "d0": jnp.concatenate([out.send_d0, out.timer_d0],
-                                      axis=1),
-                "d1": jnp.concatenate(
-                    [out.send_d1, jnp.zeros((H_loc, T), jnp.int32)],
-                    axis=1),
+                "t": cols(deliver_t, pt[:, None] + out.timer_delay,
+                          rx_deliver[:, None]),
+                "seq": cols(ev_seq, tseq,
+                            pseq[:, None]).astype(jnp.int32),
+                "kind": cols(
+                    jnp.full((H_loc, K), KIND_PACKET, jnp.int32),
+                    jnp.full((H_loc, T), KIND_TIMER, jnp.int32),
+                    jnp.full((H_loc, 1), KIND_PACKET_READY,
+                             jnp.int32)),
+                "size": cols(out.send_size,
+                             jnp.zeros((H_loc, T), jnp.int32),
+                             psize[:, None]),
+                "d0": cols(out.send_d0, out.timer_d0, pd0[:, None]),
+                "d1": cols(out.send_d1,
+                           jnp.zeros((H_loc, T), jnp.int32),
+                           pd1[:, None]),
+                "src": cols(
+                    jnp.broadcast_to(gid[:, None], (H_loc, K)),
+                    jnp.broadcast_to(gid[:, None], (H_loc, T)),
+                    psrc[:, None]),
             }
-            M = K + T
+            M = K + T + (1 if MB else 0)
             free = state["t"] == INF                            # [H,E]
             slot_order = jnp.argsort(
                 jnp.where(free, 0, E) + jnp.arange(E)[None, :],
@@ -376,7 +516,7 @@ class DeviceEngine:
                     vals, mode="drop")
 
             bscat("t", ins["t"])
-            bscat("src", jnp.broadcast_to(gid[:, None], (H_loc, M)))
+            bscat("src", ins["src"])
             bscat("seq", ins["seq"])
             bscat("kind", ins["kind"])
             bscat("size", ins["size"])
@@ -626,11 +766,12 @@ class DeviceEngine:
             nxt = _axis_min(state["t"].min())
             return state, nxt
 
-        specs = {k: self._shard_spec for k in
-                 ("t", "src", "seq", "kind", "size", "d0", "d1",
-                  "event_seq", "packet_seq", "app_seq", "app",
-                  "n_exec", "n_sent", "n_drop", "n_deliv", "overflow",
-                  "x_overflow", "chk")}
+        spec_keys = ("t", "src", "seq", "kind", "size", "d0", "d1",
+                     "event_seq", "packet_seq", "app_seq", "app",
+                     "n_exec", "n_sent", "n_drop", "n_deliv",
+                     "overflow", "x_overflow", "chk") + \
+            (NIC_KEYS if MB else ())
+        specs = {k: self._shard_spec for k in spec_keys}
         repl = self._repl_spec
         self._run = jax.jit(jax.shard_map(
             _run_shard, mesh=self.mesh,

@@ -168,12 +168,17 @@ class DeviceRunner:
                 seed=cfg.general.seed,
                 exchange=cfg.experimental.exchange,
                 exchange_capacity=cfg.experimental.exchange_capacity,
+                model_bandwidth=cfg.experimental.model_bandwidth,
             ),
             self.app,
             host_vertex=sim.netmodel.host_vertex.astype(np.int32),
             latency_ns=sim.topology.latency_ns,
             reliability=sim.topology.reliability,
             mesh=mesh,
+            bw_up_bits=np.array([h.bw_up_bits for h in sim.hosts],
+                                dtype=np.int64),
+            bw_down_bits=np.array([h.bw_down_bits for h in sim.hosts],
+                                  dtype=np.int64),
         )
         self.final_state: Optional[dict] = None
 

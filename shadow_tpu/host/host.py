@@ -27,6 +27,7 @@ class Host:
     app: Any = None             # ModelApp instance (interpose=model)
     net: Any = None             # HostNetStack (CPU engines)
     cpu: Any = None             # host/cpu.py Cpu delay model
+    model_nic: Any = None       # host/model_nic.py ModelNic (raw sends)
     tracker: Any = None         # host/tracker.py Tracker
     address: Any = None         # routing/address.py Address (via DNS)
     pcap_directory: Optional[str] = None

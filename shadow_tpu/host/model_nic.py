@@ -1,0 +1,127 @@
+"""Bandwidth + CoDel for raw model-app sends ("model NIC").
+
+The socket path (host/nic.py + routing/queues.py) models bandwidth
+with token buckets and queues of Packet objects — per-object state
+that cannot live on the device. This module is the *vectorizable*
+transport model used by raw ctx.send() traffic when
+`experimental.model_bandwidth: true`: a fluid token bucket expressed
+as virtual finish times (the scalar-per-host limit of the reference's
+1 ms-refill buckets, network_interface.c:99-228) plus an event-driven
+CoDel (RFC 8289, router_queue_codel.c:36-79) that decides one packet
+per delivery event.
+
+Semantics, identical by construction on the CPU engines and the device
+engine (device/engine.py mirrors this arithmetic in jnp — keep them in
+lockstep):
+
+* TX at send time t of a packet of S bytes on host h:
+    depart = max(t, tx_free);  tx_free = depart + S*8e9//bw_up
+  (bursts within one event serialize in slot order). The drop roll and
+  latency are applied on top: arrival = depart + latency; the
+  bootstrap/drop gate uses the send event time t.
+* RX at the packet event's execution on the destination (time arr):
+    dq = max(arr, rx_free);  sojourn = dq - arr
+    CoDel(sojourn, dq) may drop; otherwise
+    deliver = dq + S*8e9//bw_down;  rx_free = deliver
+  and the payload is re-scheduled as a KIND_PACKET_READY event at
+  `deliver` (same src/seq — the app sees it then).
+* CoDel control law uses an integer lookup table LAW[count] =
+  interval/sqrt(count) so CPU float64 and device float32 can never
+  disagree.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from shadow_tpu import simtime
+
+CODEL_TARGET_NS = 10 * simtime.SIMTIME_ONE_MILLISECOND
+CODEL_INTERVAL_NS = 100 * simtime.SIMTIME_ONE_MILLISECOND
+LAW_SIZE = 1024
+
+_NS_PER_SEC = 1_000_000_000
+# serialization sizes clamp to 1 GiB: size*8e9 must fit int64 on the
+# device twin (which cannot use Python bigints); both twins clamp
+# identically so traces stay equal
+MAX_SER_BYTES = 1 << 30
+
+
+def codel_law_table(interval_ns: int = CODEL_INTERVAL_NS) -> np.ndarray:
+    """LAW[c] = interval/sqrt(c) ns (c=0 unused)."""
+    t = np.zeros(LAW_SIZE, dtype=np.int64)
+    for c in range(1, LAW_SIZE):
+        t[c] = int(interval_ns / math.sqrt(c))
+    return t
+
+
+LAW = codel_law_table()
+
+
+def serialize_ns(size_bytes: int, bw_bits: int) -> int:
+    return (min(max(1, size_bytes), MAX_SER_BYTES) * 8 * _NS_PER_SEC) \
+        // max(1, bw_bits)
+
+
+class ModelNic:
+    """Per-host model-NIC state (CPU twin of the device's 7 scalars:
+    tx_free, rx_free, cd_fa, cd_next, cd_cnt, cd_last, cd_drop)."""
+
+    def __init__(self, bw_up_bits: int, bw_down_bits: int):
+        self.bw_up = bw_up_bits
+        self.bw_down = bw_down_bits
+        self.tx_free = 0
+        self.rx_free = 0
+        self.cd_fa = 0          # first_above_time
+        self.cd_next = 0        # drop_next
+        self.cd_cnt = 0
+        self.cd_last = 0        # lastcount
+        self.cd_drop = 0        # in dropping state
+
+    # -- TX ------------------------------------------------------------
+    def tx_depart(self, now: int, size: int) -> int:
+        depart = max(now, self.tx_free)
+        self.tx_free = depart + serialize_ns(size, self.bw_up)
+        return depart
+
+    # -- RX + event-driven CoDel ----------------------------------------
+    def rx_deliver(self, arr: int, size: int) -> int:
+        """Returns the delivery time, or -1 if CoDel dropped the
+        packet. One packet per call — the event-driven adaptation of
+        RFC 8289's dequeue loop; the device implements this exact
+        decision tree."""
+        dq = max(arr, self.rx_free)
+        sojourn = dq - arr
+        drop = False
+        if sojourn < CODEL_TARGET_NS:
+            self.cd_fa = 0
+            self.cd_drop = 0
+        elif self.cd_fa == 0:
+            self.cd_fa = dq + CODEL_INTERVAL_NS
+        elif dq >= self.cd_fa:
+            if self.cd_drop:
+                if dq >= self.cd_next:
+                    drop = True
+                    self.cd_cnt += 1
+                    self.cd_next = self.cd_next + int(
+                        LAW[min(self.cd_cnt, LAW_SIZE - 1)])
+            else:
+                drop = True
+                self.cd_drop = 1
+                delta = self.cd_cnt - self.cd_last
+                if dq - self.cd_next < CODEL_INTERVAL_NS and delta > 1:
+                    self.cd_cnt = delta
+                else:
+                    self.cd_cnt = 1
+                self.cd_last = self.cd_cnt
+                self.cd_next = dq + int(
+                    LAW[min(self.cd_cnt, LAW_SIZE - 1)])
+        else:
+            self.cd_drop = 0
+        if drop:
+            return -1
+        deliver = dq + serialize_ns(size, self.bw_down)
+        self.rx_free = deliver
+        return deliver

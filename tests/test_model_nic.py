@@ -1,0 +1,126 @@
+"""Model-NIC (bandwidth + CoDel) on both engines.
+
+experimental.model_bandwidth routes raw ctx.send() traffic through the
+fluid TX/RX buckets and the event-driven CoDel (host/model_nic.py on
+the CPU engines; the same arithmetic vectorized in device/engine.py).
+The oracle test is the framework's standard one: bit-identical trace
+checksums between the serial CPU run and the device run on a
+bandwidth-CONSTRAINED config — bandwidth delays and CoDel drops are
+part of the schedule, so any divergence in their arithmetic shows up.
+"""
+
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.host.model_nic import ModelNic, serialize_ns
+
+YAML = """
+general:
+  stop_time: {stop}
+  seed: {seed}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 bandwidth_down "{bw}" bandwidth_up "{bw}" ]
+        node [ id 1 bandwidth_down "{bw}" bandwidth_up "{bw}" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss {loss} ]
+        edge [ source 0 target 1 latency "10 ms" packet_loss {loss} ]
+        edge [ source 1 target 1 latency "10 ms" packet_loss {loss} ]
+      ]
+experimental:
+  scheduler_policy: {policy}
+  model_bandwidth: true
+  event_capacity: 96
+  outbox_capacity: 48
+hosts:
+  left:
+    quantity: 8
+    network_node_id: 0
+    processes:
+    - path: model:phold
+      args: msgload={msgload} size={size}
+      start_time: 10ms
+  right:
+    quantity: 8
+    network_node_id: 1
+    processes:
+    - path: model:phold
+      args: msgload={msgload} size={size}
+      start_time: 10ms
+"""
+
+
+def _run(policy, bw="1 Mbit", seed=3, loss=0.0, msgload=3,
+         size=4096, stop="3s"):
+    c = Controller(load_config_str(YAML.format(
+        policy=policy, bw=bw, seed=seed, loss=loss, msgload=msgload,
+        size=size, stop=stop)))
+    stats = c.run()
+    return stats, c.sim.hosts
+
+
+def test_model_nic_unit_tx_rx():
+    nic = ModelNic(bw_up_bits=8_000_000, bw_down_bits=8_000_000)
+    # 1000 bytes at 8 Mbit = 1 ms serialization
+    assert serialize_ns(1000, 8_000_000) == 1_000_000
+    assert nic.tx_depart(10_000, 1000) == 10_000
+    # second send in the same instant queues behind the first
+    assert nic.tx_depart(10_000, 1000) == 1_010_000
+    # rx: no standing queue -> no drop, serialization delay applies
+    d = nic.rx_deliver(5_000_000, 1000)
+    assert d == 6_000_000
+    d2 = nic.rx_deliver(5_000_000, 1000)   # queued behind the first
+    assert d2 == 7_000_000
+
+
+def test_model_nic_codel_drops_standing_queue():
+    """A long steady overload must trigger CoDel drops (sojourn above
+    the 10 ms target for over the 100 ms interval)."""
+    nic = ModelNic(bw_up_bits=10**9, bw_down_bits=800_000)
+    # 1000-byte packets arriving every 1 ms but taking 10 ms to drain
+    drops = 0
+    t = 0
+    for i in range(400):
+        t += 1_000_000
+        if nic.rx_deliver(t, 1000) < 0:
+            drops += 1
+    assert drops > 0
+    assert nic.cd_cnt > 1          # control law escalated
+
+
+@pytest.mark.parametrize("bw,loss", [("1 Mbit", 0.0),
+                                     ("2 Mbit", 0.05)],
+                         ids=["constrained", "constrained_lossy"])
+def test_device_matches_serial_oracle_with_bandwidth(bw, loss):
+    s_stats, s_hosts = _run("serial", bw=bw, loss=loss)
+    d_stats, d_hosts = _run("tpu", bw=bw, loss=loss)
+    assert d_stats.ok
+    assert s_stats.events_executed == d_stats.events_executed
+    assert s_stats.packets_sent == d_stats.packets_sent
+    assert s_stats.packets_dropped == d_stats.packets_dropped
+    assert s_stats.packets_delivered == d_stats.packets_delivered
+    for sh, dh in zip(s_hosts, d_hosts):
+        assert sh.trace_checksum == dh.trace_checksum, sh.name
+
+
+def test_bandwidth_actually_constrains():
+    """Same workload, 1000x less bandwidth -> fewer deliveries by the
+    stop time (serialization pushes traffic past the horizon) and/or
+    CoDel drops; and the constrained run must differ from the
+    unconstrained schedule."""
+    wide, _ = _run("serial", bw="1 Gbit")
+    narrow, _ = _run("serial", bw="500 Kbit", size=16384)
+    assert narrow.packets_delivered < wide.packets_delivered
+
+
+def test_hybrid_matches_serial_with_bandwidth():
+    s_stats, s_hosts = _run("serial", bw="1 Mbit")
+    h_stats, h_hosts = _run("hybrid", bw="1 Mbit")
+    assert s_stats.packets_sent == h_stats.packets_sent
+    assert s_stats.packets_dropped == h_stats.packets_dropped
+    for sh, hh in zip(s_hosts, h_hosts):
+        assert sh.trace_checksum == hh.trace_checksum, sh.name
