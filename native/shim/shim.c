@@ -32,7 +32,13 @@
 
 #define _GNU_SOURCE
 #include <errno.h>
+#include <ifaddrs.h>
+#include <net/if.h>
+#include <netdb.h>
+#include <netinet/in.h>
 #include <stdio.h>
+#include <sys/socket.h>
+#include <sys/utsname.h>
 #include <linux/audit.h>
 #include <linux/filter.h>
 #include <linux/futex.h>
@@ -425,16 +431,37 @@ static long shim_handle_clone(const long args[6]) {
 /* rt_sigprocmask with SIGSYS stripped from block requests: if the app
  * (glibc blocks ALL signals around pthread_create's clone) could mask
  * SIGSYS, the next seccomp trap would be force-killed instead of
- * handled. Runs entirely shim-side — no simulator round trip. */
+ * handled. Runs entirely shim-side — no simulator round trip.
+ *
+ * Subtlety: this executes INSIDE the SIGSYS handler, and the trap
+ * frame's sigreturn will restore the PRE-trap mask afterwards —
+ * silently undoing the app's request (e.g. siglongjmp's mask restore
+ * out of a signal handler would leave SIGSEGV blocked forever and the
+ * next TSC trap would force-kill). So the resulting mask is mirrored
+ * into the trap frame's uc_sigmask: sigreturn then installs exactly
+ * what the app asked for. */
 static long shim_sigprocmask(const long a[6]) {
   const uint64_t *set = (const uint64_t *)a[1];
+  long r;
   if (set && a[0] != 1 /* != SIG_UNBLOCK */ && a[3] == 8) {
     uint64_t copy = *set & ~(1ULL << (SIGSYS - 1));
-    return shim_rawsyscall(SYS_rt_sigprocmask, a[0], (long)&copy, a[2],
-                           8, 0, 0);
+    r = shim_rawsyscall(SYS_rt_sigprocmask, a[0], (long)&copy, a[2],
+                        8, 0, 0);
+  } else {
+    r = shim_rawsyscall(SYS_rt_sigprocmask, a[0], a[1], a[2], a[3],
+                        0, 0);
   }
-  return shim_rawsyscall(SYS_rt_sigprocmask, a[0], a[1], a[2], a[3],
-                         0, 0);
+  if (r == 0 && set && t_trap_ctx) {
+    uint64_t cur = 0;
+    if (shim_rawsyscall(SYS_rt_sigprocmask, 0 /* SIG_BLOCK */, 0,
+                        (long)&cur, 8, 0, 0) == 0) {
+      /* kernel sigsets are 8 bytes; uc_sigmask's first word is what
+       * sigreturn installs */
+      uint64_t *frame = (uint64_t *)&t_trap_ctx->uc_sigmask;
+      *frame = cur & ~(1ULL << (SIGSYS - 1));
+    }
+  }
+  return r;
 }
 
 static long shim_do_syscall(long nr, const long args[6]) {
@@ -705,6 +732,491 @@ unsigned int sleep(unsigned int seconds) {
   return nanosleep(&req, NULL) == 0 ? 0 : seconds;
 }
 
+/* ---- name resolution (preload_libraries.c:30-120 analogue) --------- */
+/* Managed processes resolve simulated hostnames from the simulator's
+ * hosts file (dns.c's /etc/hosts emission) without ever touching the
+ * real resolver: getaddrinfo/getifaddrs/gethostname are overridden
+ * here. File IO below runs natively (the BPF filter only gates
+ * virtual-range fds), and none of this runs in signal context. */
+
+static char g_hostname[256];
+static char g_hosts_path[512];
+static uint32_t g_host_ip_net; /* network byte order; 0 = unknown */
+
+static int shim_parse_ip(const char *s, uint32_t *out_net) {
+  /* dotted-quad parser (avoids pulling inet_pton into the shim) */
+  uint32_t parts[4];
+  int i = 0;
+  const char *p = s;
+  for (i = 0; i < 4; i++) {
+    if (*p < '0' || *p > '9')
+      return 0;
+    uint32_t v = 0;
+    while (*p >= '0' && *p <= '9') {
+      v = v * 10 + (uint32_t)(*p - '0');
+      if (v > 255)
+        return 0;
+      p++;
+    }
+    parts[i] = v;
+    if (i < 3) {
+      if (*p != '.')
+        return 0;
+      p++;
+    }
+  }
+  if (*p != '\0')
+    return 0;
+  *out_net = (uint32_t)((parts[0]) | (parts[1] << 8) | (parts[2] << 16) |
+                        (parts[3] << 24));
+  return 1;
+}
+
+/* The hosts file is immutable for the run: parse it ONCE into a
+ * table so lookups at 10k-host scale cost no repeated IO. */
+typedef struct {
+  char name[64];
+  uint32_t ip_net;
+} HostEntry;
+static HostEntry *g_hosts_tab = NULL;
+static size_t g_hosts_n = 0;
+static int g_hosts_loaded = 0;
+
+static void shim_load_hosts(void) {
+  if (g_hosts_loaded)
+    return;
+  g_hosts_loaded = 1;
+  if (!g_hosts_path[0])
+    return;
+  FILE *f = fopen(g_hosts_path, "r");
+  if (!f)
+    return;
+  size_t cap = 0;
+  char line[512];
+  while (fgets(line, sizeof line, f)) {
+    char *save = NULL;
+    char *ip_tok = strtok_r(line, " \t\r\n", &save);
+    if (!ip_tok || ip_tok[0] == '#')
+      continue;
+    uint32_t ip;
+    if (!shim_parse_ip(ip_tok, &ip))
+      continue;
+    char *tok;
+    while ((tok = strtok_r(NULL, " \t\r\n", &save)) != NULL) {
+      if (g_hosts_n == cap) {
+        cap = cap ? cap * 2 : 64;
+        HostEntry *nt = realloc(g_hosts_tab, cap * sizeof *nt);
+        if (!nt)
+          goto out;
+        g_hosts_tab = nt;
+      }
+      snprintf(g_hosts_tab[g_hosts_n].name,
+               sizeof g_hosts_tab[g_hosts_n].name, "%s", tok);
+      g_hosts_tab[g_hosts_n].ip_net = ip;
+      g_hosts_n++;
+    }
+  }
+out:
+  fclose(f);
+}
+
+static int shim_lookup_hosts(const char *name, uint32_t *out_net) {
+  if (g_hostname[0] && g_host_ip_net && strcmp(name, g_hostname) == 0) {
+    *out_net = g_host_ip_net;
+    return 1;
+  }
+  shim_load_hosts();
+  for (size_t i = 0; i < g_hosts_n; i++) {
+    if (strcmp(g_hosts_tab[i].name, name) == 0) {
+      *out_net = g_hosts_tab[i].ip_net;
+      return 1;
+    }
+  }
+  return 0;
+}
+
+static const char *shim_reverse_hosts(uint32_t ip_net) {
+  if (g_host_ip_net && ip_net == g_host_ip_net && g_hostname[0])
+    return g_hostname;
+  shim_load_hosts();
+  for (size_t i = 0; i < g_hosts_n; i++)
+    if (g_hosts_tab[i].ip_net == ip_net)
+      return g_hosts_tab[i].name;
+  return NULL;
+}
+
+/* dlsym(RTLD_NEXT) fallbacks: when the shim is dormant/disabled the
+ * overrides defer to the real libc so a plain process stays usable. */
+#include <dlfcn.h>
+#define SHIM_REAL(name) \
+  (__typeof__(&name))(uintptr_t)dlsym(RTLD_NEXT, #name)
+
+struct shim_addrinfo_blk {
+  struct addrinfo ai;
+  struct sockaddr_in sa;
+  char canon[256];
+};
+
+static struct addrinfo *shim_make_ai(uint32_t ip_net, uint16_t port,
+                                     int socktype, int protocol,
+                                     int flags, const char *canon) {
+  struct shim_addrinfo_blk *b = calloc(1, sizeof *b);
+  if (!b)
+    return NULL;
+  b->sa.sin_family = AF_INET;
+  b->sa.sin_port = htons(port);
+  b->sa.sin_addr.s_addr = ip_net;
+  b->ai.ai_family = AF_INET;
+  b->ai.ai_socktype = socktype;
+  b->ai.ai_protocol = protocol;
+  b->ai.ai_addrlen = sizeof(struct sockaddr_in);
+  b->ai.ai_addr = (struct sockaddr *)&b->sa;
+  if ((flags & AI_CANONNAME) && canon) {
+    snprintf(b->canon, sizeof b->canon, "%s", canon);
+    b->ai.ai_canonname = b->canon;
+  }
+  return &b->ai;
+}
+
+int getaddrinfo(const char *node, const char *service,
+                const struct addrinfo *hints, struct addrinfo **res) {
+  if (!g_enabled) {
+    int (*real)(const char *, const char *, const struct addrinfo *,
+                struct addrinfo **) = SHIM_REAL(getaddrinfo);
+    return real ? real(node, service, hints, res) : EAI_FAIL;
+  }
+  if (!res)
+    return EAI_FAIL;
+  int flags = hints ? hints->ai_flags : 0;
+  int family = hints ? hints->ai_family : AF_UNSPEC;
+  int socktype = hints ? hints->ai_socktype : 0;
+  if (family != AF_UNSPEC && family != AF_INET)
+    return EAI_FAMILY; /* the simulated internet is IPv4 */
+
+  uint32_t ip_net = 0;
+  if (node == NULL) {
+    ip_net = (flags & AI_PASSIVE) ? 0u /* INADDR_ANY */
+                                  : htonl(0x7F000001u /* loopback */);
+  } else if (shim_parse_ip(node, &ip_net)) {
+    /* numeric */
+  } else if (flags & AI_NUMERICHOST) {
+    return EAI_NONAME;
+  } else if (!shim_lookup_hosts(node, &ip_net)) {
+    return EAI_NONAME;
+  }
+  uint16_t port = 0;
+  if (service) {
+    /* numeric services only (no in-sim /etc/services); port 0 is
+     * valid (bind-ephemeral idiom) */
+    char *end = NULL;
+    long p = strtol(service, &end, 10);
+    if (end == service || *end != '\0' || p < 0 || p > 65535)
+      return EAI_SERVICE;
+    port = (uint16_t)p;
+  }
+
+  struct addrinfo *head = NULL, **tail = &head;
+  const int types[2][2] = {{SOCK_STREAM, IPPROTO_TCP},
+                           {SOCK_DGRAM, IPPROTO_UDP}};
+  for (int i = 0; i < 2; i++) {
+    if (socktype && socktype != types[i][0])
+      continue;
+    struct addrinfo *ai = shim_make_ai(ip_net, port, types[i][0],
+                                       types[i][1], flags, node);
+    if (!ai) {
+      freeaddrinfo(head);
+      return EAI_MEMORY;
+    }
+    *tail = ai;
+    tail = &ai->ai_next;
+  }
+  if (!head)
+    return EAI_SOCKTYPE;
+  *res = head;
+  return 0;
+}
+
+void freeaddrinfo(struct addrinfo *res) {
+  if (!g_enabled) {
+    void (*real)(struct addrinfo *) = SHIM_REAL(freeaddrinfo);
+    if (real) {
+      real(res);
+      return;
+    }
+  }
+  /* when enabled, every addrinfo came from the override above */
+  while (res) {
+    struct addrinfo *next = res->ai_next;
+    free(res);
+    res = next;
+  }
+}
+
+int gethostname(char *name, size_t len) {
+  const char *src = g_hostname;
+  struct utsname u;
+  if (!g_enabled || !g_hostname[0]) {
+    /* fall back to the (emulated, when live) uname nodename */
+    if (uname(&u) != 0)
+      return -1;
+    src = u.nodename;
+  }
+  size_t need = strlen(src);
+  if (len <= need) {
+    errno = ENAMETOOLONG;
+    return -1;
+  }
+  memcpy(name, src, need + 1);
+  return 0;
+}
+
+/* legacy resolver APIs: without these, gethostbyname would leak to the
+ * real NSS stack (wrong /etc/hosts, nondeterministic DNS attempts into
+ * the simulated network) */
+static struct hostent g_he;
+static char g_he_name[64];
+static char *g_he_aliases[1];
+static uint32_t g_he_ip;
+static char *g_he_addr_list[2];
+
+struct hostent *gethostbyname(const char *name) {
+  if (!g_enabled) {
+    struct hostent *(*real)(const char *) = SHIM_REAL(gethostbyname);
+    return real ? real(name) : NULL;
+  }
+  uint32_t ip_net;
+  if (!shim_parse_ip(name, &ip_net) &&
+      !shim_lookup_hosts(name, &ip_net)) {
+    h_errno = HOST_NOT_FOUND;
+    return NULL;
+  }
+  snprintf(g_he_name, sizeof g_he_name, "%s", name);
+  g_he_ip = ip_net;
+  g_he_aliases[0] = NULL;
+  g_he_addr_list[0] = (char *)&g_he_ip;
+  g_he_addr_list[1] = NULL;
+  g_he.h_name = g_he_name;
+  g_he.h_aliases = g_he_aliases;
+  g_he.h_addrtype = AF_INET;
+  g_he.h_length = 4;
+  g_he.h_addr_list = g_he_addr_list;
+  return &g_he;
+}
+
+int getnameinfo(const struct sockaddr *addr, socklen_t addrlen,
+                char *host, socklen_t hostlen, char *serv,
+                socklen_t servlen, int flags) {
+  if (!g_enabled) {
+    int (*real)(const struct sockaddr *, socklen_t, char *, socklen_t,
+                char *, socklen_t, int) = SHIM_REAL(getnameinfo);
+    return real ? real(addr, addrlen, host, hostlen, serv, servlen,
+                       flags)
+                : EAI_FAIL;
+  }
+  if (!addr || addrlen < (socklen_t)sizeof(struct sockaddr_in) ||
+      addr->sa_family != AF_INET)
+    return EAI_FAMILY;
+  const struct sockaddr_in *sa = (const struct sockaddr_in *)addr;
+  if (host && hostlen) {
+    const char *name = (flags & NI_NUMERICHOST)
+                           ? NULL
+                           : shim_reverse_hosts(sa->sin_addr.s_addr);
+    if (name) {
+      snprintf(host, hostlen, "%s", name);
+    } else if (flags & NI_NAMEREQD) {
+      return EAI_NONAME;
+    } else {
+      uint32_t ip = ntohl(sa->sin_addr.s_addr);
+      snprintf(host, hostlen, "%u.%u.%u.%u", (ip >> 24) & 255,
+               (ip >> 16) & 255, (ip >> 8) & 255, ip & 255);
+    }
+  }
+  if (serv && servlen)
+    snprintf(serv, servlen, "%u", (unsigned)ntohs(sa->sin_port));
+  return 0;
+}
+
+struct shim_ifaddrs_blk {
+  struct ifaddrs ifa;
+  struct sockaddr_in addr, mask, brd;
+  char name[16];
+};
+
+static struct ifaddrs *shim_make_ifa(const char *name, uint32_t ip_net,
+                                     uint32_t mask_net,
+                                     unsigned int extra_flags) {
+  struct shim_ifaddrs_blk *b = calloc(1, sizeof *b);
+  if (!b)
+    return NULL;
+  snprintf(b->name, sizeof b->name, "%s", name);
+  b->ifa.ifa_name = b->name;
+  b->ifa.ifa_flags = IFF_UP | IFF_RUNNING | extra_flags;
+  b->addr.sin_family = AF_INET;
+  b->addr.sin_addr.s_addr = ip_net;
+  b->mask.sin_family = AF_INET;
+  b->mask.sin_addr.s_addr = mask_net;
+  b->brd.sin_family = AF_INET;
+  b->brd.sin_addr.s_addr = ip_net | ~mask_net;
+  b->ifa.ifa_addr = (struct sockaddr *)&b->addr;
+  b->ifa.ifa_netmask = (struct sockaddr *)&b->mask;
+  b->ifa.ifa_broadaddr = (struct sockaddr *)&b->brd;
+  return &b->ifa;
+}
+
+int getifaddrs(struct ifaddrs **ifap) {
+  if (!g_enabled) {
+    int (*real)(struct ifaddrs **) = SHIM_REAL(getifaddrs);
+    if (real)
+      return real(ifap);
+    errno = ENOSYS;
+    return -1;
+  }
+  if (!ifap) {
+    errno = EINVAL;
+    return -1;
+  }
+  struct ifaddrs *lo = shim_make_ifa("lo", htonl(0x7F000001u),
+                                     htonl(0xFF000000u), IFF_LOOPBACK);
+  if (!lo) {
+    errno = ENOMEM;
+    return -1;
+  }
+  if (g_host_ip_net) {
+    struct ifaddrs *eth = shim_make_ifa("eth0", g_host_ip_net,
+                                        htonl(0xFFFFFFFFu), 0);
+    if (!eth) {
+      free(lo);
+      errno = ENOMEM;
+      return -1;
+    }
+    lo->ifa_next = eth;
+  }
+  *ifap = lo;
+  return 0;
+}
+
+void freeifaddrs(struct ifaddrs *ifa) {
+  if (!g_enabled) {
+    void (*real)(struct ifaddrs *) = SHIM_REAL(freeifaddrs);
+    if (real) {
+      real(ifa);
+      return;
+    }
+  }
+  while (ifa) {
+    struct ifaddrs *next = ifa->ifa_next;
+    free(ifa);
+    ifa = next;
+  }
+}
+
+/* ---- TSC emulation (preload mode; lib/tsc/tsc.c analogue) ---------- */
+/* prctl(PR_SET_TSC, PR_TSC_SIGSEGV) makes every rdtsc/rdtscp raise
+ * SIGSEGV; the handler decodes the instruction and synthesizes the
+ * counter from SIMULATED time at a nominal 1 GHz (cycles == sim ns —
+ * the same convention as the ptrace backend's Tsc emulation), so
+ * plugin time reads via TSC are deterministic. */
+
+#ifndef PR_SET_TSC
+#define PR_SET_TSC 26
+#endif
+#ifndef PR_TSC_SIGSEGV
+#define PR_TSC_SIGSEGV 2
+#endif
+
+/* The app may install its own SIGSEGV handler (Go, JVM, ASan do); the
+ * shim must stay first in line or every rdtsc after that would hit
+ * the app's handler as an inexplicable fault. sigaction/signal are
+ * overridden below to STASH the app's SIGSEGV disposition; real
+ * faults chain to it. */
+static struct sigaction g_app_segv;
+static int g_app_segv_set = 0;
+/* resolved once at init: dlsym is not async-signal-safe, and the
+ * overridden signal()/sigaction() must never be re-entered from the
+ * fault path */
+static int (*g_real_sigaction)(int, const struct sigaction *,
+                               struct sigaction *) = NULL;
+
+static void shim_chain_segv(int sig, siginfo_t *info, void *vctx) {
+  if (g_app_segv_set) {
+    if (g_app_segv.sa_flags & SA_SIGINFO) {
+      if (g_app_segv.sa_sigaction) {
+        g_app_segv.sa_sigaction(sig, info, vctx);
+        return;
+      }
+    } else if (g_app_segv.sa_handler != SIG_DFL &&
+               g_app_segv.sa_handler != SIG_IGN &&
+               g_app_segv.sa_handler != NULL) {
+      g_app_segv.sa_handler(sig);
+      return;
+    } else if (g_app_segv.sa_handler == SIG_IGN) {
+      return;
+    }
+  }
+  /* default: restore SIG_DFL and let the kernel re-raise on return */
+  struct sigaction dfl;
+  memset(&dfl, 0, sizeof dfl);
+  dfl.sa_handler = SIG_DFL;
+  if (g_real_sigaction)
+    g_real_sigaction(sig, &dfl, NULL);
+}
+
+static void sigsegv_handler(int sig, siginfo_t *info, void *vctx) {
+  ucontext_t *ctx = (ucontext_t *)vctx;
+  greg_t *g = ctx->uc_mcontext.gregs;
+  const uint8_t *ip = (const uint8_t *)g[REG_RIP];
+  int is_rdtsc = ip && ip[0] == 0x0F && ip[1] == 0x31;
+  int is_rdtscp = ip && ip[0] == 0x0F && ip[1] == 0x01 && ip[2] == 0xF9;
+  if (!g_enabled || (!is_rdtsc && !is_rdtscp)) {
+    shim_chain_segv(sig, info, vctx);
+    return;
+  }
+  struct timespec ts;
+  long args[6] = {1 /* CLOCK_MONOTONIC */, (long)&ts, 0, 0, 0, 0};
+  long r = shim_emulated_syscall(SYS_clock_gettime, args);
+  uint64_t cycles = 0;
+  if (r == 0)
+    cycles = (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+  g[REG_RAX] = (greg_t)(cycles & 0xFFFFFFFFu);
+  g[REG_RDX] = (greg_t)(cycles >> 32);
+  if (is_rdtscp) {
+    g[REG_RCX] = 0; /* IA32_TSC_AUX: virtual cpu 0 */
+    g[REG_RIP] += 3;
+  } else {
+    g[REG_RIP] += 2;
+  }
+}
+
+int sigaction(int signum, const struct sigaction *act,
+              struct sigaction *oldact) {
+  int (*real)(int, const struct sigaction *, struct sigaction *) =
+      g_real_sigaction ? g_real_sigaction : SHIM_REAL(sigaction);
+  if (!g_enabled || signum != SIGSEGV || !real)
+    return real ? real(signum, act, oldact)
+                : (errno = ENOSYS, -1);
+  if (oldact)
+    *oldact = g_app_segv_set ? g_app_segv
+                             : (struct sigaction){.sa_handler = SIG_DFL};
+  if (act) {
+    g_app_segv = *act;
+    g_app_segv_set = 1;
+  }
+  return 0; /* the shim's handler stays installed */
+}
+
+sighandler_t signal(int signum, sighandler_t handler) {
+  if (!g_enabled || signum != SIGSEGV) {
+    sighandler_t (*real)(int, sighandler_t) = SHIM_REAL(signal);
+    return real ? real(signum, handler) : SIG_ERR;
+  }
+  sighandler_t old =
+      g_app_segv_set ? g_app_segv.sa_handler : SIG_DFL;
+  memset(&g_app_segv, 0, sizeof g_app_segv);
+  g_app_segv.sa_handler = handler;
+  g_app_segv_set = 1;
+  return old;
+}
+
 /* ---- init ---------------------------------------------------------- */
 
 static void shim_log_fail(const char *msg) {
@@ -754,10 +1266,33 @@ __attribute__((constructor)) static void shim_init(void) {
     return;
   }
 
+  const char *hn = getenv("SHADOWTPU_HOSTNAME");
+  if (hn)
+    snprintf(g_hostname, sizeof g_hostname, "%s", hn);
+  const char *hf = getenv("SHADOWTPU_HOSTS_FILE");
+  if (hf)
+    snprintf(g_hosts_path, sizeof g_hosts_path, "%s", hf);
+  const char *hip = getenv("SHADOWTPU_HOST_IP");
+  if (hip)
+    shim_parse_ip(hip, &g_host_ip_net);
+
   g_enabled = 1;
   if (shim_install_seccomp() != 0) {
     g_enabled = 0;
     shim_log_fail("shadowtpu-shim: seccomp install failed\n");
     return;
   }
+
+  /* TSC emulation: after seccomp so an early failure leaves a usable
+   * process. rdtsc executed before this point (dynamic loader) ran
+   * natively; every app-visible read from here on is simulated. */
+  g_real_sigaction = SHIM_REAL(sigaction);
+  struct sigaction segv;
+  memset(&segv, 0, sizeof segv);
+  segv.sa_sigaction = sigsegv_handler;
+  segv.sa_flags = SA_SIGINFO;
+  sigemptyset(&segv.sa_mask);
+  if (g_real_sigaction &&
+      g_real_sigaction(SIGSEGV, &segv, NULL) == 0)
+    prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
 }

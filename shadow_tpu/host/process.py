@@ -233,6 +233,15 @@ class ManagedProcess:
         env["SHADOWTPU_SHM"] = self.runtime.arena.name
         env["SHADOWTPU_IPC_OFFSET"] = str(self.channel.offset)
         env["LD_PRELOAD"] = self.runtime.shim_path
+        # name resolution for the shim's getaddrinfo/gethostname
+        # overrides (preload_libraries.c analogue): the simulated
+        # hostname/IP and the DNS hosts file
+        env["SHADOWTPU_HOSTNAME"] = self.host.name
+        if self.host.ip:
+            env["SHADOWTPU_HOST_IP"] = self.host.ip
+        hosts_file = os.path.join(self.runtime.data_dir, "etc_hosts")
+        if os.path.exists(hosts_file):
+            env["SHADOWTPU_HOSTS_FILE"] = os.path.abspath(hosts_file)
 
         # determinism: disable ASLR in the child (main.c:287,
         # disable_aslr.c). Like the reference, set ADDR_NO_RANDOMIZE on

@@ -1,0 +1,138 @@
+"""Name resolution + preload-mode TSC emulation for managed processes.
+
+Round-3 closure of two determinism/fidelity gaps: managed programs can
+now resolve simulated hostnames (shim getaddrinfo/gethostname/
+getifaddrs overrides reading the simulator's hosts file — reference
+preload_libraries.c:30-120 + dns.c), and rdtsc/rdtscp in PRELOAD mode
+are trapped via PR_SET_TSC and synthesized from simulated time
+(reference lib/tsc/tsc.c — previously only the ptrace backend did
+this, so a preload plugin reading TSC silently broke determinism).
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller
+
+PLUGIN_DIR = os.path.join(os.path.dirname(__file__), "plugins")
+
+GML = """graph [ directed 0
+  node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+  node [ id 1 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+  edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ]
+  edge [ source 0 target 1 latency "25 ms" packet_loss 0.0 ]
+  edge [ source 1 target 1 latency "10 ms" packet_loss 0.0 ]
+]"""
+
+
+def _indent(text: str, n: int) -> str:
+    return "\n".join(" " * n + line for line in text.splitlines())
+
+
+@pytest.fixture(scope="module")
+def bins(tmp_path_factory):
+    out = tmp_path_factory.mktemp("plugins")
+    built = {}
+    for name in ("resolver_check", "rdtsc_check", "tcp_server",
+                 "segv_chain_check"):
+        exe = out / name
+        subprocess.run(
+            ["cc", "-O1", "-pthread", "-o", str(exe),
+             os.path.join(PLUGIN_DIR, f"{name}.c")],
+            check=True, capture_output=True)
+        built[name] = str(exe)
+    return built
+
+
+def run_sim(hosts_yaml: str, data: str, stop: str = "30s"):
+    cfg = load_config_str(f"""
+general:
+  stop_time: {stop}
+  seed: 1
+  data_directory: {data}
+network:
+  graph:
+    type: gml
+    inline: |
+{_indent(GML, 6)}
+hosts:
+{hosts_yaml}
+""")
+    return Controller(cfg).run()
+
+
+def stdout_of(data: str, host: str, exe: str) -> str:
+    d = os.path.join(data, "hosts", host)
+    for f in sorted(os.listdir(d)):
+        if f.startswith(exe) and f.endswith(".stdout"):
+            with open(os.path.join(d, f)) as fh:
+                return fh.read()
+    raise FileNotFoundError(f"no stdout for {exe} in {d}")
+
+
+def test_managed_process_resolves_simulated_names(bins, tmp_path):
+    data = str(tmp_path / "shadow.data")
+    stats = run_sim(f"""
+  server:
+    network_node_id: 0
+    processes:
+    - path: {bins['tcp_server']}
+      args: 8080
+      start_time: 1s
+  client:
+    network_node_id: 1
+    processes:
+    - path: {bins['resolver_check']}
+      args: server 8080
+      start_time: 2s
+""", data)
+    assert stats.ok
+    out = stdout_of(data, "client", "resolver_check").splitlines()
+    assert out[0] == "hostname client"
+    # DNS assigns 11.0.0.x in registration order: server first
+    assert out[1] == "resolved server 11.0.0.1:8080"
+    assert out[2] == "unknown rc==EAI_NONAME 1"
+    assert out[3] == "self 11.0.0.2"
+    assert out[4] == "if lo 127.0.0.1"
+    assert out[5] == "if eth0 11.0.0.2"
+    assert out[6] == "connected wrote 13"
+
+
+def test_preload_rdtsc_is_simulated_time(bins, tmp_path):
+    """rdtsc in preload mode: cycles == simulated ns at the nominal
+    1 GHz, so a 50 ms usleep reads as exactly 50,000,000 cycles."""
+    data = str(tmp_path / "shadow.data")
+    stats = run_sim(f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {bins['rdtsc_check']}
+      start_time: 1s
+""", data)
+    assert stats.ok
+    out = stdout_of(data, "alice", "rdtsc_check").splitlines()
+    # t0 = 1 s sim = 1e9 cycles at boot of the process
+    assert out[0] == "t0 1000000000"
+    assert out[1] == "dt 50000000"
+    assert out[2] == "p_ge 1"
+
+
+def test_app_sigsegv_handler_chains_with_tsc(bins, tmp_path):
+    """An app-installed SIGSEGV handler (Go/JVM-style) must not break
+    TSC emulation, and real faults must reach the app's handler."""
+    data = str(tmp_path / "shadow.data")
+    stats = run_sim(f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {bins['segv_chain_check']}
+      start_time: 1s
+""", data)
+    assert stats.ok
+    out = stdout_of(data, "alice", "segv_chain_check").splitlines()
+    assert out[0] == "dt 20000000"      # rdtsc emulated: 20 ms sim
+    assert out[1] == "faults 1"         # real fault chained to the app
+    assert out[2] == "t2_ge 1"          # emulation survives the chain
