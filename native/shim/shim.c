@@ -79,6 +79,10 @@ enum {
                          * vtid, args[0] = child channel arena offset */
   IPC_THREAD_START = 7, /* child -> sim on its own channel: alive */
   IPC_THREAD_FAIL = 8,  /* child channel: native clone failed */
+  IPC_FORK_RESULT = 9,  /* parent -> sim: real child pid (or -errno) */
+  IPC_SIGNAL = 10,      /* sim -> plugin: run handler args[0] for
+                         * signal `number` (args[1] = sa_flags) */
+  IPC_SIGNAL_DONE = 11, /* plugin -> sim: handler returned */
 };
 
 /* ---- IPC ABI: byte-compatible with native/ipc/spinsem.hpp ---------- */
@@ -266,6 +270,60 @@ static int is_fd_gated(long nr) {
   }
 }
 
+/* Virtual signal delivery: the simulator may interleave IPC_SIGNAL
+ * messages before any reply; the shim runs the app's handler (in the
+ * app's own address space — this IS the signal frame, delivered at a
+ * syscall boundary exactly like the kernel would) and acks. */
+/* forward decls: the handler-nesting state lives with the SIGSYS
+ * handler below */
+static __thread volatile int g_in_handler
+    __attribute__((tls_model("initial-exec")));
+static __thread ucontext_t *t_trap_ctx
+    __attribute__((tls_model("initial-exec")));
+
+static void shim_invoke_signal(const ShimMsg *m) {
+  int signum = (int)m->number;
+  void *h = (void *)(uintptr_t)m->args[0];
+  uint64_t sa_flags = m->args[1];
+  if (!h)
+    return;
+  /* the handler is APP code: it may legitimately make trapped
+   * syscalls, which nest another SIGSYS while we might already be
+   * inside one — suspend the nested-trap diagnostics and the trap
+   * context for the duration */
+  int saved_in = g_in_handler;
+  ucontext_t *saved_ctx = t_trap_ctx;
+  g_in_handler = 0;
+  if (sa_flags & 4 /* SA_SIGINFO */) {
+    siginfo_t si;
+    memset(&si, 0, sizeof si);
+    si.si_signo = signum;
+    ucontext_t uc;
+    memset(&uc, 0, sizeof uc);
+    ((void (*)(int, siginfo_t *, void *))h)(signum, &si, &uc);
+  } else {
+    ((void (*)(int))h)(signum);
+  }
+  g_in_handler = saved_in;
+  t_trap_ctx = saved_ctx;
+}
+
+/* Wait for a simulator reply on `ch`, servicing any interleaved
+ * IPC_SIGNAL deliveries. */
+static ShimMsg *shim_wait_reply(ShimChannel *ch) {
+  for (;;) {
+    sem_wait(&ch->to_plugin);
+    ShimMsg *in = (ShimMsg *)&ch->msg_to_plugin;
+    if (in->kind != IPC_SIGNAL)
+      return in;
+    shim_invoke_signal(in);
+    ShimMsg *out = (ShimMsg *)&ch->msg_to_simulator;
+    out->kind = IPC_SIGNAL_DONE;
+    out->number = 0;
+    sem_post(&ch->to_simulator.value);
+  }
+}
+
 /* Forward one syscall to the simulator over the calling thread's
  * channel; returns the kernel-convention result (negative errno on
  * failure) or the raw reply message for multi-step protocols (clone).
@@ -278,8 +336,7 @@ static ShimMsg *shim_roundtrip(long nr, const long args[6]) {
   for (int i = 0; i < 6; i++)
     out->args[i] = (uint64_t)args[i];
   sem_post(&ch->to_simulator.value);
-  sem_wait(&ch->to_plugin);
-  return (ShimMsg *)&ch->msg_to_plugin;
+  return shim_wait_reply(ch);
 }
 
 static long shim_emulated_syscall(long nr, const long args[6]) {
@@ -464,16 +521,74 @@ static long shim_sigprocmask(const long a[6]) {
   return r;
 }
 
+#ifndef CLONE_VM
+#define CLONE_VM 0x00000100
+#endif
+
+/* fork / vfork / fork-style clone: the simulator allocates the child's
+ * virtual pid + IPC channel (IPC_CLONE_GO), the shim performs a real
+ * COW fork, the child adopts the new channel and announces itself,
+ * and the parent reports the real child pid (IPC_FORK_RESULT) so the
+ * simulator can watch for its death. vfork degrades to fork semantics
+ * (the child gets its own COW image — safe for the exec-or-exit
+ * pattern and for everything else). */
+static long shim_handle_fork(const long args[6]) {
+  ShimMsg *in = shim_roundtrip(SYS_fork, args);
+  if (in->kind == IPC_SYSCALL_DONE)
+    return (long)in->number; /* refused */
+  if (in->kind != IPC_CLONE_GO)
+    return -ENOSYS;
+  ShimChannel *childch = (ShimChannel *)(g_arena_base + in->args[0]);
+
+  long r = shim_rawsyscall(SYS_clone, SIGCHLD, 0, 0, 0, 0, 0);
+  if (r == 0) {
+    /* child: fresh single-threaded image; adopt the new channel (the
+     * MAP_SHARED arena mapping survived the fork) */
+    t_ch = childch;
+    g_ch = childch;
+    ShimMsg *out = (ShimMsg *)&childch->msg_to_simulator;
+    out->kind = IPC_THREAD_START;
+    out->number = 0;
+    sem_post(&childch->to_simulator.value);
+    shim_wait_reply(childch); /* IPC_START: simulator scheduled us */
+    return 0;
+  }
+  /* parent: report the real pid (or -errno) and collect the vpid */
+  ShimChannel *ch = cur_ch();
+  ShimMsg *out = (ShimMsg *)&ch->msg_to_simulator;
+  out->kind = IPC_FORK_RESULT;
+  out->number = r;
+  sem_post(&ch->to_simulator.value);
+  ShimMsg *rep = shim_wait_reply(ch);
+  if (rep->kind == IPC_SYSCALL_DONE)
+    return (long)rep->number;
+  return -ENOSYS;
+}
+
 static long shim_do_syscall(long nr, const long args[6]) {
   uint32_t fd0 = (uint32_t)args[0];
   if (is_fd_gated(nr) &&
       (fd0 < SHADOWTPU_VFD_BASE || fd0 >= SHADOWTPU_VFD_END))
     return shim_rawsyscall(nr, args[0], args[1], args[2], args[3],
                            args[4], args[5]);
-  if (nr == SYS_clone)
+  if (nr == SYS_clone) {
+    if (!(args[0] & CLONE_VM))
+      return shim_handle_fork(args);
     return shim_handle_clone(args);
+  }
+  if (nr == SYS_fork || nr == SYS_vfork)
+    return shim_handle_fork(args);
   if (nr == SYS_rt_sigprocmask)
     return shim_sigprocmask(args);
+  if (nr == SYS_wait4) {
+    /* virtual wait; then reap any real zombie children so the
+     * plugin's process table doesn't accumulate them */
+    long r = shim_emulated_syscall(nr, args);
+    while (shim_rawsyscall(SYS_wait4, -1, 0, 1 /* WNOHANG */, 0, 0,
+                           0) > 0) {
+    }
+    return r;
+  }
   return shim_emulated_syscall(nr, args);
 }
 
@@ -554,7 +669,8 @@ static const int kTrapSyscalls[] = {
     SYS_exit_group,   SYS_clone,        SYS_fork,
     SYS_vfork,        SYS_futex,        SYS_sysinfo,
     SYS_gettid,       SYS_set_tid_address, SYS_tgkill,
-    SYS_rt_sigprocmask,
+    SYS_rt_sigprocmask, SYS_wait4,      SYS_kill,
+    SYS_rt_sigaction, SYS_pause,
 #ifdef SYS_clone3
     SYS_clone3,       /* refused with ENOSYS: glibc falls back to clone */
 #endif
@@ -1165,8 +1281,13 @@ static void sigsegv_handler(int sig, siginfo_t *info, void *vctx) {
   ucontext_t *ctx = (ucontext_t *)vctx;
   greg_t *g = ctx->uc_mcontext.gregs;
   const uint8_t *ip = (const uint8_t *)g[REG_RIP];
-  int is_rdtsc = ip && ip[0] == 0x0F && ip[1] == 0x31;
-  int is_rdtscp = ip && ip[0] == 0x0F && ip[1] == 0x01 && ip[2] == 0xF9;
+  /* an EXECUTE fault (jump through a bad pointer) has si_addr == rip:
+   * reading instruction bytes there would fault recursively with
+   * SIGSEGV blocked (kernel force-kill) — chain without sniffing */
+  int ip_readable = ip && info->si_addr != (void *)ip;
+  int is_rdtsc = ip_readable && ip[0] == 0x0F && ip[1] == 0x31;
+  int is_rdtscp = ip_readable && ip[0] == 0x0F && ip[1] == 0x01 &&
+                  ip[2] == 0xF9;
   if (!g_enabled || (!is_rdtsc && !is_rdtscp)) {
     shim_chain_segv(sig, info, vctx);
     return;
@@ -1276,16 +1397,12 @@ __attribute__((constructor)) static void shim_init(void) {
   if (hip)
     shim_parse_ip(hip, &g_host_ip_net);
 
-  g_enabled = 1;
-  if (shim_install_seccomp() != 0) {
-    g_enabled = 0;
-    shim_log_fail("shadowtpu-shim: seccomp install failed\n");
-    return;
-  }
-
-  /* TSC emulation: after seccomp so an early failure leaves a usable
-   * process. rdtsc executed before this point (dynamic loader) ran
-   * natively; every app-visible read from here on is simulated. */
+  /* TSC emulation: installed BEFORE seccomp — rt_sigaction is in the
+   * trap list, and a trapped SIGSEGV registration is recorded
+   * virtually (sys_rt_sigaction), which must never apply to the
+   * shim's own handler. rdtsc executed before this point (dynamic
+   * loader) ran natively; every app-visible read from here on is
+   * simulated. */
   g_real_sigaction = SHIM_REAL(sigaction);
   struct sigaction segv;
   memset(&segv, 0, sizeof segv);
@@ -1295,4 +1412,11 @@ __attribute__((constructor)) static void shim_init(void) {
   if (g_real_sigaction &&
       g_real_sigaction(SIGSEGV, &segv, NULL) == 0)
     prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
+
+  g_enabled = 1;
+  if (shim_install_seccomp() != 0) {
+    g_enabled = 0;
+    shim_log_fail("shadowtpu-shim: seccomp install failed\n");
+    return;
+  }
 }

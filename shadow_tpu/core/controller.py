@@ -102,13 +102,20 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
             host.ip = host.address.ip_str
             for proc in group.processes:
                 for _ in range(proc.quantity):
-                    if host.app is not None:
-                        raise ValueError(
-                            f"host {name}: multiple processes per host "
-                            "not yet supported")
+                    app = None
                     if is_model_path(proc.path):
-                        host.app = make_app(proc.path, proc.args,
-                                            host_id, n_total)
+                        # packet/timer events dispatch to the host's
+                        # single model app; real processes are driven
+                        # by their syscalls instead, so any number of
+                        # those can share the host
+                        if any(not hasattr(a, "vpid")
+                               for a in host.apps):
+                            raise ValueError(
+                                f"host {name}: at most one model app "
+                                "per host (any number of real "
+                                "processes)")
+                        app = make_app(proc.path, proc.args,
+                                       host_id, n_total)
                     else:
                         # real executable under syscall interposition
                         import shutil
@@ -135,16 +142,25 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
                             from shadow_tpu.host.ptrace import (
                                 PtraceProcess,
                             )
-                            host.app = PtraceProcess(
+                            app = PtraceProcess(
                                 runtime, path, proc.args,
                                 proc.environment)
                         else:
-                            host.app = ManagedProcess(
+                            app = ManagedProcess(
                                 runtime, path, proc.args,
                                 proc.environment)
+                    proc_idx = len(host.apps)
+                    host.apps.append(app)
+                    # the model app (at most one) is ALWAYS the
+                    # packet/timer dispatch target, regardless of its
+                    # position in the process list; otherwise the
+                    # first process stands in
+                    if is_model_path(proc.path) or host.app is None:
+                        host.app = app
                     starts.append((host_id, proc.start_time,
                                    proc.stop_time
-                                   if proc.stop_time is not None else -1))
+                                   if proc.stop_time is not None else -1,
+                                   proc_idx))
             hosts.append(host)
 
     netmodel = NetworkModel(
@@ -234,13 +250,15 @@ class Controller:
             next_time = m.run_window(next_time, window_end)
 
         if self.sim.runtime is not None:
-            # kill surviving managed processes, release the arena
+            # kill surviving managed processes (forked children die
+            # with their parents), release the arena
             ctx = m._ctx
             ctx.now = stop
             for h in m.hosts:
-                if h.app is not None and hasattr(h.app, "on_sim_end"):
-                    ctx.host = h
-                    h.app.on_sim_end(ctx)
+                for app in (h.apps or [h.app]):
+                    if app is not None and hasattr(app, "on_sim_end"):
+                        ctx.host = h
+                        app.on_sim_end(ctx)
             self.sim.runtime.close()
         m.finalize()
         m.stats.end_time = stop

@@ -159,20 +159,25 @@ class Manager:
         self._worker_stats.append(stats)
         return SimContext(self, stats), stats
 
-    def boot_hosts(self, start_times: list[tuple[int, int, int]]) -> None:
-        """start_times: (host_id, start_time, stop_time|-1) per process.
-        Boot/stop events enter the queue before the first round
-        (worker_bootHosts analogue, worker.c:581-591)."""
-        for host_id, t_start, t_stop in start_times:
+    def boot_hosts(self, start_times: list[tuple]) -> None:
+        """start_times: (host_id, start_time, stop_time|-1[, proc_idx])
+        per process. Boot/stop events enter the queue before the first
+        round (worker_bootHosts analogue, worker.c:581-591); the
+        process index rides in the event data so multi-process hosts
+        boot each process independently."""
+        for entry in start_times:
+            host_id, t_start, t_stop = entry[0], entry[1], entry[2]
+            idx = entry[3] if len(entry) > 3 else 0
             h = self.hosts[host_id]
             self.push_event(Event(time=t_start, dst_host=host_id,
                                   src_host=host_id,
-                                  seq=h.next_event_seq(), kind=KIND_BOOT))
+                                  seq=h.next_event_seq(),
+                                  kind=KIND_BOOT, data=(idx,)))
             if t_stop is not None and t_stop >= 0:
                 self.push_event(Event(time=t_stop, dst_host=host_id,
                                       src_host=host_id,
                                       seq=h.next_event_seq(),
-                                      kind=KIND_STOP))
+                                      kind=KIND_STOP, data=(idx,)))
 
     def _apply_verdict(self, rec: tuple, delivered: bool,
                        deliver_time: int) -> None:
@@ -320,6 +325,16 @@ class Manager:
                                   seq=h.next_event_seq(),
                                   kind=KIND_TASK, task=make_task(h)))
 
+    @staticmethod
+    def _proc_of(host, ev: Event):
+        """BOOT/STOP dispatch target: the process the event's index
+        names (multi-process hosts), defaulting to the primary app."""
+        if ev.data and host.apps:
+            idx = ev.data[0]
+            if 0 <= idx < len(host.apps):
+                return host.apps[idx]
+        return host.app
+
     def execute_event(self, ev: Event, ctx: SimContext,
                       stats: SimStats) -> None:
         """event_execute analogue (core/work/event.c:64): set the clock
@@ -396,10 +411,12 @@ class Manager:
                 if app is not None:
                     app.on_timer(ctx, ev.data)
             elif ev.kind == KIND_BOOT:
-                if app is not None:
-                    app.boot(ctx)
+                target = self._proc_of(host, ev)
+                if target is not None:
+                    target.boot(ctx)
             elif ev.kind == KIND_STOP:
-                if app is not None:
-                    app.on_stop(ctx)
+                target = self._proc_of(host, ev)
+                if target is not None:
+                    target.on_stop(ctx)
         finally:
             clear_context()

@@ -139,9 +139,11 @@ class DeviceEngine:
     # ------------------------------------------------------------------
     # state construction (host side)
     # ------------------------------------------------------------------
-    def init_state(self, starts: list[tuple[int, int, int]]) -> dict:
-        """starts: (host_id, start_time, stop_time|-1) per process, in
-        registration order — seq consumption mirrors Manager.boot_hosts."""
+    def init_state(self, starts: list[tuple]) -> dict:
+        """starts: (host_id, start_time, stop_time|-1[, proc_idx]) per
+        process, in registration order — seq consumption mirrors
+        Manager.boot_hosts (device configs are single-process/host, so
+        the index is ignored here)."""
         H, E = self.H_pad, self.config.event_capacity
         W = self.app.n_state_words
         t = np.full((H, E), INF, dtype=np.int64)
@@ -166,7 +168,8 @@ class DeviceEngine:
             event_seq[h] += 1
             fill[h] += 1
 
-        for host_id, t_start, t_stop in starts:
+        for entry in starts:
+            host_id, t_start, t_stop = entry[0], entry[1], entry[2]
             _push(host_id, t_start, KIND_BOOT)
             if t_stop is not None and t_stop >= 0:
                 _push(host_id, t_stop, KIND_STOP)

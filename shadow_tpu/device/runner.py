@@ -46,6 +46,8 @@ def device_twin(sim) -> DeviceApp:
     """Map the config's CPU model apps to their vectorized device twin.
     Supported: homogeneous phold; tgen server/client mixes (homogeneous
     client args)."""
+    if any(len(h.apps) > 1 for h in sim.hosts):
+        raise NoDeviceTwin("tpu policy: multi-process hosts run hybrid")
     apps = [h.app for h in sim.hosts]
     n_hosts = len(sim.hosts)
     real = [a for a in apps if a is not None]

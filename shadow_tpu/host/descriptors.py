@@ -465,6 +465,17 @@ class DescriptorTable:
         for fd in list(self._slots):
             self.close_fd(ctx, fd)
 
+    def fork_clone(self) -> "DescriptorTable":
+        """fork(2) semantics: the child gets its own fd table whose
+        entries reference the SAME open file descriptions (refcounted;
+        a close in either process only drops that table's reference)."""
+        t = DescriptorTable(self.manager)
+        t._slots = dict(self._slots)
+        t._next = self._next
+        for d in t._slots.values():
+            d.refs += 1
+        return t
+
     # -- TCP byte-stream channels (keyed by connection 4-tuple) --------
     def recv_channel(self, sock: TcpSocket) -> StreamChannel:
         """Channel carrying bytes TOWARD this socket."""

@@ -24,7 +24,11 @@ class Host:
     bw_down_bits: int
     bw_up_bits: int
     rng: SeededRandom
-    app: Any = None             # ModelApp instance (interpose=model)
+    app: Any = None             # primary app (model-dispatch target)
+    apps: list = field(default_factory=list)   # all processes, in
+                                # config order (process.c's per-host
+                                # process list; BOOT/STOP events carry
+                                # the index)
     net: Any = None             # HostNetStack (CPU engines)
     cpu: Any = None             # host/cpu.py Cpu delay model
     model_nic: Any = None       # host/model_nic.py ModelNic (raw sends)

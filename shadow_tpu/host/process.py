@@ -139,6 +139,8 @@ class ManagedProcess:
 
     _next_vpid = [1000]
     supports_threads = True        # preload backend handles clone
+    supports_fork = True           # IPC fork handshake (spawn_fork)
+    supports_signals = True        # IPC_SIGNAL handler injection
 
     def __init__(self, runtime: ManagedRuntime, path: str, args,
                  environment: str = ""):
@@ -172,6 +174,15 @@ class ManagedProcess:
         self._reaper: Optional[threading.Thread] = None
         self._rng_counter = 0
         self.syscall_counts: dict[str, int] = {}
+        # process tree + virtual signals (signal.c / exit.c analogues)
+        self.parent_proc: Optional["ManagedProcess"] = None
+        self.children: dict[int, "ManagedProcess"] = {}
+        self.sigactions: dict[int, tuple] = {}  # sig -> (h, fl, r, m)
+        self.pending_signals: list[int] = []
+        self.wstatus: Optional[int] = None      # set at exit (zombie)
+        self.term_signal: Optional[int] = None  # fatal-signal death
+        self._pending_fork: Optional[tuple] = None
+        self._forked_pid: Optional[int] = None  # real pid when forked
 
     # the syscall handler's per-invocation restart state lives on the
     # thread being serviced (SysCallHandler->blockedSyscallNR analogue)
@@ -185,7 +196,9 @@ class ManagedProcess:
 
     @property
     def native_pid(self) -> Optional[int]:
-        return self.proc.pid if self.proc is not None else None
+        if self.proc is not None:
+            return self.proc.pid
+        return self._forked_pid
 
     # -- spawn plumbing shared by the preload and ptrace backends -------
     def _host_paths(self) -> tuple[str, str, str]:
@@ -411,6 +424,233 @@ class ManagedProcess:
         th.channel.send_to_plugin(go)
         self._continue(ctx, th)
 
+    # -- fork (process.c:457-651's child creation, preload-funnel form)
+    def spawn_fork(self, ctx) -> "CloneGo":
+        """Approve a fork: allocate the child's vpid + IPC channel.
+        The shim does the real COW fork and reports the native pid via
+        IPC_FORK_RESULT (handled in _continue -> _complete_fork)."""
+        vpid = ManagedProcess._next_vpid[0]
+        ManagedProcess._next_vpid[0] += 1
+        ch = native.IpcChannel(self.runtime.arena,
+                               spin_max=self.runtime.spin_max)
+        self._pending_fork = (vpid, ch)
+        return CloneGo(vpid, ch.offset)
+
+    def _complete_fork(self, ctx, th: ManagedThread,
+                       real_pid: int) -> None:
+        """IPC_FORK_RESULT from the parent: build the child process
+        object around the already-running native child."""
+        vpid, ch = self._pending_fork
+        self._pending_fork = None
+        if real_pid < 0:
+            self._reply_to(th, real_pid)
+            return
+        child = ManagedProcess.__new__(ManagedProcess)
+        child.runtime = self.runtime
+        child.path = self.path
+        child.args = list(self.args)
+        child.environment = self.environment
+        child.vpid = vpid
+        child.host = self.host
+        child.manager = self.manager
+        child.proc = None
+        child._forked_pid = real_pid
+        child.mem = ProcessMemory(real_pid)
+        # fork semantics: own fd table, shared file descriptions
+        child.table = self.table.fork_clone()
+        child.handler = SyscallHandler(child)
+        child.channel = ch
+        child.alive = True
+        child.exiting = False
+        child.exit_code = None
+        child.futexes = {}          # private memory from here on
+        main = ManagedThread(child, vpid, ch)
+        child.threads = {vpid: main}
+        child.current = main
+        child._rng_counter = 0
+        child.syscall_counts = {}
+        child.parent_proc = self
+        child.children = {}
+        child.sigactions = dict(self.sigactions)
+        child.pending_signals = []
+        child.wstatus = None
+        child.term_signal = None
+        child._pending_fork = None
+        self.children[vpid] = child
+
+        # death watch without being the kernel parent: poll a pidfd
+        pidfd = os.pidfd_open(real_pid)
+
+        def reap():
+            import select as _select
+            _select.select([pidfd], [], [])
+            os.close(pidfd)
+            for t in list(child.threads.values()):
+                t.channel.mark_plugin_exited()
+
+        child._reaper = threading.Thread(target=reap, daemon=True)
+        child._reaper.start()
+
+        child._push_task(ctx.now,
+                         lambda c2, ev: child._start_forked(c2))
+        log.debug("fork: vpid=%d -> child vpid=%d pid=%d on %s",
+                  self.vpid, vpid, real_pid, self.host.name)
+        self._reply_to(th, vpid)
+
+    def _start_forked(self, ctx) -> None:
+        """First scheduling of a forked child: wait for its
+        announcement on the new channel, then release it."""
+        main = self.current
+        if not self.alive or not main.alive:
+            return
+        status, msg = main.channel.recv_from_plugin_timed(
+            RECV_TIMEOUT_MS)
+        if status != 1 or msg.kind != native.IPC_THREAD_START:
+            log.warning("forked child vpid=%d never announced",
+                        self.vpid)
+            self.alive = False
+            return
+        go = native.IpcMessage()
+        go.kind = native.IPC_START
+        go.number = 0
+        main.channel.send_to_plugin(go)
+        self._continue(ctx, main)
+
+    # -- virtual signals (signal.c analogue) ----------------------------
+    SIG_DFL, SIG_IGN = 0, 1
+    SIGKILL, SIGCHLD = 9, 17
+    SA_RESTART = 0x10000000
+    _DEFAULT_IGNORE = {17, 18, 23, 28}   # CHLD, CONT, URG, WINCH
+
+    def deliver_signal(self, ctx, sig: int) -> None:
+        """Queue a virtual signal; handlers run in the plugin at its
+        next syscall boundary (IPC_SIGNAL), exactly where the kernel
+        delivers. Default dispositions: terminate, or ignore for the
+        usual set. A parked (blocked-syscall) thread is interrupted
+        now: handler first, then -EINTR or an SA_RESTART redispatch."""
+        if not self.alive:
+            return
+        if sig == self.SIGKILL:
+            self.term_signal = sig
+            self.exit_code = 128 + sig
+            self._kill(ctx)
+            return
+        act = self.sigactions.get(sig)
+        handler = act[0] if act else self.SIG_DFL
+        if handler == self.SIG_IGN:
+            return
+        if handler == self.SIG_DFL:
+            if sig in self._DEFAULT_IGNORE:
+                return
+            log.debug("vpid=%d: fatal signal %d (default action)",
+                      self.vpid, sig)
+            self.term_signal = sig
+            self.exit_code = 128 + sig
+            self._kill(ctx)
+            return
+        self.pending_signals.append(sig)
+        for th in self.threads.values():
+            if th.alive and th.parked is not None:
+                self._interrupt_parked(ctx, th)
+                break
+
+    def _flush_signals(self, ctx, th: ManagedThread) -> list[tuple]:
+        """Run every pending handler in the plugin (the thread must be
+        awaiting a reply). Returns the delivered (sig, act) list."""
+        delivered = []
+        while self.pending_signals and self.alive and th.alive:
+            sig = self.pending_signals.pop(0)
+            act = self.sigactions.get(sig)
+            if act is None or act[0] in (self.SIG_DFL, self.SIG_IGN):
+                continue        # disposition changed since queueing
+            msg = native.IpcMessage()
+            msg.kind = native.IPC_SIGNAL
+            msg.number = sig
+            msg.args[0] = act[0]
+            msg.args[1] = act[1]
+            th.channel.send_to_plugin(msg)
+            if not self._await_signal_ack(ctx, th, sig):
+                break
+            delivered.append((sig, act))
+        return delivered
+
+    def _await_signal_ack(self, ctx, th: ManagedThread,
+                          sig: int) -> bool:
+        """Wait for IPC_SIGNAL_DONE, servicing any trapped syscalls
+        the handler itself makes (handlers may legitimately call
+        write/kill/time/...). A handler syscall that would BLOCK gets
+        -EINTR instead — signal handlers cannot park the ping-pong."""
+        while True:
+            status, ack = th.channel.recv_from_plugin_timed(
+                RECV_TIMEOUT_MS)
+            if status != 1:
+                log.warning("vpid=%d: signal %d handler did not ack",
+                            self.vpid, sig)
+                return False
+            if ack.kind == native.IPC_SIGNAL_DONE:
+                return True
+            if ack.kind == native.IPC_SYSCALL:
+                nr = int(ack.number)
+                args = tuple(int(ack.args[i]) for i in range(6))
+                self.current = th
+                try:
+                    res = self.handler.dispatch(ctx, nr, args)
+                except Blocked:
+                    from shadow_tpu.host.syscalls import EINTR
+                    res = -EINTR
+                except Exception:
+                    log.exception("handler-context syscall crashed")
+                    res = -38
+                self._reply_to(th, res)
+                th.syscall_state = {}
+                continue
+            log.warning("vpid=%d: unexpected ipc kind %d during "
+                        "signal %d delivery", self.vpid, ack.kind, sig)
+            return False
+
+    def _interrupt_parked(self, ctx, th: ManagedThread) -> None:
+        """Deliver pending signals to a thread blocked in an emulated
+        syscall: run the handlers, then either redispatch (SA_RESTART)
+        or fail the syscall with -EINTR."""
+        nr, args = th.parked
+        th.parked = None
+        delivered = self._flush_signals(ctx, th)
+        if not delivered:
+            # nothing ran (dispositions changed): re-park untouched
+            th.parked = (nr, args)
+            return
+        from shadow_tpu.host.syscalls import EINTR, NR
+        restartable = nr not in (NR["pause"],)
+        if restartable and all(a[1] & self.SA_RESTART
+                               for _, a in delivered):
+            self.current = th
+            try:
+                res = self.handler.dispatch(ctx, nr, args)
+            except Blocked as b:
+                self._park(ctx, b, nr, args)
+                return
+            except Exception:
+                log.exception("restarted syscall failed")
+                res = -38
+        else:
+            res = -EINTR
+        self._reply_to(th, res)
+        th.syscall_state = {}
+        self._continue(ctx, th)
+
+    def child_exited(self, ctx, child: "ManagedProcess") -> None:
+        """A forked child became a zombie: SIGCHLD + wake any thread
+        parked in wait4."""
+        self.deliver_signal(ctx, self.SIGCHLD)
+        if not self.alive:
+            return
+        from shadow_tpu.host.syscalls import NR
+        for th in self.threads.values():
+            if th.alive and th.parked is not None and \
+                    th.parked[0] == NR["wait4"]:
+                th.schedule_continue(ctx)
+                break
+
     def thread_exit(self, ctx, th: ManagedThread, code: int) -> bool:
         """SYS_exit from one thread. Marks the thread dead; the
         CLEARTID write + futex wake for pthread_join'ers is deferred to
@@ -492,10 +732,13 @@ class ManagedProcess:
                 return
             if status == -1:           # wall-clock stall
                 log.warning("%s pid=%s unresponsive for %ds; killing",
-                            self.path, self.proc.pid,
+                            self.path, self.native_pid,
                             RECV_TIMEOUT_MS // 1000)
                 self._kill(ctx)
                 return
+            if msg.kind == native.IPC_FORK_RESULT:
+                self._complete_fork(ctx, th, int(msg.number))
+                continue
             if msg.kind != native.IPC_SYSCALL:
                 log.warning("unexpected ipc kind %d", msg.kind)
                 continue
@@ -514,6 +757,12 @@ class ManagedProcess:
                 log.exception("syscall %s(%s) handler crashed", name,
                               args)
                 res = -38              # ENOSYS
+            # deliver pending virtual signals (e.g. a self-kill) at
+            # the syscall boundary, before the result lands
+            if self.pending_signals and th.alive and self.alive:
+                self._flush_signals(ctx, th)
+                if not self.alive:
+                    return             # a fatal disposition fired
             self._reply_to(th, res)
             th.syscall_state = {}
             if not th.alive:           # replied to an exiting thread
@@ -542,8 +791,9 @@ class ManagedProcess:
         self.alive = False
         for th in self.threads.values():
             th.alive = False
-        self._reaper.join(timeout=10)
-        rc = self.proc.returncode
+        if self._reaper is not None:
+            self._reaper.join(timeout=10)
+        rc = self.proc.returncode if self.proc is not None else None
         if self.exit_code is None and rc is not None:
             self.exit_code = rc
         log.debug("%s on %s exited code=%s (%d syscalls)", self.path,
@@ -551,12 +801,31 @@ class ManagedProcess:
                   sum(self.syscall_counts.values()))
         if self.table is not None:
             self.table.close_all(ctx)
+        # orphaned forked children die with us (no re-parenting model)
+        for child in list(self.children.values()):
+            if child.alive:
+                child._kill(ctx)
+        # become a zombie for the parent's wait4: WIFSIGNALED encodes
+        # the signal in the low 7 bits, WIFEXITED the code in byte 1
+        if self.term_signal is not None:
+            self.wstatus = self.term_signal & 0x7F
+        else:
+            self.wstatus = ((self.exit_code or 0) & 0xFF) << 8
+        if self.parent_proc is not None and self.parent_proc.alive:
+            self.parent_proc.child_exited(ctx, self)
 
     def _kill(self, ctx) -> None:
-        if not self.alive or self.proc is None:
+        if not self.alive:
             return
-        try:
-            self.proc.kill()
-        except ProcessLookupError:
-            pass
+        if self.proc is not None:
+            try:
+                self.proc.kill()
+            except ProcessLookupError:
+                pass
+        elif self._forked_pid is not None:
+            import signal as _signal
+            try:
+                os.kill(self._forked_pid, _signal.SIGKILL)
+            except ProcessLookupError:
+                pass
         self._finalize_exit(ctx)

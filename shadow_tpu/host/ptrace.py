@@ -382,6 +382,8 @@ class PtraceProcess(ManagedProcess):
     preload shim (same app interface, same SyscallHandler)."""
 
     supports_threads = False       # SYSEMU multi-tracee: roadmap
+    supports_fork = False          # fork needs the preload channel
+    supports_signals = False       # IPC_SIGNAL needs the preload shim
 
     def __init__(self, runtime, path: str, args, environment: str = ""):
         super().__init__(runtime, path, args, environment)

@@ -79,11 +79,13 @@ NR = dict(
     getrandom=318, newfstatat=262, statx=332,
     sched_yield=24, gettid=186, sysinfo=99, futex=202,
     set_tid_address=218, sendfile=40, tgkill=234, clone3=435,
+    wait4=61, kill=62, rt_sigaction=13, pause=34,
 )
 NR_NAME = {v: k for k, v in NR.items()}
 
 # errno
 EPERM, ENOENT, EINTR, EBADF, EAGAIN, EFAULT, EINVAL = 1, 2, 4, 9, 11, 14, 22
+ECHILD = 10
 ENOTTY, ESPIPE, EPIPE, ENOSYS, ENOTSOCK, EDESTADDRREQ = 25, 29, 32, 38, 88, 89
 EMSGSIZE, ENOPROTOOPT, EPROTONOSUPPORT, EOPNOTSUPP, EAFNOSUPPORT = \
     90, 92, 93, 95, 97
@@ -291,7 +293,8 @@ class SyscallHandler:
         return self.p.vpid
 
     def sys_getppid(self, ctx, a):
-        return 1
+        parent = getattr(self.p, "parent_proc", None)
+        return parent.vpid if parent is not None else 1
 
     def sys_uname(self, ctx, a):
         if not a[0]:
@@ -347,21 +350,152 @@ class SyscallHandler:
         return -ENOSYS
 
     def sys_fork(self, ctx, a):
-        return -ENOSYS
+        """fork / vfork / fork-style clone: the shim normalizes all
+        three to SYS_fork (vfork degrades to COW-fork semantics). The
+        process layer allocates the child's vpid + channel; the shim
+        performs the real fork and reports the native pid via
+        IPC_FORK_RESULT (process.c:457-651's child-process creation,
+        reshaped for the preload funnel)."""
+        if not getattr(self.p, "supports_fork", False):
+            return -ENOSYS      # ptrace backend: fork later
+        return self.p.spawn_fork(ctx)
 
     def sys_vfork(self, ctx, a):
-        return -ENOSYS
+        return self.sys_fork(ctx, a)
+
+    def sys_wait4(self, ctx, a):
+        """Virtual child wait (kernel/exit.c semantics over vpids):
+        reaps a zombie child, writes the wstatus, blocks without
+        WNOHANG. The shim additionally reaps the REAL zombie
+        natively after the virtual result."""
+        pid, status_ptr, options = _s32(a[0]), a[1], _s32(a[2])
+        WNOHANG = 1
+        p = self.p
+        children = getattr(p, "children", None)
+        if children is None:
+            return -ECHILD
+        matching = [c for c in children.values()
+                    if pid in (-1, c.vpid)]
+        if not matching:
+            return -ECHILD
+        for c in matching:
+            if c.wstatus is not None:
+                if status_ptr:
+                    self.mem.write(status_ptr,
+                                   struct.pack("<i", c.wstatus))
+                del children[c.vpid]
+                return c.vpid
+        if options & WNOHANG:
+            return 0
+        raise Blocked()          # child_exited wakes the parked thread
+
+    def sys_kill(self, ctx, a):
+        """Virtual signal delivery by vpid (signal.c's kill path):
+        routed to the target process on the same simulated host."""
+        pid, sig = _s32(a[0]), _s32(a[1])
+        if not getattr(self.p, "supports_signals", False):
+            return -ENOSYS      # ptrace backend: signals later
+        target = self.p
+        if pid > 0 and pid != self.p.vpid:
+            target = self._find_process(pid)
+            if target is None:
+                return -3       # ESRCH
+        if sig == 0:
+            return 0
+        if sig < 1 or sig > 64:
+            return -EINVAL
+        target.deliver_signal(ctx, sig)
+        return 0
+
+    def _find_process(self, vpid: int):
+        """vpid -> live ManagedProcess on the same host (parent,
+        children, siblings)."""
+        seen = set()
+        stack = [self.p]
+        root = getattr(self.p, "parent_proc", None)
+        while root is not None:
+            stack.append(root)
+            root = getattr(root, "parent_proc", None)
+        while stack:
+            proc = stack.pop()
+            if id(proc) in seen:
+                continue
+            seen.add(id(proc))
+            if proc.vpid == vpid:
+                return proc if proc.alive else None
+            stack.extend(getattr(proc, "children", {}).values())
+        # fall back to any process on this host (configured siblings)
+        for app in getattr(self.p.host, "apps", []) or []:
+            if getattr(app, "vpid", None) == vpid:
+                return app if app.alive else None
+        return None
+
+    def sys_rt_sigaction(self, ctx, a):
+        """Virtual signal dispositions (signal.c:rt_sigaction): the
+        handler address + flags are recorded simulator-side and
+        invoked in the plugin via IPC_SIGNAL at syscall boundaries.
+        Hardware faults (SEGV/BUS/ILL/FPE) stay native — the shim owns
+        SIGSEGV for TSC emulation and chains app handlers itself;
+        SIGSYS is load-bearing and silently ignored."""
+        if not getattr(self.p, "supports_signals", False):
+            return NATIVE       # ptrace backend: kernel semantics
+        signum, act_ptr, old_ptr = _s32(a[0]), a[1], a[2]
+        SIGKILL, SIGSTOP, SIGSYS = 9, 19, 31
+        SIGSEGV = 11
+        HW_NATIVE = (4, 7, 8)   # ILL, BUS, FPE: shim doesn't own these
+        if signum in HW_NATIVE:
+            return NATIVE
+        if signum == SIGSEGV:
+            # NEVER native: the shim's SIGSEGV handler is the TSC
+            # emulation; libc-level registrations are chained by the
+            # shim's sigaction override, and raw-syscall registrations
+            # are recorded here but only fire virtually (documented
+            # limitation — real faults still chain via the shim)
+            if act_ptr:
+                handler, flags, restorer, mask = struct.unpack(
+                    "<QQQQ", self.mem.read(act_ptr, 32))
+                self.p.sigactions[signum] = (handler, flags, restorer,
+                                             mask)
+            return 0
+        if signum in (SIGKILL, SIGSTOP) and act_ptr:
+            return -EINVAL
+        if signum < 1 or signum > 64:
+            return -EINVAL
+        acts = self.p.sigactions
+        old = acts.get(signum)
+        if old_ptr:
+            # kernel struct sigaction: handler, flags, restorer, mask
+            if old is None:
+                self.mem.write(old_ptr, b"\x00" * 32)
+            else:
+                self.mem.write(old_ptr, struct.pack(
+                    "<QQQQ", old[0], old[1], old[2], old[3]))
+        if act_ptr and signum != SIGSYS:
+            handler, flags, restorer, mask = struct.unpack(
+                "<QQQQ", self.mem.read(act_ptr, 32))
+            acts[signum] = (handler, flags, restorer, mask)
+        return 0
+
+    def sys_pause(self, ctx, a):
+        """Blocks until a signal handler runs (always -EINTR after)."""
+        raise Blocked()
 
     def sys_tgkill(self, ctx, a):
-        """Existence checks against virtual tids; actual cross-thread
-        signal delivery is not modeled yet."""
+        """Signal a thread by virtual tid. Delivery is process-level
+        (one signal queue per process, like our one-thread-at-a-time
+        execution model)."""
         tid, sig = _s32(a[1]), _s32(a[2])
         threads = getattr(self.p, "threads", {})
         if tid not in threads or not threads[tid].alive:
             return -3           # ESRCH
         if sig == 0:
             return 0
-        return -ENOSYS
+        if not getattr(self.p, "supports_signals", False):
+            return -ENOSYS
+        if sig < 1 or sig > 64:
+            return -EINVAL
+        self.p.deliver_signal(ctx, sig)
+        return 0
 
     # ==================================================================
     # sockets (host/syscall/socket.c)

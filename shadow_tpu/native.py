@@ -40,6 +40,9 @@ IPC_STOP = 5
 IPC_CLONE_GO = 6       # sim->plugin: clone approved (vtid + chan offset)
 IPC_THREAD_START = 7   # child thread announcing itself on its channel
 IPC_THREAD_FAIL = 8    # native clone failed after approval
+IPC_FORK_RESULT = 9    # parent->sim: real child pid (or -errno)
+IPC_SIGNAL = 10        # sim->plugin: run handler args[0] for signal
+IPC_SIGNAL_DONE = 11   # plugin->sim: handler returned
 
 
 def load(build_if_missing: bool = True) -> ctypes.CDLL:

@@ -1,0 +1,51 @@
+/* fork + wait4 under the simulator: the child is a real forked
+ * process with its own virtual pid, simulated clocks stay coherent
+ * across the tree, and the parent's blocking wait returns the child's
+ * exit status at the simulated instant the child died. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static long now_ms(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+int main(void) {
+  long t0 = now_ms();
+  pid_t me = getpid();
+  pid_t child = fork();
+  if (child < 0) {
+    perror("fork");
+    return 1;
+  }
+  if (child == 0) {
+    /* child: distinct pid, correct ppid, sleeps 200 ms sim time */
+    printf("child pid!=parent %d ppid_ok %d\n", getpid() != me,
+           getppid() == me);
+    fflush(stdout);
+    usleep(200 * 1000);
+    _exit(42);
+  }
+  printf("parent sees child %d\n", child > 0 && child != me);
+  int status = 0;
+  pid_t r = waitpid(child, &status, 0);
+  long waited = now_ms() - t0;
+  printf("wait ret_ok %d exited %d code %d t_ms %ld\n", r == child,
+         WIFEXITED(status), WEXITSTATUS(status), waited);
+
+  /* second child, reaped with wait4(-1) */
+  pid_t c2 = fork();
+  if (c2 == 0)
+    _exit(7);
+  int st2 = 0;
+  pid_t r2 = wait(&st2);
+  printf("second ok %d code %d\n", r2 == c2, WEXITSTATUS(st2));
+
+  /* no children left: ECHILD */
+  printf("echild %d\n", wait(NULL) == -1);
+  return 0;
+}

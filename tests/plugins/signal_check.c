@@ -1,0 +1,90 @@
+/* Virtual signal delivery: self-kill runs the handler at the syscall
+ * boundary; a forked child's signal interrupts the parent's blocking
+ * nanosleep with EINTR at the simulated send instant; SIG_IGN and
+ * default-ignore signals are inert. */
+#include <errno.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static volatile sig_atomic_t got1 = 0, got2 = 0;
+static volatile long h1_time = -1;
+
+static long now_ms(void);
+
+/* the handler itself makes a TRAPPED syscall (clock_gettime goes
+ * through the shim funnel): delivery must service it */
+static void h1(int sig) {
+  got1 = sig;
+  h1_time = now_ms();
+}
+static void h2(int sig, siginfo_t *si, void *uc) {
+  (void)uc;
+  got2 = sig + (si != NULL);
+}
+
+static long now_ms(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+int main(void) {
+  struct sigaction sa;
+  memset(&sa, 0, sizeof sa);
+  sa.sa_handler = h1;
+  sigaction(SIGUSR1, &sa, NULL);
+
+  struct sigaction sa2;
+  memset(&sa2, 0, sizeof sa2);
+  sa2.sa_sigaction = h2;
+  sa2.sa_flags = SA_SIGINFO;
+  sigaction(SIGUSR2, &sa2, NULL);
+
+  /* self-signal: handler runs before kill() returns to us, and the
+   * handler's own trapped clock_gettime works */
+  kill(getpid(), SIGUSR1);
+  printf("self got1 %d handler_syscall_ok %d\n", (int)got1,
+         h1_time >= 0);
+
+  /* ignored signal is inert */
+  signal(SIGHUP, SIG_IGN);
+  kill(getpid(), SIGHUP);
+  printf("ignored ok\n");
+
+  /* cross-process: child interrupts parent's 10 s nanosleep at 150 ms */
+  long t0 = now_ms();
+  pid_t child = fork();
+  if (child == 0) {
+    usleep(150 * 1000);
+    kill(getppid(), SIGUSR2);
+    _exit(0);
+  }
+  struct timespec req = {10, 0};
+  int r = nanosleep(&req, NULL);
+  long dt = now_ms() - t0;
+  printf("eintr %d errno_ok %d got2 %d t_ms %ld\n", r == -1,
+         errno == EINTR, (int)got2, dt);
+  int st;
+  waitpid(child, &st, 0);
+
+  /* SIGKILL a sleeping child: wait status must say SIGNALED(9) */
+  long tk = now_ms();
+  pid_t victim = fork();
+  if (victim == 0) {
+    sleep(10);
+    _exit(0);
+  }
+  usleep(50 * 1000);
+  kill(victim, SIGKILL);
+  int vst = 0;
+  pid_t vr = waitpid(victim, &vst, 0);
+  printf("sigkill ok %d signaled %d sig %d t_ms %ld\n", vr == victim,
+         WIFSIGNALED(vst), WTERMSIG(vst), now_ms() - tk);
+  printf("done\n");
+  return 0;
+}

@@ -1,0 +1,146 @@
+"""fork/wait4 + virtual signal delivery for managed processes.
+
+VERDICT round-3 item #7: a managed program can fork real children
+(each a full virtual process: own vpid, fd table sharing the parent's
+file descriptions, COW memory), wait for them (blocking wait4 with
+zombie reaping + ECHILD), and exchange virtual signals (rt_sigaction
+registry, kill/tgkill, handler invocation at syscall boundaries via
+IPC_SIGNAL, EINTR on interrupted blocking syscalls). Reference:
+src/main/host/process.c:457-651, syscall/signal.c, kernel exit.c.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller
+
+PLUGIN_DIR = os.path.join(os.path.dirname(__file__), "plugins")
+
+GML = """graph [ directed 0
+  node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+  edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ]
+]"""
+
+
+def _indent(text: str, n: int) -> str:
+    return "\n".join(" " * n + line for line in text.splitlines())
+
+
+@pytest.fixture(scope="module")
+def bins(tmp_path_factory):
+    out = tmp_path_factory.mktemp("plugins")
+    built = {}
+    for name in ("fork_check", "signal_check"):
+        exe = out / name
+        subprocess.run(
+            ["cc", "-O1", "-pthread", "-o", str(exe),
+             os.path.join(PLUGIN_DIR, f"{name}.c")],
+            check=True, capture_output=True)
+        built[name] = str(exe)
+    return built
+
+
+def run_one(exe: str, data: str, stop: str = "30s"):
+    cfg = load_config_str(f"""
+general:
+  stop_time: {stop}
+  seed: 1
+  data_directory: {data}
+network:
+  graph:
+    type: gml
+    inline: |
+{_indent(GML, 6)}
+hosts:
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {exe}
+      start_time: 1s
+""")
+    return Controller(cfg).run()
+
+
+def stdout_of(data: str, host: str, exe: str) -> str:
+    d = os.path.join(data, "hosts", host)
+    for f in sorted(os.listdir(d)):
+        if f.startswith(exe) and f.endswith(".stdout"):
+            with open(os.path.join(d, f)) as fh:
+                return fh.read()
+    raise FileNotFoundError(f"no stdout for {exe} in {d}")
+
+
+def test_fork_wait_exit_status(bins, tmp_path):
+    data = str(tmp_path / "shadow.data")
+    stats = run_one(bins["fork_check"], data)
+    assert stats.ok
+    out = stdout_of(data, "alice", "fork_check").splitlines()
+    assert out[0] == "child pid!=parent 1 ppid_ok 1"
+    assert out[1] == "parent sees child 1"
+    # the child slept 200 ms of SIMULATED time before exiting; the
+    # parent's blocking wait returns at that exact simulated instant
+    assert out[2] == "wait ret_ok 1 exited 1 code 42 t_ms 200"
+    assert out[3] == "second ok 1 code 7"
+    assert out[4] == "echild 1"
+
+
+def test_fork_deterministic(bins, tmp_path):
+    outs = []
+    for run in range(2):
+        data = str(tmp_path / f"r{run}" / "shadow.data")
+        stats = run_one(bins["fork_check"], data)
+        assert stats.ok
+        outs.append(stdout_of(data, "alice", "fork_check"))
+    assert outs[0] == outs[1]
+
+
+def test_multi_process_host(bins, tmp_path):
+    """Several real processes on ONE simulated host (the reference's
+    hosts run arbitrary process lists, process.c:457): both boot at
+    their own start times and produce independent stdout."""
+    data = str(tmp_path / "shadow.data")
+    cfg = load_config_str(f"""
+general:
+  stop_time: 30s
+  seed: 1
+  data_directory: {data}
+network:
+  graph:
+    type: gml
+    inline: |
+{_indent(GML, 6)}
+hosts:
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {bins['fork_check']}
+      start_time: 1s
+    - path: {bins['signal_check']}
+      start_time: 2s
+""")
+    stats = Controller(cfg).run()
+    assert stats.ok
+    out1 = stdout_of(data, "alice", "fork_check")
+    out2 = stdout_of(data, "alice", "signal_check")
+    assert "echild 1" in out1
+    assert "done" in out2
+
+
+def test_signals_self_cross_and_eintr(bins, tmp_path):
+    data = str(tmp_path / "shadow.data")
+    stats = run_one(bins["signal_check"], data)
+    assert stats.ok
+    out = stdout_of(data, "alice", "signal_check").splitlines()
+    # SIGUSR1 handler ran AND its own trapped syscall was serviced
+    assert out[0] == "self got1 10 handler_syscall_ok 1"
+    assert out[1] == "ignored ok"
+    # child's SIGUSR2 at +150 ms sim interrupted the 10 s nanosleep:
+    # SA_SIGINFO handler got (sig, siginfo) -> 12+1; EINTR; exact time
+    assert out[2] == "eintr 1 errno_ok 1 got2 13 t_ms 150"
+    # SIGKILL'd sleeping child: WIFSIGNALED with WTERMSIG 9, reaped at
+    # the kill instant (+50 ms)
+    assert out[3] == "sigkill ok 1 signaled 1 sig 9 t_ms 50"
+    assert out[4] == "done"
