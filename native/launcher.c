@@ -1,0 +1,47 @@
+/* Tracee launcher for the ptrace backend.
+ *
+ * The tracer must not os.fork() the Python simulator (JAX's runtime
+ * threads make a non-exec fork a deadlock risk); instead the child is
+ * posix_spawn'd running THIS stub, which applies the pre-exec
+ * settings the old fork path did inline — deterministic-TSC trapping
+ * (PR_SET_TSC survives execve) and ASLR off — then stops itself so
+ * the tracer can PTRACE_SEIZE before a single app instruction runs,
+ * and finally execs the real program (the tracer resumes it and
+ * catches the PTRACE_EVENT_EXEC stop).
+ *
+ * Reference analogue: utility/fork_proxy.c isolates the same hazard
+ * with a dedicated early fork thread. */
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/personality.h>
+#include <sys/prctl.h>
+#include <unistd.h>
+
+#ifndef PR_SET_TSC
+#define PR_SET_TSC 26
+#endif
+#ifndef PR_TSC_SIGSEGV
+#define PR_TSC_SIGSEGV 2
+#endif
+#define ADDR_NO_RANDOMIZE 0x0040000
+
+int main(int argc, char **argv) {
+  int argi = 1;
+  int tsc = 1;
+  if (argi < argc && strcmp(argv[argi], "--no-tsc") == 0) {
+    tsc = 0;
+    argi++;
+  }
+  if (argi >= argc) {
+    fprintf(stderr, "usage: launcher [--no-tsc] <prog> [args...]\n");
+    return 2;
+  }
+  personality(ADDR_NO_RANDOMIZE);
+  if (tsc)
+    prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
+  raise(SIGSTOP); /* tracer seizes here */
+  execv(argv[argi], argv + argi);
+  perror("execv");
+  return 127;
+}

@@ -51,16 +51,19 @@ _libc.ptrace.argtypes = [ctypes.c_long, ctypes.c_long,
                          ctypes.c_void_p, ctypes.c_void_p]
 
 # ptrace requests
-TRACEME = 0
+# (TRACEME/SETOPTIONS are gone with the old fork path: the tracee is
+# spawned via the launcher stub and PTRACE_SEIZEd with options)
 CONT = 7
 GETREGS = 12
 SETREGS = 13
-SETOPTIONS = 0x4200
 SYSCALL = 24
 SYSEMU = 31
 
 OPT_SYSGOOD = 0x1           # PTRACE_O_TRACESYSGOOD
+OPT_TRACEEXEC = 0x10        # PTRACE_O_TRACEEXEC
 OPT_EXITKILL = 0x00100000   # PTRACE_O_EXITKILL
+SEIZE = 0x4206              # PTRACE_SEIZE
+EVENT_EXEC = 4              # PTRACE_EVENT_EXEC
 
 SYSCALL_TRAP = signal.SIGTRAP | 0x80     # sysgood syscall stop
 
@@ -84,8 +87,6 @@ _VDSO_STUBS = {
     b"getcpu": 309,
 }
 
-PR_SET_TSC, PR_TSC_SIGSEGV = 26, 2
-ADDR_NO_RANDOMIZE = 0x0040000
 
 NOMINAL_TSC_HZ = 1_000_000_000           # 1 GHz: cycles == sim ns
 
@@ -140,26 +141,84 @@ class _Tracer(threading.Thread):
         self.exited = threading.Event()
         self.sim_ns = 0
 
-    # -- child setup (between fork and exec; async-signal-safe-ish) ----
-    def _child(self) -> None:
+    # -- spawn + seize (replaces the old fork/TRACEME path) ------------
+    def _spawn_seize(self) -> int:
+        """Popen the launcher stub, wait for its self-SIGSTOP, SEIZE
+        it from THIS thread (all later ptrace requests must come from
+        the seizing thread), resume, and run to the real program's
+        PTRACE_EVENT_EXEC stop."""
+        import subprocess
+        import time as _time
+
+        from shadow_tpu import native as _native
+
+        launcher = [_native.launcher_path()]
+        if not self.emulate_tsc:
+            launcher.append("--no-tsc")
+        out = open(self.stdout_path, "wb")
+        err = open(self.stderr_path, "wb")
         try:
-            _libc.ptrace(TRACEME, 0, None, None)
-            _libc.personality(ADDR_NO_RANDOMIZE)
-            if self.emulate_tsc:
-                _libc.prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0)
-            out = os.open(self.stdout_path,
-                          os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
-            err = os.open(self.stderr_path,
-                          os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
-            devnull = os.open(os.devnull, os.O_RDONLY)
-            os.dup2(devnull, 0)
-            os.dup2(out, 1)
-            os.dup2(err, 2)
-            os.chdir(self.cwd)
-            os.execve(self.argv[0], self.argv, self.env)
-        except BaseException:
-            pass
-        os._exit(127)
+            proc = subprocess.Popen(
+                launcher + self.argv,
+                env=self.env, cwd=self.cwd, stdout=out, stderr=err,
+                stdin=subprocess.DEVNULL)
+        finally:
+            out.close()
+            err.close()
+        pid = proc.pid
+        self.pid = pid
+        self._popen = proc          # keeps the zombie reapable
+
+        # the launcher raise(SIGSTOP)s itself; as its parent we see
+        # the stop (or an early death) in one blocking wait
+        _, status = os.waitpid(pid, os.WUNTRACED)
+        if os.WIFEXITED(status):
+            raise _TraceeExited(os.WEXITSTATUS(status))
+        if os.WIFSIGNALED(status):
+            raise _TraceeExited(128 + os.WTERMSIG(status))
+
+        _ptrace(SEIZE, pid, None,
+                ctypes.c_void_p(OPT_SYSGOOD | OPT_EXITKILL |
+                                OPT_TRACEEXEC))
+        # consume the post-SEIZE ptrace (group-)stop notification if
+        # the kernel reports one before we resume; a CONT issued in
+        # the stop-to-ptrace-trap transition window returns ESRCH,
+        # which the retry below also absorbs
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 2.0:
+            r, _st = os.waitpid(pid, os.WNOHANG)
+            if r == pid:
+                break
+            _time.sleep(0.001)
+        os.kill(pid, signal.SIGCONT)
+
+        def cont(sig: int) -> None:
+            for _ in range(500):
+                try:
+                    _ptrace(CONT, pid, None,
+                            ctypes.c_void_p(sig) if sig else None)
+                    return
+                except OSError:
+                    _time.sleep(0.001)
+            raise OSError(f"pid={pid}: PTRACE_CONT kept failing")
+
+        # run the stub to the exec of the real program
+        deliver = 0
+        while True:
+            cont(deliver)
+            deliver = 0
+            _, status = os.waitpid(pid, 0)
+            if os.WIFEXITED(status):
+                raise _TraceeExited(os.WEXITSTATUS(status))
+            if os.WIFSIGNALED(status):
+                raise _TraceeExited(128 + os.WTERMSIG(status))
+            if (status >> 8) == (signal.SIGTRAP | (EVENT_EXEC << 8)):
+                break               # the real program's first moment
+            sig = os.WSTOPSIG(status)
+            if sig not in (signal.SIGSTOP, signal.SIGCONT,
+                           signal.SIGTRAP):
+                deliver = sig
+        return pid
 
     # -- vDSO patching (tracer thread, at the exec stop) ----------------
     def _patch_vdso(self) -> None:
@@ -321,25 +380,18 @@ class _Tracer(threading.Thread):
             cmd, payload = self.cmds.get()
             try:
                 if cmd == "spawn":
-                    # fork (not posix_spawn) because the tracer must be
-                    # the tracee's parent AND the same thread for every
-                    # later ptrace request. Known caveat: forking a
-                    # multithreaded process is only safe if the child
-                    # sticks to async-signal-safe work — _child() does
-                    # raw execve plumbing only, but a malloc-holding
-                    # thread at fork time could in principle deadlock
-                    # the pre-exec child (the reference isolates this
-                    # with a dedicated ForkProxy thread created before
-                    # threads proliferate, utility/fork_proxy.c).
-                    pid = os.fork()
-                    if pid == 0:
-                        self._child()           # never returns
-                    self.pid = pid
-                    sig = self._wait()          # exec SIGTRAP stop
-                    if sig != signal.SIGTRAP:
-                        log.warning("unexpected first stop sig=%d", sig)
-                    _ptrace(SETOPTIONS, pid, None,
-                            ctypes.c_void_p(OPT_SYSGOOD | OPT_EXITKILL))
+                    # NO os.fork() of the (JAX-threaded) simulator: a
+                    # non-exec fork with runtime threads holding locks
+                    # is a deadlock risk. Instead the child is spawned
+                    # via subprocess (vfork+exec) running the launcher
+                    # stub, which applies the pre-exec settings
+                    # (PR_SET_TSC survives execve, ASLR already off via
+                    # inherited personality) and SIGSTOPs itself; this
+                    # tracer thread PTRACE_SEIZEs it there and resumes
+                    # to the PTRACE_EVENT_EXEC stop of the real
+                    # program. Reference: utility/fork_proxy.c solves
+                    # the same hazard with a pre-forked proxy.
+                    pid = self._spawn_seize()
                     self._patch_vdso()
                     self.replies.put(("pid", pid))
                 elif cmd == "step":

@@ -209,3 +209,16 @@ def shim_path(build_if_missing: bool = True) -> str:
                         "build/libshadowtpu_shim.so"],
                        check=True, capture_output=True)
     return _SHIM_PATH
+
+
+_LAUNCHER_PATH = os.path.join(_NATIVE_DIR, "build",
+                              "shadowtpu_launcher")
+
+
+def launcher_path(build_if_missing: bool = True) -> str:
+    """Path to the ptrace-backend tracee launcher stub."""
+    if not os.path.exists(_LAUNCHER_PATH) and build_if_missing:
+        subprocess.run(["make", "-C", _NATIVE_DIR,
+                        "build/shadowtpu_launcher"],
+                       check=True, capture_output=True)
+    return _LAUNCHER_PATH
