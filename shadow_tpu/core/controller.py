@@ -262,4 +262,10 @@ class Controller:
             self.sim.runtime.close()
         m.finalize()
         m.stats.end_time = stop
+        if m.net_judge is not None:
+            log.info("hybrid perf: %d packets judged on device in %d "
+                     "batches (%.1f pkts/batch)", m.net_judge.packets,
+                     m.net_judge.batches,
+                     m.net_judge.packets / m.net_judge.batches
+                     if m.net_judge.batches else 0.0)
         return m.stats

@@ -735,9 +735,12 @@ class DeviceEngine:
         def _axis_min(x):
             return lax.all_gather(jnp.reshape(x, (1,)), AXIS).min()
 
-        def _run_shard(state, host_vertex, lat, rel, stop):
-            # `stop` is a traced scalar so one compiled program serves
-            # every stop_time for a given config/shape
+        def _run_shard(state, host_vertex, lat, rel, stop, final_stop):
+            # `stop` is where THIS invocation pauses (a traced scalar,
+            # so one compiled program serves every slice length);
+            # `final_stop` is the simulation end that window boundaries
+            # clamp to — pausing at heartbeat boundaries therefore
+            # yields the EXACT window sequence of an unsegmented run
             my_shard = lax.axis_index(AXIS)
             gid = (my_shard * H_loc + hidx).astype(jnp.int32)
 
@@ -750,7 +753,7 @@ class DeviceEngine:
 
             def body(c):
                 state, nxt, rounds = c
-                win_end = jnp.minimum(nxt + LOOKAHEAD, stop)
+                win_end = jnp.minimum(nxt + LOOKAHEAD, final_stop)
                 state = _round(state, win_end, gid, my_shard,
                                host_vertex, lat, rel)
                 return state, next_time(state), rounds + 1
@@ -778,7 +781,7 @@ class DeviceEngine:
         repl = self._repl_spec
         self._run = jax.jit(jax.shard_map(
             _run_shard, mesh=self.mesh,
-            in_specs=(specs, repl, repl, repl, repl),
+            in_specs=(specs, repl, repl, repl, repl, repl),
             out_specs=(specs, repl),
             check_vma=False,
         ))
@@ -790,14 +793,20 @@ class DeviceEngine:
         ))
 
     # ------------------------------------------------------------------
-    def run(self, state: dict, stop: Optional[int] = None):
+    def run(self, state: dict, stop: Optional[int] = None,
+            final_stop: Optional[int] = None):
         """Run to `stop` (default config.stop_time); returns
-        (final_state, rounds) on device. `stop` is a runtime scalar —
-        different stop times reuse the same compiled program."""
+        (final_state, rounds) on device. Both stops are runtime
+        scalars — every slice length reuses one compiled program.
+        `final_stop` (default = stop) is the window-clamping horizon:
+        pass the simulation end when pausing at intermediate
+        boundaries (heartbeats) so the window sequence — and thus the
+        trace — is identical to an unsegmented run."""
         repl = NamedSharding(self.mesh, self._repl_spec)
         hv = jax.device_put(jnp.asarray(self.host_vertex), repl)
         lat = jax.device_put(jnp.asarray(self.latency), repl)
         rel = jax.device_put(jnp.asarray(self.reliability), repl)
         stop_v = jnp.int64(self.config.stop_time if stop is None
                            else stop)
-        return self._run(state, hv, lat, rel, stop_v)
+        final_v = stop_v if final_stop is None else jnp.int64(final_stop)
+        return self._run(state, hv, lat, rel, stop_v, final_v)

@@ -186,8 +186,14 @@ class _Tracer(threading.Thread):
         # which the retry below also absorbs
         t0 = _time.monotonic()
         while _time.monotonic() - t0 < 2.0:
-            r, _st = os.waitpid(pid, os.WNOHANG)
+            r, st = os.waitpid(pid, os.WNOHANG)
             if r == pid:
+                # a tracee killed in this window must surface its exit
+                # code, not a stale-pid SIGCONT failure
+                if os.WIFEXITED(st):
+                    raise _TraceeExited(os.WEXITSTATUS(st))
+                if os.WIFSIGNALED(st):
+                    raise _TraceeExited(128 + os.WTERMSIG(st))
                 break
             _time.sleep(0.001)
         os.kill(pid, signal.SIGCONT)

@@ -33,8 +33,17 @@ class Tracker:
     _last: dict = field(default_factory=dict)
     _socket_header_logged: bool = False
 
+    _events_total_last: int = 0
+
     def on_event(self) -> None:
         self.events += 1
+
+    def set_events_total(self, total: int) -> None:
+        """Device path: the engine reports a CUMULATIVE per-host event
+        count; diff it into this interval's value (the CPU path counts
+        per event via on_event instead)."""
+        self.events = total - self._events_total_last
+        self._events_total_last = total
 
     def snapshot_host(self, host) -> None:
         """Diff cumulative host/NIC counters into interval values."""
