@@ -224,6 +224,7 @@ class ExperimentalOptions:
     socket_send_buffer: int = 131072
     socket_recv_autotune: bool = True
     socket_send_autotune: bool = True
+    tcp_congestion: str = "reno"            # tcp_cong.h algorithm name
     router_queue: str = "codel"             # codel | single | static
     router_static_capacity: int = 1024      # packets, for `static` queue
     # bandwidth + CoDel for RAW model-app sends (the socket path always
@@ -270,6 +271,10 @@ class ExperimentalOptions:
                       out.router_queue, ("codel", "single", "static"))
         _check_choice("experimental", "exchange",
                       out.exchange, ("all_gather", "all_to_all"))
+        from shadow_tpu.host.tcp import CONGESTION_ALGORITHMS
+        _check_choice("experimental", "tcp_congestion",
+                      out.tcp_congestion,
+                      sorted(CONGESTION_ALGORITHMS))
         _check_choice("experimental", "hybrid_cpu_policy",
                       out.hybrid_cpu_policy,
                       [p for p in SCHEDULER_POLICIES

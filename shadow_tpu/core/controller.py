@@ -226,6 +226,13 @@ class Controller:
                 router_static_capacity=cfg.experimental
                 .router_static_capacity,
                 bootstrap_end=cfg.general.bootstrap_end_time,
+                tcp_congestion=cfg.experimental.tcp_congestion,
+                tcp_recv_buffer=cfg.experimental.socket_recv_buffer,
+                tcp_send_buffer=cfg.experimental.socket_send_buffer,
+                tcp_recv_autotune=cfg.experimental
+                .socket_recv_autotune,
+                tcp_send_autotune=cfg.experimental
+                .socket_send_autotune,
             ),
         )
 

@@ -90,6 +90,22 @@ class NetOptions:
     router_queue: str = "codel"
     router_static_capacity: int = 1024
     bootstrap_end: int = 0
+    tcp_congestion: str = "reno"
+    # defaults live in host/tcp.py (DEFAULT_RECV_WINDOW/SEND_BUFFER)
+    tcp_recv_buffer: int = 0
+    tcp_send_buffer: int = 0
+    tcp_recv_autotune: bool = True
+    tcp_send_autotune: bool = True
+
+    def __post_init__(self):
+        from shadow_tpu.host.tcp import (
+            DEFAULT_RECV_WINDOW,
+            DEFAULT_SEND_BUFFER,
+        )
+        self.tcp_recv_buffer = self.tcp_recv_buffer \
+            or DEFAULT_RECV_WINDOW
+        self.tcp_send_buffer = self.tcp_send_buffer \
+            or DEFAULT_SEND_BUFFER
 
 
 @dataclass
@@ -131,7 +147,12 @@ class Manager:
             h.net = HostNetStack(
                 h, self, qdisc=no.qdisc, router_queue=no.router_queue,
                 router_static_capacity=no.router_static_capacity,
-                bootstrap_end=no.bootstrap_end)
+                bootstrap_end=no.bootstrap_end,
+                tcp_congestion=no.tcp_congestion,
+                tcp_recv_buffer=no.tcp_recv_buffer,
+                tcp_send_buffer=no.tcp_send_buffer,
+                tcp_recv_autotune=no.tcp_recv_autotune,
+                tcp_send_autotune=no.tcp_send_autotune)
 
     def resolve(self, name: str) -> int:
         if name not in self._name_to_id:

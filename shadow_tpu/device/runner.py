@@ -215,11 +215,20 @@ class DeviceRunner:
             # heartbeat boundary; window clamping stays on the global
             # stop so the trace equals an unsegmented run
             rounds = 0
+            budget = self.engine.config.max_rounds
             t = min(hb, stop)
             while True:
                 state, seg_rounds = self.engine.run(
                     state, stop=t, final_stop=stop)
                 rounds += int(seg_rounds)
+                if rounds >= budget:
+                    # the per-invocation cap would otherwise reset per
+                    # segment; enforce it cumulatively and don't emit
+                    # a heartbeat for an interval the budget cut short
+                    log.warning("max_rounds (%d) exhausted during "
+                                "heartbeat segmentation; stopping",
+                                budget)
+                    break
                 if t >= stop:
                     break
                 self._emit_heartbeats(t, state)

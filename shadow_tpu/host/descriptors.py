@@ -29,7 +29,7 @@ from collections import deque
 from typing import Optional
 
 from shadow_tpu.host.sockets import UdpSocket
-from shadow_tpu.host.tcp import TcpSocket, TcpState
+from shadow_tpu.host.tcp import DEFAULT_SEND_BUFFER, TcpSocket, TcpState
 
 VFD_BASE = 0x0FD00000           # keep in sync with native/shim/shim.c
 
@@ -145,7 +145,9 @@ class StreamChannel:
 class TcpDesc(Descriptor):
     """A TCP connection descriptor wrapping host/tcp.py's TcpSocket."""
 
-    SNDBUF = 131072               # app-visible send buffer cap
+    # getsockopt fallback pre-connect; live sockets use
+    # send_buffer_limit()
+    SNDBUF = DEFAULT_SEND_BUFFER
 
     def __init__(self, table: "DescriptorTable",
                  sock: Optional[TcpSocket] = None):
@@ -195,7 +197,7 @@ class TcpDesc(Descriptor):
         if s is None:
             return 0
         used = (s.snd_nxt - s.snd_una) + s.send_pending
-        return max(0, self.SNDBUF - used)
+        return max(0, s.send_buffer_limit() - used)
 
     def status(self) -> int:
         st = 0

@@ -35,9 +35,26 @@ class HostNetStack:
     def __init__(self, host, manager, qdisc: str = "fifo",
                  router_queue: str = "codel",
                  router_static_capacity: int = 1024,
-                 bootstrap_end: int = 0):
+                 bootstrap_end: int = 0,
+                 tcp_congestion: str = "reno",
+                 tcp_recv_buffer: int = 0,
+                 tcp_send_buffer: int = 0,
+                 tcp_recv_autotune: bool = True,
+                 tcp_send_autotune: bool = True):
+        from shadow_tpu.host.tcp import (
+            DEFAULT_RECV_WINDOW,
+            DEFAULT_SEND_BUFFER,
+        )
+        tcp_recv_buffer = tcp_recv_buffer or DEFAULT_RECV_WINDOW
+        tcp_send_buffer = tcp_send_buffer or DEFAULT_SEND_BUFFER
         self.host = host
         self._m = manager
+        # per-socket TCP knobs (TcpSocket reads these off its net)
+        self.tcp_congestion = tcp_congestion
+        self.tcp_recv_buffer = tcp_recv_buffer
+        self.tcp_send_buffer = tcp_send_buffer
+        self.tcp_recv_autotune = tcp_recv_autotune
+        self.tcp_send_autotune = tcp_send_autotune
         router = Router(make_router_queue(router_queue,
                                           router_static_capacity))
         self.eth = NetworkInterface(

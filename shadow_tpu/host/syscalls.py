@@ -792,9 +792,24 @@ class SyscallHandler:
                 val = SOCK_DGRAM if isinstance(desc, UdpDesc) \
                     else SOCK_STREAM
             elif opt == SO_SNDBUF:
-                val = TcpDesc.SNDBUF
+                sock = getattr(desc, "sock", None)
+                net = self.p.host.net
+                if isinstance(desc, TcpDesc) and sock is not None:
+                    val = sock.send_buffer_limit()
+                elif net is not None:
+                    val = net.tcp_send_buffer
+                else:
+                    val = TcpDesc.SNDBUF
             elif opt == SO_RCVBUF:
-                val = 174760
+                sock = getattr(desc, "sock", None)
+                net = self.p.host.net
+                if isinstance(desc, TcpDesc) and sock is not None:
+                    val = sock.recv_window
+                elif net is not None:
+                    val = net.tcp_recv_buffer
+                else:
+                    from shadow_tpu.host.tcp import DEFAULT_RECV_WINDOW
+                    val = DEFAULT_RECV_WINDOW
             elif opt == SO_ACCEPTCONN:
                 val = 1 if isinstance(desc, TcpListenDesc) else 0
         if val_ptr and len_ptr:

@@ -42,10 +42,60 @@ from shadow_tpu.host.sockets import BaseSocket
 MSS = simtime.CONFIG_TCP_MAX_SEGMENT_SIZE
 INIT_CWND_SEGMENTS = 10          # modern initial window (RFC 6928)
 DEFAULT_RECV_WINDOW = 174760     # reference socket_recv_buffer default
+DEFAULT_SEND_BUFFER = 131072     # reference socket_send_buffer default
+MAX_AUTOTUNE_BUFFER = 1 << 24    # 16 MiB cap for autotuned buffers
+RECV_EPOCH_NS = 200 * simtime.SIMTIME_ONE_MILLISECOND  # DRS epoch
 MIN_RTO_NS = 200 * simtime.SIMTIME_ONE_MILLISECOND
 MAX_RTO_NS = 60 * simtime.SIMTIME_ONE_SECOND
 TIME_WAIT_NS = simtime.CONFIG_TCP_TIMEWAIT_SECONDS \
     * simtime.SIMTIME_ONE_SECOND
+
+
+class RenoCongestion:
+    """NewReno congestion control (tcp_cong_reno.c:13-40): slow start
+    to ssthresh, AIMD avoidance, fast recovery with inflation. One
+    instance per socket, dispatched through the vtable points below —
+    the pluggable-CC seam of the reference's tcp_cong.h."""
+
+    name = "reno"
+
+    def on_ack(self, s, acked: int) -> None:
+        """New data cumulatively acked outside recovery."""
+        if s.cwnd < s.ssthresh:
+            s.cwnd += min(acked, MSS)             # slow start
+        else:
+            s.cwnd += max(1, MSS * MSS // s.cwnd)  # cong avoidance
+
+    def on_enter_recovery(self, s) -> None:
+        """Third duplicate ACK: fast retransmit + fast recovery."""
+        s.ssthresh = max(s._flight() // 2, 2 * MSS)
+        s.cwnd = s.ssthresh + 3 * MSS
+
+    def on_recovery_ack(self, s) -> None:
+        """Further dup ACK while in recovery: window inflation."""
+        s.cwnd += MSS
+
+    def on_exit_recovery(self, s) -> None:
+        s.cwnd = s.ssthresh
+
+    def on_rto(self, s) -> None:
+        """Retransmission timeout: collapse to one segment."""
+        s.ssthresh = max(s._flight() // 2, 2 * MSS)
+        s.cwnd = MSS
+
+
+# tcp_cong.h's algorithm registry; additional algorithms (cubic, bbr)
+# slot in here and are selected by experimental.tcp_congestion
+CONGESTION_ALGORITHMS = {"reno": RenoCongestion}
+
+
+def make_congestion(name: str):
+    try:
+        return CONGESTION_ALGORITHMS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown tcp congestion algorithm {name!r} "
+            f"(have: {sorted(CONGESTION_ALGORITHMS)})") from None
 
 
 class RetransmitTally:
@@ -129,12 +179,25 @@ class TcpSocket(BaseSocket):
         self.peer_window = DEFAULT_RECV_WINDOW
         self.tally = RetransmitTally()  # peer-SACKed spans
 
-        # congestion control (tcp_cong_reno.c)
+        # congestion control: pluggable vtable (tcp_cong.h), selected
+        # by experimental.tcp_congestion; Reno implements the
+        # reference's tcp_cong_reno.c
+        self.cc = make_congestion(getattr(net, "tcp_congestion",
+                                          "reno"))
         self.cwnd = INIT_CWND_SEGMENTS * MSS
         self.ssthresh = 1 << 30
         self.dup_acks = 0
         self.in_recovery = False
         self.recover = 0
+        # buffer sizing (reference tcp.c autotuning): the send cap
+        # tracks 2x cwnd when autotuned; the receive window doubles
+        # whenever an epoch fills it (simplified DRS), both bounded
+        self.send_buffer = getattr(net, "tcp_send_buffer",
+                                   DEFAULT_SEND_BUFFER)
+        self._send_autotune = getattr(net, "tcp_send_autotune", True)
+        self._recv_autotune = getattr(net, "tcp_recv_autotune", True)
+        self._recv_epoch_bytes = 0
+        self._recv_epoch_start = 0
 
         # RTO (RFC 6298)
         self.srtt: Optional[int] = None
@@ -147,7 +210,8 @@ class TcpSocket(BaseSocket):
         self.irs = 0
         self.rcv_nxt = 0
         self.reorder: dict[int, int] = {}      # seq -> len
-        self.recv_window = DEFAULT_RECV_WINDOW
+        self.recv_window = getattr(net, "tcp_recv_buffer",
+                                   DEFAULT_RECV_WINDOW)
         self.bytes_received = 0
         self.bytes_acked = 0
         # stats (tracker feed; retransmit split like tracker.c:12-50)
@@ -168,6 +232,15 @@ class TcpSocket(BaseSocket):
         self._emit(now, TcpFlags.SYN, seq=self.snd_nxt)
         self.snd_nxt += 1
         self._arm_rto(now)
+
+    def send_buffer_limit(self) -> int:
+        """App-visible send buffer cap; autotuned to track 2x cwnd so
+        the window, not the buffer, limits throughput (tcp.c send-side
+        autotuning)."""
+        if self._send_autotune:
+            return min(MAX_AUTOTUNE_BUFFER,
+                       max(self.send_buffer, 2 * self.cwnd))
+        return self.send_buffer
 
     def send(self, now: int, nbytes: int) -> int:
         """App write: queue nbytes for transmission."""
@@ -275,8 +348,7 @@ class TcpSocket(BaseSocket):
         if not self.retx:
             return
         # RTO fire (tcp retransmit timer): back off, collapse cwnd
-        self.ssthresh = max(self._flight() // 2, 2 * MSS)
-        self.cwnd = MSS
+        self.cc.on_rto(self)
         self.dup_acks = 0
         self.in_recovery = False
         self.rto = min(self.rto * 2, MAX_RTO_NS)
@@ -391,17 +463,14 @@ class TcpSocket(BaseSocket):
             if self.in_recovery:
                 if ack >= self.recover:
                     self.in_recovery = False
-                    self.cwnd = self.ssthresh
+                    self.cc.on_exit_recovery(self)
                     self.dup_acks = 0
                 else:
                     # NewReno partial ACK: retransmit next hole
                     self._retransmit_first(now)
             else:
                 self.dup_acks = 0
-                if self.cwnd < self.ssthresh:
-                    self.cwnd += min(acked, MSS)          # slow start
-                else:
-                    self.cwnd += max(1, MSS * MSS // self.cwnd)
+                self.cc.on_ack(self, acked)
             self._restart_rto(now)
             self._try_send(now)
             if self.on_writable:
@@ -410,13 +479,12 @@ class TcpSocket(BaseSocket):
             self.dup_acks += 1
             if self.dup_acks == 3 and not self.in_recovery:
                 # fast retransmit + fast recovery
-                self.ssthresh = max(self._flight() // 2, 2 * MSS)
-                self.cwnd = self.ssthresh + 3 * MSS
+                self.cc.on_enter_recovery(self)
                 self.in_recovery = True
                 self.recover = self.snd_nxt
                 self._retransmit_first(now)
             elif self.in_recovery:
-                self.cwnd += MSS                          # inflation
+                self.cc.on_recovery_ack(self)
                 self._try_send(now)
 
     def _sample_rtt(self, now: int, ts_echo: int) -> None:
@@ -452,6 +520,21 @@ class TcpSocket(BaseSocket):
             delivered += sz
             self.rcv_nxt += sz
         self.bytes_received += delivered
+        # receive-buffer autotuning (tcp.c's dynamic right-sizing,
+        # simplified): a time-bounded epoch that fills the advertised
+        # window means the sender is window-limited — double it. The
+        # epoch bound keeps slow trickle flows from accumulating their
+        # way to the cap over a lifetime.
+        if self._recv_autotune:
+            if now - self._recv_epoch_start > RECV_EPOCH_NS:
+                self._recv_epoch_start = now
+                self._recv_epoch_bytes = 0
+            self._recv_epoch_bytes += delivered
+            if self._recv_epoch_bytes >= self.recv_window:
+                self.recv_window = min(MAX_AUTOTUNE_BUFFER,
+                                       self.recv_window * 2)
+                self._recv_epoch_bytes = 0
+                self._recv_epoch_start = now
         self._send_ack(now)
         if self.on_data:
             self.on_data(self.net.ctx, self, delivered, now)
