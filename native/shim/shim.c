@@ -33,6 +33,7 @@
 #define _GNU_SOURCE
 #include <errno.h>
 #include <ifaddrs.h>
+#include <stdarg.h>
 #include <net/if.h>
 #include <netdb.h>
 #include <netinet/in.h>
@@ -124,6 +125,8 @@ _Static_assert(__builtin_offsetof(ShimChannel, msg_to_simulator) == 152,
 
 static int g_enabled = 0;
 static int g_trace_traps = 0;
+static void shim_logf(const char *fmt, ...)
+    __attribute__((format(printf, 1, 2)));
 static ShimChannel *g_ch = NULL;     /* main thread's channel */
 static char *g_arena_base = NULL;
 
@@ -631,11 +634,8 @@ static void sigsys_handler(int sig, siginfo_t *info, void *vctx) {
   g_in_handler = 1;
   t_trap_ctx = ctx;
   long nr = (long)g[REG_RAX];
-  if (g_trace_traps) {
-    char tb[48];
-    int tn = snprintf(tb, sizeof tb, "[trap %ld]", nr);
-    shim_rawsyscall(SYS_write, 2, (long)tb, tn, 0, 0, 0);
-  }
+  if (g_trace_traps)
+    shim_logf("trap nr=%ld", nr);
   long args[6] = {(long)g[REG_RDI], (long)g[REG_RSI], (long)g[REG_RDX],
                   (long)g[REG_R10], (long)g[REG_R8],  (long)g[REG_R9]};
   long saved_errno = errno;
@@ -1338,6 +1338,94 @@ sighandler_t signal(int signum, sighandler_t handler) {
   return old;
 }
 
+/* ---- shim logger (shim_logger.c analogue) -------------------------- */
+/* Diagnostics from inside the plugin, stamped with SIMULATED time
+ * (the emulated CLOCK_MONOTONIC), written to the fd opened at init
+ * from SHADOWTPU_SHIM_LOG (default: stderr, which the spawner
+ * redirects into the host's data dir). Uses only raw syscalls +
+ * snprintf — safe wherever the funnel is. */
+
+static int g_log_fd = 2;
+
+static void shim_logf(const char *fmt, ...) {
+  char buf[256];
+  long secs = 0, nanos = 0;
+  /* never roundtrip for the timestamp while servicing a trap: the
+   * extra emulated clock_gettime would change simulator-visible
+   * behavior (an added syscall event + an earlier signal-delivery
+   * boundary) — tracing must be a passive observer */
+  if (g_enabled && !g_in_handler) {
+    struct timespec ts;
+    long args[6] = {1 /* CLOCK_MONOTONIC */, (long)&ts, 0, 0, 0, 0};
+    if (shim_emulated_syscall(SYS_clock_gettime, args) == 0) {
+      secs = ts.tv_sec;
+      nanos = ts.tv_nsec;
+    }
+  }
+  int n = snprintf(buf, sizeof buf, "%02ld:%02ld:%02ld.%09ld [shim] ",
+                   secs / 3600, (secs / 60) % 60, secs % 60, nanos);
+  va_list ap;
+  va_start(ap, fmt);
+  n += vsnprintf(buf + n, sizeof buf - (size_t)n, fmt, ap);
+  va_end(ap);
+  if (n > (int)sizeof buf - 2)
+    n = (int)sizeof buf - 2;
+  buf[n++] = '\n';
+  shim_rawsyscall(SYS_write, g_log_fd, (long)buf, n, 0, 0, 0);
+}
+
+/* ---- OpenSSL RNG overrides (openssl_preload analogue) -------------- */
+/* The reference ships a separate preload lib overriding OpenSSL's
+ * RAND_* so crypto apps (Tor!) draw from the deterministic seeded
+ * stream (shadow_openssl_rng.c). Same effect here: the overrides
+ * funnel into the trapped getrandom, which the simulator serves from
+ * the host's seeded RNG. Signatures are ABI-stable C, so no OpenSSL
+ * headers are needed; unlinked symbols simply never bind. */
+
+static int shim_rand_fill(unsigned char *buf, int num) {
+  if (num < 0)
+    return 0;
+  long off = 0;
+  while (off < num) {
+    long r = g_enabled
+                 ? shim_emulated_syscall(
+                       SYS_getrandom,
+                       (long[6]){(long)(buf + off), num - off, 0, 0, 0,
+                                 0})
+                 : shim_rawsyscall(SYS_getrandom, (long)(buf + off),
+                                   num - off, 0, 0, 0, 0);
+    if (r <= 0)
+      return 0;
+    off += r;
+  }
+  return 1;
+}
+
+int RAND_bytes(unsigned char *buf, int num) {
+  return shim_rand_fill(buf, num);
+}
+
+int RAND_priv_bytes(unsigned char *buf, int num) {
+  return shim_rand_fill(buf, num);
+}
+
+int RAND_pseudo_bytes(unsigned char *buf, int num) {
+  return shim_rand_fill(buf, num);
+}
+
+int RAND_status(void) { return 1; }
+int RAND_poll(void) { return 1; }
+void RAND_seed(const void *buf, int num) {
+  (void)buf;
+  (void)num; /* determinism: external entropy is ignored */
+}
+void RAND_add(const void *buf, int num, double entropy) {
+  (void)buf;
+  (void)num;
+  (void)entropy;
+}
+void RAND_cleanup(void) {}
+
 /* ---- init ---------------------------------------------------------- */
 
 static void shim_log_fail(const char *msg) {
@@ -1374,6 +1462,13 @@ __attribute__((constructor)) static void shim_init(void) {
     return;
   }
   g_trace_traps = getenv("SHADOWTPU_TRACE_TRAPS") != NULL;
+  const char *logpath = getenv("SHADOWTPU_SHIM_LOG");
+  if (logpath) {
+    int lfd = open(logpath,
+                   O_WRONLY | O_CREAT | O_APPEND | O_CLOEXEC, 0644);
+    if (lfd >= 0)
+      g_log_fd = lfd;
+  }
   g_arena_base = (char *)base;
   g_ch = (ShimChannel *)(g_arena_base + strtoull(off_s, NULL, 10));
 

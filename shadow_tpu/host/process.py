@@ -93,6 +93,14 @@ class ManagedRuntime:
     @property
     def arena(self):
         if self._arena is None:
+            # unlink shm files orphaned by dead/killed simulator runs
+            # before creating ours (shmem_cleanup.c via main.c:247)
+            try:
+                n = native.cleanup_orphans()
+                if n:
+                    log.info("cleaned up %d orphaned shm file(s)", n)
+            except Exception as e:      # never block startup on this
+                log.debug("orphan cleanup skipped: %s", e)
             name = f"shadowtpu_shm_{os.getpid()}_{self.seed}"
             self._arena = native.ShmArena(name, size=1 << 22,
                                           create=True)

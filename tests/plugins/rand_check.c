@@ -1,0 +1,39 @@
+/* Deterministic randomness: getrandom(2) and the shim's OpenSSL
+ * RAND_bytes override (openssl_preload analogue) must both draw from
+ * the simulator's seeded per-host stream — identical across runs of
+ * the same seed. RAND_bytes is resolved with dlsym(RTLD_DEFAULT): no
+ * libcrypto dev files in the image, and under the simulator the
+ * LD_PRELOADed shim provides the symbol exactly like it would shadow
+ * a real libcrypto's. */
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <stdio.h>
+#include <sys/random.h>
+
+static void hex(const char *tag, const unsigned char *b, int n) {
+  printf("%s ", tag);
+  for (int i = 0; i < n; i++)
+    printf("%02x", b[i]);
+  printf("\n");
+}
+
+int main(void) {
+  unsigned char a[8], b[8];
+  if (getrandom(a, sizeof a, 0) != (long)sizeof a) {
+    perror("getrandom");
+    return 1;
+  }
+  hex("getrandom", a, sizeof a);
+  int (*rand_bytes)(unsigned char *, int) =
+      (int (*)(unsigned char *, int))dlsym(RTLD_DEFAULT, "RAND_bytes");
+  if (!rand_bytes) {
+    printf("randbytes unavailable\n");
+    return 0;
+  }
+  if (rand_bytes(b, sizeof b) != 1) {
+    fprintf(stderr, "RAND_bytes failed\n");
+    return 1;
+  }
+  hex("randbytes", b, sizeof b);
+  return 0;
+}
