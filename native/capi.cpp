@@ -18,10 +18,11 @@ using shadow_tpu::ShmBlockHandle;
 // plain C structs; a layout drift here must fail the build, not the
 // plugin at runtime.
 static_assert(sizeof(IpcMessage) == 128, "ipc message abi");
-static_assert(sizeof(IpcChannel) == 280, "ipc channel abi");
+static_assert(sizeof(IpcChannel) == 288, "ipc channel abi");
 static_assert(offsetof(IpcChannel, plugin_exited) == 16, "ipc abi");
 static_assert(offsetof(IpcChannel, msg_to_plugin) == 24, "ipc abi");
 static_assert(offsetof(IpcChannel, msg_to_simulator) == 152, "ipc abi");
+static_assert(offsetof(IpcChannel, sim_now) == 280, "ipc abi");
 
 extern "C" {
 
@@ -85,6 +86,11 @@ void shadowtpu_ipc_init(void* mem, uint32_t spin_max) {
 
 void shadowtpu_ipc_send_to_plugin(void* ch, const IpcMessage* m) {
   static_cast<IpcChannel*>(ch)->send_to_plugin(*m);
+}
+
+void shadowtpu_ipc_set_sim_now(void* ch, uint64_t now_ns) {
+  static_cast<IpcChannel*>(ch)->sim_now.store(
+      now_ns, std::memory_order_relaxed);
 }
 
 int shadowtpu_ipc_recv_from_plugin(void* ch, IpcMessage* out) {

@@ -138,6 +138,9 @@ struct IpcChannel {
   std::atomic<uint32_t> native_thread_alive;
   IpcMessage msg_to_plugin;
   IpcMessage msg_to_simulator;
+  // Simulated CLOCK_MONOTONIC ns, published by the simulator at every
+  // syscall dispatch; read passively by the shim (log timestamps).
+  std::atomic<uint64_t> sim_now;
 
   void init(uint32_t spin_max) {
     to_plugin.init(spin_max);
@@ -146,6 +149,7 @@ struct IpcChannel {
     native_thread_alive.store(0, std::memory_order_relaxed);
     memset(&msg_to_plugin, 0, sizeof(msg_to_plugin));
     memset(&msg_to_simulator, 0, sizeof(msg_to_simulator));
+    sim_now.store(0, std::memory_order_relaxed);
   }
 
   // simulator side

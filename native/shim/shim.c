@@ -112,10 +112,16 @@ typedef struct {
   volatile uint32_t native_thread_alive;
   ShimMsg msg_to_plugin;
   ShimMsg msg_to_simulator;
+  /* Simulated CLOCK_MONOTONIC ns, published by the simulator at every
+   * syscall dispatch (ref shim_event.h:17-22 sim_time block): lets the
+   * shim timestamp logs — and potentially fast-path time reads —
+   * without an IPC round trip. */
+  volatile uint64_t sim_now;
 } ShimChannel;
 
 _Static_assert(sizeof(ShimMsg) == 128, "msg abi");
-_Static_assert(sizeof(ShimChannel) == 280, "channel abi");
+_Static_assert(sizeof(ShimChannel) == 288, "channel abi");
+_Static_assert(__builtin_offsetof(ShimChannel, sim_now) == 280, "abi");
 _Static_assert(__builtin_offsetof(ShimChannel, plugin_exited) == 16, "abi");
 _Static_assert(__builtin_offsetof(ShimChannel, msg_to_plugin) == 24, "abi");
 _Static_assert(__builtin_offsetof(ShimChannel, msg_to_simulator) == 152,
@@ -581,8 +587,17 @@ static long shim_do_syscall(long nr, const long args[6]) {
   }
   if (nr == SYS_fork || nr == SYS_vfork)
     return shim_handle_fork(args);
-  if (nr == SYS_rt_sigprocmask)
-    return shim_sigprocmask(args);
+  if (nr == SYS_rt_sigprocmask) {
+    /* native change first (authoritative result, SIGSYS stripped,
+     * trap frame mirrored), then inform the simulator so virtual
+     * IPC_SIGNAL delivery honors the blocked set; the handler
+     * answers DONE(0), never NATIVE (a raw re-execution here would
+     * install the unstripped set) */
+    long r = shim_sigprocmask(args);
+    if (r == 0 && args[1] /* query-only calls change nothing */)
+      (void)shim_emulated_syscall(nr, args);
+    return r;
+  }
   if (nr == SYS_wait4) {
     /* virtual wait; then reap any real zombie children so the
      * plugin's process table doesn't accumulate them */
@@ -670,7 +685,8 @@ static const int kTrapSyscalls[] = {
     SYS_vfork,        SYS_futex,        SYS_sysinfo,
     SYS_gettid,       SYS_set_tid_address, SYS_tgkill,
     SYS_rt_sigprocmask, SYS_wait4,      SYS_kill,
-    SYS_rt_sigaction, SYS_pause,
+    SYS_rt_sigaction, SYS_pause,       SYS_rt_sigpending,
+    SYS_rt_sigtimedwait, SYS_rt_sigsuspend, SYS_tkill,
 #ifdef SYS_clone3
     SYS_clone3,       /* refused with ENOSYS: glibc falls back to clone */
 #endif
@@ -702,6 +718,8 @@ static int shim_install_seccomp(void) {
 
 #define EMIT(code_, k_, jt_, jf_)                                       \
   do {                                                                  \
+    if (n >= MAX_INS)                                                   \
+      return -1;                                                        \
     prog[n].f.code = (code_);                                           \
     prog[n].f.k = (k_);                                                 \
     prog[n].f.jt = 0;                                                   \
@@ -1350,17 +1368,14 @@ static int g_log_fd = 2;
 static void shim_logf(const char *fmt, ...) {
   char buf[256];
   long secs = 0, nanos = 0;
-  /* never roundtrip for the timestamp while servicing a trap: the
-   * extra emulated clock_gettime would change simulator-visible
-   * behavior (an added syscall event + an earlier signal-delivery
-   * boundary) — tracing must be a passive observer */
-  if (g_enabled && !g_in_handler) {
-    struct timespec ts;
-    long args[6] = {1 /* CLOCK_MONOTONIC */, (long)&ts, 0, 0, 0, 0};
-    if (shim_emulated_syscall(SYS_clock_gettime, args) == 0) {
-      secs = ts.tv_sec;
-      nanos = ts.tv_nsec;
-    }
+  /* passive timestamp: the simulator publishes sim time into the
+   * channel at every dispatch (ShimChannel.sim_now), so tracing never
+   * adds a syscall event or an extra delivery boundary */
+  ShimChannel *ch = cur_ch();
+  if (g_enabled && ch) {
+    uint64_t t = ch->sim_now;
+    secs = (long)(t / 1000000000ull);
+    nanos = (long)(t % 1000000000ull);
   }
   int n = snprintf(buf, sizeof buf, "%02ld:%02ld:%02ld.%09ld [shim] ",
                    secs / 3600, (secs / 60) % 60, secs % 60, nanos);

@@ -132,6 +132,10 @@ class ManagedThread:
         self.parked: Optional[tuple] = None      # (nr, args)
         self.syscall_state: dict = {}
         self.clear_ctid = 0         # CLONE_CHILD_CLEARTID address
+        self.sigmask = 0            # virtual blocked set (bit sig-1)
+        self.restore_mask: Optional[int] = None  # sigsuspend epilogue
+        self.sigwait: Optional[tuple] = None     # (set, siginfo_ptr)
+        self.pending: list = []     # thread-directed (tkill) queue
 
     def schedule_continue(self, ctx) -> None:
         """Condition wakeup target: resume THIS thread's parked
@@ -250,6 +254,13 @@ class ManagedProcess:
         stdout_f = open(stdout_path, "wb")
         stderr_f = open(stderr_path, "wb")
 
+        # publish sim time into the channel only when the shim will
+        # read it (log/trace runs): keeps the per-dispatch hot path
+        # free of a ctypes call nobody consumes
+        self.publish_sim_time = (
+            "SHADOWTPU_SHIM_LOG" in os.environ
+            or "SHADOWTPU_TRACE_TRAPS" in os.environ)
+
         env = self._child_env(host_dir)
         env["SHADOWTPU_SHM"] = self.runtime.arena.name
         env["SHADOWTPU_IPC_OFFSET"] = str(self.channel.offset)
@@ -365,6 +376,13 @@ class ManagedProcess:
                 cond.wake(ctx2)
 
             self._push_task(max(b.deadline, ctx.now), timeout_task)
+        # a signal that was already pending when this park began (e.g.
+        # raised-while-blocked, then sigsuspend swapped the mask) must
+        # interrupt it now — nothing else will re-deliver it
+        if self._has_deliverable(th):
+            self._push_task(ctx.now, lambda ctx2, ev: (
+                self._interrupt_parked(ctx2, th)
+                if th.parked is not None else None))
 
     def _resume_thread(self, ctx, th: ManagedThread) -> None:
         if not self.alive or not th.alive or th.parked is None:
@@ -398,6 +416,7 @@ class ManagedProcess:
         ch = native.IpcChannel(self.runtime.arena,
                                spin_max=self.runtime.spin_max)
         th = ManagedThread(self, vtid, ch)
+        th.sigmask = self.current.sigmask     # clone inherits the mask
         CLONE_CHILD_CLEARTID = 0x00200000
         if flags & CLONE_CHILD_CLEARTID:
             th.clear_ctid = args[3]
@@ -473,6 +492,7 @@ class ManagedProcess:
         child.exit_code = None
         child.futexes = {}          # private memory from here on
         main = ManagedThread(child, vpid, ch)
+        main.sigmask = self.current.sigmask   # fork inherits the mask
         child.threads = {vpid: main}
         child.current = main
         child._rng_counter = 0
@@ -530,18 +550,44 @@ class ManagedProcess:
     SA_RESTART = 0x10000000
     _DEFAULT_IGNORE = {17, 18, 23, 28}   # CHLD, CONT, URG, WINCH
 
-    def deliver_signal(self, ctx, sig: int) -> None:
+    def deliver_signal(self, ctx, sig: int,
+                       target: "ManagedThread" = None) -> None:
         """Queue a virtual signal; handlers run in the plugin at its
         next syscall boundary (IPC_SIGNAL), exactly where the kernel
         delivers. Default dispositions: terminate, or ignore for the
         usual set. A parked (blocked-syscall) thread is interrupted
-        now: handler first, then -EINTR or an SA_RESTART redispatch."""
+        now: handler first, then -EINTR or an SA_RESTART redispatch.
+        `target` directs the signal at one thread (tkill/tgkill):
+        only that thread's mask gates it and only its queue holds it;
+        standard (non-RT, <32) signals coalesce like the kernel's."""
         if not self.alive:
             return
         if sig == self.SIGKILL:
             self.term_signal = sig
             self.exit_code = 128 + sig
             self._kill(ctx)
+            return
+        bit = 1 << (sig - 1)
+        # sigtimedwait consumers outrank dispositions: a thread parked
+        # waiting on this signal takes it synchronously, no handler
+        for th in self.threads.values():
+            if th.alive and th.parked is not None and \
+                    th.sigwait is not None and th.sigwait[0] & bit \
+                    and (target is None or th is target):
+                self._complete_sigwait(ctx, th, sig)
+                return
+        gate = [target] if target is not None else \
+            [t for t in self.threads.values() if t.alive]
+        queue = target.pending if target is not None \
+            else self.pending_signals
+        if gate and all(t.sigmask & bit for t in gate):
+            # blocked at every eligible thread: queued regardless of
+            # disposition (kernel prepare_signal: sig_ignored() is
+            # false when blocked — the block-then-sigtimedwait reaper
+            # idiom); ignore/default discard happens at delivery in
+            # _flush_signals
+            if sig >= 32 or sig not in queue:
+                queue.append(sig)
             return
         act = self.sigactions.get(sig)
         handler = act[0] if act else self.SIG_DFL
@@ -556,21 +602,59 @@ class ManagedProcess:
             self.exit_code = 128 + sig
             self._kill(ctx)
             return
-        self.pending_signals.append(sig)
-        for th in self.threads.values():
-            if th.alive and th.parked is not None:
+        if sig < 32 and sig in queue:
+            return              # standard signals don't stack
+        queue.append(sig)
+        for th in gate:
+            if th.parked is not None and not th.sigmask & bit:
                 self._interrupt_parked(ctx, th)
                 break
+
+    def _dequeue_deliverable(self, th: "ManagedThread"):
+        """Pop the first pending signal `th` doesn't block: directed
+        queue first, then the shared process queue (kernel order)."""
+        for q in (th.pending, self.pending_signals):
+            for i, s in enumerate(q):
+                if not th.sigmask & (1 << (s - 1)):
+                    return q.pop(i)
+        return None
+
+    def _has_deliverable(self, th: "ManagedThread") -> bool:
+        return any(not th.sigmask & (1 << (s - 1))
+                   for s in th.pending + self.pending_signals)
+
+    def _complete_sigwait(self, ctx, th: "ManagedThread",
+                          sig: int) -> None:
+        """Finish a parked rt_sigtimedwait with `sig` (no handler)."""
+        th.parked = None
+        info_ptr = th.sigwait[1]
+        th.sigwait = None
+        self.handler.write_siginfo(info_ptr, sig)
+        self.current = th
+        self._reply_to(th, sig)
+        th.syscall_state = {}
+        self._continue(ctx, th)
 
     def _flush_signals(self, ctx, th: ManagedThread) -> list[tuple]:
         """Run every pending handler in the plugin (the thread must be
         awaiting a reply). Returns the delivered (sig, act) list."""
         delivered = []
-        while self.pending_signals and self.alive and th.alive:
-            sig = self.pending_signals.pop(0)
+        while self.alive and th.alive:
+            sig = self._dequeue_deliverable(th)
+            if sig is None:
+                break           # everything pending is blocked here
             act = self.sigactions.get(sig)
-            if act is None or act[0] in (self.SIG_DFL, self.SIG_IGN):
-                continue        # disposition changed since queueing
+            if act is None or act[0] == self.SIG_DFL:
+                # disposition changed since queueing — or it was queued
+                # while blocked and the default action applies now
+                if sig in self._DEFAULT_IGNORE:
+                    continue
+                self.term_signal = sig
+                self.exit_code = 128 + sig
+                self._kill(ctx)
+                break
+            if act[0] == self.SIG_IGN:
+                continue
             msg = native.IpcMessage()
             msg.kind = native.IPC_SIGNAL
             msg.number = sig
@@ -623,12 +707,26 @@ class ManagedProcess:
         nr, args = th.parked
         th.parked = None
         delivered = self._flush_signals(ctx, th)
+        if not self.alive or not th.alive:
+            return
         if not delivered:
             # nothing ran (dispositions changed): re-park untouched
             th.parked = (nr, args)
             return
+        if th.restore_mask is not None:
+            # sigsuspend epilogue: handler ran, original mask returns
+            th.sigmask = th.restore_mask
+            th.restore_mask = None
+        th.sigwait = None       # an interrupted sigtimedwait is over
         from shadow_tpu.host.syscalls import EINTR, NR
-        restartable = nr not in (NR["pause"],)
+        # the kernel's never-restarted set (man 7 signal): waits,
+        # sleeps, and the pure signal syscalls EINTR regardless of
+        # SA_RESTART
+        _NO_RESTART = {NR[n] for n in (
+            "pause", "rt_sigsuspend", "rt_sigtimedwait", "poll",
+            "ppoll", "select", "pselect6", "epoll_wait", "epoll_pwait",
+            "nanosleep", "clock_nanosleep")}
+        restartable = nr not in _NO_RESTART
         if restartable and all(a[1] & self.SA_RESTART
                                for _, a in delivered):
             self.current = th
@@ -711,6 +809,11 @@ class ManagedProcess:
 
     # -- the IPC ping-pong loop (thread_preload.c event loop) -----------
     def _reply_to(self, th: ManagedThread, res) -> None:
+        if th.restore_mask is not None:
+            # a p-variant wait's temporary mask (or sigsuspend's, on
+            # paths _interrupt_parked didn't cover) ends with the call
+            th.sigmask = th.restore_mask
+            th.restore_mask = None
         msg = native.IpcMessage()
         if res is NATIVE:
             msg.kind = native.IPC_SYSCALL_NATIVE
@@ -767,7 +870,8 @@ class ManagedProcess:
                 res = -38              # ENOSYS
             # deliver pending virtual signals (e.g. a self-kill) at
             # the syscall boundary, before the result lands
-            if self.pending_signals and th.alive and self.alive:
+            if (self.pending_signals or th.pending) and th.alive \
+                    and self.alive:
                 self._flush_signals(ctx, th)
                 if not self.alive:
                     return             # a fatal disposition fired

@@ -80,6 +80,8 @@ NR = dict(
     sched_yield=24, gettid=186, sysinfo=99, futex=202,
     set_tid_address=218, sendfile=40, tgkill=234, clone3=435,
     wait4=61, kill=62, rt_sigaction=13, pause=34,
+    rt_sigprocmask=14, rt_sigpending=127, rt_sigtimedwait=128,
+    rt_sigsuspend=130, tkill=200,
 )
 NR_NAME = {v: k for k, v in NR.items()}
 
@@ -208,6 +210,10 @@ class SyscallHandler:
     # -- dispatch ------------------------------------------------------
     def dispatch(self, ctx, nr: int, args):
         self.p.host.net.ctx = ctx
+        if getattr(self.p, "publish_sim_time", False):
+            ch = getattr(self.p.current, "channel", None)
+            if ch is not None:
+                ch.set_sim_now(ctx.now)   # passive shim clock (logf)
         name = NR_NAME.get(nr)
         if name is None:
             return NATIVE
@@ -484,7 +490,13 @@ class SyscallHandler:
         """Signal a thread by virtual tid. Delivery is process-level
         (one signal queue per process, like our one-thread-at-a-time
         execution model)."""
-        tid, sig = _s32(a[1]), _s32(a[2])
+        return self._thread_kill(ctx, _s32(a[1]), _s32(a[2]))
+
+    def sys_tkill(self, ctx, a):
+        """Obsolete tgkill without the tgid check (signal.c tkill)."""
+        return self._thread_kill(ctx, _s32(a[0]), _s32(a[1]))
+
+    def _thread_kill(self, ctx, tid: int, sig: int):
         threads = getattr(self.p, "threads", {})
         if tid not in threads or not threads[tid].alive:
             return -3           # ESRCH
@@ -494,8 +506,125 @@ class SyscallHandler:
             return -ENOSYS
         if sig < 1 or sig > 64:
             return -EINVAL
-        self.p.deliver_signal(ctx, sig)
+        self.p.deliver_signal(ctx, sig, target=threads[tid])
         return 0
+
+    # -- signal masks & synchronous waits (signal.c analogues) ---------
+    _UNBLOCKABLE = (1 << 8) | (1 << 18)     # SIGKILL, SIGSTOP
+
+    def sys_rt_sigprocmask(self, ctx, a):
+        """Virtual-mask mirror: the shim already performed the native
+        mask change (shim.c shim_sigprocmask — SIGSYS stripped, trap
+        frame mirrored) and reports here so IPC_SIGNAL delivery can
+        honor blocking. Ptrace backend: kernel semantics, untouched.
+        Ref: src/main/host/syscall/signal.c rt_sigprocmask."""
+        if not getattr(self.p, "supports_signals", False):
+            return NATIVE
+        how, set_ptr, size = _s32(a[0]), a[1], a[3]
+        th = self.p.current
+        if set_ptr and size >= 8:
+            s = struct.unpack("<Q", self.mem.read(set_ptr, 8))[0]
+            s &= ~self._UNBLOCKABLE
+            if how == 0:                    # SIG_BLOCK
+                th.sigmask |= s
+            elif how == 1:                  # SIG_UNBLOCK
+                th.sigmask &= ~s
+            elif how == 2:                  # SIG_SETMASK
+                th.sigmask = s
+            else:
+                return -EINVAL
+        # the post-dispatch boundary flush delivers newly unblocked
+        # pending signals before this result lands
+        return 0
+
+    def sys_rt_sigpending(self, ctx, a):
+        if not getattr(self.p, "supports_signals", False):
+            return NATIVE
+        ptr, size = a[0], a[1]
+        pend = 0
+        for s in list(getattr(self.p, "pending_signals", ())) + \
+                list(self.p.current.pending):
+            pend |= 1 << (s - 1)
+        if ptr and size >= 8:
+            self.mem.write(ptr, struct.pack("<Q", pend))
+        return 0
+
+    def sys_rt_sigsuspend(self, ctx, a):
+        """Swap the mask and park until a virtual signal's handler has
+        run; always fails with EINTR, mask restored by the delivery
+        path (ManagedProcess._interrupt_parked)."""
+        if not getattr(self.p, "supports_signals", False):
+            return NATIVE
+        if not a[0]:
+            return -EFAULT
+        th = self.p.current
+        st = self.state
+        if "ss_armed" not in st:
+            st["ss_armed"] = True
+            mask = struct.unpack("<Q", self.mem.read(a[0], 8))[0]
+            th.restore_mask = th.sigmask
+            th.sigmask = mask & ~self._UNBLOCKABLE
+        raise Blocked()
+
+    def _swap_pmask(self, ptr: int) -> None:
+        """The p-variant waits' atomic temporary mask (ppoll/pselect6/
+        epoll_pwait): installed on first entry, restored by the reply
+        path (ManagedProcess._reply_to) when the result lands — so
+        virtual delivery can interrupt a park the temp mask admits."""
+        if not ptr or not getattr(self.p, "supports_signals", False):
+            return
+        st = self.state
+        if st.get("pmask_set"):
+            return
+        st["pmask_set"] = True
+        th = self.p.current
+        mask = struct.unpack("<Q", self.mem.read(ptr, 8))[0]
+        th.restore_mask = th.sigmask
+        th.sigmask = mask & ~self._UNBLOCKABLE
+
+    def sys_rt_sigtimedwait(self, ctx, a):
+        """Synchronously consume a queued signal from `set` without
+        running its handler (signal.c rt_sigtimedwait). Signals in the
+        wait set are normally blocked by the caller; delivery to a
+        parked waiter happens in ManagedProcess.deliver_signal."""
+        if not getattr(self.p, "supports_signals", False):
+            return NATIVE
+        th = self.p.current
+        set_ptr, info_ptr, timeout_ptr = a[0], a[1], a[2]
+        if not set_ptr:
+            return -EFAULT
+        wset = struct.unpack("<Q", self.mem.read(set_ptr, 8))[0]
+        for pend in (th.pending, getattr(self.p, "pending_signals",
+                                         [])):
+            for i, s in enumerate(pend):
+                if (wset >> (s - 1)) & 1:
+                    pend.pop(i)
+                    th.sigwait = None
+                    self.write_siginfo(info_ptr, s)
+                    return s
+        st = self.state
+        if "deadline" not in st:
+            if timeout_ptr:
+                sec, nsec = struct.unpack(
+                    "<qq", self.mem.read(timeout_ptr, 16))
+                if sec < 0 or nsec < 0 or nsec >= 10**9:
+                    return -EINVAL
+                st["deadline"] = ctx.now + sec * 10**9 + nsec
+            else:
+                st["deadline"] = None
+        if st["deadline"] is not None and ctx.now >= st["deadline"]:
+            th.sigwait = None
+            return -EAGAIN
+        th.sigwait = (wset, info_ptr)
+        raise Blocked(deadline=st["deadline"])
+
+    def write_siginfo(self, ptr: int, sig: int) -> None:
+        """Minimal siginfo_t: si_signo / si_errno / si_code(SI_USER),
+        rest zero (kernel_types.h layout; 128 bytes)."""
+        if not ptr:
+            return
+        self.mem.write(ptr, struct.pack("<iii", sig, 0, 0)
+                       + b"\x00" * 116)
 
     # ==================================================================
     # sockets (host/syscall/socket.c)
@@ -1192,6 +1321,7 @@ class SyscallHandler:
         return self._epoll_wait(ctx, a, _s32(a[3]))
 
     def sys_epoll_pwait(self, ctx, a):
+        self._swap_pmask(a[4])
         return self._epoll_wait(ctx, a, _s32(a[3]))
 
     def _epoll_wait(self, ctx, a, timeout_ms: int):
@@ -1221,6 +1351,7 @@ class SyscallHandler:
         return self._poll(ctx, a[0], int(a[1]), _s32(a[2]))
 
     def sys_ppoll(self, ctx, a):
+        self._swap_pmask(a[3])
         timeout_ms = -1
         if a[2]:
             ns = kmem.unpack_timespec(self.mem.read(a[2], 16))
@@ -1282,6 +1413,10 @@ class SyscallHandler:
         return self._select(ctx, a, timeval=True)
 
     def sys_pselect6(self, ctx, a):
+        if a[5]:
+            # arg 6 is a {const sigset_t *ss; size_t ss_len} pair
+            ss_ptr = struct.unpack("<Q", self.mem.read(a[5], 8))[0]
+            self._swap_pmask(ss_ptr)
         return self._select(ctx, a, timeval=False)
 
     def _select(self, ctx, a, timeval: bool):

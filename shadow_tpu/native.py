@@ -80,6 +80,8 @@ def load(build_if_missing: bool = True) -> ctypes.CDLL:
     lib.shadowtpu_ipc_init.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.shadowtpu_ipc_send_to_plugin.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(IpcMessage)]
+    lib.shadowtpu_ipc_set_sim_now.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64]
     lib.shadowtpu_ipc_recv_from_plugin.restype = ctypes.c_int
     lib.shadowtpu_ipc_recv_from_plugin.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(IpcMessage)]
@@ -160,6 +162,11 @@ class IpcChannel:
     def send_to_plugin(self, msg: IpcMessage) -> None:
         self._lib.shadowtpu_ipc_send_to_plugin(self.ptr,
                                                ctypes.byref(msg))
+
+    def set_sim_now(self, now_ns: int) -> None:
+        """Publish simulated time for the shim's passive readers
+        (log timestamps; ref shim_event.h:17-22 sim_time block)."""
+        self._lib.shadowtpu_ipc_set_sim_now(self.ptr, now_ns)
 
     def recv_from_plugin(self) -> Optional[IpcMessage]:
         out = IpcMessage()

@@ -1,0 +1,183 @@
+/* Signal masks + synchronous waits under the virtual signal layer:
+ * blocked signals stay pending (sigpending sees them), delivery
+ * happens at the unblocking boundary, sigsuspend atomically swaps the
+ * mask and returns EINTR after one handler, and sigtimedwait consumes
+ * a queued signal synchronously (no handler) or times out with EAGAIN
+ * at the exact simulated deadline. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <poll.h>
+#include <pthread.h>
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+static volatile sig_atomic_t got1 = 0, got2 = 0, term_handled = 0;
+
+static void h1(int sig) { (void)sig; got1++; }
+static void h2(int sig) { (void)sig; got2++; }
+static void hterm(int sig) { (void)sig; term_handled++; }
+
+static long now_ms(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+static volatile sig_atomic_t t_phase = 0;
+
+static void *blocker(void *arg) {
+  (void)arg;
+  sigset_t m;
+  sigemptyset(&m);
+  sigaddset(&m, SIGUSR1);
+  pthread_sigmask(SIG_BLOCK, &m, NULL);
+  t_phase = 1;
+  while (t_phase == 1)
+    usleep(10 * 1000);          /* main sends the directed signal */
+  int before = (int)got1;
+  usleep(50 * 1000);            /* still blocked: handler must wait */
+  int during = (int)got1 - before;
+  pthread_sigmask(SIG_UNBLOCK, &m, NULL);
+  (void)now_ms();               /* a boundary after the unblock */
+  printf("directed held %d delivered %d\n", during == 0,
+         (int)got1 - before);
+  return NULL;
+}
+
+int main(void) {
+  signal(SIGUSR1, h1);
+  signal(SIGUSR2, h2);
+
+  /* 1: block SIGUSR1, self-kill — handler must NOT run; pending set
+   * shows it; unblock — handler runs at that boundary */
+  sigset_t blk, old, pend;
+  sigemptyset(&blk);
+  sigaddset(&blk, SIGUSR1);
+  sigprocmask(SIG_BLOCK, &blk, &old);
+  kill(getpid(), SIGUSR1);
+  int before = got1;
+  sigpending(&pend);
+  int was_pending = sigismember(&pend, SIGUSR1);
+  sigprocmask(SIG_UNBLOCK, &blk, NULL);
+  /* one more trapped syscall boundary so the flush has landed */
+  (void)now_ms();
+  printf("blocked %d pending %d after_unblock %d\n", before == 0,
+         was_pending, (int)got1);
+
+  /* 2: sigsuspend — USR2 pending while blocked; suspend with a mask
+   * that admits it: handler runs, EINTR, old mask back in force */
+  sigemptyset(&blk);
+  sigaddset(&blk, SIGUSR2);
+  sigprocmask(SIG_BLOCK, &blk, NULL);
+  kill(getpid(), SIGUSR2);
+  sigset_t none;
+  sigemptyset(&none);
+  int sr = sigsuspend(&none);
+  sigset_t cur;
+  sigprocmask(SIG_BLOCK, NULL, &cur);
+  printf("sigsuspend %d errno_ok %d got2 %d mask_restored %d\n",
+         sr == -1, errno == EINTR, (int)got2,
+         sigismember(&cur, SIGUSR2));
+  sigprocmask(SIG_UNBLOCK, &blk, NULL);
+
+  /* 3: sigtimedwait consumes a child's SIGTERM synchronously at the
+   * simulated send instant — the handler must NOT run */
+  signal(SIGTERM, hterm);
+  sigemptyset(&blk);
+  sigaddset(&blk, SIGTERM);
+  sigprocmask(SIG_BLOCK, &blk, NULL);
+  long t0 = now_ms();
+  pid_t child = fork();
+  if (child == 0) {
+    usleep(100 * 1000);
+    kill(getppid(), SIGTERM);
+    _exit(0);
+  }
+  siginfo_t si;
+  memset(&si, 0, sizeof si);
+  int w = sigtimedwait(&blk, &si, NULL);
+  long dt = now_ms() - t0;
+  printf("sigtimedwait %d si_signo %d handler_ran %d t_ms %ld\n",
+         w == SIGTERM, si.si_signo, (int)term_handled, dt);
+  int st;
+  waitpid(child, &st, 0);
+
+  /* 3b: the reaper idiom — SIGCHLD (default-ignore) raised while
+   * blocked and BEFORE the wait starts must still be queued, so a
+   * later sigtimedwait consumes it instantly */
+  sigset_t chld;
+  sigemptyset(&chld);
+  sigaddset(&chld, SIGCHLD);
+  sigprocmask(SIG_BLOCK, &chld, NULL);
+  pid_t quick = fork();
+  if (quick == 0)
+    _exit(0);
+  usleep(50 * 1000);            /* child is long dead + queued */
+  t0 = now_ms();
+  struct timespec zero_plus = {5, 0};
+  int wc = sigtimedwait(&chld, NULL, &zero_plus);
+  dt = now_ms() - t0;
+  printf("reaper %d instant %d\n", wc == SIGCHLD, dt == 0);
+  waitpid(quick, &st, 0);
+  sigprocmask(SIG_UNBLOCK, &chld, NULL);
+
+  /* 4: sigtimedwait timeout — EAGAIN at exactly +250 ms sim time */
+  sigset_t never;
+  sigemptyset(&never);
+  sigaddset(&never, SIGWINCH);
+  sigprocmask(SIG_BLOCK, &never, NULL);
+  struct timespec to = {0, 250 * 1000 * 1000};
+  t0 = now_ms();
+  int w2 = sigtimedwait(&never, NULL, &to);
+  dt = now_ms() - t0;
+  printf("timeout %d errno_ok %d t_ms %ld\n", w2 == -1,
+         errno == EAGAIN, dt);
+
+  /* 4b: ppoll's atomic mask swap — SIGUSR1 blocked outside the call;
+   * the empty temp mask must let a child's signal interrupt the wait
+   * (EINTR at the send instant), and the block is back afterwards */
+  got1 = 0;
+  sigemptyset(&blk);
+  sigaddset(&blk, SIGUSR1);
+  sigprocmask(SIG_BLOCK, &blk, NULL);
+  t0 = now_ms();
+  pid_t pinger = fork();
+  if (pinger == 0) {
+    usleep(80 * 1000);
+    kill(getppid(), SIGUSR1);
+    _exit(0);
+  }
+  struct timespec long_to = {5, 0};
+  sigset_t empty;
+  sigemptyset(&empty);
+  int pr = ppoll(NULL, 0, &long_to, &empty);
+  dt = now_ms() - t0;
+  sigprocmask(SIG_BLOCK, NULL, &cur);
+  printf("ppoll_eintr %d got1 %d t_ms %ld mask_back %d\n",
+         pr == -1 && errno == EINTR, (int)got1, dt,
+         sigismember(&cur, SIGUSR1));
+  waitpid(pinger, &st, 0);
+  sigprocmask(SIG_UNBLOCK, &blk, NULL);
+
+  /* 5: thread-directed signals — pthread_kill at a thread that
+   * blocks the signal must park it on THAT thread only: the main
+   * thread (unblocked) never runs the handler, and delivery happens
+   * at the target's own unblock boundary */
+  got1 = 0;
+  pthread_t th;
+  pthread_create(&th, NULL, blocker, NULL);
+  while (t_phase == 0)
+    usleep(10 * 1000);
+  pthread_kill(th, SIGUSR1);
+  int main_saw = (int)got1;     /* boundary was pthread_kill itself */
+  t_phase = 2;
+  pthread_join(th, NULL);
+  printf("main_held %d\n", main_saw == 0);
+
+  printf("done\n");
+  return 0;
+}

@@ -33,7 +33,7 @@ def _indent(text: str, n: int) -> str:
 def bins(tmp_path_factory):
     out = tmp_path_factory.mktemp("plugins")
     built = {}
-    for name in ("fork_check", "signal_check"):
+    for name in ("fork_check", "signal_check", "sigmask_check"):
         exe = out / name
         subprocess.run(
             ["cc", "-O1", "-pthread", "-o", str(exe),
@@ -144,3 +144,40 @@ def test_signals_self_cross_and_eintr(bins, tmp_path):
     # the kill instant (+50 ms)
     assert out[3] == "sigkill ok 1 signaled 1 sig 9 t_ms 50"
     assert out[4] == "done"
+
+
+def test_sigmask_pending_suspend_timedwait(bins, tmp_path):
+    """The blocked-signal contract (ref signal.c rt_sigprocmask /
+    rt_sigpending / rt_sigsuspend / rt_sigtimedwait): blocked signals
+    stay pending and deliver at the unblock boundary; sigsuspend swaps
+    the mask atomically and EINTRs after one handler; sigtimedwait
+    consumes a queued signal with no handler, or times out with EAGAIN
+    at the exact simulated deadline."""
+    data = str(tmp_path / "shadow.data")
+    stats = run_one(bins["sigmask_check"], data)
+    assert stats.ok
+    out = stdout_of(data, "alice", "sigmask_check").splitlines()
+    assert out[0] == "blocked 1 pending 1 after_unblock 1"
+    assert out[1] == "sigsuspend 1 errno_ok 1 got2 1 mask_restored 1"
+    assert out[2] == "sigtimedwait 1 si_signo 15 handler_ran 0 t_ms 100"
+    # blocked default-ignore signal queued BEFORE the wait began
+    # (kernel prepare_signal semantics; the SIGCHLD reaper idiom)
+    assert out[3] == "reaper 1 instant 1"
+    assert out[4] == "timeout 1 errno_ok 1 t_ms 250"
+    # ppoll's temp mask admits the signal mid-wait; block returns after
+    assert out[5] == "ppoll_eintr 1 got1 1 t_ms 80 mask_back 1"
+    # pthread_kill at a blocking thread: held on that thread, the
+    # unblocked main thread never runs it
+    assert out[6] == "directed held 1 delivered 1"
+    assert out[7] == "main_held 1"
+    assert out[8] == "done"
+
+
+def test_sigmask_deterministic(bins, tmp_path):
+    outs = []
+    for run in range(2):
+        data = str(tmp_path / f"r{run}" / "shadow.data")
+        stats = run_one(bins["sigmask_check"], data)
+        assert stats.ok
+        outs.append(stdout_of(data, "alice", "sigmask_check"))
+    assert outs[0] == outs[1]
