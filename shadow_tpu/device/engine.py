@@ -10,20 +10,26 @@ and a per-round collective packet exchange:
 
   pop min event/host -> app handle (batched) -> counter-RNG drop rolls
   + latency gathers (worker_sendPacket semantics, worker.c:520-579) ->
-  outbox -> all_gather over the mesh axis -> merge into destination
-  heaps (causality bump, host_single.c:174-220) -> pmin next event time.
+  outbox -> collective exchange over the mesh axis -> merge into
+  destination heaps (causality bump, host_single.c:174-220) -> pmin
+  next event time.
 
 Determinism: every stochastic decision is keyed by stable integer ids
 (threefry counters), per-host event heaps merge by full-key sort, and
-incoming packets are ordered by (src_gid, outbox_slot) — so results are
-bit-identical across mesh shapes AND match the CPU serial oracle's
+incoming packets are ordered by (src_gid, outbox column) — so results
+are bit-identical across mesh shapes AND match the CPU serial oracle's
 per-host schedule (verified by trace checksums in tests).
 
-The heap is a fixed-capacity unsorted slot array per host: pops are
-two-stage argmins (O(E) vector work, no data-dependent shapes), and
-per-round batch inserts are one lexicographic lax.sort of the
-concatenated [heap | incoming] rows. Everything is static-shape; the
-only dynamism is while_loop trip counts.
+v2 data-structure design — NO SCATTERS. TPU scatters with computed
+indices serialize per element and crash on multi-million-element
+operands, so every hot-path op here is a sort, a contiguous
+dynamic-slice, or a take: heaps are per-host SORTED rows popped by a
+head cursor; each pop iteration appends its sends to a contiguous
+per-iteration column block of the outbox; flushes regroup rows with
+one flat sort by (dst, okey) + searchsorted segment starts + windowed
+takes, and merge with one per-row lexicographic sort of
+[live heap | incoming]. Everything is static-shape; the only dynamism
+is while_loop trip counts.
 """
 
 from __future__ import annotations
@@ -64,7 +70,6 @@ IMAX = np.int64(np.iinfo(np.int64).max)
 
 AXIS = "hosts"
 
-HEAP_FIELDS = ("t", "src", "seq", "kind", "size", "d0", "d1")
 NIC_KEYS = ("tx_free", "rx_free", "cd_fa", "cd_next", "cd_cnt",
             "cd_last", "cd_drop")
 
@@ -143,16 +148,23 @@ class DeviceEngine:
         """starts: (host_id, start_time, stop_time|-1[, proc_idx]) per
         process, in registration order — seq consumption mirrors
         Manager.boot_hosts (device configs are single-process/host, so
-        the index is ignored here)."""
+        the index is ignored here).
+
+        v2 state layout (scatter-free engine): per-host event heaps are
+        SORTED rows of four packed i64 arrays —
+          ht [H,E] time (INF = empty slot),
+          hk [H,E] src<<32|seq  (the deterministic tiebreak key),
+          hm [H,E] kind<<32|size,
+          hv [H,E] d0<<32|d1,
+        plus a per-host `head` cursor: slots < head are consumed; the
+        next event of host h is always column head[h]. Rows re-sort
+        only at flush (one lax.sort per phase) — no scatters anywhere.
+        """
         H, E = self.H_pad, self.config.event_capacity
-        W = self.app.n_state_words
         t = np.full((H, E), INF, dtype=np.int64)
-        src = np.zeros((H, E), dtype=np.int32)
-        seq = np.zeros((H, E), dtype=np.int32)
-        kind = np.zeros((H, E), dtype=np.int32)
-        size = np.zeros((H, E), dtype=np.int32)
-        d0 = np.zeros((H, E), dtype=np.int32)
-        d1 = np.zeros((H, E), dtype=np.int32)
+        src = np.zeros((H, E), dtype=np.int64)
+        seq = np.zeros((H, E), dtype=np.int64)
+        kind = np.zeros((H, E), dtype=np.int64)
         event_seq = np.zeros(H, dtype=np.int32)
         fill = np.zeros(H, dtype=np.int32)
 
@@ -174,10 +186,24 @@ class DeviceEngine:
             if t_stop is not None and t_stop >= 0:
                 _push(host_id, t_stop, KIND_STOP)
 
+        # sort rows by (t, src, seq): stable secondary-then-primary
+        k2 = (src << 32) | seq
+        k2[t >= INF] = IMAX
+        order = np.argsort(k2, axis=1, kind="stable")
+        t = np.take_along_axis(t, order, axis=1)
+        k2 = np.take_along_axis(k2, order, axis=1)
+        kind = np.take_along_axis(kind, order, axis=1)
+        order = np.argsort(t, axis=1, kind="stable")
+        t = np.take_along_axis(t, order, axis=1)
+        k2 = np.take_along_axis(k2, order, axis=1)
+        kind = np.take_along_axis(kind, order, axis=1)
+
         zeros_i32 = np.zeros(H, dtype=np.int32)
         state = {
-            "t": t, "src": src, "seq": seq, "kind": kind,
-            "size": size, "d0": d0, "d1": d1,
+            "ht": t, "hk": k2,
+            "hm": kind << 32,            # kind<<32 | size(=0)
+            "hv": np.zeros((H, E), dtype=np.int64),
+            "head": zeros_i32.copy(),
             "event_seq": event_seq,
             "packet_seq": zeros_i32.copy(),
             "app_seq": zeros_i32.copy(),
@@ -199,14 +225,24 @@ class DeviceEngine:
                 for k, v in state.items()}
 
     # ------------------------------------------------------------------
-    # the jitted program
+    # the jitted program (v2: scatter-free)
     # ------------------------------------------------------------------
+    # TPU scatters with computed indices serialize per element (~1.4 us
+    # each) and crash outright on multi-million-element operands; v1's
+    # per-step heap/outbox scatters made iteration cost scale with H
+    # and the exchange scatters killed the 10k-host rung. v2 uses only
+    # TPU-fast primitives, all O(H)-parallel:
+    #   pops     — the heap rows are kept sorted; the next event is
+    #              column head[h] (take_along_axis, no argmin);
+    #   appends  — each iteration owns a CONTIGUOUS column block of
+    #              the outbox (lax.dynamic_update_slice at blk*M);
+    #   exchange — one flat lax.sort by dst*SPAN+okey, segment starts
+    #              via searchsorted, arrivals via contiguous takes;
+    #   merge    — one per-row lax.sort of [live heap | incoming].
     def _build_program(self):
         cfg = self.config
         app = self.app
         E = cfg.event_capacity
-        OB = cfg.outbox_capacity
-        IN = E                       # per-round incoming capacity
         K = app.max_sends
         T = app.max_timers
         D = max(1, app.max_draws)
@@ -215,14 +251,23 @@ class DeviceEngine:
         seed_pair = self.seed_pair
         LOOKAHEAD = np.int64(max(1, cfg.lookahead))
         BOOT_END = np.int64(cfg.bootstrap_end)
-
-        if OB < K:
-            raise ValueError(
-                f"outbox_capacity ({OB}) must be >= the app's max "
-                f"sends per event ({K}): one event's burst must fit "
-                "or the flow-control phase loop cannot make progress")
-
         MB = bool(cfg.model_bandwidth)
+
+        # outbox layout: each pop iteration owns M_out columns (K sends
+        # + T timers + the model-NIC READY reinsert); a phase runs at
+        # most B iterations between flushes
+        M_out = K + T + (1 if MB else 0)
+        B = max(1, cfg.outbox_capacity // M_out)
+        OB = B * M_out
+        IN = E                        # per-flush arrivals per host
+        SPAN = np.int64(H_pad) * OB   # okey < SPAN
+        if cfg.exchange == "all_to_all" and n_shards > 1:
+            R = H_loc * OB
+            CAP = cfg.exchange_capacity or \
+                min(R, max(64, E, (4 * R + n_shards - 1) // n_shards))
+        else:
+            CAP = 0
+
         # model-NIC constants (host/model_nic.py twins; keep in
         # lockstep with its arithmetic — trace equality depends on it)
         from shadow_tpu.host.model_nic import (
@@ -238,35 +283,42 @@ class DeviceEngine:
         bw_down_t = jnp.asarray(self.bw_down)
         NSx8 = np.int64(8) * np.int64(1_000_000_000)
 
+        U32 = jnp.int64(0xFFFFFFFF)
+
+        def pack2(hi, lo):
+            return ((hi.astype(jnp.int64) & U32) << 32) | \
+                (lo.astype(jnp.int64) & U32)
+
+        def hi32(x):
+            return (x >> 32).astype(jnp.int32)
+
+        def lo32(x):
+            return (x & U32).astype(jnp.int32)
+
         hidx = jnp.arange(H_loc)
 
-        def key2_of(src, seq):
-            return (src.astype(jnp.int64) << 32) | \
-                (seq.astype(jnp.int64) & 0xFFFFFFFF)
+        def _take_head(arr, head, fill):
+            v = jnp.take_along_axis(
+                arr, jnp.minimum(head, E - 1)[:, None], axis=1)[:, 0]
+            return jnp.where(head < E, v, fill)
 
         # ---------------- inner loop body: one event per host ----------
         def _step(carry, win_end, gid, host_vertex, lat, rel):
-            state, ob, ob_cnt, _ = carry
-            t = state["t"]
-            min_t = t.min(axis=-1)                              # [H]
-            tie = t == min_t[:, None]
-            k2 = jnp.where(tie, key2_of(state["src"], state["seq"]), IMAX)
-            slot = jnp.argmin(k2, axis=-1)                      # [H]
-            # flow control: a host only pops while its outbox has
-            # headroom for a full K-send burst; a blocked host's events
-            # stay heaped and run in the next phase of the SAME window
-            # (outer phase loop in _round), so bursty apps never lose
-            # packets to a fixed outbox (SURVEY hard-part #2: ragged
-            # all_to_all under static shapes)
-            runnable = (min_t < win_end) & (ob_cnt + K <= OB)
+            state, ob, blk, dirty = carry
+            head = state["head"]
+            pt = _take_head(state["ht"], head, INF)
+            pk2 = _take_head(state["hk"], head, IMAX)
+            pm = _take_head(state["hm"], head, jnp.int64(0))
+            pv = _take_head(state["hv"], head, jnp.int64(0))
+            psrc, pseq = hi32(pk2), lo32(pk2)
+            pkind, psize = hi32(pm), lo32(pm)
+            pd0, pd1 = hi32(pv), lo32(pv)
 
-            def g(f):
-                return state[f][hidx, slot]
-
-            pt = g("t")
-            psrc, pseq, pkind = g("src"), g("seq"), g("kind")
-            psize, pd0, pd1 = g("size"), g("d0"), g("d1")
-            state["t"] = t.at[hidx, slot].set(jnp.where(runnable, INF, pt))
+            # a host with a possibly-in-window insert pending in the
+            # outbox (dirty) must stall until the flush lands it, or
+            # it would pop later events first (order violation)
+            runnable = (pt < win_end) & ~dirty
+            state["head"] = head + runnable
 
             state["n_exec"] = state["n_exec"] + runnable
             # with the model NIC, a packet pops twice: the RX stage
@@ -287,8 +339,8 @@ class DeviceEngine:
             # app dispatch (batched); masked hosts see kind=-1. Under
             # the model NIC the RX stage is engine-internal (app sees
             # -1) and READY pops present as KIND_PACKET to the app.
-            draw_seqs = state["app_seq"][:, None] + jnp.arange(D,
-                                                              dtype=jnp.int32)
+            draw_seqs = state["app_seq"][:, None] + \
+                jnp.arange(D, dtype=jnp.int32)
             draws = prng.random_bits32(prng.chain_key(
                 seed_pair, PURPOSE_APP, gid[:, None], draw_seqs))
             if MB:
@@ -299,13 +351,8 @@ class DeviceEngine:
                 app_kind = jnp.where(runnable, pkind, -1)
             out = app.handle(gid, pt, app_kind,
                              psrc, psize, pd0, pd1, state["app"], draws)
-            # commit app outputs only for pops the app really handled:
-            # RX-stage pops are engine-internal, and the engine (not
-            # each app's kind=-1 behavior) enforces that their outputs
-            # are discarded
             app_on = runnable & ~is_rx if MB else runnable
             # apps may return [H,1] columns that broadcast over K/T
-            # (e.g. a role-constant dst); materialize full shapes once
             out = out._replace(
                 send_dst=jnp.broadcast_to(out.send_dst, (H_loc, K)),
                 send_size=jnp.broadcast_to(out.send_size, (H_loc, K)),
@@ -370,36 +417,15 @@ class DeviceEngine:
             deliver_t = depart + latv
             cross = dst != gid[:, None]
             # cross-host causality bump (host_single.c:174-220); self
-            # packets keep their true time — they may run this round
+            # packets keep their true time — they may run this window
+            # (the flush + another phase makes them poppable)
             deliver_t = jnp.where(cross,
                                   jnp.maximum(deliver_t, win_end),
                                   deliver_t)
 
-            # cross-host sends -> outbox (slots beyond OB overflow)
-            to_outbox = delivered & cross
-            orank = jnp.cumsum(to_outbox, axis=-1) - to_outbox
-            pos = ob_cnt[:, None] + orank
-            ok = to_outbox & (pos < OB)
-            state["overflow"] = state["overflow"] + \
-                (to_outbox & (pos >= OB)).sum(-1).astype(jnp.int32)
-            spos = jnp.where(ok, pos, OB)        # OB = out-of-bounds drop
-
-            def scat(arr, val):
-                return arr.at[hidx[:, None], spos].set(val, mode="drop")
-
-            ob["t"] = scat(ob["t"], deliver_t)
-            ob["dst"] = scat(ob["dst"], dst.astype(jnp.int32))
-            ob["src"] = scat(ob["src"], jnp.broadcast_to(gid[:, None],
-                                                         dst.shape))
-            ob["seq"] = scat(ob["seq"], ev_seq.astype(jnp.int32))
-            ob["size"] = scat(ob["size"], out.send_size)
-            ob["d0"] = scat(ob["d0"], out.send_d0)
-            ob["d1"] = scat(ob["d1"], out.send_d1)
-            ob_cnt = ob_cnt + to_outbox.sum(-1).astype(jnp.int32)
-
             # model-NIC RX stage (ModelNic.rx_deliver twin): the popped
             # KIND_PACKET row passes the download bucket + event-driven
-            # CoDel; survivors re-enter the local heap as READY rows at
+            # CoDel; survivors re-enter via the outbox as READY rows at
             # their post-serialization delivery time (same src/seq)
             if MB:
                 rxf = state["rx_free"]
@@ -455,270 +481,220 @@ class DeviceEngine:
                 rx_keep = jnp.zeros_like(runnable)
                 rx_deliver = pt
 
-            # self-destined sends insert into the local heap immediately
-            # (like the CPU engine's push): with a runahead override
-            # larger than a self-path latency they must be runnable in
-            # this same window, in timestamp order. Timers likewise.
-            # Both go through ONE batched insert: rank the heap's free
-            # slots once and scatter every item to its own slot —
-            # O(E log E) instead of (K+T) sequential full-heap scans
-            # (slot choice doesn't affect semantics; pops order by
-            # (t, src, seq), never by slot index).
-            to_self = delivered & ~cross
+            # timers (self rows; may fire inside this window)
             timer_valid = out.timer_valid & app_on[:, None]     # [H,T]
             trank = jnp.cumsum(timer_valid, axis=-1) - timer_valid
             tseq = state["event_seq"][:, None] + n_snt[:, None] + trank
             state["event_seq"] = state["event_seq"] + n_snt + \
                 timer_valid.sum(-1).astype(jnp.int32)
+            timer_t = pt[:, None] + out.timer_delay
 
-            # column layout: K sends | T timers | (MB only) 1 READY
-            # reinsert, which keeps its ORIGINAL sender/seq
+            # the iteration's outbox block: K sends | T timers | READY.
+            # EVERY insert goes through the outbox (no heap scatters);
+            # a self-destined row that could run inside this window
+            # marks the host dirty so pop order is preserved.
             def cols(*parts):
                 return jnp.concatenate(
                     parts[:2 + (1 if MB else 0)], axis=1)
 
-            ins_valid = cols(to_self, timer_valid, rx_keep[:, None])
-            ins = {
-                "t": cols(deliver_t, pt[:, None] + out.timer_delay,
-                          rx_deliver[:, None]),
-                "seq": cols(ev_seq, tseq,
-                            pseq[:, None]).astype(jnp.int32),
-                "kind": cols(
-                    jnp.full((H_loc, K), KIND_PACKET, jnp.int32),
-                    jnp.full((H_loc, T), KIND_TIMER, jnp.int32),
-                    jnp.full((H_loc, 1), KIND_PACKET_READY,
-                             jnp.int32)),
-                "size": cols(out.send_size,
-                             jnp.zeros((H_loc, T), jnp.int32),
-                             psize[:, None]),
-                "d0": cols(out.send_d0, out.timer_d0, pd0[:, None]),
-                "d1": cols(out.send_d1,
-                           jnp.zeros((H_loc, T), jnp.int32),
-                           pd1[:, None]),
-                "src": cols(
-                    jnp.broadcast_to(gid[:, None], (H_loc, K)),
-                    jnp.broadcast_to(gid[:, None], (H_loc, T)),
-                    psrc[:, None]),
-            }
-            M = K + T + (1 if MB else 0)
-            free = state["t"] == INF                            # [H,E]
-            slot_order = jnp.argsort(
-                jnp.where(free, 0, E) + jnp.arange(E)[None, :],
-                axis=-1)                                        # [H,E]
-            n_free = free.sum(-1)                               # [H]
-            irank = jnp.cumsum(ins_valid, axis=-1) - ins_valid  # [H,M]
-            ok = ins_valid & (irank < n_free[:, None]) & (irank < E)
-            state["overflow"] = state["overflow"] + \
-                (ins_valid & ~ok).sum(-1).astype(jnp.int32)
-            dest = jnp.take_along_axis(
-                slot_order, jnp.minimum(irank, E - 1), axis=1)  # [H,M]
-            dest = jnp.where(ok, dest, E)       # E = out-of-bounds drop
+            gcol = jnp.broadcast_to(gid[:, None], (H_loc, K))
+            gcolT = jnp.broadcast_to(gid[:, None], (H_loc, T))
+            bvalid = cols(delivered, timer_valid, rx_keep[:, None])
+            bt = jnp.where(bvalid,
+                           cols(deliver_t, timer_t,
+                                rx_deliver[:, None]),
+                           INF)
+            bk = cols(pack2(gcol, ev_seq), pack2(gcolT, tseq),
+                      pk2[:, None])
+            bdst = cols(dst, gcolT, gid[:, None])
+            bkind = cols(
+                jnp.full((H_loc, K), KIND_PACKET, jnp.int32),
+                jnp.full((H_loc, T), KIND_TIMER, jnp.int32),
+                jnp.full((H_loc, 1), KIND_PACKET_READY, jnp.int32))
+            bm = pack2(bdst, bkind)
+            bs = pack2(cols(out.send_size,
+                            jnp.zeros((H_loc, T), jnp.int32),
+                            psize[:, None]),
+                       cols(out.send_d0, out.timer_d0, pd0[:, None]))
+            bv = cols(out.send_d1,
+                      jnp.zeros((H_loc, T), jnp.int32),
+                      pd1[:, None]).astype(jnp.int64)
 
-            def bscat(f, vals):
-                state[f] = state[f].at[hidx[:, None], dest].set(
-                    vals, mode="drop")
+            col0 = blk * jnp.int32(M_out)
+            for f, block in (("t", bt), ("k", bk), ("m", bm),
+                             ("s", bs), ("v", bv)):
+                ob[f] = lax.dynamic_update_slice(ob[f], block,
+                                                 (jnp.int32(0), col0))
 
-            bscat("t", ins["t"])
-            bscat("src", ins["src"])
-            bscat("seq", ins["seq"])
-            bscat("kind", ins["kind"])
-            bscat("size", ins["size"])
-            bscat("d0", ins["d0"])
-            bscat("d1", ins["d1"])
+            in_win = bvalid & (bt < win_end) & \
+                (bdst == gid[:, None])
+            dirty = dirty | (runnable & in_win.any(-1))
 
-            return state, ob, ob_cnt, runnable.any()
+            return state, ob, blk + 1, dirty
 
-        # ---------------- end-of-round exchange + merge ----------------
-        # Two exchange strategies produce the same multiset of rows in
-        # the same deterministic arrival order — keyed by
-        # (dst_local, okey) where okey = src_gid*OB + outbox slot:
-        #
-        # all_gather: every shard replicates its whole outbox
-        # (bandwidth ∝ H_pad*OB rows per device, (n-1)/n discarded).
-        #
-        # all_to_all (default): two-phase — sort the local outbox by
-        # destination shard, pack each shard's rows into a
-        # [n_shards, CAP] buffer, and lax.all_to_all it so each pair
-        # of shards exchanges only its own rows (bandwidth ∝ traffic).
-        # CAP is derived from the outbox volume (4x headroom for skew);
-        # rows beyond CAP are counted per source host in `overflow`
-        # and fail the run — never silently lost (SURVEY hard-part #2).
-        R = H_loc * OB
-        SPAN = H_pad * OB              # exclusive upper bound on okey
-        if cfg.exchange == "all_to_all":
-            # auto-size for 4x-skewed traffic, floored at one full
-            # event-capacity burst toward a single shard; hub-heavy
-            # configs that concentrate a whole outbox on one shard
-            # should set exchange_capacity (or exchange: all_gather) —
-            # overflow is loud, counted separately, and names the knob
-            CAP = cfg.exchange_capacity or \
-                min(R, max(64, E, (4 * R + n_shards - 1) // n_shards))
-        else:
-            CAP = 0
-        XFIELDS = ("t", "dst", "src", "seq", "size", "d0", "d1")
+        # ---------------- flush: exchange + merge ----------------------
+        # Deterministic arrival order — keyed by skey = dst*SPAN + okey
+        # with okey = src_gid*OB + column — independent of mesh shape
+        # and exchange strategy. Rows beyond a dst host's IN window (or
+        # a shard pair's CAP) are counted in overflow/x_overflow and
+        # fail the run — never silently lost (SURVEY hard-part #2).
+        XF = ("t", "k", "m", "s", "v")
 
-        def _rows_all_gather(state, ob):
-            G = H_pad * OB
-            rows = {f: lax.all_gather(ob[f], AXIS).reshape(G)
-                    for f in XFIELDS}
-            # gather order is gid-major: row index == src_gid*OB + slot
-            return state, rows, jnp.arange(G, dtype=jnp.int64)
-
-        def _rows_all_to_all(state, ob, my_shard):
-            slot = jnp.broadcast_to(
-                jnp.arange(OB, dtype=jnp.int64)[None, :], (H_loc, OB))
-            flat = {f: ob[f].reshape(R) for f in XFIELDS}
-            flat["okey"] = (ob["src"].astype(jnp.int64) * OB
-                            + slot).reshape(R)
+        def _flat_sorted(ob, gid):
+            slot = jnp.arange(OB, dtype=jnp.int64)[None, :]
+            okey = gid.astype(jnp.int64)[:, None] * OB + slot
+            F = H_loc * OB
+            flat = {f: ob[f].reshape(F) for f in XF}
+            fdst = hi32(flat["m"]).astype(jnp.int64)
             valid = flat["t"] < INF
-            ds = jnp.where(valid, flat["dst"] // H_loc, n_shards)
-            perm = jnp.argsort(ds.astype(jnp.int64) * SPAN
-                               + jnp.where(valid, flat["okey"], 0))
-            sds = ds[perm]
-            idx = jnp.arange(R, dtype=jnp.int64)
-            is_new = jnp.concatenate([jnp.array([True]),
-                                      sds[1:] != sds[:-1]])
-            seg_start = lax.associative_scan(
-                jnp.maximum, jnp.where(is_new, idx, 0))
-            rank = idx - seg_start
-            ok = (sds < n_shards) & (rank < CAP)
-            lost = (sds < n_shards) & (rank >= CAP)
-            # overflow attributed to the SENDING host (it owns sizing),
-            # in its own counter so the failure names the right knob
-            src_loc = (flat["okey"][perm] // OB).astype(jnp.int32) \
-                - my_shard * H_loc
-            state["x_overflow"] = state["x_overflow"] + \
-                jnp.zeros((H_loc,), jnp.int32).at[
-                    jnp.where(lost, src_loc, H_loc)].add(1, mode="drop")
+            skey = jnp.where(valid, fdst * SPAN + okey.reshape(F),
+                             IMAX)
+            srt = lax.sort((skey,) + tuple(flat[f] for f in XF),
+                           num_keys=1)
+            return srt[0], dict(zip(XF, srt[1:]))
 
-            row = jnp.where(ok, sds, n_shards)   # n_shards = drop row
-            col = jnp.where(ok, rank, 0).astype(jnp.int32)
+        def _seg_take(skey_s, rows, starts, counts, width):
+            """Contiguous per-segment windows: row i of the result is
+            rows[starts[i] : starts[i]+width], masked past counts."""
+            G = skey_s.shape[0]
+            idx = starts[:, None] + jnp.arange(width,
+                                               dtype=starts.dtype)
+            ok = jnp.arange(width)[None, :] < \
+                jnp.minimum(counts, width)[:, None]
+            cidx = jnp.clip(idx, 0, G - 1).reshape(-1)
+            out = {}
+            for f in XF:
+                v = jnp.take(rows[f], cidx).reshape(idx.shape)
+                fillv = INF if f == "t" else (IMAX if f == "k" else 0)
+                out[f] = jnp.where(ok, v, fillv)
+            return out
 
-            def pack(f, fillv, dtype):
-                base = jnp.full((n_shards, CAP), fillv, dtype)
-                return base.at[row, col].set(
-                    flat[f][perm].astype(dtype), mode="drop")
+        def _exchange(state, ob, gid, my_shard):
+            skey, rows = _flat_sorted(ob, gid)
+            G = H_loc * OB
 
-            send = {"t": pack("t", INF, jnp.int64),
-                    "okey": pack("okey", 0, jnp.int64)}
-            for f in ("dst", "src", "seq", "size", "d0", "d1"):
-                send[f] = pack(f, 0, jnp.int32)
-            rows = {f: lax.all_to_all(v, AXIS, split_axis=0,
-                                      concat_axis=0)
-                    .reshape(n_shards * CAP)
-                    for f, v in send.items()}
-            return state, rows, rows.pop("okey")
-
-        def _exchange(state, ob, my_shard):
-            if cfg.exchange == "all_to_all":
-                state, rows, okey = _rows_all_to_all(state, ob, my_shard)
+            if n_shards > 1 and cfg.exchange == "all_to_all":
+                # pack each destination shard's contiguous run into
+                # [n_shards, CAP] and all_to_all only those rows
+                bound = (jnp.arange(n_shards + 1, dtype=jnp.int64)
+                         * H_loc * SPAN)
+                edges = jnp.searchsorted(skey, bound)
+                starts, nxt = edges[:-1], edges[1:]
+                counts = nxt - starts
+                lost = jnp.maximum(0, counts - CAP).sum()
+                state["x_overflow"] = state["x_overflow"].at[0].add(
+                    lost.astype(jnp.int32))
+                win = _seg_take(skey, rows, starts, counts, CAP)
+                kidx = jnp.clip(
+                    starts[:, None] + jnp.arange(CAP,
+                                                 dtype=jnp.int64),
+                    0, G - 1)
+                kwin = jnp.where(
+                    jnp.arange(CAP)[None, :] <
+                    jnp.minimum(counts, CAP)[:, None],
+                    jnp.take(skey, kidx.reshape(-1)).reshape(
+                        n_shards, CAP),
+                    IMAX)
+                moved = {f: lax.all_to_all(
+                    win[f], AXIS, split_axis=0, concat_axis=0)
+                    .reshape(n_shards * CAP) for f in XF}
+                kmoved = lax.all_to_all(
+                    kwin, AXIS, split_axis=0,
+                    concat_axis=0).reshape(n_shards * CAP)
+                srt = lax.sort((kmoved,) + tuple(moved[f]
+                                                 for f in XF),
+                               num_keys=1)
+                skey, rows = srt[0], dict(zip(XF, srt[1:]))
                 G = n_shards * CAP
-            else:
-                state, rows, okey = _rows_all_gather(state, ob)
-                G = H_pad * OB
+            elif n_shards > 1:
+                # all_gather fallback: replicate every shard's sorted
+                # rows, then one global re-sort (debug / hub-heavy)
+                gath = {f: lax.all_gather(rows[f], AXIS)
+                        .reshape(n_shards * G) for f in XF}
+                kg = lax.all_gather(skey, AXIS).reshape(n_shards * G)
+                srt = lax.sort((kg,) + tuple(gath[f] for f in XF),
+                               num_keys=1)
+                skey, rows = srt[0], dict(zip(XF, srt[1:]))
+                G = n_shards * G
 
-            gt = rows["t"]
-            gdst = rows["dst"]
-            valid = gt < INF
-            dshard = gdst // H_loc
-            mine = valid & (dshard == my_shard)
-            dloc = gdst % H_loc
-
-            # deterministic arrival order: (dst, src_gid*OB + slot) —
-            # independent of mesh shape AND exchange strategy
-            skey = jnp.where(mine,
-                             dloc.astype(jnp.int64) * SPAN + okey, IMAX)
-            perm = jnp.argsort(skey)
-            sdloc = dloc[perm]
-            smine = mine[perm]
-
-            idx = jnp.arange(G, dtype=jnp.int64)
-            is_new = jnp.concatenate([jnp.array([True]),
-                                      sdloc[1:] != sdloc[:-1]])
-            seg_start = lax.associative_scan(
-                jnp.maximum, jnp.where(is_new, idx, 0))
-            rank = idx - seg_start
-
-            keep = smine & (rank < IN)
-            # per-host overflow for arrivals beyond IN
-            lost = smine & (rank >= IN)
+            # my hosts' contiguous arrival segments -> [H_loc, IN]
+            base = my_shard.astype(jnp.int64) * H_loc
+            hb = (base + jnp.arange(H_loc + 1, dtype=jnp.int64)) \
+                * SPAN
+            edges = jnp.searchsorted(skey, hb)
+            starts, nxt = edges[:-1], edges[1:]
+            counts = nxt - starts
             state["overflow"] = state["overflow"] + \
-                jnp.zeros((H_loc,), jnp.int32).at[sdloc].add(
-                    lost.astype(jnp.int32), mode="drop")
+                jnp.maximum(0, counts - IN).astype(jnp.int32)
+            inc = _seg_take(skey, rows, starts, counts, IN)
 
-            row = jnp.where(keep, sdloc, H_loc)       # H_loc = drop row
-            col = jnp.where(keep, rank, 0).astype(jnp.int32)
-
-            def scatter_in(f, fill, dtype):
-                base = jnp.full((H_loc, IN), fill, dtype)
-                return base.at[row, col].set(
-                    rows[f][perm].astype(dtype), mode="drop")
-
-            inc_t = scatter_in("t", INF, jnp.int64)
-            inc = {
-                "t": inc_t,
-                "src": scatter_in("src", 0, jnp.int32),
-                "seq": scatter_in("seq", 0, jnp.int32),
-                "kind": jnp.where(inc_t < INF, jnp.int32(KIND_PACKET),
-                                  jnp.int32(0)),
-                "size": scatter_in("size", 0, jnp.int32),
-                "d0": scatter_in("d0", 0, jnp.int32),
-                "d1": scatter_in("d1", 0, jnp.int32),
-            }
-
-            # merge: lexicographic sort of [heap | incoming] rows by
-            # (time, src, seq); first E slots survive
-            cat = {f: jnp.concatenate([state[f], inc[f]], axis=1)
-                   for f in HEAP_FIELDS}
-            k2 = key2_of(cat["src"], cat["seq"])
-            sorted_ops = lax.sort(
-                (cat["t"], k2, cat["src"], cat["seq"], cat["kind"],
-                 cat["size"], cat["d0"], cat["d1"]),
-                dimension=1, num_keys=2)
-            (st, _, ssrc, sseq, skind, ssize, sd0, sd1) = sorted_ops
+            # merge: one lexicographic row sort of [live heap | inc]
+            # by (time, src<<32|seq); first E slots survive
+            live = jnp.arange(E)[None, :] >= state["head"][:, None]
+            mt = jnp.where(live, state["ht"], INF)
+            mk = jnp.where(live, state["hk"], IMAX)
+            inc_kind = lo32(inc["m"])
+            inc_hm = pack2(inc_kind, hi32(inc["s"]))
+            inc_hv = pack2(lo32(inc["s"]), lo32(inc["v"]))
+            ct = jnp.concatenate([mt, inc["t"]], axis=1)
+            ck = jnp.concatenate([mk, inc["k"]], axis=1)
+            cm = jnp.concatenate([state["hm"], inc_hm], axis=1)
+            cv = jnp.concatenate([state["hv"], inc_hv], axis=1)
+            st, sk, sm, sv = lax.sort((ct, ck, cm, cv),
+                                      dimension=1, num_keys=2)
             state["overflow"] = state["overflow"] + \
                 (st[:, E:] < INF).sum(-1).astype(jnp.int32)
-            state["t"] = st[:, :E]
-            state["src"] = ssrc[:, :E]
-            state["seq"] = sseq[:, :E]
-            state["kind"] = skind[:, :E]
-            state["size"] = ssize[:, :E]
-            state["d0"] = sd0[:, :E]
-            state["d1"] = sd1[:, :E]
+            state["ht"] = st[:, :E]
+            state["hk"] = sk[:, :E]
+            state["hm"] = sm[:, :E]
+            state["hv"] = sv[:, :E]
+            state["head"] = jnp.zeros_like(state["head"])
             return state
 
         # ---------------- one round (window) ---------------------------
-        # A window may take several phases: each phase pops until every
-        # host is drained below win_end OR outbox-blocked, exchanges,
-        # and the window only advances when no host has events left
-        # under the barrier. Phase count is data-dependent but the
-        # predicate is a collective, so all shards agree.
-        def _round(state, win_end, gid, my_shard, host_vertex, lat, rel):
+        # A window may take several phases: each phase pops up to B
+        # events per host (or until every host is drained below
+        # win_end / stalled on an in-window insert), then flushes. The
+        # window advances only when no host has events under the
+        # barrier; the predicate is a collective, so all shards agree.
+        def _round(state, win_end, gid, my_shard, host_vertex, lat,
+                   rel):
             def _phase(state):
-                ob = {
-                    "t": jnp.full((H_loc, OB), INF, jnp.int64),
-                    "dst": jnp.zeros((H_loc, OB), jnp.int32),
-                    "src": jnp.zeros((H_loc, OB), jnp.int32),
-                    "seq": jnp.zeros((H_loc, OB), jnp.int32),
-                    "size": jnp.zeros((H_loc, OB), jnp.int32),
-                    "d0": jnp.zeros((H_loc, OB), jnp.int32),
-                    "d1": jnp.zeros((H_loc, OB), jnp.int32),
-                }
-                ob_cnt = jnp.zeros((H_loc,), jnp.int32)
-                carry = (state, ob, ob_cnt,
-                         (state["t"].min(axis=-1) < win_end).any())
+                ob = {"t": jnp.full((H_loc, OB), INF, jnp.int64)}
+                for f in ("k", "m", "s", "v"):
+                    ob[f] = jnp.zeros((H_loc, OB), jnp.int64)
+                dirty = jnp.zeros((H_loc,), bool)
+
+                def cond(c):
+                    state_, _, blk, dirty_ = c
+                    nt = _take_head(state_["ht"], state_["head"], INF)
+                    return ((nt < win_end) & ~dirty_).any() & \
+                        (blk < B)
+
                 carry = lax.while_loop(
-                    lambda c: c[3],
-                    lambda c: _step(c, win_end, gid, host_vertex, lat,
-                                    rel),
-                    carry)
+                    cond,
+                    lambda c: _step(c, win_end, gid, host_vertex,
+                                    lat, rel),
+                    (state, ob, jnp.int32(0), dirty))
                 state2, ob, _, _ = carry
-                return _exchange(state2, ob, my_shard)
+                # skip the whole exchange when nothing was sent and no
+                # slots were consumed (idle windows). The predicate is
+                # COLLECTIVE: the flush contains all_to_all, so every
+                # shard must take the same branch
+                any_work = (ob["t"] < INF).any() | \
+                    (state2["head"] > 0).any()
+                go = _axis_min(jnp.where(any_work, jnp.int64(0),
+                                         jnp.int64(1))) == 0
+                return lax.cond(
+                    go,
+                    lambda s: _exchange(s, ob, gid, my_shard),
+                    lambda s: s,
+                    state2)
 
             def more(state):
                 return _axis_min(
-                    jnp.where(state["t"].min(axis=-1) < win_end,
-                              jnp.int64(0), jnp.int64(1)).min()) == 0
+                    jnp.where((state["ht"][:, 0] < win_end).any(),
+                              jnp.int64(0), jnp.int64(1))) == 0
 
             state = _phase(state)
             state, _ = lax.while_loop(
@@ -745,7 +721,10 @@ class DeviceEngine:
             gid = (my_shard * H_loc + hidx).astype(jnp.int32)
 
             def next_time(state):
-                return _axis_min(state["t"].min())
+                # rows are sorted and slots < head are INF-free only
+                # after a flush; take the per-host head element
+                return _axis_min(
+                    _take_head(state["ht"], state["head"], INF).min())
 
             def cond(c):
                 state, nxt, rounds = c
@@ -769,10 +748,11 @@ class DeviceEngine:
             gid = (my_shard * H_loc + hidx).astype(jnp.int32)
             state = _round(state, win_end, gid, my_shard,
                            host_vertex, lat, rel)
-            nxt = _axis_min(state["t"].min())
+            nxt = _axis_min(
+                _take_head(state["ht"], state["head"], INF).min())
             return state, nxt
 
-        spec_keys = ("t", "src", "seq", "kind", "size", "d0", "d1",
+        spec_keys = ("ht", "hk", "hm", "hv", "head",
                      "event_seq", "packet_seq", "app_seq", "app",
                      "n_exec", "n_sent", "n_drop", "n_deliv",
                      "overflow", "x_overflow", "chk") + \

@@ -36,6 +36,7 @@ from shadow_tpu.host.descriptors import Condition, DescriptorTable
 from shadow_tpu.host.memory import ProcessMemory
 from shadow_tpu.host.syscalls import (
     NATIVE,
+    NR,
     Blocked,
     CloneGo,
     NR_NAME,
@@ -44,6 +45,13 @@ from shadow_tpu.host.syscalls import (
 from shadow_tpu.utils.slog import get_logger
 
 log = get_logger("process")
+
+# the kernel's never-restarted set (man 7 signal): waits, sleeps, and
+# the pure signal syscalls EINTR regardless of SA_RESTART
+_NO_RESTART = frozenset(NR[n] for n in (
+    "pause", "rt_sigsuspend", "rt_sigtimedwait", "poll", "ppoll",
+    "select", "pselect6", "epoll_wait", "epoll_pwait", "nanosleep",
+    "clock_nanosleep"))
 
 # wall-clock patience for a plugin that neither syscalls nor exits
 # (a real-CPU-bound plugin phase); generous because simulator and
@@ -254,14 +262,19 @@ class ManagedProcess:
         stdout_f = open(stdout_path, "wb")
         stderr_f = open(stderr_path, "wb")
 
+        env = self._child_env(host_dir)
+        # forward the shim debug knobs from the simulator's environment
+        # (the quick debugging path; config `environment` entries win)
+        for k in ("SHADOWTPU_SHIM_LOG", "SHADOWTPU_TRACE_TRAPS"):
+            if k in os.environ and k not in env:
+                env[k] = os.environ[k]
         # publish sim time into the channel only when the shim will
         # read it (log/trace runs): keeps the per-dispatch hot path
-        # free of a ctypes call nobody consumes
+        # free of a ctypes call nobody consumes. The gate tests the
+        # CHILD's environment — the only one the shim sees
         self.publish_sim_time = (
-            "SHADOWTPU_SHIM_LOG" in os.environ
-            or "SHADOWTPU_TRACE_TRAPS" in os.environ)
-
-        env = self._child_env(host_dir)
+            "SHADOWTPU_SHIM_LOG" in env
+            or "SHADOWTPU_TRACE_TRAPS" in env)
         env["SHADOWTPU_SHM"] = self.runtime.arena.name
         env["SHADOWTPU_IPC_OFFSET"] = str(self.channel.offset)
         env["LD_PRELOAD"] = self.runtime.shim_path
@@ -501,6 +514,7 @@ class ManagedProcess:
         child.children = {}
         child.sigactions = dict(self.sigactions)
         child.pending_signals = []
+        child.publish_sim_time = self.publish_sim_time
         child.wstatus = None
         child.term_signal = None
         child._pending_fork = None
@@ -718,14 +732,7 @@ class ManagedProcess:
             th.sigmask = th.restore_mask
             th.restore_mask = None
         th.sigwait = None       # an interrupted sigtimedwait is over
-        from shadow_tpu.host.syscalls import EINTR, NR
-        # the kernel's never-restarted set (man 7 signal): waits,
-        # sleeps, and the pure signal syscalls EINTR regardless of
-        # SA_RESTART
-        _NO_RESTART = {NR[n] for n in (
-            "pause", "rt_sigsuspend", "rt_sigtimedwait", "poll",
-            "ppoll", "select", "pselect6", "epoll_wait", "epoll_pwait",
-            "nanosleep", "clock_nanosleep")}
+        from shadow_tpu.host.syscalls import EINTR
         restartable = nr not in _NO_RESTART
         if restartable and all(a[1] & self.SA_RESTART
                                for _, a in delivered):
