@@ -55,6 +55,10 @@ class Event:
     task: Callable[..., Any] | None = None
     kind: int = 0
     data: tuple = field(default_factory=tuple)
+    # packets carried by this delivery event (a packet TRAIN's
+    # surviving count; 1 for ordinary packets) — stats only, never
+    # part of the ordering key
+    npkts: int = 1
 
     @property
     def key(self) -> EventKey:

@@ -415,16 +415,17 @@ class Manager:
                         self.policy.push(
                             Event(time=deliver, dst_host=ev.dst_host,
                                   src_host=ev.src_host, seq=ev.seq,
-                                  kind=KIND_PACKET_READY, data=ev.data),
+                                  kind=KIND_PACKET_READY, data=ev.data,
+                                  npkts=ev.npkts),
                             simtime.SIMTIME_INVALID)
                 else:
-                    host.packets_delivered += 1
+                    host.packets_delivered += ev.npkts
                     if app is not None:
                         size = ev.data[0] if ev.data else 0
                         app.on_packet(ctx, ev.src_host, size,
                                       ev.data[1:])
             elif ev.kind == KIND_PACKET_READY:
-                host.packets_delivered += 1
+                host.packets_delivered += ev.npkts
                 if app is not None:
                     size = ev.data[0] if ev.data else 0
                     app.on_packet(ctx, ev.src_host, size, ev.data[1:])

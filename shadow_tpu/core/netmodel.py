@@ -60,6 +60,34 @@ class NetworkModel:
             for key, n in counts.items():
                 self.path_packets[key] = self.path_packets.get(key, 0) + n
 
+    def judge_train(self, now: int, src_host: int, dst_host: int,
+                    pkt_seq0: int, count: int
+                    ) -> tuple[int, int, int]:
+        """Judge a packet TRAIN (count packets sharing one path and
+        send instant, e.g. a tgen chunk): per-packet drop rolls with
+        the same (src, pkt_seq0+j) keys individual sends would use, so
+        loss statistics are bit-identical to per-packet sends. Returns
+        (survivor_bitmask, deliver_time, latency_ns); bit j set means
+        packet pkt_seq0+j survived."""
+        sv = int(self.host_vertex[src_host])
+        dv = int(self.host_vertex[dst_host])
+        latency = int(self.topology.latency_ns[sv, dv])
+        reliability = float(self.topology.reliability[sv, dv])
+
+        surv = (1 << count) - 1
+        if reliability < 1.0 and now >= self.bootstrap_end:
+            rolls = nprng.packet_uniform(
+                self.seed, PURPOSE_PACKET_DROP, src_host,
+                np.arange(pkt_seq0, pkt_seq0 + count))
+            bits = (rolls < reliability).astype(np.uint64)
+            surv = int((bits << np.arange(count, dtype=np.uint64))
+                       .sum())
+        key = (sv, dv)
+        with self._lock:
+            self.path_packets[key] = self.path_packets.get(key, 0) \
+                + count
+        return surv, now + latency, latency
+
     def judge(self, now: int, src_host: int, dst_host: int,
               pkt_seq: int) -> PacketVerdict:
         sv = int(self.host_vertex[src_host])

@@ -125,6 +125,41 @@ class SimContext:
         self._m.push_event(ev)
         return True
 
+    def send_train(self, dst_host: int, size: int, data: tuple = (),
+                   count: int = 1) -> int:
+        """Send `count` packets as ONE train event (a tgen chunk):
+        one event/one delivery, per-packet drop rolls with the same
+        keys individual sends would use. The delivered event's data is
+        (size, *data, survivor_bitmask). Returns the survivor mask (0
+        = whole train lost); like send(), apps must not branch on it.
+        Trains are the standard DES optimization for bulk flows: the
+        event count per chunk drops from `count` to 1 on both engines
+        while loss statistics stay bit-identical."""
+        if count <= 1:
+            ok = self.send(dst_host, size, data + (1,))
+            return 1 if ok else 0
+        host = self.host
+        pkt_seq0 = host._packet_seq
+        host._packet_seq += count
+        ev_seq = host.next_event_seq()
+        surv, deliver, lat = self._m.netmodel.judge_train(
+            self.now, host.host_id, dst_host, pkt_seq0, count)
+        host.packets_sent += count
+        host.packets_dropped += count - surv.bit_count()
+        if host.model_nic is not None:
+            # dropped trains still consume uplink serialization (the
+            # network drops them later) — device-engine parity
+            depart = host.model_nic.tx_depart(self.now, size)
+            deliver = depart + lat
+        if surv == 0:
+            return 0
+        ev = Event(time=deliver, dst_host=dst_host,
+                   src_host=host.host_id, seq=ev_seq,
+                   kind=KIND_PACKET, data=(size,) + tuple(data)
+                   + (surv,), npkts=surv.bit_count())
+        self._m.push_event(ev)
+        return surv
+
     def schedule(self, delay_ns: int, data: tuple = ()) -> None:
         """Self timer after delay_ns -> on_timer."""
         host = self.host

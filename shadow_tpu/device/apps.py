@@ -20,11 +20,12 @@ engine's arrays; they are compile-time constants.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import numpy as np
 
 from shadow_tpu._jax import jnp
+from jax import lax
 from shadow_tpu.core.event import KIND_BOOT, KIND_PACKET, KIND_TIMER
 from shadow_tpu.device import prng
 from shadow_tpu.utils.rng import PURPOSE_TOR_ROUTE
@@ -44,20 +45,29 @@ class AppOut(NamedTuple):
     # bookkeeping, each [H]
     n_draws: jnp.ndarray         # app RNG draws consumed (i32)
     app_state: jnp.ndarray       # updated [H, W]
+    # packets per send row, each [H, K] (packet TRAINS: the network
+    # rolls one drop per packet with the same keys per-packet sends
+    # would use and delivers a survivor bitmask as d2); None = all 1
+    send_count: Optional[jnp.ndarray] = None
 
 
 class DeviceApp:
-    """Interface; see PholdDevice for the canonical implementation."""
+    """Interface; see PholdDevice for the canonical implementation.
+    `max_train` > 1 declares the app sends packet trains (send_count
+    up to max_train per row); delivered events then carry the
+    survivor bitmask in d2."""
 
     n_state_words: int = 1
     max_sends: int = 1
     max_timers: int = 0
     max_draws: int = 1
+    max_train: int = 1
 
     def init_state(self, n_hosts: int) -> jnp.ndarray:
         return jnp.zeros((n_hosts, self.n_state_words), jnp.int32)
 
-    def handle(self, gid, now, kind, src, size, d0, d1, app_state, draws
+    def handle(self, gid, now, kind, src, size, d0, d1, d2, app_state,
+               draws
                ) -> AppOut:
         raise NotImplementedError
 
@@ -88,7 +98,8 @@ class PholdDevice(DeviceApp):
                  + bits % jnp.uint32(n - 1))
                 % jnp.uint32(n)).astype(jnp.int32)
 
-    def handle(self, gid, now, kind, src, size, d0, d1, app_state, draws
+    def handle(self, gid, now, kind, src, size, d0, d1, d2, app_state,
+               draws
                ) -> AppOut:
         H, K = draws.shape[0], self.max_sends
         boot = kind == KIND_BOOT
@@ -125,10 +136,11 @@ class TgenDevice(DeviceApp):
 
     State words: [role, server_gid, chunk_start, got, downloads_done,
     req_gen, seq_mask]. Protocol/tag/timer encodings match the CPU twin
-    exactly (REQ d0=TAG_REQ d1=start; DATA d0=TAG_DATA d1=seq; timer
+    exactly (REQ d0=TAG_REQ d1=start; DATA is a packet TRAIN row with
+    d1=start and the network-computed survivor bitmask in d2; timer
     d0=-1 pause / d0=gen retry), so event traces are bit-identical.
     seq_mask is the received-seq bitmask within the current window:
-    only fresh in-window DATA advances it, so duplicates from a
+    only fresh in-window bits advance it, so duplicates from a
     premature retry never complete a chunk (same rule as the CPU
     twin's _mask)."""
 
@@ -152,7 +164,8 @@ class TgenDevice(DeviceApp):
             "seq_mask is one int32 word: CHUNK_PKTS must stay <= 32"
         self.chunk = CHUNK_PKTS
         self.n_state_words = 7
-        self.max_sends = self.chunk
+        self.max_sends = 1              # a whole chunk is ONE train row
+        self.max_train = self.chunk
         self.max_timers = 1
         self.max_draws = 1              # no randomness consumed
 
@@ -165,7 +178,8 @@ class TgenDevice(DeviceApp):
         st[:n, 1] = self.server_gid[:n]
         return jnp.asarray(st)
 
-    def handle(self, gid, now, kind, src, size, d0, d1, app_state, draws
+    def handle(self, gid, now, kind, src, size, d0, d1, d2, app_state,
+               draws
                ) -> AppOut:
         H, K = draws.shape[0], self.max_sends
         role = app_state[:, 0]
@@ -185,15 +199,39 @@ class TgenDevice(DeviceApp):
         timer_pause = is_timer & (d0 < 0)
         timer_retry = is_timer & (d0 >= 0) & (d0 == gen)
 
-        # ---- client window progress (fresh in-window DATA only) ----
+        # ---- client window progress (fresh in-window bits only) ----
+        # a DATA train: d1 = start packet index, d2 = survivor bitmask
+        # (bit j <-> packet d1+j). Align to the current window, mask
+        # off already-received bits, count the rest (popcount — the
+        # CPU twin counts the same bits one by one).
         chunk_len = jnp.minimum(self.chunk, self.npkts - chunk_start)
-        off = d1 - chunk_start
-        in_window = is_data & (off >= 0) & (off < chunk_len)
-        bit = jnp.left_shift(jnp.int32(1),
-                             jnp.clip(off, 0, self.chunk - 1))
-        fresh = in_window & ((mask & bit) == 0)
-        new_mask = jnp.where(fresh, mask | bit, mask)
-        new_got = jnp.where(fresh, got + 1, got)
+        shift = d1 - chunk_start                              # [H]
+        surv_u = d2.astype(jnp.uint32)
+        up = jnp.left_shift(surv_u,
+                            jnp.clip(shift, 0, 31).astype(jnp.uint32))
+        down = jnp.right_shift(surv_u,
+                               jnp.clip(-shift, 0,
+                                        31).astype(jnp.uint32))
+        aligned = jnp.where(shift >= 0, up, down)
+        # a train a full window or more away contributes nothing (the
+        # u32 shifts clip at 31; the CPU twin's python shift yields 0)
+        aligned = jnp.where((shift >= 32) | (shift <= -32),
+                            jnp.uint32(0), aligned)
+        wmask = jnp.where(
+            chunk_len >= 32, jnp.uint32(0xFFFFFFFF),
+            (jnp.uint32(1) << jnp.clip(chunk_len, 0,
+                                       31).astype(jnp.uint32))
+            - jnp.uint32(1))
+        window = aligned & wmask
+        fresh_bits = window & ~mask.astype(jnp.uint32)
+        fresh = is_data & (fresh_bits != 0)
+        new_mask = jnp.where(
+            fresh, (mask.astype(jnp.uint32) | fresh_bits)
+            .astype(jnp.int32), mask)
+        new_got = jnp.where(
+            fresh,
+            got + lax.population_count(fresh_bits).astype(jnp.int32),
+            got)
         complete = fresh & (new_got >= chunk_len)
         next_start = chunk_start + chunk_len
         dl_done = complete & (next_start >= self.npkts)
@@ -218,23 +256,28 @@ class TgenDevice(DeviceApp):
         st = st.at[:, 5].set(new_gen)
         st = st.at[:, 6].set(new_mask)
 
-        # ---- sends ----
-        ks = jnp.arange(K, dtype=jnp.int32)[None, :]           # [1,K]
-        seqs = d1[:, None] + ks                                # [H,K]
-        srv_valid = is_req[:, None] & (seqs < self.npkts)
-        srv_size = jnp.where(seqs == self.npkts - 1, self.last_sz,
-                             self.MSS)
-        cli_valid = (ks == 0) & send_req[:, None]
+        # ---- sends (K == 1: one REQ row or one DATA train row) ----
+        # server answer: the whole chunk [d1, d1+cnt) as one train of
+        # cnt packets totalling nbytes (MSS each, last-packet
+        # remainder when the chunk reaches the end of the file)
+        srv_cnt = jnp.clip(self.npkts - d1, 0, self.chunk)
+        srv_valid = is_req & (srv_cnt > 0)
+        ends_file = d1 + srv_cnt >= self.npkts
+        srv_bytes = jnp.where(
+            ends_file, (srv_cnt - 1) * self.MSS + self.last_sz,
+            srv_cnt * self.MSS)
 
-        sv = is_server[:, None]
-        send_valid = jnp.where(sv, srv_valid, cli_valid)
-        send_dst = jnp.where(sv, src[:, None],
-                             server[:, None]).astype(jnp.int32)
-        send_size = jnp.where(sv, srv_size, 64).astype(jnp.int32)
+        sv = is_server
+        send_valid = jnp.where(sv, srv_valid, send_req)[:, None]
+        send_dst = jnp.where(sv, src, server)[:, None].astype(jnp.int32)
+        send_size = jnp.where(sv, srv_bytes, 64)[:, None].astype(
+            jnp.int32)
         send_d0 = jnp.where(sv, self.TAG_DATA,
-                            self.TAG_REQ).astype(jnp.int32)
-        send_d1 = jnp.where(sv, seqs,
-                            req_start[:, None]).astype(jnp.int32)
+                            self.TAG_REQ)[:, None].astype(jnp.int32)
+        send_d1 = jnp.where(sv, d1,
+                            req_start)[:, None].astype(jnp.int32)
+        send_count = jnp.where(sv, srv_cnt, 1)[:, None].astype(
+            jnp.int32)
 
         # ---- timers (pause and retry are mutually exclusive) ----
         pause_valid = dl_done & (new_done < self.count)
@@ -252,6 +295,7 @@ class TgenDevice(DeviceApp):
             timer_valid=timer_valid,
             n_draws=jnp.zeros((H,), jnp.int32),
             app_state=st,
+            send_count=send_count,
         )
 
 
@@ -319,7 +363,8 @@ class TorDevice(DeviceApp):
         gids = jnp.asarray(self.relay_gids.astype(np.int32))
         return gids[g], gids[m], gids[e]
 
-    def handle(self, gid, now, kind, src, size, d0, d1, app_state, draws
+    def handle(self, gid, now, kind, src, size, d0, d1, d2, app_state,
+               draws
                ) -> AppOut:
         H, K = draws.shape[0], self.max_sends
         role = app_state[:, 0]

@@ -151,11 +151,12 @@ class DeviceEngine:
         the index is ignored here).
 
         v2 state layout (scatter-free engine): per-host event heaps are
-        SORTED rows of four packed i64 arrays —
+        SORTED rows of five packed i64 arrays —
           ht [H,E] time (INF = empty slot),
           hk [H,E] src<<32|seq  (the deterministic tiebreak key),
           hm [H,E] kind<<32|size,
           hv [H,E] d0<<32|d1,
+          hw [H,E] d2 (train survivor bitmask; 0 otherwise),
         plus a per-host `head` cursor: slots < head are consumed; the
         next event of host h is always column head[h]. Rows re-sort
         only at flush (one lax.sort per phase) — no scatters anywhere.
@@ -203,6 +204,7 @@ class DeviceEngine:
             "ht": t, "hk": k2,
             "hm": kind << 32,            # kind<<32 | size(=0)
             "hv": np.zeros((H, E), dtype=np.int64),
+            "hw": np.zeros((H, E), dtype=np.int64),
             "head": zeros_i32.copy(),
             "event_seq": event_seq,
             "packet_seq": zeros_i32.copy(),
@@ -256,6 +258,7 @@ class DeviceEngine:
         # outbox layout: each pop iteration owns M_out columns (K sends
         # + T timers + the model-NIC READY reinsert); a phase runs at
         # most B iterations between flushes
+        C = max(1, getattr(app, "max_train", 1))
         M_out = K + T + (1 if MB else 0)
         B = max(1, cfg.outbox_capacity // M_out)
         OB = B * M_out
@@ -310,9 +313,11 @@ class DeviceEngine:
             pk2 = _take_head(state["hk"], head, IMAX)
             pm = _take_head(state["hm"], head, jnp.int64(0))
             pv = _take_head(state["hv"], head, jnp.int64(0))
+            pw = _take_head(state["hw"], head, jnp.int64(0))
             psrc, pseq = hi32(pk2), lo32(pk2)
             pkind, psize = hi32(pm), lo32(pm)
             pd0, pd1 = hi32(pv), lo32(pv)
+            pd2 = lo32(pw)
 
             # a host with a possibly-in-window insert pending in the
             # outbox (dirty) must stall until the flush lands it, or
@@ -328,7 +333,12 @@ class DeviceEngine:
                 jnp.zeros_like(runnable)
             is_pkt = runnable & (pkind == (KIND_PACKET_READY if MB
                                            else KIND_PACKET))
-            state["n_deliv"] = state["n_deliv"] + is_pkt
+            # delivered PACKETS: a train row carries popcount(d2)
+            # survivors (ordinary packets carry d2 == 1)
+            state["n_deliv"] = state["n_deliv"] + jnp.where(
+                is_pkt,
+                lax.population_count(pd2.astype(jnp.uint32))
+                .astype(jnp.int32), 0)
             mix = (pt ^ (psrc.astype(jnp.int64) * CHK_SRC)
                    ^ (pkind.astype(jnp.int64) * CHK_KIND)
                    ^ (pseq.astype(jnp.int64) * CHK_SEQ)) & MASK63
@@ -350,7 +360,8 @@ class DeviceEngine:
             else:
                 app_kind = jnp.where(runnable, pkind, -1)
             out = app.handle(gid, pt, app_kind,
-                             psrc, psize, pd0, pd1, state["app"], draws)
+                             psrc, psize, pd0, pd1, pd2, state["app"],
+                             draws)
             app_on = runnable & ~is_rx if MB else runnable
             # apps may return [H,1] columns that broadcast over K/T
             out = out._replace(
@@ -373,18 +384,55 @@ class DeviceEngine:
             # sends -> network judgment (worker_sendPacket semantics)
             send_valid = out.send_valid & app_on[:, None]       # [H,K]
             vrank = jnp.cumsum(send_valid, axis=-1) - send_valid
-            pkt_seq = state["packet_seq"][:, None] + vrank
-            state["packet_seq"] = state["packet_seq"] + \
-                send_valid.sum(-1).astype(jnp.int32)
+            if C > 1:
+                counts = jnp.clip(
+                    jnp.broadcast_to(out.send_count, (H_loc, K))
+                    if out.send_count is not None
+                    else jnp.ones((H_loc, K), jnp.int32), 1, C)
+                vcnt = counts * send_valid
+                ccum = jnp.cumsum(vcnt, axis=-1) - vcnt
+                pkt_seq = state["packet_seq"][:, None] + ccum
+                state["packet_seq"] = state["packet_seq"] + \
+                    vcnt.sum(-1).astype(jnp.int32)
+            else:
+                counts = jnp.ones((H_loc, K), jnp.int32)
+                vcnt = send_valid.astype(jnp.int32)
+                pkt_seq = state["packet_seq"][:, None] + vrank
+                state["packet_seq"] = state["packet_seq"] + \
+                    send_valid.sum(-1).astype(jnp.int32)
 
             dst = out.send_dst                                   # [H,K]
             srcv = host_vertex[gid][:, None]
             dstv = host_vertex[jnp.clip(dst, 0, H_pad - 1)]
             latv = lat[srcv, dstv].astype(jnp.int64)             # [H,K]
             relv = rel[srcv, dstv]
-            dropped = send_valid & packet_drop_mask(
-                seed_pair, BOOT_END, pt[:, None], gid[:, None],
-                pkt_seq, relv)
+            if C > 1:
+                # packet TRAINS: one drop roll per packet, keyed by the
+                # exact (src, pkt_seq0+j) sequence individual sends
+                # would consume — loss statistics are bit-identical to
+                # per-packet sends; survivors become the d2 bitmask
+                js = jnp.arange(C, dtype=jnp.int32)              # [C]
+                seqs3 = pkt_seq[..., None] + js                  # [H,K,C]
+                drop3 = packet_drop_mask(
+                    seed_pair, BOOT_END, pt[:, None, None],
+                    gid[:, None, None], seqs3, relv[..., None])
+                win3 = js[None, None, :] < counts[..., None]
+                lost3 = drop3 & win3 & send_valid[..., None]
+                surv = jnp.where(
+                    ~drop3 & win3,
+                    jnp.left_shift(jnp.uint32(1),
+                                   js.astype(jnp.uint32)),
+                    jnp.uint32(0)).sum(-1, dtype=jnp.uint32)     # [H,K]
+                surv = jnp.where(send_valid, surv, 0)
+                dropped = send_valid & (surv == 0)
+                n_lost = lost3.sum((-2, -1)).astype(jnp.int32)
+            else:
+                dropped = send_valid & packet_drop_mask(
+                    seed_pair, BOOT_END, pt[:, None], gid[:, None],
+                    pkt_seq, relv)
+                surv = jnp.where(send_valid & ~dropped,
+                                 jnp.uint32(1), jnp.uint32(0))
+                n_lost = dropped.sum(-1).astype(jnp.int32)
             if MB:
                 # TX fluid bucket (ModelNic.tx_depart): a burst's sends
                 # serialize in slot order; drop-rolled packets still
@@ -404,9 +452,8 @@ class DeviceEngine:
                 depart = pt[:, None]
             delivered = send_valid & ~dropped
             state["n_sent"] = state["n_sent"] + \
-                send_valid.sum(-1).astype(jnp.int32)
-            state["n_drop"] = state["n_drop"] + \
-                dropped.sum(-1).astype(jnp.int32)
+                vcnt.sum(-1).astype(jnp.int32)
+            state["n_drop"] = state["n_drop"] + n_lost
 
             # event seq consumed per SEND (delivered or dropped alike),
             # matching the CPU engines — lets the CPU side defer drop
@@ -516,9 +563,12 @@ class DeviceEngine:
                             jnp.zeros((H_loc, T), jnp.int32),
                             psize[:, None]),
                        cols(out.send_d0, out.timer_d0, pd0[:, None]))
-            bv = cols(out.send_d1,
-                      jnp.zeros((H_loc, T), jnp.int32),
-                      pd1[:, None]).astype(jnp.int64)
+            bv = pack2(cols(surv.astype(jnp.int32),
+                            jnp.zeros((H_loc, T), jnp.int32),
+                            pd2[:, None]),
+                       cols(out.send_d1,
+                            jnp.zeros((H_loc, T), jnp.int32),
+                            pd1[:, None]))
 
             col0 = blk * jnp.int32(M_out)
             for f, block in (("t", bt), ("k", bk), ("m", bm),
@@ -581,9 +631,24 @@ class DeviceEngine:
                 edges = jnp.searchsorted(skey, bound)
                 starts, nxt = edges[:-1], edges[1:]
                 counts = nxt - starts
-                lost = jnp.maximum(0, counts - CAP).sum()
-                state["x_overflow"] = state["x_overflow"].at[0].add(
-                    lost.astype(jnp.int32))
+                # overflow attributed to the SENDING host (it owns the
+                # sizing knob): per-shard ranks via segment scan, then
+                # a 1-key sort + searchsorted histogram of the lost
+                # rows' source hosts — scatter-free like everything
+                idx = jnp.arange(G, dtype=jnp.int64)
+                shard_of = skey // (H_loc * SPAN)
+                is_new = jnp.concatenate(
+                    [jnp.array([True]), shard_of[1:] != shard_of[:-1]])
+                seg0 = lax.associative_scan(
+                    jnp.maximum, jnp.where(is_new, idx, 0))
+                lost_mask = (skey < IMAX) & ((idx - seg0) >= CAP)
+                src_loc = (skey % SPAN) // OB \
+                    - my_shard.astype(jnp.int64) * H_loc
+                lk = lax.sort(jnp.where(lost_mask, src_loc, IMAX))
+                hb = jnp.searchsorted(
+                    lk, jnp.arange(H_loc + 1, dtype=jnp.int64))
+                state["x_overflow"] = state["x_overflow"] + \
+                    (hb[1:] - hb[:-1]).astype(jnp.int32)
                 win = _seg_take(skey, rows, starts, counts, CAP)
                 kidx = jnp.clip(
                     starts[:, None] + jnp.arange(CAP,
@@ -636,18 +701,21 @@ class DeviceEngine:
             inc_kind = lo32(inc["m"])
             inc_hm = pack2(inc_kind, hi32(inc["s"]))
             inc_hv = pack2(lo32(inc["s"]), lo32(inc["v"]))
+            inc_hw = (inc["v"] >> 32) & U32        # d2 (train survivors)
             ct = jnp.concatenate([mt, inc["t"]], axis=1)
             ck = jnp.concatenate([mk, inc["k"]], axis=1)
             cm = jnp.concatenate([state["hm"], inc_hm], axis=1)
             cv = jnp.concatenate([state["hv"], inc_hv], axis=1)
-            st, sk, sm, sv = lax.sort((ct, ck, cm, cv),
-                                      dimension=1, num_keys=2)
+            cw = jnp.concatenate([state["hw"], inc_hw], axis=1)
+            st, sk, sm, sv, sw = lax.sort((ct, ck, cm, cv, cw),
+                                          dimension=1, num_keys=2)
             state["overflow"] = state["overflow"] + \
                 (st[:, E:] < INF).sum(-1).astype(jnp.int32)
             state["ht"] = st[:, :E]
             state["hk"] = sk[:, :E]
             state["hm"] = sm[:, :E]
             state["hv"] = sv[:, :E]
+            state["hw"] = sw[:, :E]
             state["head"] = jnp.zeros_like(state["head"])
             return state
 
@@ -752,7 +820,7 @@ class DeviceEngine:
                 _take_head(state["ht"], state["head"], INF).min())
             return state, nxt
 
-        spec_keys = ("ht", "hk", "hm", "hv", "head",
+        spec_keys = ("ht", "hk", "hm", "hv", "hw", "head",
                      "event_seq", "packet_seq", "app_seq", "app",
                      "n_exec", "n_sent", "n_drop", "n_deliv",
                      "overflow", "x_overflow", "chk") + \

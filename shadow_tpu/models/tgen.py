@@ -46,8 +46,12 @@ def n_packets(total_bytes: int) -> int:
 
 
 class TgenServerApp(ModelApp):
-    """Stateless chunk server: REQ(start, total) -> up to CHUNK_PKTS
-    DATA packets [start, ...), sizes MSS except the final remainder."""
+    """Stateless chunk server: REQ(start, total) -> ONE packet-train
+    event carrying up to CHUNK_PKTS DATA packets [start, ...). The
+    train is the classic DES bulk-flow optimization: one event per
+    chunk instead of one per packet, while the network still rolls a
+    drop per packet (SimContext.send_train) with the identical keys —
+    so loss behavior matches per-packet sends bit-for-bit."""
 
     def on_packet(self, ctx, src_host, size, data) -> None:
         tag = data[0] if data else 0
@@ -55,13 +59,13 @@ class TgenServerApp(ModelApp):
             return
         start, total = data[1], data[2]
         npkts = n_packets(total)
-        for k in range(CHUNK_PKTS):
-            seq = start + k
-            if seq >= npkts:
-                break
-            sz = MSS if seq < npkts - 1 or total % MSS == 0 \
-                else total % MSS
-            ctx.send(src_host, sz, (TAG_DATA, seq))
+        cnt = min(CHUNK_PKTS, npkts - start)
+        if cnt <= 0:
+            return
+        last = total % MSS or MSS
+        nbytes = cnt * MSS if start + cnt < npkts \
+            else (cnt - 1) * MSS + last
+        ctx.send_train(src_host, nbytes, (TAG_DATA, start), count=cnt)
 
 
 class TgenClientApp(ModelApp):
@@ -112,19 +116,27 @@ class TgenClientApp(ModelApp):
         tag = data[0] if data else 0
         if tag != TAG_DATA:
             return
-        # count only fresh in-window packets: a premature retry can put
-        # duplicate DATA in flight, which must not advance the window
-        seq = data[1] if len(data) > 1 else -1
+        # a train event: data = (start, survivor_bitmask). Only fresh
+        # in-window bits advance the window — duplicates from a
+        # premature retry must not complete a chunk
+        start = data[1] if len(data) > 1 else -1
+        surv = data[2] if len(data) > 2 else 0
         chunk_len = min(CHUNK_PKTS, self._npkts - self._chunk_start)
-        off = seq - self._chunk_start
-        if off < 0 or off >= chunk_len:
-            return                     # stale chunk / out of window
-        bit = 1 << off
-        if self._mask & bit:
-            return                     # duplicate within the window
-        self._mask |= bit
-        self.bytes_received += size
-        self._got += 1
+        shift = start - self._chunk_start
+        if shift > 0:
+            window = (surv << shift) & ((1 << chunk_len) - 1)
+        else:
+            window = (surv >> -shift) & ((1 << chunk_len) - 1)
+        fresh = window & ~self._mask
+        if not fresh:
+            return                     # stale chunk / all duplicates
+        self._mask |= fresh
+        for off in range(chunk_len):
+            if fresh & (1 << off):
+                seq = self._chunk_start + off
+                self.bytes_received += MSS if seq < self._npkts - 1 \
+                    else (self.size % MSS or MSS)
+                self._got += 1
         if self._got < chunk_len:
             return
         self._chunk_start += chunk_len

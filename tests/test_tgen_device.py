@@ -55,7 +55,11 @@ def _run(policy, seed=1, loss=0.0, clients=4, size="200KiB", count=2,
 
 
 @pytest.mark.parametrize("loss,extra",
-                         [(0.0, ""), (0.02, "retry=500ms")])
+                         [(0.0, ""), (0.02, "retry=500ms"),
+                          # heavy loss + tight retries: duplicate
+                          # trains in flight, including stale trains a
+                          # full window back (the u32 shift-clip edge)
+                          (0.25, "retry=120ms")])
 def test_tgen_device_matches_serial_oracle(loss, extra):
     s_stats, s_hosts = _run("serial", loss=loss, extra=extra)
     d_stats, d_hosts = _run("tpu", loss=loss, extra=extra)
