@@ -214,6 +214,10 @@ class ExperimentalOptions:
     scheduler_policy: str = "serial"
     runahead: Optional[int] = None          # override lookahead window, ns
     use_cpu_pinning: bool = True
+    # worker CONTEXTS for threaded policies; 0 = one per LP. When
+    # workers > general.parallelism, the LogicalProcessors layer
+    # multiplexes them (logical_processor.rs analogue)
+    workers: int = 0
     use_memory_manager: bool = True
     use_seccomp: bool = True
     use_shim_syscall_handler: bool = True
@@ -231,6 +235,10 @@ class ExperimentalOptions:
     # models bandwidth): the vectorizable fluid NIC that exists on both
     # the CPU and device engines (host/model_nic.py)
     model_bandwidth: bool = False
+    # per-path packet counters (topology_incrementPathPacketCounter):
+    # tracked by the CPU NetworkModel always; on the device engine
+    # this opts into the flush-time [V,V] histogram (V^2 <= 65536)
+    count_paths: bool = False
 
     # --- TPU engine knobs (new; absent from the reference) ---
     event_capacity: int = 64        # device event slots per host

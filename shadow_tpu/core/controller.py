@@ -214,7 +214,11 @@ class Controller:
         self.manager = Manager(
             hosts=self.sim.hosts,
             policy=make_policy(policy_name,
-                               cfg.general.parallelism),
+                               n_workers=(cfg.experimental.workers
+                                          or cfg.general.parallelism),
+                               parallelism=cfg.general.parallelism,
+                               pin_cpus=cfg.experimental
+                               .use_cpu_pinning),
             netmodel=self.sim.netmodel,
             seed=cfg.general.seed,
             trace=trace,

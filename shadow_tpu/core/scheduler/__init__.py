@@ -4,14 +4,20 @@ from shadow_tpu.core.scheduler.serial import SerialPolicy
 __all__ = ["SchedulerPolicy", "SerialPolicy", "make_policy"]
 
 
-def make_policy(name: str, n_workers: int = 0) -> SchedulerPolicy:
+def make_policy(name: str, n_workers: int = 0, parallelism: int = 0,
+                pin_cpus: bool = False) -> SchedulerPolicy:
     """Policy factory (scheduler_policy_type.h analogue). The five CPU
     policies of the reference map onto our thread-pool policies; `serial`
     is the single-threaded oracle and `tpu` is handled by the device
-    engine (core/manager.py selects it before reaching here)."""
+    engine (core/manager.py selects it before reaching here).
+    `parallelism` caps concurrently-running workers (the
+    LogicalProcessors layer); `pin_cpus` applies the affinity module's
+    placement to the LP threads."""
     if name == "serial":
         return SerialPolicy()
     if name in ("host", "steal", "thread", "threadXthread", "threadXhost"):
         from shadow_tpu.core.scheduler.threads import ThreadedPolicy
-        return ThreadedPolicy(kind=name, n_workers=n_workers)
+        return ThreadedPolicy(kind=name, n_workers=n_workers,
+                              parallelism=parallelism,
+                              pin_cpus=pin_cpus)
     raise ValueError(f"unknown scheduler policy {name!r}")

@@ -74,15 +74,24 @@ _worker_tls = threading.local()
 
 
 class ThreadedPolicy(SchedulerPolicy):
-    def __init__(self, kind: str, n_workers: int = 0):
+    def __init__(self, kind: str, n_workers: int = 0,
+                 parallelism: int = 0, pin_cpus: bool = False):
         self.kind = kind
         self.n_workers = n_workers if n_workers > 0 else (os.cpu_count() or 2)
+        # LogicalProcessors (logical_processor.rs): worker CONTEXTS may
+        # exceed the concurrency cap; `parallelism` OS threads then
+        # multiplex them with round-robin assignment + stealing
+        self.parallelism = min(parallelism or self.n_workers,
+                               self.n_workers)
+        self.pin_cpus = pin_cpus
         self._host_queues: dict[int, _LockedQueue] = {}
         self._worker_queues: list[_LockedQueue] = []
-        # threadXthread: staging[src_worker][dst_worker], unlocked —
-        # written only by src worker, merged by dst worker at its next
-        # round start (the latch/semaphore handoff orders the accesses)
-        self._staging: list[list[PriorityQueue]] = []
+        # threadXthread: staging[src_worker][dst_worker]. LOCKED: with
+        # LP multiplexing a worker's merge runs whenever an LP reaches
+        # it mid-round, concurrent with other workers' pushes — the
+        # old "merged at round start" ordering argument no longer
+        # holds
+        self._staging: list[list[_LockedQueue]] = []
         self._owner: dict[int, int] = {}       # host -> worker
         self._worker_hosts: list[list[int]] = []
         self._pool: Optional[_WorkerPool] = None
@@ -98,7 +107,7 @@ class ThreadedPolicy(SchedulerPolicy):
                                    for _ in range(self.n_workers)]
             if self.kind == "threadXthread":
                 self._staging = [
-                    [PriorityQueue() for _ in range(self.n_workers)]
+                    [_LockedQueue() for _ in range(self.n_workers)]
                     for _ in range(self.n_workers)
                 ]
         w = host_id % self.n_workers          # round-robin assignment
@@ -125,9 +134,8 @@ class ThreadedPolicy(SchedulerPolicy):
     def merge_staging(self, dst_w: int) -> None:
         for src_w in range(self.n_workers):
             q = self._staging[src_w][dst_w]
-            while q:
-                key, ev = q.pop()
-                self._worker_queues[dst_w].push(key, ev)
+            while (ev := q.pop_before(simtime.SIMTIME_MAX)) is not None:
+                self._worker_queues[dst_w].push(ev.key, ev)
 
     def pop(self, barrier: int) -> Optional[Event]:
         raise RuntimeError("ThreadedPolicy executes rounds via "
@@ -139,9 +147,7 @@ class ThreadedPolicy(SchedulerPolicy):
         times = [q.next_time() for q in queues]
         for row in self._staging:
             for q in row:
-                key = q.peek_key()
-                if key is not None:
-                    times.append(key.time)
+                times.append(q.next_time())
         return min(times, default=simtime.SIMTIME_MAX)
 
     # -- parallel round execution -------------------------------------
@@ -157,25 +163,39 @@ class ThreadedPolicy(SchedulerPolicy):
 
 
 class _WorkerPool:
-    """Persistent pthread-pool analogue (core/worker.c:132-185): workers
-    wait on a per-round start signal, drain their share of the queues,
-    then count down a finish latch."""
+    """Persistent pthread-pool analogue (core/worker.c:132-185) with a
+    LogicalProcessors layer (logical_processor.rs:17-60): `parallelism`
+    OS threads multiplex `n_workers` worker contexts. Each round the
+    worker ids are dealt round-robin onto per-LP ready queues; an idle
+    LP steals worker ids from its neighbors (pop_worker_to_run_on).
+    With parallelism == n_workers this degenerates to one worker per
+    thread, the reference's common case. Threads optionally pin to the
+    affinity module's CPU assignment (worker.c:316-330)."""
 
     def __init__(self, policy: ThreadedPolicy, manager):
         self.policy = policy
         self.manager = manager
         self.n = policy.n_workers
+        self.n_lps = policy.parallelism
         self._error: Optional[BaseException] = None
         self._barrier = simtime.SIMTIME_INVALID
-        self._start = [threading.Semaphore(0) for _ in range(self.n)]
+        self._start = [threading.Semaphore(0) for _ in range(self.n_lps)]
         self._done: Optional[CountDownLatch] = None
         self._shutdown = False
         self._steal_lock = threading.Lock()
         self._steal_cursor = 0
+        self._lp_lock = threading.Lock()
+        self._lp_ready: list[list[int]] = [[] for _ in range(self.n_lps)]
+        self._states: dict[int, tuple] = {}     # wid -> (ctx, stats)
+        if policy.pin_cpus:
+            from shadow_tpu.utils.affinity import good_worker_affinity
+            self._affinity = good_worker_affinity(self.n_lps)
+        else:
+            self._affinity = None
         self._threads = [
             threading.Thread(target=self._run, args=(i,), daemon=True,
                              name=f"shadow-worker-{i}")
-            for i in range(self.n)
+            for i in range(self.n_lps)
         ]
         for t in self._threads:
             t.start()
@@ -184,7 +204,11 @@ class _WorkerPool:
         self._barrier = window_end
         self._steal_cursor = 0
         self._error: Optional[BaseException] = None
-        self._done = CountDownLatch(self.n)
+        for lp in self._lp_ready:
+            lp.clear()
+        for wid in range(self.n):
+            self._lp_ready[wid % self.n_lps].append(wid)
+        self._done = CountDownLatch(self.n_lps)
         for s in self._start:
             s.release()
         self._done.wait()
@@ -199,32 +223,60 @@ class _WorkerPool:
             s.release()
 
     # -- worker bodies -------------------------------------------------
-    def _run(self, wid: int) -> None:
+    def _next_worker(self, lp: int) -> Optional[int]:
+        """Pop a ready worker id: own queue first, then steal round-
+        robin from the other LPs (logical_processor.rs:42-55)."""
+        with self._lp_lock:
+            for j in range(self.n_lps):
+                q = self._lp_ready[(lp + j) % self.n_lps]
+                if q:
+                    return q.pop(0)
+        return None
+
+    def _run(self, lp: int) -> None:
         from shadow_tpu.core.scheduler.threads import _worker_tls
-        _worker_tls.wid = wid
-        ctx, stats = self.manager.make_worker_state()
+        if self._affinity is not None:
+            from shadow_tpu.utils.affinity import pin_current_thread
+            pin_current_thread(self._affinity[lp])
         while True:
-            self._start[wid].acquire()
+            self._start[lp].acquire()
             if self._shutdown:
                 return
             barrier = self._barrier
             try:
-                if self.policy.kind == "threadXthread":
-                    self.policy.merge_staging(wid)
                 if self.policy.kind == "steal":
+                    # host-level stealing is already global: every LP
+                    # drains from the shared cursor
+                    ctx, stats = self._state_for(lp)
+                    _worker_tls.wid = lp
                     self._drain_stealing(ctx, stats, barrier)
-                elif self.policy._per_host():
-                    for hid in self.policy._worker_hosts[wid]:
-                        self._drain(self.policy._host_queues[hid],
-                                    ctx, stats, barrier)
                 else:
-                    self._drain(self.policy._worker_queues[wid],
-                                ctx, stats, barrier)
+                    while (wid := self._next_worker(lp)) is not None:
+                        self._run_worker(wid, barrier)
             except BaseException as e:   # propagate to run_round
                 if self._error is None:
                     self._error = e
             finally:
                 self._done.count_down()
+
+    def _state_for(self, wid: int) -> tuple:
+        st = self._states.get(wid)
+        if st is None:
+            st = self._states[wid] = self.manager.make_worker_state()
+        return st
+
+    def _run_worker(self, wid: int, barrier: int) -> None:
+        _worker_tls.wid = wid
+        ctx, stats = self._state_for(wid)
+        if self.policy.kind == "threadXthread":
+            self.policy.merge_staging(wid)
+        if self.policy._per_host():
+            for hid in self.policy._worker_hosts[wid]:
+                self._drain(self.policy._host_queues[hid],
+                            ctx, stats, barrier)
+        else:
+            self._drain(self.policy._worker_queues[wid],
+                        ctx, stats, barrier)
 
     def _drain(self, q: _LockedQueue, ctx, stats, barrier: int) -> None:
         while (ev := q.pop_before(barrier)) is not None:

@@ -1,0 +1,82 @@
+"""Worker CPU affinity (src/main/host/affinity.c analogue).
+
+Parses the machine topology from /proc/cpuinfo (processor, physical
+package id, core id) and hands out one CPU per worker, spreading
+across physical cores before reusing hyperthread siblings — the same
+placement goal as the reference's affinity_getGoodWorkerAffinity
+(affinity.c, used core/worker.c:316-330). Pinning is per-thread via
+sched_setaffinity(0) from inside the worker thread.
+
+Fails soft everywhere: exotic /proc formats or containers without
+affinity rights degrade to "no pinning", never to an error.
+"""
+
+from __future__ import annotations
+
+import os
+
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("affinity")
+
+
+def platform_cpus() -> list[int]:
+    """CPU ids ordered for worker assignment: one logical CPU per
+    physical (package, core) first, then the remaining hyperthread
+    siblings, each group in id order."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            text = f.read()
+    except OSError:
+        return sorted(os.sched_getaffinity(0))
+    cpus = []                    # (processor, physical_id, core_id)
+    cur: dict = {}
+    for line in text.splitlines():
+        if not line.strip():
+            if "processor" in cur:
+                cpus.append((cur["processor"],
+                             cur.get("physical id", 0),
+                             cur.get("core id", cur["processor"])))
+            cur = {}
+            continue
+        if ":" in line:
+            k, _, v = line.partition(":")
+            k, v = k.strip(), v.strip()
+            if k in ("processor", "physical id", "core id"):
+                try:
+                    cur[k] = int(v)
+                except ValueError:
+                    pass
+    if "processor" in cur:
+        cpus.append((cur["processor"], cur.get("physical id", 0),
+                     cur.get("core id", cur["processor"])))
+    if not cpus:
+        return sorted(os.sched_getaffinity(0))
+    allowed = os.sched_getaffinity(0)
+    cpus = [c for c in cpus if c[0] in allowed] or \
+        [(c, 0, c) for c in sorted(allowed)]
+    seen_cores: set = set()
+    primary, siblings = [], []
+    for proc, phys, core in sorted(cpus, key=lambda c: c[0]):
+        if (phys, core) in seen_cores:
+            siblings.append(proc)
+        else:
+            seen_cores.add((phys, core))
+            primary.append(proc)
+    return primary + siblings
+
+
+def good_worker_affinity(n_workers: int) -> list[int]:
+    """CPU id for each worker index (wraps when workers > CPUs)."""
+    cpus = platform_cpus()
+    return [cpus[i % len(cpus)] for i in range(n_workers)]
+
+
+def pin_current_thread(cpu: int) -> bool:
+    """Pin the calling thread to one CPU; False if not permitted."""
+    try:
+        os.sched_setaffinity(0, {cpu})
+        return True
+    except OSError as e:
+        log.debug("cpu pinning unavailable: %s", e)
+        return False

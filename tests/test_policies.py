@@ -72,3 +72,43 @@ def test_policy_matches_serial_oracle(policy):
     # identical per-host schedules (global interleaving may differ)
     assert _per_host(s_trace) == _per_host(p_trace)
     assert s_stats.events_executed > 200
+
+
+@pytest.mark.parametrize("policy", ["thread", "threadXthread", "host"])
+def test_lp_multiplexing_matches_oracle(policy):
+    """More worker contexts than LPs: the LogicalProcessors layer
+    (logical_processor.rs analogue) multiplexes 6 workers onto 2 OS
+    threads with stealing — same per-host schedule as serial."""
+    s_stats, s_trace = _run("serial")
+    cfg = load_config_str(YAML, overrides=[
+        f"experimental.scheduler_policy={policy}",
+        "experimental.workers=6",
+        "general.parallelism=2",
+    ])
+    trace = []
+    c = Controller(cfg, trace=trace)
+    p_stats = c.run()
+    assert c.manager.policy.n_workers == 6
+    assert c.manager.policy.parallelism == 2
+    assert s_stats.events_executed == p_stats.events_executed
+    assert s_stats.packets_sent == p_stats.packets_sent
+    assert _per_host(s_trace) == _per_host(trace)
+
+
+def test_affinity_assignment_shapes():
+    """Affinity module (affinity.c analogue): every worker gets a CPU
+    from the allowed set, spreading before reuse."""
+    import os
+
+    from shadow_tpu.utils import affinity
+
+    cpus = affinity.platform_cpus()
+    allowed = os.sched_getaffinity(0)
+    assert cpus and set(cpus) <= allowed
+    assert len(set(cpus)) == len(cpus)          # no duplicates
+    a = affinity.good_worker_affinity(len(cpus) * 2 + 1)
+    assert len(a) == len(cpus) * 2 + 1
+    assert set(a) <= allowed
+    # pinning the current thread is either applied or soft-refused
+    assert affinity.pin_current_thread(cpus[0]) in (True, False)
+    os.sched_setaffinity(0, allowed)            # restore for the suite
