@@ -134,10 +134,13 @@ class SimContext:
         = whole train lost); like send(), apps must not branch on it.
         Trains are the standard DES optimization for bulk flows: the
         event count per chunk drops from `count` to 1 on both engines
-        while loss statistics stay bit-identical."""
-        if count <= 1:
-            ok = self.send(dst_host, size, data + (1,))
-            return 1 if ok else 0
+        while loss statistics stay bit-identical.
+
+        Trains are judged synchronously even under hybrid mode's
+        deferred (device-batched) judgment — the verdict is a pure
+        function of stable keys, so results are identical; deferral is
+        a batching optimization for per-packet send() traffic."""
+        count = max(1, count)
         host = self.host
         pkt_seq0 = host._packet_seq
         host._packet_seq += count

@@ -66,6 +66,9 @@ from shadow_tpu.utils.checksum import (
 )
 
 INF = np.int64(1) << np.int64(62)
+# reserved outbox time marker: a drop-rolled send carried only for the
+# per-path packet histogram (never exchanged or delivered)
+DROP_T = INF - 1
 IMAX = np.int64(np.iinfo(np.int64).max)
 
 AXIS = "hosts"
@@ -98,6 +101,11 @@ class EngineConfig:
     # TX serialization at send, RX serialization + event-driven CoDel
     # at delivery via a KIND_PACKET -> KIND_PACKET_READY two-stage pop
     model_bandwidth: bool = False
+    # per-path packet counters (topology_incrementPathPacketCounter,
+    # ref topology.c:1983): a [V,V] histogram of SENT packets
+    # (drop-rolled included) accumulated at flush time. Costs one
+    # extra flat sort per flush; requires V*V <= 65536.
+    count_paths: bool = False
 
 
 class DeviceEngine:
@@ -126,6 +134,11 @@ class DeviceEngine:
         self.host_vertex = np.zeros(self.H_pad, dtype=np.int32)
         self.host_vertex[:H] = host_vertex
         self.latency = latency_ns.astype(np.int32)
+        self.n_vertices = int(latency_ns.shape[0])
+        if config.count_paths and self.n_vertices ** 2 > 65536:
+            raise ValueError(
+                "count_paths needs V*V <= 65536 (histogram boundaries "
+                f"scale with V^2; this graph has V={self.n_vertices})")
         self.reliability = reliability.astype(np.float32)
         self.seed_pair = prng.seed_key(config.seed)
         # model-NIC bandwidths (bits/s), padded; 1 Gbit default keeps
@@ -218,6 +231,10 @@ class DeviceEngine:
             "x_overflow": zeros_i32.copy(),
             "chk": np.zeros(H, dtype=np.int64),
         }
+        if self.config.count_paths:
+            V = self.n_vertices
+            state["path_cnt"] = np.zeros((self.n_shards, V * V),
+                                         dtype=np.int64)
         if self.config.model_bandwidth:
             # model-NIC scalars (host/model_nic.py ModelNic twin)
             for k in NIC_KEYS:
@@ -259,6 +276,8 @@ class DeviceEngine:
         # + T timers + the model-NIC READY reinsert); a phase runs at
         # most B iterations between flushes
         C = max(1, getattr(app, "max_train", 1))
+        CP = bool(cfg.count_paths)
+        V = self.n_vertices
         M_out = K + T + (1 if MB else 0)
         B = max(1, cfg.outbox_capacity // M_out)
         OB = B * M_out
@@ -546,16 +565,28 @@ class DeviceEngine:
 
             gcol = jnp.broadcast_to(gid[:, None], (H_loc, K))
             gcolT = jnp.broadcast_to(gid[:, None], (H_loc, T))
-            bvalid = cols(delivered, timer_valid, rx_keep[:, None])
+            if CP:
+                # drop-rolled sends ride along under the reserved
+                # DROP_T marker so the flush's path histogram counts
+                # them (ref counts per SENT packet, worker.c:554)
+                bvalid_send = send_valid
+                send_t = jnp.where(delivered, deliver_t, DROP_T)
+            else:
+                bvalid_send = delivered
+                send_t = deliver_t
+            bvalid = cols(bvalid_send, timer_valid, rx_keep[:, None])
             bt = jnp.where(bvalid,
-                           cols(deliver_t, timer_t,
+                           cols(send_t, timer_t,
                                 rx_deliver[:, None]),
                            INF)
             bk = cols(pack2(gcol, ev_seq), pack2(gcolT, tseq),
                       pk2[:, None])
             bdst = cols(dst, gcolT, gid[:, None])
+            # packet-kind rows carry their train count in bits 8+ of
+            # the kind field (histogram weight; kind itself is <256)
             bkind = cols(
-                jnp.full((H_loc, K), KIND_PACKET, jnp.int32),
+                jnp.full((H_loc, K), KIND_PACKET, jnp.int32)
+                | (counts << 8),
                 jnp.full((H_loc, T), KIND_TIMER, jnp.int32),
                 jnp.full((H_loc, 1), KIND_PACKET_READY, jnp.int32))
             bm = pack2(bdst, bkind)
@@ -596,12 +627,41 @@ class DeviceEngine:
             F = H_loc * OB
             flat = {f: ob[f].reshape(F) for f in XF}
             fdst = hi32(flat["m"]).astype(jnp.int64)
-            valid = flat["t"] < INF
+            # DROP_T rows exist only for the path histogram — they are
+            # never exchanged or delivered
+            valid = flat["t"] < DROP_T
             skey = jnp.where(valid, fdst * SPAN + okey.reshape(F),
                              IMAX)
             srt = lax.sort((skey,) + tuple(flat[f] for f in XF),
                            num_keys=1)
             return srt[0], dict(zip(XF, srt[1:]))
+
+        def _count_paths(state, ob, host_vertex):
+            """topology_incrementPathPacketCounter parity: a [V,V]
+            histogram of SENT packets per (src_vertex, dst_vertex),
+            drop-rolled packets included — scatter-free via one flat
+            sort + prefix-sum segment totals."""
+            F = H_loc * OB
+            ft = ob["t"].reshape(F)
+            fm = ob["m"].reshape(F)
+            fk = ob["k"].reshape(F)
+            kindf = lo32(fm)
+            is_pkt = (ft < INF) & ((kindf & 0xFF) == KIND_PACKET)
+            cnt = jnp.where(is_pkt, (kindf >> 8).astype(jnp.int64), 0)
+            src = hi32(fk)
+            dstf = hi32(fm)
+            sv = host_vertex[jnp.clip(src, 0, H_pad - 1)]
+            dv = host_vertex[jnp.clip(dstf, 0, H_pad - 1)]
+            pair = jnp.where(is_pkt,
+                             sv.astype(jnp.int64) * V + dv, V * V)
+            spair, scnt = lax.sort((pair, cnt), num_keys=1)
+            prefix = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int64), jnp.cumsum(scnt)])
+            edges = jnp.searchsorted(
+                spair, jnp.arange(V * V + 1, dtype=jnp.int64))
+            state["path_cnt"] = state["path_cnt"] + \
+                (prefix[edges[1:]] - prefix[edges[:-1]])[None, :]
+            return state
 
         def _seg_take(skey_s, rows, starts, counts, width):
             """Contiguous per-segment windows: row i of the result is
@@ -619,7 +679,9 @@ class DeviceEngine:
                 out[f] = jnp.where(ok, v, fillv)
             return out
 
-        def _exchange(state, ob, gid, my_shard):
+        def _exchange(state, ob, gid, my_shard, host_vertex):
+            if CP:
+                state = _count_paths(state, ob, host_vertex)
             skey, rows = _flat_sorted(ob, gid)
             G = H_loc * OB
 
@@ -698,7 +760,7 @@ class DeviceEngine:
             live = jnp.arange(E)[None, :] >= state["head"][:, None]
             mt = jnp.where(live, state["ht"], INF)
             mk = jnp.where(live, state["hk"], IMAX)
-            inc_kind = lo32(inc["m"])
+            inc_kind = lo32(inc["m"]) & 0xFF   # strip the train count
             inc_hm = pack2(inc_kind, hi32(inc["s"]))
             inc_hv = pack2(lo32(inc["s"]), lo32(inc["v"]))
             inc_hw = (inc["v"] >> 32) & U32        # d2 (train survivors)
@@ -755,7 +817,8 @@ class DeviceEngine:
                                          jnp.int64(1))) == 0
                 return lax.cond(
                     go,
-                    lambda s: _exchange(s, ob, gid, my_shard),
+                    lambda s: _exchange(s, ob, gid, my_shard,
+                                        host_vertex),
                     lambda s: s,
                     state2)
 
@@ -824,7 +887,8 @@ class DeviceEngine:
                      "event_seq", "packet_seq", "app_seq", "app",
                      "n_exec", "n_sent", "n_drop", "n_deliv",
                      "overflow", "x_overflow", "chk") + \
-            (NIC_KEYS if MB else ())
+            (NIC_KEYS if MB else ()) + \
+            (("path_cnt",) if CP else ())
         specs = {k: self._shard_spec for k in spec_keys}
         repl = self._repl_spec
         self._run = jax.jit(jax.shard_map(

@@ -167,6 +167,7 @@ class DeviceRunner:
                 exchange=cfg.experimental.exchange,
                 exchange_capacity=cfg.experimental.exchange_capacity,
                 model_bandwidth=cfg.experimental.model_bandwidth,
+                count_paths=cfg.experimental.count_paths,
             ),
             self.app,
             host_vertex=sim.netmodel.host_vertex.astype(np.int32),
@@ -243,6 +244,15 @@ class DeviceRunner:
         wall = _time.perf_counter() - t0
         self.final_state = final
         H = len(self.sim.hosts)
+        if "path_cnt" in final:
+            # surface the device path histogram through the same API
+            # the CPU engines populate (NetworkModel.path_packets)
+            V = self.engine.n_vertices
+            cnt = np.asarray(final["path_cnt"]).sum(0).reshape(V, V)
+            nz = np.nonzero(cnt)
+            self.sim.netmodel.record_paths(
+                {(int(i), int(j)): int(cnt[i, j])
+                 for i, j in zip(*nz)})
         n_exec_total = int(final["n_exec"][:H].sum())
         # perf-timer parity (USE_PERF_TIMERS round summaries): the
         # device program is one fused loop, so the breakdown is

@@ -196,3 +196,26 @@ def test_device_app_state_matches_cpu():
     dev_recv = list(np.asarray(
         c2.runner.final_state["app"][:len(c2.sim.hosts), 0]))
     assert cpu_recv == dev_recv
+
+
+def test_path_packet_counters_match_oracle():
+    """topology_incrementPathPacketCounter parity (ref topology.c:1983):
+    the device's flush-time [V,V] histogram equals the CPU oracle's
+    per-path judged-packet counts — drop-rolled packets included."""
+    from shadow_tpu.config import load_config_str
+
+    def run(policy):
+        yaml = PHOLD_YAML.format(policy=policy, seed=5, loss=0.1, q=8,
+                                 msgload=2)
+        yaml += "\n"
+        cfg = load_config_str(
+            yaml, overrides=["experimental.count_paths=true"])
+        c = Controller(cfg)
+        stats = c.run()
+        assert stats.ok
+        return dict(c.sim.netmodel.path_packets)
+
+    s = run("serial")
+    d = run("tpu")
+    assert s and sum(s.values()) > 200
+    assert s == d
