@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Determinism CI gate (ref src/test/determinism/ +
+determinism1_compare.cmake): run the same config twice and
+byte-compare every host's outputs.
+
+Two layers of comparison, mirroring the reference's diff loop:
+  1. per-host trace checksums + packet counters from the engine;
+  2. every file under each host's data directory (managed-process
+     stdout/stderr), byte for byte.
+
+Exit 0 = bit-identical; 1 = divergence (the reproducibility bar the
+reference enforces in CI).
+
+Usage: python scripts/determinism_gate.py [config.yaml] [--policy P]
+Defaults to examples/minimal.yaml with the serial policy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import filecmp
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_once(config: str, policy: str, data_dir: str):
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+
+    cfg = load_config(config)
+    cfg.experimental.scheduler_policy = policy
+    cfg.general.data_directory = data_dir
+    c = Controller(cfg)
+    stats = c.run()
+    if not stats.ok:
+        print(f"FAIL: run reported not-ok ({policy})")
+        sys.exit(1)
+    sig = [(h.name, h.trace_checksum, h.events_executed,
+            h.packets_sent, h.packets_dropped, h.packets_delivered)
+           for h in c.sim.hosts]
+    return sig, stats
+
+
+def compare_trees(a: str, b: str) -> list[str]:
+    """Byte-compare every file under both trees; return differences."""
+    diffs = []
+    for root, _, files in os.walk(a):
+        rel = os.path.relpath(root, a)
+        for f in files:
+            fa = os.path.join(root, f)
+            fb = os.path.join(b, rel, f)
+            if not os.path.exists(fb):
+                diffs.append(f"only in run 1: {os.path.join(rel, f)}")
+            elif not filecmp.cmp(fa, fb, shallow=False):
+                diffs.append(f"differs: {os.path.join(rel, f)}")
+    for root, _, files in os.walk(b):
+        rel = os.path.relpath(root, b)
+        for f in files:
+            if not os.path.exists(os.path.join(a, rel, f)):
+                diffs.append(f"only in run 2: {os.path.join(rel, f)}")
+    return diffs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config", nargs="?", default="examples/minimal.yaml")
+    ap.add_argument("--policy", default="serial")
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        d1 = os.path.join(tmp, "run1", "shadow.data")
+        d2 = os.path.join(tmp, "run2", "shadow.data")
+        sig1, stats1 = run_once(args.config, args.policy, d1)
+        sig2, stats2 = run_once(args.config, args.policy, d2)
+
+        rc = 0
+        if sig1 != sig2:
+            rc = 1
+            print("DETERMINISM FAILURE: per-host signatures differ")
+            for a, b in zip(sig1, sig2):
+                if a != b:
+                    print(f"  {a[0]}: {a[1:]} != {b[1:]}")
+        diffs = compare_trees(d1, d2)
+        if diffs:
+            rc = 1
+            print("DETERMINISM FAILURE: host files differ")
+            for d in diffs[:20]:
+                print(f"  {d}")
+        if rc == 0:
+            print(f"determinism OK: {args.config} policy={args.policy} "
+                  f"({stats1.events_executed} events, "
+                  f"{stats1.packets_sent} packets, bit-identical "
+                  "signatures and host files across 2 runs)")
+        return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
