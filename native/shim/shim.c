@@ -81,6 +81,8 @@ enum {
   IPC_THREAD_START = 7, /* child -> sim on its own channel: alive */
   IPC_THREAD_FAIL = 8,  /* child channel: native clone failed */
   IPC_FORK_RESULT = 9,  /* parent -> sim: real child pid (or -errno) */
+  IPC_EXEC_DONE = 12,   /* plugin -> sim: new image after execve is
+                           live on the same channel (constructor) */
   IPC_SIGNAL = 10,      /* sim -> plugin: run handler args[0] for
                          * signal `number` (args[1] = sa_flags) */
   IPC_SIGNAL_DONE = 11, /* plugin -> sim: handler returned */
@@ -162,20 +164,34 @@ static __thread void *t_scratch
  * shim_child_start (never returns), while the parent returns the
  * kernel result. */
 
-long shim_rawsyscall(long nr, long a, long b, long c, long d, long e,
-                     long f);
-long shim_clone_raw(long flags, long child_sp, long ptid, long ctid,
-                    long tls);
+typedef long (*shim_raw_fn)(long nr, long a, long b, long c, long d,
+                            long e, long f);
+typedef long (*shim_clone_fn)(long flags, long child_sp, long ptid,
+                              long ctid, long tls);
+long shim_rawsyscall_tmpl(long nr, long a, long b, long c, long d,
+                          long e, long f);
+long shim_clone_raw_tmpl(long flags, long child_sp, long ptid,
+                         long ctid, long tls);
 void shim_child_start(void *boot);
 extern const char shim_syscall_insn_start[];
 extern const char shim_syscall_insn_end[];
+extern const char shim_child_slot[];
+extern const char shim_sigreturn_tmpl[];
 
+/* The template is POSITION-INDEPENDENT as a block (the one external
+ * reference, shim_child_start, goes through shim_child_slot inside
+ * the block) so it can be copied to SHIM_TRAMP_ADDR — a FIXED page
+ * shared by every shim generation. Why: seccomp filters survive
+ * execve, and a stale filter's instruction-pointer escape would
+ * otherwise point at the OLD image's shim mapping, force-killing the
+ * new image's raw syscalls. With the escape range at a fixed address,
+ * arbitrarily many stacked generations all allow the same page. */
 __asm__(".text\n"
         ".globl shim_syscall_insn_start\n"
         "shim_syscall_insn_start:\n"
-        ".globl shim_rawsyscall\n"
-        ".type shim_rawsyscall,@function\n"
-        "shim_rawsyscall:\n"
+        ".globl shim_rawsyscall_tmpl\n"
+        ".type shim_rawsyscall_tmpl,@function\n"
+        "shim_rawsyscall_tmpl:\n"
         "  mov %rdi,%rax\n"
         "  mov %rsi,%rdi\n"
         "  mov %rdx,%rsi\n"
@@ -185,19 +201,30 @@ __asm__(".text\n"
         "  mov 8(%rsp),%r9\n"
         "  syscall\n"
         "  ret\n"
-        ".size shim_rawsyscall,.-shim_rawsyscall\n"
-        ".globl shim_clone_raw\n"
-        ".type shim_clone_raw,@function\n"
-        "shim_clone_raw:\n"
+        ".size shim_rawsyscall_tmpl,.-shim_rawsyscall_tmpl\n"
+        ".globl shim_clone_raw_tmpl\n"
+        ".type shim_clone_raw_tmpl,@function\n"
+        "shim_clone_raw_tmpl:\n"
         "  mov %rcx,%r10\n"          /* ctid: SysV rcx -> kernel r10 */
         "  mov $56,%eax\n"           /* SYS_clone */
         "  syscall\n"
         "  test %rax,%rax\n"
         "  jnz 1f\n"
         "  pop %rdi\n"               /* child: scratch top = CloneBoot* */
-        "  call shim_child_start\n"  /* never returns */
+        "  call *shim_child_slot(%rip)\n"  /* never returns */
         "1: ret\n"
-        ".size shim_clone_raw,.-shim_clone_raw\n"
+        ".size shim_clone_raw_tmpl,.-shim_clone_raw_tmpl\n"
+        ".globl shim_sigreturn_tmpl\n"
+        ".type shim_sigreturn_tmpl,@function\n"
+        "shim_sigreturn_tmpl:\n"
+        "  mov $15,%eax\n"            /* SYS_rt_sigreturn */
+        "  syscall\n"
+        ".size shim_sigreturn_tmpl,.-shim_sigreturn_tmpl\n"
+        ".balign 8\n"
+        ".globl shim_child_slot\n"
+        ".hidden shim_child_slot\n"
+        "shim_child_slot:\n"
+        "  .quad 0\n"
         ".globl shim_restore_context\n"
         ".type shim_restore_context,@function\n"
         "shim_restore_context:\n"    /* (CloneBoot*) — jump into app */
@@ -224,6 +251,79 @@ __asm__(".text\n"
         ".size shim_restore_context,.-shim_restore_context\n"
         ".globl shim_syscall_insn_end\n"
         "shim_syscall_insn_end:\n");
+
+/* Fixed-address trampoline page (see the template comment). All raw
+ * syscalls route through these pointers; the seccomp escape range is
+ * [active base, +template size). */
+#define SHIM_TRAMP_ADDR ((void *)0x6fff00000000UL)
+
+static shim_raw_fn shim_rawsyscall = shim_rawsyscall_tmpl;
+static shim_clone_fn shim_clone_raw = shim_clone_raw_tmpl;
+static void *g_sigreturn = NULL;
+static uintptr_t g_escape_lo, g_escape_hi;
+
+/* Raw rt_sigaction through the trampoline, with the trampoline's own
+ * rt_sigreturn restorer: a post-execve constructor runs under the OLD
+ * image's stacked seccomp filter, which traps rt_sigaction — glibc's
+ * sigaction would be force-killed before our SIGSYS handler exists. */
+struct shim_ksigaction {
+  void *handler;
+  unsigned long flags;
+  void *restorer;
+  uint64_t mask;
+};
+
+#define SHIM_SA_RESTORER 0x04000000UL
+
+static int shim_raw_sigaction(int sig, void *fn, unsigned long flags) {
+  struct shim_ksigaction ks;
+  ks.handler = fn;
+  ks.flags = flags | SHIM_SA_RESTORER;
+  ks.restorer = g_sigreturn;
+  ks.mask = 0;
+  return (int)shim_rawsyscall(SYS_rt_sigaction, sig, (long)&ks, 0, 8,
+                              0, 0);
+}
+
+static void shim_setup_trampoline(void) {
+  size_t len = (size_t)(shim_syscall_insn_end - shim_syscall_insn_start);
+  size_t plen = (len + 4095) & ~(size_t)4095;
+  long slot_off = shim_child_slot - shim_syscall_insn_start;
+  long raw_off = (const char *)shim_rawsyscall_tmpl
+      - shim_syscall_insn_start;
+  long clone_off = (const char *)shim_clone_raw_tmpl
+      - shim_syscall_insn_start;
+  long sr_off = shim_sigreturn_tmpl - shim_syscall_insn_start;
+  char *page = mmap(SHIM_TRAMP_ADDR, plen, PROT_READ | PROT_WRITE,
+                    MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED_NOREPLACE,
+                    -1, 0);
+  if (page == SHIM_TRAMP_ADDR) {
+    memcpy(page, shim_syscall_insn_start, len);
+    *(void **)(page + slot_off) = (void *)shim_child_start;
+    if (mprotect(page, plen, PROT_READ | PROT_EXEC) == 0) {
+      shim_rawsyscall = (shim_raw_fn)(page + raw_off);
+      shim_clone_raw = (shim_clone_fn)(page + clone_off);
+      g_sigreturn = page + sr_off;
+      g_escape_lo = (uintptr_t)page;
+      g_escape_hi = (uintptr_t)page + len;
+      return;
+    }
+    munmap(page, plen);
+  } else if (page != MAP_FAILED) {
+    munmap(page, plen);
+  }
+  /* fallback: stay in the .so image (execve into a differently-laid-
+   * out image is then unsupported); patch the slot in place */
+  uintptr_t sbase = ((uintptr_t)shim_child_slot) & ~(uintptr_t)4095;
+  if (mprotect((void *)sbase, 8192,
+               PROT_READ | PROT_WRITE | PROT_EXEC) == 0) {
+    *(void **)shim_child_slot = (void *)shim_child_start;
+    mprotect((void *)sbase, 8192, PROT_READ | PROT_EXEC);
+  }
+  g_sigreturn = (void *)shim_sigreturn_tmpl;
+  g_escape_lo = (uintptr_t)shim_syscall_insn_start;
+  g_escape_hi = (uintptr_t)shim_syscall_insn_end;
+}
 
 /* ---- spinning semaphore (plugin side) ------------------------------ */
 
@@ -541,6 +641,8 @@ static long shim_sigprocmask(const long a[6]) {
  * simulator can watch for its death. vfork degrades to fork semantics
  * (the child gets its own COW image — safe for the exec-or-exit
  * pattern and for everything else). */
+static void shim_patch_env(const char *name, const char *value);
+
 static long shim_handle_fork(const long args[6]) {
   ShimMsg *in = shim_roundtrip(SYS_fork, args);
   if (in->kind == IPC_SYSCALL_DONE)
@@ -555,6 +657,13 @@ static long shim_handle_fork(const long args[6]) {
      * MAP_SHARED arena mapping survived the fork) */
     t_ch = childch;
     g_ch = childch;
+    /* rebind the env so a later execve reconnects to OUR channel,
+     * not the fork parent's (field zero-padded by the spawner) */
+    char offbuf[24];
+    unsigned long off = (unsigned long)((char *)childch - g_arena_base);
+    int olen = snprintf(offbuf, sizeof offbuf, "%lu", off);
+    if (olen > 0)
+      shim_patch_env("SHADOWTPU_IPC_OFFSET", offbuf);
     ShimMsg *out = (ShimMsg *)&childch->msg_to_simulator;
     out->kind = IPC_THREAD_START;
     out->number = 0;
@@ -572,6 +681,54 @@ static long shim_handle_fork(const long args[6]) {
   if (rep->kind == IPC_SYSCALL_DONE)
     return (long)rep->number;
   return -ENOSYS;
+}
+
+/* Overwrite the VALUE of environ entry `name=` in place (async-signal
+ * safe: pure byte stores into this process's own env strings). The
+ * spawner pads the value field so the new text always fits. */
+static void shim_patch_env(const char *name, const char *value) {
+  extern char **environ;
+  size_t nlen = strlen(name);
+  size_t vlen = strlen(value);
+  for (char **e = environ; e && *e; e++) {
+    if (strncmp(*e, name, nlen) == 0 && (*e)[nlen] == '=') {
+      char *dst = *e + nlen + 1;
+      size_t room = strlen(dst);
+      if (vlen <= room) {
+        /* right-align into the zero-padded field */
+        memset(dst, '0', room - vlen);
+        memcpy(dst + (room - vlen), value, vlen);
+      }
+      return;
+    }
+  }
+}
+
+/* execve: ask the simulator (it validates the target and tears down
+ * sibling threads on success), flip SHADOWTPU_EXEC so the NEW image's
+ * constructor announces itself, then run the real execve through the
+ * trampoline. The stacked old seccomp filter keeps trapping — its
+ * escape range is the FIXED trampoline page the new shim also uses.
+ * On failure the flag flips back and the old image continues. */
+static long shim_handle_execve(const long args[6]) {
+  ShimMsg *in = shim_roundtrip(SYS_execve, args);
+  if (in->kind == IPC_SYSCALL_DONE)
+    return (long)in->number;        /* refused (bad path / bad envp) */
+  if (in->kind != IPC_SYSCALL_NATIVE)
+    return -ENOSYS;
+  /* the simulator already flipped SHADOWTPU_EXEC to '1' in the
+   * envp the app is passing (plugin-memory write, so it works even
+   * for deep-copied env arrays). PR_SET_TSC survives execve but the
+   * SIGSEGV handler does not: disarm it or the new image's early
+   * rdtsc (glibc init) faults fatally; the new constructor re-arms. */
+  prctl(PR_SET_TSC, PR_TSC_ENABLE, 0, 0, 0);
+  long r = shim_rawsyscall(SYS_execve, args[0], args[1], args[2], 0, 0,
+                           0);
+  if (g_trace_traps)
+    shim_logf("execve failed r=%ld", r);
+  prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
+  shim_patch_env("SHADOWTPU_EXEC", "0");  /* exec failed: still here */
+  return r;
 }
 
 static long shim_do_syscall(long nr, const long args[6]) {
@@ -598,12 +755,19 @@ static long shim_do_syscall(long nr, const long args[6]) {
       (void)shim_emulated_syscall(nr, args);
     return r;
   }
+  if (nr == SYS_execve)
+    return shim_handle_execve(args);
   if (nr == SYS_wait4) {
     /* virtual wait; then reap any real zombie children so the
      * plugin's process table doesn't accumulate them */
     long r = shim_emulated_syscall(nr, args);
-    while (shim_rawsyscall(SYS_wait4, -1, 0, 1 /* WNOHANG */, 0, 0,
-                           0) > 0) {
+    int nst = 0;
+    long rp;
+    while ((rp = shim_rawsyscall(SYS_wait4, -1, (long)&nst,
+                                 1 /* WNOHANG */, 0, 0, 0)) > 0) {
+      if (g_trace_traps)
+        shim_logf("reaped native pid=%ld status=0x%x", rp,
+                  (unsigned)nst);
     }
     return r;
   }
@@ -649,10 +813,10 @@ static void sigsys_handler(int sig, siginfo_t *info, void *vctx) {
   g_in_handler = 1;
   t_trap_ctx = ctx;
   long nr = (long)g[REG_RAX];
-  if (g_trace_traps)
-    shim_logf("trap nr=%ld", nr);
   long args[6] = {(long)g[REG_RDI], (long)g[REG_RSI], (long)g[REG_RDX],
                   (long)g[REG_R10], (long)g[REG_R8],  (long)g[REG_R9]};
+  if (g_trace_traps)
+    shim_logf("trap nr=%ld a0=%ld a1=%ld", nr, args[0], args[1]);
   long saved_errno = errno;
   g[REG_RAX] = shim_do_syscall(nr, args);
   errno = saved_errno;
@@ -674,19 +838,32 @@ static const int kTrapSyscalls[] = {
     SYS_epoll_create, SYS_epoll_create1, SYS_epoll_ctl,
     SYS_epoll_wait,   SYS_epoll_pwait,  SYS_poll,
     SYS_ppoll,        SYS_select,       SYS_pselect6,
-    SYS_clock_gettime, SYS_gettimeofday, SYS_time,
+    /* NOT trapped: clock_gettime/gettimeofday/time/getpid/getrandom.
+     * glibc init calls them BEFORE a post-execve image can install
+     * its SIGSYS handler (a stale stacked filter would force-kill the
+     * new image), and libc time reads go through the vDSO — no
+     * syscall — so the filter never reliably caught them anyway. The
+     * shim's SYMBOL overrides are the real interposition for these
+     * (explicit IPC funnel); raw-syscall users of exactly these five
+     * bypass virtualization (documented). */
     SYS_nanosleep,    SYS_clock_nanosleep,
     SYS_alarm,        SYS_setitimer,    SYS_getitimer,
     SYS_timerfd_create, SYS_timerfd_settime, SYS_timerfd_gettime,
     SYS_eventfd,      SYS_eventfd2,     SYS_pipe,
-    SYS_pipe2,        SYS_getrandom,    SYS_uname,
-    SYS_getpid,       SYS_getppid,      SYS_exit,
+    SYS_pipe2,        SYS_uname,
+    SYS_getppid,      SYS_exit,
     SYS_exit_group,   SYS_clone,        SYS_fork,
     SYS_vfork,        SYS_futex,        SYS_sysinfo,
-    SYS_gettid,       SYS_set_tid_address, SYS_tgkill,
+    /* NOT trapped: set_tid_address — glibc calls it during startup,
+     * BEFORE a post-execve image has installed its SIGSYS handler
+     * (the stale filter would kill the new image). Thread CLEARTID
+     * words are captured from clone flags instead; the ptrace
+     * backend still sees it (every syscall stops there). */
+    SYS_gettid,       SYS_tgkill,
     SYS_rt_sigprocmask, SYS_wait4,      SYS_kill,
     SYS_rt_sigaction, SYS_pause,       SYS_rt_sigpending,
     SYS_rt_sigtimedwait, SYS_rt_sigsuspend, SYS_tkill,
+    SYS_execve,
 #ifdef SYS_clone3
     SYS_clone3,       /* refused with ENOSYS: glibc falls back to clone */
 #endif
@@ -711,8 +888,8 @@ typedef struct {
 static int shim_install_seccomp(void) {
   Ins prog[MAX_INS];
   int n = 0;
-  uint64_t lo = (uint64_t)(uintptr_t)shim_syscall_insn_start;
-  uint64_t hi = (uint64_t)(uintptr_t)shim_syscall_insn_end;
+  uint64_t lo = (uint64_t)g_escape_lo;
+  uint64_t hi = (uint64_t)g_escape_hi;
   if ((lo >> 32) != (hi >> 32))
     return -1; /* 4 GiB-straddling mapping: cannot express the range */
 
@@ -864,6 +1041,22 @@ int usleep(useconds_t usec) {
 unsigned int sleep(unsigned int seconds) {
   struct timespec req = {seconds, 0};
   return nanosleep(&req, NULL) == 0 ? 0 : seconds;
+}
+
+pid_t getpid(void) {
+  /* virtual pid via the explicit funnel (the raw syscall is allowed
+   * natively for the post-execve startup window; see kTrapSyscalls) */
+  return (pid_t)shim_time_syscall(SYS_getpid, 0, 0, 0, 0);
+}
+
+ssize_t getrandom(void *buf, size_t buflen, unsigned int flags) {
+  long r = shim_time_syscall(SYS_getrandom, (long)buf, (long)buflen,
+                             (long)flags, 0);
+  if (r < 0) {
+    errno = (int)-r;
+    return -1;
+  }
+  return (ssize_t)r;
 }
 
 /* ---- name resolution (preload_libraries.c:30-120 analogue) --------- */
@@ -1454,6 +1647,13 @@ __attribute__((constructor)) static void shim_init(void) {
   const char *off_s = getenv("SHADOWTPU_IPC_OFFSET");
   if (!shm || !off_s)
     return; /* not spawned by the simulator: stay dormant */
+  if (getenv("SHADOWTPU_CTOR_TRACE"))
+    shim_log_fail("ctor: enter\n");
+  shim_setup_trampoline();
+  if (getenv("SHADOWTPU_CTOR_TRACE"))
+    shim_log_fail(g_escape_lo == (uintptr_t)SHIM_TRAMP_ADDR
+                      ? "ctor: tramp fixed\n"
+                      : "ctor: tramp FALLBACK\n");
 
   char path[256];
   if (shm[0] == '/')
@@ -1487,15 +1687,16 @@ __attribute__((constructor)) static void shim_init(void) {
   g_arena_base = (char *)base;
   g_ch = (ShimChannel *)(g_arena_base + strtoull(off_s, NULL, 10));
 
-  struct sigaction sa;
-  memset(&sa, 0, sizeof(sa));
-  sa.sa_sigaction = sigsys_handler;
-  sa.sa_flags = SA_SIGINFO | SA_NODEFER;
-  sigemptyset(&sa.sa_mask);
-  if (sigaction(SIGSYS, &sa, NULL) != 0) {
+  /* RAW rt_sigaction via the trampoline: in a post-execve image the
+   * OLD image's stacked seccomp filter is already live and traps
+   * glibc's sigaction — before this very handler exists to field it */
+  if (shim_raw_sigaction(SIGSYS, (void *)sigsys_handler,
+                         SA_SIGINFO | SA_NODEFER) != 0) {
     shim_log_fail("shadowtpu-shim: sigaction(SIGSYS) failed\n");
     return;
   }
+  if (getenv("SHADOWTPU_CTOR_TRACE"))
+    shim_log_fail("ctor: sigsys installed\n");
 
   const char *hn = getenv("SHADOWTPU_HOSTNAME");
   if (hn)
@@ -1514,13 +1715,8 @@ __attribute__((constructor)) static void shim_init(void) {
    * loader) ran natively; every app-visible read from here on is
    * simulated. */
   g_real_sigaction = SHIM_REAL(sigaction);
-  struct sigaction segv;
-  memset(&segv, 0, sizeof segv);
-  segv.sa_sigaction = sigsegv_handler;
-  segv.sa_flags = SA_SIGINFO;
-  sigemptyset(&segv.sa_mask);
-  if (g_real_sigaction &&
-      g_real_sigaction(SIGSEGV, &segv, NULL) == 0)
+  if (shim_raw_sigaction(SIGSEGV, (void *)sigsegv_handler,
+                         SA_SIGINFO) == 0)
     prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
 
   g_enabled = 1;
@@ -1528,5 +1724,21 @@ __attribute__((constructor)) static void shim_init(void) {
     g_enabled = 0;
     shim_log_fail("shadowtpu-shim: seccomp install failed\n");
     return;
+  }
+
+  /* post-execve image: announce on the (inherited) channel so the
+   * simulator finishes the exec bookkeeping before app code runs */
+  const char *execed = getenv("SHADOWTPU_EXEC");
+  if (getenv("SHADOWTPU_CTOR_TRACE"))
+    shim_log_fail("ctor: seccomp on\n");
+  if (execed && strchr(execed, '1') != NULL) {
+    if (getenv("SHADOWTPU_CTOR_TRACE"))
+      shim_log_fail("ctor: announcing exec\n");
+    shim_patch_env("SHADOWTPU_EXEC", "0");
+    ShimMsg *out = (ShimMsg *)&g_ch->msg_to_simulator;
+    out->kind = IPC_EXEC_DONE;
+    out->number = 0;
+    sem_post(&g_ch->to_simulator.value);
+    shim_wait_reply(g_ch);          /* simulator: teardown + resume */
   }
 }

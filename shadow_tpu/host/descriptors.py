@@ -415,6 +415,10 @@ class DescriptorTable:
         self.manager = manager
         self._slots: dict[int, Descriptor] = {}
         self._next = 0
+        # close-on-exec is a PER-FD flag (kernel fd table), not a
+        # property of the open file description: dup'd fds never
+        # inherit it, fork'd tables copy it, execve closes these
+        self.cloexec: set[int] = set()
 
     def alloc(self, desc: Descriptor, min_fd: int = 0) -> int:
         idx = max(self._next, min_fd)
@@ -456,6 +460,7 @@ class DescriptorTable:
 
     def close_fd(self, ctx, fd: int) -> bool:
         d = self._slots.pop(fd, None)
+        self.cloexec.discard(fd)
         if d is None:
             return False
         d.refs -= 1
@@ -474,6 +479,7 @@ class DescriptorTable:
         t = DescriptorTable(self.manager)
         t._slots = dict(self._slots)
         t._next = self._next
+        t.cloexec = set(self.cloexec)   # fd flags copy across fork
         for d in t._slots.values():
             d.refs += 1
         return t

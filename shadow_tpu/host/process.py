@@ -84,6 +84,12 @@ class ManagedRuntime:
 
     def __init__(self, dns, data_dir: str, seed: int,
                  spin_max: int = 8096):
+        # virtual pids restart per simulation: they are app-visible
+        # (getpid/fork), so a process-wide monotonic counter would
+        # make back-to-back runs diverge (determinism gate). Instance
+        # state, so concurrent Controllers in one interpreter don't
+        # rewind each other.
+        self._next_vpid = 1000
         self.dns = dns
         self.data_dir = data_dir
         self.spin_max = spin_max
@@ -113,6 +119,11 @@ class ManagedRuntime:
             self._arena = native.ShmArena(name, size=1 << 22,
                                           create=True)
         return self._arena
+
+    def next_vpid(self) -> int:
+        v = self._next_vpid
+        self._next_vpid += 1
+        return v
 
     def resolve_ip(self, ip_int: int) -> Optional[int]:
         addr = self.dns.resolve_ip(ip_int)
@@ -157,7 +168,6 @@ class ManagedProcess:
     """One real executable on one simulated host (app-interface
     compatible with the model runtime: boot / on_stop hooks)."""
 
-    _next_vpid = [1000]
     supports_threads = True        # preload backend handles clone
     supports_fork = True           # IPC fork handshake (spawn_fork)
     supports_signals = True        # IPC_SIGNAL handler injection
@@ -175,8 +185,7 @@ class ManagedProcess:
         else:
             self.args = [str(args)]        # YAML scalar (e.g. a port)
         self.environment = environment
-        self.vpid = ManagedProcess._next_vpid[0]
-        ManagedProcess._next_vpid[0] += 1
+        self.vpid = runtime.next_vpid()
 
         self.host = None
         self.manager = None
@@ -265,7 +274,8 @@ class ManagedProcess:
         env = self._child_env(host_dir)
         # forward the shim debug knobs from the simulator's environment
         # (the quick debugging path; config `environment` entries win)
-        for k in ("SHADOWTPU_SHIM_LOG", "SHADOWTPU_TRACE_TRAPS"):
+        for k in ("SHADOWTPU_SHIM_LOG", "SHADOWTPU_TRACE_TRAPS",
+                  "SHADOWTPU_CTOR_TRACE"):
             if k in os.environ and k not in env:
                 env[k] = os.environ[k]
         # publish sim time into the channel only when the shim will
@@ -276,7 +286,11 @@ class ManagedProcess:
             "SHADOWTPU_SHIM_LOG" in env
             or "SHADOWTPU_TRACE_TRAPS" in env)
         env["SHADOWTPU_SHM"] = self.runtime.arena.name
-        env["SHADOWTPU_IPC_OFFSET"] = str(self.channel.offset)
+        # zero-padded: forked children re-point this at THEIR channel
+        # by overwriting digits in place (async-signal-safe), so an
+        # execve from any process reconnects to the right channel
+        env["SHADOWTPU_IPC_OFFSET"] = f"{self.channel.offset:010d}"
+        env["SHADOWTPU_EXEC"] = "0"     # flipped to 1 across an execve
         env["LD_PRELOAD"] = self.runtime.shim_path
         # name resolution for the shim's getaddrinfo/gethostname
         # overrides (preload_libraries.c analogue): the simulated
@@ -424,8 +438,7 @@ class ManagedProcess:
         """Approve a clone: allocate the child's IPC channel + vtid and
         schedule its first run. The shim performs the native clone and
         the child announces itself on the new channel."""
-        vtid = ManagedProcess._next_vpid[0]
-        ManagedProcess._next_vpid[0] += 1
+        vtid = self.runtime.next_vpid()
         ch = native.IpcChannel(self.runtime.arena,
                                spin_max=self.runtime.spin_max)
         th = ManagedThread(self, vtid, ch)
@@ -469,8 +482,7 @@ class ManagedProcess:
         """Approve a fork: allocate the child's vpid + IPC channel.
         The shim does the real COW fork and reports the native pid via
         IPC_FORK_RESULT (handled in _continue -> _complete_fork)."""
-        vpid = ManagedProcess._next_vpid[0]
-        ManagedProcess._next_vpid[0] += 1
+        vpid = self.runtime.next_vpid()
         ch = native.IpcChannel(self.runtime.arena,
                                spin_max=self.runtime.spin_max)
         self._pending_fork = (vpid, ch)
@@ -526,6 +538,15 @@ class ManagedProcess:
         def reap():
             import select as _select
             _select.select([pidfd], [], [])
+            try:
+                info = os.waitid(os.P_PIDFD, pidfd,
+                                 os.WEXITED | os.WNOWAIT)
+                if info is not None:
+                    log.debug("forked child vpid=%d death: code=%d "
+                              "status=%d", child.vpid, info.si_code,
+                              info.si_status)
+            except OSError:
+                pass
             os.close(pidfd)
             for t in list(child.threads.values()):
                 t.channel.mark_plugin_exited()
@@ -636,6 +657,40 @@ class ManagedProcess:
     def _has_deliverable(self, th: "ManagedThread") -> bool:
         return any(not th.sigmask & (1 << (s - 1))
                    for s in th.pending + self.pending_signals)
+
+    def _complete_exec(self, ctx, th: "ManagedThread") -> None:
+        """The post-execve image announced itself (IPC_EXEC_DONE):
+        finish the kernel's exec semantics — sibling threads are gone,
+        close-on-exec descriptors close, caught signal dispositions
+        reset to default (ignored ones stay ignored, masks and pending
+        signals survive) — then release the new image into app code.
+        Ref: the exec handling of process.c + kernel exec.c rules."""
+        new_path = getattr(self, "exec_pending", None)
+        if new_path is None:
+            log.warning("vpid=%d: unexpected IPC_EXEC_DONE", self.vpid)
+        else:
+            log.debug("vpid=%d: execve -> %s", self.vpid, new_path)
+            self.exec_path = new_path
+        self.exec_pending = None
+        for t in list(self.threads.values()):
+            if t is not th:
+                t.alive = False     # the kernel killed them on exec
+                # their stacks/futexes lived in the REPLACED address
+                # space — no CLEARTID writes; just unblock any
+                # simulator-side wait on their channels
+                t.channel.mark_plugin_exited()
+        self.threads = {th.vtid: th}
+        self.current = th
+        th.parked = None
+        th.syscall_state = {}
+        th.sigwait = None
+        th.restore_mask = None
+        for fd in sorted(self.table.cloexec):
+            self.table.close_fd(ctx, fd)
+        self.sigactions = {
+            sig: act for sig, act in self.sigactions.items()
+            if act[0] == self.SIG_IGN}
+        self._reply_to(th, 0)
 
     def _complete_sigwait(self, ctx, th: "ManagedThread",
                           sig: int) -> None:
@@ -857,9 +912,16 @@ class ManagedProcess:
             if msg.kind == native.IPC_FORK_RESULT:
                 self._complete_fork(ctx, th, int(msg.number))
                 continue
+            if msg.kind == native.IPC_EXEC_DONE:
+                self._complete_exec(ctx, th)
+                continue
             if msg.kind != native.IPC_SYSCALL:
                 log.warning("unexpected ipc kind %d", msg.kind)
                 continue
+            if getattr(self, "exec_pending", None) is not None:
+                # a normal syscall after an approved execve means the
+                # native exec failed — the old image lives on
+                self.exec_pending = None
             nr = int(msg.number)
             args = tuple(int(msg.args[i]) for i in range(6))
             name = NR_NAME.get(nr, str(nr))

@@ -81,7 +81,7 @@ NR = dict(
     set_tid_address=218, sendfile=40, tgkill=234, clone3=435,
     wait4=61, kill=62, rt_sigaction=13, pause=34,
     rt_sigprocmask=14, rt_sigpending=127, rt_sigtimedwait=128,
-    rt_sigsuspend=130, tkill=200,
+    rt_sigsuspend=130, tkill=200, execve=59,
 )
 NR_NAME = {v: k for k, v in NR.items()}
 
@@ -618,6 +618,74 @@ class SyscallHandler:
         th.sigwait = (wset, info_ptr)
         raise Blocked(deadline=st["deadline"])
 
+    def sys_execve(self, ctx, a):
+        """Replace the process image (process.c exec handling): the
+        shim runs the real execve through the fixed-address trampoline
+        (stacked seccomp filters all allow it), the new image's shim
+        reconnects over the same IPC channel, and its constructor
+        announces IPC_EXEC_DONE so bookkeeping (sibling threads,
+        close-on-exec descriptors, signal dispositions) completes
+        before any app code runs. The caller must pass an environment
+        containing the SHADOWTPU_* variables (i.e. its own environ) —
+        a clean envp would produce an unmanaged image, so it is
+        refused."""
+        if not getattr(self.p, "supports_fork", False):
+            return -ENOSYS          # ptrace backend: not yet wired
+        if self.p.current is not self.p.threads.get(self.p.vpid):
+            # exec from a secondary thread would announce on the main
+            # channel while the simulator listens on the caller's —
+            # refuse rather than stall-kill (documented limitation)
+            log.warning("execve from a non-main thread is not "
+                        "supported under the preload backend")
+            return -ENOSYS
+        path_ptr, envp_ptr = a[0], a[2]
+        if not path_ptr:
+            return -EFAULT
+        try:
+            path = self.mem.read_cstr(path_ptr).decode(
+                errors="replace")
+        except OSError:
+            return -EFAULT
+        if not os.path.isabs(path):
+            try:
+                cwd = os.readlink(f"/proc/{self.p.native_pid}/cwd")
+                path = os.path.join(cwd, path)
+            except OSError:
+                return -ENOENT
+        if not os.path.exists(path):
+            return -ENOENT
+        if not os.access(path, os.X_OK):
+            return -13              # EACCES
+        exec_str = None
+        has_shm = False
+        if envp_ptr:
+            for i in range(512):
+                p = struct.unpack(
+                    "<Q", self.mem.read(envp_ptr + 8 * i, 8))[0]
+                if p == 0:
+                    break
+                try:
+                    s = self.mem.read_cstr(p).decode(errors="replace")
+                except OSError:
+                    break
+                if s.startswith("SHADOWTPU_SHM="):
+                    has_shm = True
+                elif s.startswith("SHADOWTPU_EXEC="):
+                    exec_str = (p, s)
+        if not has_shm or exec_str is None:
+            log.warning(
+                "execve(%s): envp lacks the SHADOWTPU_* variables "
+                "(pass your environ) — refusing", path)
+            return -EPERM
+        # flip SHADOWTPU_EXEC to 1 IN THE ENV THE APP IS PASSING so
+        # the new image's constructor knows to announce itself (works
+        # for deep-copied env arrays too; the shim flips its own
+        # environ back if the exec fails)
+        p, s = exec_str
+        self.mem.write(p + len(s) - 1, b"1")
+        self.p.exec_pending = path
+        return NATIVE
+
     def write_siginfo(self, ptr: int, sig: int) -> None:
         """Minimal siginfo_t: si_signo / si_errno / si_code(SI_USER),
         rest zero (kernel_types.h layout; 128 bytes)."""
@@ -641,7 +709,10 @@ class SyscallHandler:
         else:
             return -EPROTONOSUPPORT
         desc.nonblock = bool(stype & SOCK_NONBLOCK)
-        return self.table.alloc(desc)
+        fd = self.table.alloc(desc)
+        if stype & SOCK_CLOEXEC:
+            self.table.cloexec.add(fd)
+        return fd
 
     def sys_bind(self, ctx, a):
         fd, addr_ptr, addrlen = _s32(a[0]), a[1], int(a[2])
@@ -705,6 +776,8 @@ class SyscallHandler:
         child = desc.accept_queue.popleft()
         child.nonblock = bool(flags & SOCK_NONBLOCK)
         cfd = self.table.alloc(child)
+        if flags & SOCK_CLOEXEC:
+            self.table.cloexec.add(cfd)
         peer_host, peer_port = child.sock.peer
         self._write_sockaddr(a[1], a[2], self._host_ip_be(peer_host),
                              peer_port)
@@ -1091,8 +1164,17 @@ class SyscallHandler:
             return self._no_desc(fd)
         if cmd in (F_DUPFD, F_DUPFD_CLOEXEC):
             min_fd = arg - VFD_BASE if arg >= VFD_BASE else 0
-            return self.table.dup(fd, min_fd)
-        if cmd == F_GETFD or cmd == F_SETFD:
+            nfd = self.table.dup(fd, min_fd)
+            if cmd == F_DUPFD_CLOEXEC and nfd >= 0:
+                self.table.cloexec.add(nfd)
+            return nfd
+        if cmd == F_GETFD:
+            return 1 if fd in self.table.cloexec else 0
+        if cmd == F_SETFD:
+            if arg & 1:                     # FD_CLOEXEC
+                self.table.cloexec.add(fd)
+            else:
+                self.table.cloexec.discard(fd)
             return 0
         if cmd == F_GETFL:
             return O_RDWR | (O_NONBLOCK if desc.nonblock else 0)
@@ -1160,6 +1242,8 @@ class SyscallHandler:
         r.nonblock = w.nonblock = bool(flags & O_NONBLOCK)
         rfd = self.table.alloc(r)
         wfd = self.table.alloc(w)
+        if flags & 0x80000:             # O_CLOEXEC
+            self.table.cloexec.update((rfd, wfd))
         self.mem.write(fds_ptr, struct.pack("<ii", rfd, wfd))
         return 0
 
@@ -1203,7 +1287,10 @@ class SyscallHandler:
     def _eventfd(self, initval: int, flags: int):
         d = EventfdDesc(initval, bool(flags & EFD_SEMAPHORE))
         d.nonblock = bool(flags & EFD_NONBLOCK)
-        return self.table.alloc(d)
+        fd = self.table.alloc(d)
+        if flags & 0x80000:             # EFD_CLOEXEC
+            self.table.cloexec.add(fd)
+        return fd
 
     def _eventfd_read(self, ctx, d: EventfdDesc, buf: int, n: int):
         if n < 8:
@@ -1228,8 +1315,12 @@ class SyscallHandler:
 
     def sys_timerfd_create(self, ctx, a):
         d = TimerfdDesc()
-        d.nonblock = bool(_s32(a[1]) & 0x800)
-        return self.table.alloc(d)
+        flags = _s32(a[1])
+        d.nonblock = bool(flags & 0x800)
+        fd = self.table.alloc(d)
+        if flags & 0x80000:             # TFD_CLOEXEC
+            self.table.cloexec.add(fd)
+        return fd
 
     def sys_timerfd_settime(self, ctx, a):
         fd, flags = _s32(a[0]), _s32(a[1])
@@ -1284,7 +1375,10 @@ class SyscallHandler:
         return self.table.alloc(EpollDesc(self.table))
 
     def sys_epoll_create1(self, ctx, a):
-        return self.table.alloc(EpollDesc(self.table))
+        fd = self.table.alloc(EpollDesc(self.table))
+        if _s32(a[0]) & 0x80000:        # EPOLL_CLOEXEC
+            self.table.cloexec.add(fd)
+        return fd
 
     def sys_epoll_ctl(self, ctx, a):
         epfd, op, fd = _s32(a[0]), _s32(a[1]), _s32(a[2])

@@ -38,6 +38,7 @@ IPC_SYSCALL_DONE = 3
 IPC_SYSCALL_NATIVE = 4
 IPC_STOP = 5
 IPC_CLONE_GO = 6       # sim->plugin: clone approved (vtid + chan offset)
+IPC_EXEC_DONE = 12     # plugin->sim: post-execve image live on channel
 IPC_THREAD_START = 7   # child thread announcing itself on its channel
 IPC_THREAD_FAIL = 8    # native clone failed after approval
 IPC_FORK_RESULT = 9    # parent->sim: real child pid (or -errno)

@@ -1,0 +1,68 @@
+/* execve under interposition: a managed process execs a second
+ * program (the fork-exec pattern real launchers use) and the new
+ * image stays managed — same virtual pid, continuous simulated time,
+ * exit status visible to wait4. Also: exec of a missing path fails
+ * with ENOENT and the OLD image continues, and close-on-exec virtual
+ * descriptors don't survive into the new image. */
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+extern char **environ;
+
+static long now_ms(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+int main(int argc, char **argv) {
+  setvbuf(stdout, NULL, _IONBF, 0);
+  if (argc < 2) {
+    printf("usage: exec_check <target>\n");
+    return 1;
+  }
+
+  /* 1: exec of a missing path fails, old image continues */
+  char *bad[] = {"nope", NULL};
+  int r = execve("/does/not/exist", bad, environ);
+  printf("badexec %d errno_ok %d\n", r == -1, errno == ENOENT);
+
+  /* 2: fork + exec; child keeps its vpid across the exec and its
+   * simulated clock keeps running; parent reaps exit code 33. The
+   * child takes two virtual sockets into the exec: one marked
+   * FD_CLOEXEC (must be closed in the new image) and one not (must
+   * survive) — the target probes both by fd number. */
+  int keep = socket(AF_INET, SOCK_DGRAM, 0);
+  int gone = socket(AF_INET, SOCK_DGRAM, 0);
+  fcntl(gone, F_SETFD, FD_CLOEXEC);
+  long t0 = now_ms();
+  pid_t child = fork();
+  if (child == 0) {
+    printf("child pre-exec pid %d t_ms %ld\n", (int)getpid(),
+           now_ms() - t0);
+    usleep(40 * 1000);                   /* 40 ms before the exec */
+    char fd_keep[16], fd_gone[16];
+    snprintf(fd_keep, sizeof fd_keep, "%d", keep);
+    snprintf(fd_gone, sizeof fd_gone, "%d", gone);
+    char *args[] = {"exec_target", "hello", fd_keep, fd_gone, NULL};
+    execve(argv[1], args, environ);
+    printf("exec failed errno %d\n", errno);
+    _exit(9);
+  }
+  int st = 0;
+  pid_t w = waitpid(child, &st, 0);
+  long dt = now_ms() - t0;
+  printf("reap ok %d exited %d code %d t_ms %ld\n",
+         w == child, WIFEXITED(st), WEXITSTATUS(st), dt);
+  close(keep);
+  close(gone);
+  printf("done\n");
+  return 0;
+}

@@ -1,0 +1,30 @@
+/* The post-execve image: proves the new program is still managed —
+ * simulated time continues from the exec instant, the virtual pid is
+ * unchanged, argv made it across, and the exit code reaches wait4. */
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  setvbuf(stdout, NULL, _IONBF, 0);
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);   /* trapped: sim time */
+  long ms = ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+  printf("target pid %d argc %d arg1 %s t_ms %ld\n", (int)getpid(),
+         argc, argc > 1 ? argv[1] : "-", ms);
+  if (argc > 3) {
+    /* inherited virtual fds: argv[2] survives, argv[3] was cloexec */
+    int keep = atoi(argv[2]), gone = atoi(argv[3]);
+    int keep_ok = fcntl(keep, F_GETFL) >= 0;
+    int gone_ok = fcntl(gone, F_GETFL) < 0 && errno == EBADF;
+    printf("cloexec keep %d gone %d\n", keep_ok, gone_ok);
+  }
+  usleep(70 * 1000);                     /* 70 ms of simulated sleep */
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  printf("target done t_ms %ld\n",
+         ts.tv_sec * 1000 + ts.tv_nsec / 1000000);
+  return 33;
+}

@@ -181,3 +181,77 @@ def test_sigmask_deterministic(bins, tmp_path):
         assert stats.ok
         outs.append(stdout_of(data, "alice", "sigmask_check"))
     assert outs[0] == outs[1]
+
+
+@pytest.fixture(scope="module")
+def exec_bins(tmp_path_factory):
+    out = tmp_path_factory.mktemp("exec_plugins")
+    built = {}
+    for name in ("exec_check", "exec_target"):
+        exe = out / name
+        subprocess.run(
+            ["cc", "-O1", "-o", str(exe),
+             os.path.join(PLUGIN_DIR, f"{name}.c")],
+            check=True, capture_output=True)
+        built[name] = str(exe)
+    return built
+
+
+def run_exec(bins, data: str):
+    cfg = load_config_str(f"""
+general:
+  stop_time: 30s
+  seed: 1
+  data_directory: {data}
+network:
+  graph:
+    type: gml
+    inline: |
+{_indent(GML, 6)}
+hosts:
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {bins['exec_check']}
+      args: {bins['exec_target']}
+      start_time: 1s
+""")
+    return Controller(cfg).run()
+
+
+def test_execve_managed(exec_bins, tmp_path):
+    """A managed process fork+execs another program and the NEW image
+    stays managed: same virtual pid, continuous simulated time, exit
+    status through wait4; a failed exec leaves the old image running
+    (ref process.c exec handling + kernel exec semantics)."""
+    data = str(tmp_path / "shadow.data")
+    stats = run_exec(exec_bins, data)
+    assert stats.ok
+    out = stdout_of(data, "alice", "exec_check").splitlines()
+    assert out[0] == "badexec 1 errno_ok 1"
+    pre = next(l for l in out if l.startswith("child pre-exec"))
+    tgt = next(l for l in out if l.startswith("target pid"))
+    pre_pid = int(pre.split()[3])
+    tgt_pid = int(tgt.split()[2])
+    assert pre_pid == tgt_pid          # vpid survives the exec
+    assert tgt.split()[6] == "hello"   # argv crossed
+    t_start = int(tgt.split()[-1])
+    done = next(l for l in out if l.startswith("target done"))
+    assert int(done.split()[-1]) == t_start + 70   # sim clock continues
+    # FD_CLOEXEC virtual fd closed by the exec; plain fd survives
+    clo = next(l for l in out if l.startswith("cloexec"))
+    assert clo == "cloexec keep 1 gone 1"
+    reap = next(l for l in out if l.startswith("reap"))
+    # exit code 33 reaped at fork+40ms(pre-exec sleep)+70ms(target)
+    assert reap == "reap ok 1 exited 1 code 33 t_ms 110"
+    assert out[-1] == "done"
+
+
+def test_execve_deterministic(exec_bins, tmp_path):
+    outs = []
+    for run in range(2):
+        data = str(tmp_path / f"r{run}" / "shadow.data")
+        stats = run_exec(exec_bins, data)
+        assert stats.ok
+        outs.append(stdout_of(data, "alice", "exec_check"))
+    assert outs[0] == outs[1]
