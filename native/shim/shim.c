@@ -314,11 +314,12 @@ static void shim_setup_trampoline(void) {
   }
   /* fallback: stay in the .so image (execve into a differently-laid-
    * out image is then unsupported); patch the slot in place */
+  /* the slot is 8-aligned so it cannot straddle a page: one page */
   uintptr_t sbase = ((uintptr_t)shim_child_slot) & ~(uintptr_t)4095;
-  if (mprotect((void *)sbase, 8192,
+  if (mprotect((void *)sbase, 4096,
                PROT_READ | PROT_WRITE | PROT_EXEC) == 0) {
     *(void **)shim_child_slot = (void *)shim_child_start;
-    mprotect((void *)sbase, 8192, PROT_READ | PROT_EXEC);
+    mprotect((void *)sbase, 4096, PROT_READ | PROT_EXEC);
   }
   g_sigreturn = (void *)shim_sigreturn_tmpl;
   g_escape_lo = (uintptr_t)shim_syscall_insn_start;

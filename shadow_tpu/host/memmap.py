@@ -1,0 +1,240 @@
+"""Plugin address-space tracking: the MemoryManager's map side.
+
+The reference's MemoryManager pairs a copier (process_vm_readv — ours
+lives in host/memory.py) with a mapping tracker fed by /proc/[pid]/maps
+and kept consistent through mmap/brk/munmap/mremap (memory_manager/
+mod.rs:1-17, proc_maps.rs, interval_map.rs). This module provides the
+tracker: an interval map over the plugin's VM, a /proc parser to
+(re)build it, and the update operations the syscall layer applies.
+
+Backend split: under ptrace every syscall stops, so the map is
+maintained LIVE from mmap/munmap/brk/mremap events. Under preload
+those syscalls run native (they must: the dynamic loader issues them
+before the shim can exist in a post-execve image), so the map is
+refreshed lazily from /proc — callers treat it as a consistent
+snapshot for bounds checks and observability, not a lock-step mirror.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_right, insort
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class Mapping:
+    start: int
+    end: int                     # exclusive
+    perms: str                   # e.g. "rw-p"
+    offset: int = 0
+    path: str = ""
+
+    @property
+    def readable(self) -> bool:
+        return self.perms[:1] == "r"
+
+    @property
+    def writable(self) -> bool:
+        return self.perms[1:2] == "w"
+
+    def __len__(self) -> int:
+        return self.end - self.start
+
+
+class IntervalMap:
+    """Non-overlapping intervals over the address space
+    (interval_map.rs analogue): insertion clips existing overlaps
+    (mmap MAP_FIXED semantics), removal punches holes (munmap can
+    split a mapping in two)."""
+
+    def __init__(self):
+        self._starts: list[int] = []
+        self._maps: dict[int, Mapping] = {}
+
+    def __len__(self) -> int:
+        return len(self._starts)
+
+    def __iter__(self):
+        for s in self._starts:
+            yield self._maps[s]
+
+    def _del(self, start: int) -> None:
+        self._starts.remove(start)
+        del self._maps[start]
+
+    def _put(self, m: Mapping) -> None:
+        insort(self._starts, m.start)
+        self._maps[m.start] = m
+
+    def clear(self) -> None:
+        self._starts.clear()
+        self._maps.clear()
+
+    def find(self, addr: int) -> Optional[Mapping]:
+        i = bisect_right(self._starts, addr) - 1
+        if i < 0:
+            return None
+        m = self._maps[self._starts[i]]
+        return m if addr < m.end else None
+
+    def overlapping(self, start: int, end: int) -> list[Mapping]:
+        out = []
+        i = max(0, bisect_right(self._starts, start) - 1)
+        for s in self._starts[i:]:
+            m = self._maps[s]
+            if m.start >= end:
+                break
+            if m.end > start:
+                out.append(m)
+        return out
+
+    def covered(self, start: int, end: int) -> bool:
+        """True iff [start, end) is fully inside tracked mappings
+        (they may be adjacent)."""
+        at = start
+        for m in self.overlapping(start, end):
+            if m.start > at:
+                return False
+            at = m.end
+            if at >= end:
+                return True
+        return at >= end
+
+    def add(self, m: Mapping) -> None:
+        """Insert, clipping anything it overlaps (MAP_FIXED)."""
+        self.remove(m.start, m.end)
+        self._put(m)
+
+    def remove(self, start: int, end: int) -> None:
+        """Punch [start, end) out of the map (munmap)."""
+        for m in self.overlapping(start, end):
+            self._del(m.start)
+            if m.start < start:
+                self._put(Mapping(m.start, start, m.perms, m.offset,
+                                  m.path))
+            if m.end > end:
+                self._put(Mapping(end, m.end, m.perms,
+                                  m.offset + (end - m.start), m.path))
+
+    def protect(self, start: int, end: int, perms: str) -> None:
+        """Change permissions on [start, end) (mprotect), splitting
+        mappings at the boundaries."""
+        for m in self.overlapping(start, end):
+            self._del(m.start)
+            if m.start < start:
+                self._put(Mapping(m.start, start, m.perms, m.offset,
+                                  m.path))
+            lo, hi = max(m.start, start), min(m.end, end)
+            self._put(Mapping(lo, hi, perms,
+                              m.offset + (lo - m.start), m.path))
+            if m.end > end:
+                self._put(Mapping(end, m.end, m.perms,
+                                  m.offset + (end - m.start), m.path))
+
+
+def parse_proc_maps(text: str) -> list[Mapping]:
+    """Parse /proc/[pid]/maps content (proc_maps.rs analogue)."""
+    out = []
+    for line in text.splitlines():
+        parts = line.split(maxsplit=5)
+        if len(parts) < 5:
+            continue
+        rng, perms, offset = parts[0], parts[1], parts[2]
+        path = parts[5] if len(parts) > 5 else ""
+        try:
+            lo, hi = (int(x, 16) for x in rng.split("-"))
+            off = int(offset, 16)
+        except ValueError:
+            continue
+        out.append(Mapping(lo, hi, perms, off, path))
+    return out
+
+
+class ProcessMaps:
+    """The per-process tracker: snapshot from /proc, live updates from
+    the syscall layer (ptrace backend), convenience queries."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.map = IntervalMap()
+        self.brk: int = 0            # program break (heap end)
+        self._brk_start: int = 0
+        # set when a kernel-chosen placement happened (non-FIXED mmap,
+        # mremap under the preload backend): queries refresh first
+        self.dirty: bool = True
+
+    def refresh(self) -> bool:
+        """Rebuild the snapshot from /proc/[pid]/maps."""
+        try:
+            with open(f"/proc/{self.pid}/maps") as f:
+                text = f.read()
+        except OSError:
+            return False
+        self.map.clear()
+        for m in parse_proc_maps(text):
+            self.map.add(m)
+            if m.path == "[heap]":
+                self._brk_start, self.brk = m.start, m.end
+        self.dirty = False
+        return True
+
+    # -- live updates from the syscall layer ---------------------------
+    PROT_READ, PROT_WRITE, PROT_EXEC = 1, 2, 4
+
+    def _perms(self, prot: int) -> str:
+        return (("r" if prot & self.PROT_READ else "-")
+                + ("w" if prot & self.PROT_WRITE else "-")
+                + ("x" if prot & self.PROT_EXEC else "-") + "p")
+
+    def on_mmap(self, addr: int, length: int, prot: int,
+                offset: int = 0, path: str = "") -> None:
+        end = addr + ((length + 4095) & ~4095)
+        self.map.add(Mapping(addr, end, self._perms(prot), offset,
+                             path))
+
+    def on_munmap(self, addr: int, length: int) -> None:
+        self.map.remove(addr, addr + ((length + 4095) & ~4095))
+
+    def on_mprotect(self, addr: int, length: int, prot: int) -> None:
+        self.map.protect(addr, addr + ((length + 4095) & ~4095),
+                         self._perms(prot))
+
+    def on_brk(self, new_brk: int) -> None:
+        if self._brk_start == 0:
+            self._brk_start = new_brk
+        new_brk = max(new_brk, self._brk_start)
+        if self.brk and new_brk < self.brk:
+            self.map.remove(new_brk, self.brk)     # heap shrank
+        if new_brk > self._brk_start:
+            self.map.add(Mapping(self._brk_start, new_brk, "rw-p",
+                                 0, "[heap]"))
+        self.brk = new_brk
+
+    # -- queries -------------------------------------------------------
+    def _fresh(self) -> None:
+        if self.dirty:
+            self.refresh()
+
+    def _check(self, addr: int, n: int, want) -> bool:
+        if n <= 0:
+            return True
+        self._fresh()
+        at, end = addr, addr + n
+        for m in self.map.overlapping(addr, end):
+            if m.start > at or not want(m):
+                return False
+            at = m.end
+            if at >= end:
+                return True
+        return False
+
+    def readable(self, addr: int, n: int) -> bool:
+        return self._check(addr, n, lambda m: m.readable)
+
+    def writable(self, addr: int, n: int) -> bool:
+        return self._check(addr, n, lambda m: m.writable)
+
+    def region_of(self, addr: int) -> Optional[Mapping]:
+        self._fresh()
+        return self.map.find(addr)

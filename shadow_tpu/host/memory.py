@@ -40,24 +40,63 @@ def _vm_op(fn, pid: int, local_buf, remote_addr: int, n: int) -> int:
 
 
 class ProcessMemory:
-    """Read/write a live child process's memory by address."""
+    """Read/write a live child process's memory by address.
+
+    Primary transport: process_vm_readv/writev (the reference's
+    MemoryCopier, memory_copier.rs). Fallback: /proc/[pid]/mem seeks —
+    some sandboxes restrict the vm syscalls (Yama, seccomp policies on
+    the SIMULATOR) while still exposing /proc; the first EPERM flips
+    this process over permanently."""
 
     def __init__(self, pid: int):
         self.pid = pid
+        self._use_proc = False
+        self._proc_r = None         # cached /proc/[pid]/mem handles
+        self._proc_w = None
+
+    def _proc_read(self, addr: int, n: int) -> bytes:
+        if self._proc_r is None:
+            self._proc_r = open(f"/proc/{self.pid}/mem", "rb",
+                                buffering=0)
+        self._proc_r.seek(addr)
+        return self._proc_r.read(n)
+
+    def _proc_write(self, addr: int, data: bytes) -> int:
+        if self._proc_w is None:
+            self._proc_w = open(f"/proc/{self.pid}/mem", "wb",
+                                buffering=0)
+        self._proc_w.seek(addr)
+        return self._proc_w.write(data)
 
     def read(self, addr: int, n: int) -> bytes:
         if n == 0:
             return b""
+        if self._use_proc:
+            return self._proc_read(addr, n)
         buf = ctypes.create_string_buffer(n)
-        got = _vm_op(_libc.process_vm_readv, self.pid, buf, addr, n)
+        try:
+            got = _vm_op(_libc.process_vm_readv, self.pid, buf, addr, n)
+        except OSError as e:
+            if e.errno == 1:            # EPERM: fall back to /proc
+                self._use_proc = True
+                return self._proc_read(addr, n)
+            raise
         return buf.raw[:got]
 
     def write(self, addr: int, data: bytes) -> int:
         if not data:
             return 0
+        if self._use_proc:
+            return self._proc_write(addr, data)
         buf = ctypes.create_string_buffer(data, len(data))
-        return _vm_op(_libc.process_vm_writev, self.pid, buf, addr,
-                      len(data))
+        try:
+            return _vm_op(_libc.process_vm_writev, self.pid, buf, addr,
+                          len(data))
+        except OSError as e:
+            if e.errno == 1:            # EPERM: fall back to /proc
+                self._use_proc = True
+                return self._proc_write(addr, data)
+            raise
 
     def read_cstr(self, addr: int, max_len: int = 4096) -> bytes:
         """Read a NUL-terminated string (page-sized probes)."""

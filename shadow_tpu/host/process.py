@@ -318,6 +318,8 @@ class ManagedProcess:
         stdout_f.close()
         stderr_f.close()
         self.mem = ProcessMemory(self.proc.pid)
+        from shadow_tpu.host.memmap import ProcessMaps
+        self.maps = ProcessMaps(self.proc.pid)
         self.alive = True
         main = ManagedThread(self, self.vpid, self.channel)
         self.threads = {self.vpid: main}
@@ -538,15 +540,9 @@ class ManagedProcess:
         def reap():
             import select as _select
             _select.select([pidfd], [], [])
-            try:
-                info = os.waitid(os.P_PIDFD, pidfd,
-                                 os.WEXITED | os.WNOWAIT)
-                if info is not None:
-                    log.debug("forked child vpid=%d death: code=%d "
-                              "status=%d", child.vpid, info.si_code,
-                              info.si_status)
-            except OSError:
-                pass
+            # (no waitid here: the KERNEL parent is the forking
+            # plugin, which reaps its own zombies via the shim's
+            # wait4 drain; the pidfd only signals death)
             os.close(pidfd)
             for t in list(child.threads.values()):
                 t.channel.mark_plugin_exited()

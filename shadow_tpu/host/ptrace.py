@@ -476,6 +476,9 @@ class PtraceProcess(ManagedProcess):
             raise RuntimeError(f"ptrace spawn failed: {rest}")
         pid = rest[0]
         self.mem = ProcessMemory(pid)
+        from shadow_tpu.host.memmap import ProcessMaps
+        self.maps = ProcessMaps(pid)
+        self.maps.refresh()
         self._native_pid = pid
         self.alive = True
         # single pseudo-thread: park/resume and per-syscall state flow

@@ -82,6 +82,7 @@ NR = dict(
     wait4=61, kill=62, rt_sigaction=13, pause=34,
     rt_sigprocmask=14, rt_sigpending=127, rt_sigtimedwait=128,
     rt_sigsuspend=130, tkill=200, execve=59,
+    mmap=9, mprotect=10, munmap=11, brk=12, mremap=25,
 )
 NR_NAME = {v: k for k, v in NR.items()}
 
@@ -659,7 +660,7 @@ class SyscallHandler:
         exec_str = None
         has_shm = False
         if envp_ptr:
-            for i in range(512):
+            for i in range(4096):           # bound, not a real cap
                 p = struct.unpack(
                     "<Q", self.mem.read(envp_ptr + 8 * i, 8))[0]
                 if p == 0:
@@ -684,6 +685,55 @@ class SyscallHandler:
         p, s = exec_str
         self.mem.write(p + len(s) - 1, b"1")
         self.p.exec_pending = path
+        return NATIVE
+
+    # -- address-space bookkeeping (MemoryManager map side) ------------
+    # Under ptrace every syscall stops here, so the plugin's mapping
+    # table (host/memmap.py) is maintained LIVE — the reference's
+    # memory_manager servicing of mmap/brk/munmap (mod.rs:1-17). The
+    # preload filter lets these run native (the dynamic loader issues
+    # them before a post-execve shim exists), and the tracker
+    # refreshes lazily from /proc instead.
+    def _maps(self):
+        return getattr(self.p, "maps", None)
+
+    def sys_mmap(self, ctx, a):
+        # the kernel chooses the address for non-FIXED maps and the
+        # tracer does not surface native return values, so mark the
+        # snapshot stale; queries refresh from /proc on demand
+        m = self._maps()
+        if m is not None:
+            MAP_FIXED = 0x10
+            if a[3] & MAP_FIXED:
+                m.on_mmap(int(a[0]), int(a[1]), int(a[2]), int(a[5]))
+            else:
+                m.dirty = True
+        return NATIVE
+
+    def sys_munmap(self, ctx, a):
+        m = self._maps()
+        if m is not None:
+            m.on_munmap(int(a[0]), int(a[1]))
+        return NATIVE
+
+    def sys_mprotect(self, ctx, a):
+        m = self._maps()
+        if m is not None:
+            m.on_mprotect(int(a[0]), int(a[1]), int(a[2]))
+        return NATIVE
+
+    def sys_brk(self, ctx, a):
+        m = self._maps()
+        if m is not None and a[0]:
+            m.on_brk(int(a[0]))
+        return NATIVE
+
+    def sys_mremap(self, ctx, a):
+        m = self._maps()
+        if m is not None:
+            # the old range may move to a kernel-chosen address
+            m.on_munmap(int(a[0]), int(a[1]))
+            m.dirty = True
         return NATIVE
 
     def write_siginfo(self, ptr: int, sig: int) -> None:
@@ -1214,7 +1264,10 @@ class SyscallHandler:
         return self._dup_to(ctx, _s32(a[0]), _s32(a[1]))
 
     def sys_dup3(self, ctx, a):
-        return self._dup_to(ctx, _s32(a[0]), _s32(a[1]))
+        r = self._dup_to(ctx, _s32(a[0]), _s32(a[1]))
+        if isinstance(r, int) and r >= 0 and _s32(a[2]) & 0x80000:
+            self.table.cloexec.add(r)       # O_CLOEXEC
+        return r
 
     def _dup_to(self, ctx, oldfd: int, newfd: int):
         if self._desc(oldfd) is None:

@@ -1,0 +1,93 @@
+"""Address-space tracking: the MemoryManager map side (ref
+memory_manager/mod.rs + proc_maps.rs + interval_map.rs)."""
+
+import os
+
+from shadow_tpu.host.memmap import (
+    IntervalMap,
+    Mapping,
+    ProcessMaps,
+    parse_proc_maps,
+)
+
+
+def test_interval_map_add_clips_overlaps():
+    m = IntervalMap()
+    m.add(Mapping(0x1000, 0x5000, "rw-p"))
+    m.add(Mapping(0x2000, 0x3000, "r--p"))     # MAP_FIXED in the middle
+    regions = list(m)
+    assert [(r.start, r.end, r.perms) for r in regions] == [
+        (0x1000, 0x2000, "rw-p"),
+        (0x2000, 0x3000, "r--p"),
+        (0x3000, 0x5000, "rw-p"),
+    ]
+    # file offsets advance through the split
+    assert regions[2].offset == 0x2000
+
+
+def test_interval_map_remove_splits():
+    m = IntervalMap()
+    m.add(Mapping(0x1000, 0x5000, "rw-p"))
+    m.remove(0x2000, 0x3000)                   # munmap a hole
+    assert [(r.start, r.end) for r in m] == [
+        (0x1000, 0x2000), (0x3000, 0x5000)]
+    assert m.find(0x2800) is None
+    assert m.find(0x1800).end == 0x2000
+    assert not m.covered(0x1800, 0x3800)
+    assert m.covered(0x3000, 0x5000)
+
+
+def test_interval_map_protect():
+    m = IntervalMap()
+    m.add(Mapping(0x1000, 0x4000, "rw-p"))
+    m.protect(0x2000, 0x3000, "r--p")
+    assert [(r.start, r.perms) for r in m] == [
+        (0x1000, "rw-p"), (0x2000, "r--p"), (0x3000, "rw-p")]
+
+
+def test_parse_proc_maps_own_process():
+    with open(f"/proc/{os.getpid()}/maps") as f:
+        regions = parse_proc_maps(f.read())
+    assert regions
+    stacks = [r for r in regions if r.path == "[stack]"]
+    assert stacks and stacks[0].readable
+    # every parsed row is well-formed
+    for r in regions:
+        assert r.end > r.start
+        assert len(r.perms) >= 4
+
+
+def test_process_maps_queries_self():
+    pm = ProcessMaps(os.getpid())
+    assert pm.refresh()
+    r = pm.region_of(id(object()))             # a live heap object
+    assert r is not None and r.readable
+    # a wild address far above any mapping is not readable
+    assert not pm.readable(1 << 46, 64)
+    data = b"shadow-tpu memmap test"
+    buf = bytearray(data)
+    import ctypes
+    addr = ctypes.addressof((ctypes.c_char * len(buf)).from_buffer(buf))
+    assert pm.readable(addr, len(buf))
+    assert pm.writable(addr, len(buf))
+
+
+def test_process_maps_live_updates():
+    pm = ProcessMaps(os.getpid())
+    pm.refresh()
+    # ptrace-backend style live updates
+    pm.on_mmap(0x7000_0000_0000, 0x2000, 3)    # rw
+    assert pm.map.find(0x7000_0000_1000).writable
+    pm.on_mprotect(0x7000_0000_0000, 0x1000, 1)
+    assert not pm.map.find(0x7000_0000_0800).writable
+    assert pm.map.find(0x7000_0000_1800).writable
+    pm.on_munmap(0x7000_0000_0000, 0x2000)
+    assert pm.map.find(0x7000_0000_0800) is None
+    # brk growth and shrink (fresh tracker: brk base comes from the
+    # first observed call, like a just-spawned plugin)
+    pb = ProcessMaps(os.getpid())
+    pb.on_brk(0x5555_0000_0000)
+    pb.on_brk(0x5555_0000_8000)
+    assert pb.map.find(0x5555_0000_4000).path == "[heap]"
+    pb.on_brk(0x5555_0000_2000)
+    assert pb.map.find(0x5555_0000_4000) is None
