@@ -171,9 +171,13 @@ class ProcessMaps:
                 text = f.read()
         except OSError:
             return False
+        # /proc rows are sorted and disjoint: assign directly (the
+        # add() path would pay an O(n^2) rebuild)
+        rows = parse_proc_maps(text)
         self.map.clear()
-        for m in parse_proc_maps(text):
-            self.map.add(m)
+        self.map._starts = [m.start for m in rows]
+        self.map._maps = {m.start: m for m in rows}
+        for m in rows:
             if m.path == "[heap]":
                 self._brk_start, self.brk = m.start, m.end
         self.dirty = False
@@ -220,14 +224,24 @@ class ProcessMaps:
         if n <= 0:
             return True
         self._fresh()
-        at, end = addr, addr + n
-        for m in self.map.overlapping(addr, end):
-            if m.start > at or not want(m):
-                return False
-            at = m.end
-            if at >= end:
-                return True
-        return False
+
+        def walk() -> bool:
+            at, end = addr, addr + n
+            for m in self.map.overlapping(addr, end):
+                if m.start > at or not want(m):
+                    return False
+                at = m.end
+                if at >= end:
+                    return True
+            return False
+
+        if walk():
+            return True
+        # a miss may just be a stale snapshot (preload backend: mmap
+        # runs native and never marks us dirty): refresh and retry
+        # once. Stale HITS on an unmapped region remain possible until
+        # the next miss — the tracker is a snapshot, not a mirror.
+        return self.refresh() and walk()
 
     def readable(self, addr: int, n: int) -> bool:
         return self._check(addr, n, lambda m: m.readable)
@@ -237,4 +251,7 @@ class ProcessMaps:
 
     def region_of(self, addr: int) -> Optional[Mapping]:
         self._fresh()
-        return self.map.find(addr)
+        m = self.map.find(addr)
+        if m is None and self.refresh():
+            m = self.map.find(addr)     # stale-miss retry
+        return m

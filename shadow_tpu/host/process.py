@@ -526,6 +526,8 @@ class ManagedProcess:
         child.syscall_counts = {}
         child.parent_proc = self
         child.children = {}
+        from shadow_tpu.host.memmap import ProcessMaps
+        child.maps = ProcessMaps(real_pid)
         child.sigactions = dict(self.sigactions)
         child.pending_signals = []
         child.publish_sim_time = self.publish_sim_time

@@ -698,16 +698,13 @@ class SyscallHandler:
         return getattr(self.p, "maps", None)
 
     def sys_mmap(self, ctx, a):
-        # the kernel chooses the address for non-FIXED maps and the
-        # tracer does not surface native return values, so mark the
-        # snapshot stale; queries refresh from /proc on demand
+        # the kernel chooses the address for non-FIXED maps, the
+        # tracer does not surface native return values, and even a
+        # MAP_FIXED request can fail — so never record at entry; mark
+        # the snapshot stale and refresh from /proc on demand
         m = self._maps()
         if m is not None:
-            MAP_FIXED = 0x10
-            if a[3] & MAP_FIXED:
-                m.on_mmap(int(a[0]), int(a[1]), int(a[2]), int(a[5]))
-            else:
-                m.dirty = True
+            m.dirty = True
         return NATIVE
 
     def sys_munmap(self, ctx, a):
@@ -1264,8 +1261,11 @@ class SyscallHandler:
         return self._dup_to(ctx, _s32(a[0]), _s32(a[1]))
 
     def sys_dup3(self, ctx, a):
-        r = self._dup_to(ctx, _s32(a[0]), _s32(a[1]))
-        if isinstance(r, int) and r >= 0 and _s32(a[2]) & 0x80000:
+        oldfd, newfd, flags = _s32(a[0]), _s32(a[1]), _s32(a[2])
+        if oldfd == newfd or flags & ~0x80000:
+            return -EINVAL              # dup3(2): unlike dup2
+        r = self._dup_to(ctx, oldfd, newfd)
+        if isinstance(r, int) and r >= 0 and flags & 0x80000:
             self.table.cloexec.add(r)       # O_CLOEXEC
         return r
 
