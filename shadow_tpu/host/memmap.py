@@ -7,12 +7,16 @@ mod.rs:1-17, proc_maps.rs, interval_map.rs). This module provides the
 tracker: an interval map over the plugin's VM, a /proc parser to
 (re)build it, and the update operations the syscall layer applies.
 
-Backend split: under ptrace every syscall stops, so the map is
-maintained LIVE from mmap/munmap/brk/mremap events. Under preload
-those syscalls run native (they must: the dynamic loader issues them
-before the shim can exist in a post-execve image), so the map is
-refreshed lazily from /proc — callers treat it as a consistent
-snapshot for bounds checks and observability, not a lock-step mirror.
+Backend split: under ptrace every syscall stops, so munmap/mprotect/
+brk (whose effects are fully determined at entry) update the map
+live, while mmap/mremap placements are kernel-chosen and unknowable
+at entry — they mark the snapshot stale for a lazy /proc refresh.
+Under preload all of these run native (they must: the dynamic loader
+issues them before the shim can exist in a post-execve image), so
+the map is purely a refreshed snapshot there. Queries self-heal on a
+miss with one refresh; callers treat the tracker as a consistent
+snapshot for bounds checks and observability, not a lock-step
+mirror.
 """
 
 from __future__ import annotations
@@ -101,6 +105,12 @@ class IntervalMap:
                 return True
         return at >= end
 
+    def bulk_load(self, rows: list) -> None:
+        """Replace the whole map with already-sorted, disjoint rows
+        (a /proc snapshot) in O(n)."""
+        self._starts = [m.start for m in rows]
+        self._maps = {m.start: m for m in rows}
+
     def add(self, m: Mapping) -> None:
         """Insert, clipping anything it overlaps (MAP_FIXED)."""
         self.remove(m.start, m.end)
@@ -171,12 +181,9 @@ class ProcessMaps:
                 text = f.read()
         except OSError:
             return False
-        # /proc rows are sorted and disjoint: assign directly (the
-        # add() path would pay an O(n^2) rebuild)
+        # /proc rows are sorted and disjoint: bulk-load in O(n)
         rows = parse_proc_maps(text)
-        self.map.clear()
-        self.map._starts = [m.start for m in rows]
-        self.map._maps = {m.start: m for m in rows}
+        self.map.bulk_load(rows)
         for m in rows:
             if m.path == "[heap]":
                 self._brk_start, self.brk = m.start, m.end
@@ -223,6 +230,7 @@ class ProcessMaps:
     def _check(self, addr: int, n: int, want) -> bool:
         if n <= 0:
             return True
+        was_dirty = self.dirty
         self._fresh()
 
         def walk() -> bool:
@@ -237,6 +245,8 @@ class ProcessMaps:
 
         if walk():
             return True
+        if was_dirty:
+            return False        # the walk already saw a fresh snapshot
         # a miss may just be a stale snapshot (preload backend: mmap
         # runs native and never marks us dirty): refresh and retry
         # once. Stale HITS on an unmapped region remain possible until
@@ -250,8 +260,9 @@ class ProcessMaps:
         return self._check(addr, n, lambda m: m.writable)
 
     def region_of(self, addr: int) -> Optional[Mapping]:
+        was_dirty = self.dirty
         self._fresh()
         m = self.map.find(addr)
-        if m is None and self.refresh():
+        if m is None and not was_dirty and self.refresh():
             m = self.map.find(addr)     # stale-miss retry
         return m

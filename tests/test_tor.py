@@ -141,6 +141,7 @@ def test_tor_large_config_builds():
     st = eng.init_state(c.sim.starts)
     boots = int((np.asarray(st["ht"]) < (1 << 62)).sum())
     assert boots == 56000                  # every host has a boot event
+    del st, c, eng                         # ~1 GB back before the run
 
     # downscale 1/400 with the same role mix and run a short slice
     # (the CPU jax backend compiles E=416 programs slowly; this keeps
