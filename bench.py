@@ -50,20 +50,36 @@ def log(msg: str) -> None:
     print(f"bench: {msg}", file=sys.stderr, flush=True)
 
 
-def _probe_tpu(timeout_s: int = 150) -> bool:
+def _probe_tpu(timeout_s: int = 420) -> str:
     """The TPU relay admits one client and a wedged claim makes
     jax.devices() HANG (not raise) — probe in a subprocess with a hard
-    timeout so a dead relay can never stall the bench itself."""
+    timeout so a dead relay can never stall the bench itself.
+
+    Returns "ok" / "fail" / "timeout". The timeout sits well above
+    worst-case cold init, and on expiry the probe gets SIGTERM + a
+    grace period before SIGKILL; the probe installs a SIGTERM handler
+    that exits via SystemExit so Python cleanup (and any claim release)
+    actually runs — default SIGTERM disposition would die as abruptly
+    as SIGKILL."""
+    p = subprocess.Popen(
+        [sys.executable, "-c",
+         "import signal, sys; "
+         "signal.signal(signal.SIGTERM, lambda *a: sys.exit(3)); "
+         "import jax; d=jax.devices(); "
+         "print(d[0].platform)"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d=jax.devices(); "
-             "print(d[0].platform)"],
-            capture_output=True, timeout=timeout_s, text=True)
-        return r.returncode == 0 and "cpu" not in r.stdout
+        out, _ = p.communicate(timeout=timeout_s)
+        return "ok" if p.returncode == 0 and "cpu" not in out else "fail"
     except subprocess.TimeoutExpired:
         log(f"backend probe hung >{timeout_s}s (wedged relay?)")
-        return False
+        p.terminate()
+        try:
+            p.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+        return "timeout"
 
 
 def init_backend():
@@ -80,7 +96,13 @@ def init_backend():
         devs = jax.devices()            # explicitly requested CPU
         log(f"backend: cpu x{len(devs)} (JAX_PLATFORMS=cpu)")
         return devs, False
-    if _probe_tpu() or _probe_tpu():
+    # retry once on a clean failure only: after a TIMEOUT the killed
+    # probe client has likely wedged the relay, and a second probe
+    # would just burn another 420s against a relay that cannot answer
+    status = _probe_tpu()
+    if status == "fail":
+        status = _probe_tpu()
+    if status == "ok":
         try:
             devs = jax.devices()
             log(f"backend: {devs[0].platform} x{len(devs)}")
@@ -155,7 +177,9 @@ def main() -> int:
         "metric": "packets_routed_per_sec_per_chip",
         "value": 0.0,
         "unit": "packets/s",
-        "vs_baseline": 0.0,
+        # None = "no valid ratio" (errors/fallback); only a completed
+        # device-vs-cpu ladder sets a number here
+        "vs_baseline": None,
     }
     rc = 0
     try:
@@ -209,7 +233,8 @@ def main() -> int:
             "sim-s/wall-s)")
 
         result["value"] = round(f_pkts / f_wall / n_chips, 1)
-        result["vs_baseline"] = ladder[headline]["speedup"]
+        if not fell_back:
+            result["vs_baseline"] = ladder[headline]["speedup"]
         result["sim_s_per_wall_s"] = round(sim_per_wall, 3)
         result["n_chips"] = n_chips
         result["ladder"] = ladder
@@ -228,14 +253,22 @@ def _supervise() -> int:
     kills the child and emits the error JSON — the one-line contract
     holds no matter what the backend does."""
     env = dict(os.environ, SHADOWTPU_BENCH_CHILD="1")
+    p = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                         env=env)
     try:
-        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           env=env, timeout=3200)
-        return r.returncode
+        return p.wait(timeout=3200)
     except subprocess.TimeoutExpired:
+        # SIGTERM + grace before SIGKILL: killing the child mid-claim
+        # wedges the relay for hours — give it a chance to release
+        p.terminate()
+        try:
+            p.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
         print(json.dumps({
             "metric": "packets_routed_per_sec_per_chip",
-            "value": 0.0, "unit": "packets/s", "vs_baseline": 0.0,
+            "value": 0.0, "unit": "packets/s", "vs_baseline": None,
             "error": "bench timed out (wedged TPU relay?)",
         }), flush=True)
         return 1
@@ -243,5 +276,9 @@ def _supervise() -> int:
 
 if __name__ == "__main__":
     if os.environ.get("SHADOWTPU_BENCH_CHILD") == "1":
+        # exit via SystemExit on SIGTERM so the supervisor's grace
+        # period lets Python cleanup (claim release) actually run
+        import signal
+        signal.signal(signal.SIGTERM, lambda *a: sys.exit(3))
         sys.exit(main())
     sys.exit(_supervise())
