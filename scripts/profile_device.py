@@ -1,0 +1,80 @@
+"""Per-phase device-engine profile (VERDICT r3 next-step #2).
+
+Runs a config's device twin with the engine's phase-split profiler and
+prints ONE JSON line attributing wall time to pop-loop vs
+exchange+merge vs host-probe sync, plus the fused-run rate for the
+same slice for calibration (the split path pays per-call dispatch +
+sync the fused while_loop does not).
+
+Usage:
+  python scripts/profile_device.py examples/tgen_10000.yaml [stop_s]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    cfg_path = sys.argv[1] if len(sys.argv) > 1 else \
+        "examples/tgen_10000.yaml"
+    stop_s = float(sys.argv[2]) if len(sys.argv) > 2 else 0.5
+
+    from shadow_tpu import simtime
+    from shadow_tpu._jax import jax
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+
+    cfg = load_config(cfg_path)
+    cfg.experimental.scheduler_policy = "tpu"
+    cfg.general.stop_time = simtime.from_seconds(stop_s)
+    c = Controller(cfg)
+    eng = c.runner.engine
+    stop = simtime.from_seconds(stop_s)
+
+    # fused-run calibration on the identical slice (compile + run)
+    st = eng.init_state(c.sim.starts)
+    t0 = time.perf_counter()
+    st_out, rounds = eng.run(st, stop=stop)
+    jax.block_until_ready(st_out)
+    fused_first = time.perf_counter() - t0
+    st = eng.init_state(c.sim.starts)
+    t0 = time.perf_counter()
+    st_out, rounds = eng.run(st, stop=stop)
+    jax.block_until_ready(st_out)
+    fused_s = time.perf_counter() - t0
+
+    st = eng.init_state(c.sim.starts)
+    prof = eng.profile(st, stop=stop)
+    prof.pop("final_state")
+
+    r = max(1, prof["rounds"])
+    out = {
+        "config": cfg_path,
+        "platform": jax.devices()[0].platform,
+        "slice_sim_s": stop_s,
+        "fused_run_s": round(fused_s, 3),
+        "fused_compile_plus_run_s": round(fused_first, 3),
+        "fused_rounds": int(rounds),
+        "split": {k: (round(v, 4) if isinstance(v, float) else v)
+                  for k, v in prof.items()},
+        "per_round_ms": {
+            "pop": round(1e3 * prof["pop_s"] / r, 3),
+            "flush": round(1e3 * prof["flush_s"] / r, 3),
+            "probe": round(1e3 * prof["probe_s"] / r, 3),
+            "fused_total": round(1e3 * fused_s / max(1, int(rounds)),
+                                 3),
+        },
+        "phases_per_round": round(prof["phases"] / r, 2),
+        "events_per_round": round(prof["events"] / r, 1),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Wait for the TPU relay to recover, then run the bench + profile.
+
+The axon relay admits one client; a wedged claim makes jax.devices()
+hang for hours. This watcher probes gently on a long cycle — each
+probe subprocess gets a generous natural window and a SIGTERM + grace
+shutdown (never a bare SIGKILL on a possibly-mid-claim client) — and
+the moment a probe sees a real accelerator it runs, in order:
+
+  1. python bench.py                    -> artifacts/BENCH_tpu.json
+  2. scripts/profile_device.py 10k rung -> artifacts/PROFILE_tpu.json
+
+Usage: python scripts/tpu_watch.py [max_hours]
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+PROBE_WINDOW_S = 2400       # one probe may legitimately sit this long
+SLEEP_BETWEEN_S = 600
+ART = "artifacts"
+
+
+def log(msg: str) -> None:
+    print(f"[tpu_watch {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def probe_once() -> bool:
+    p = subprocess.Popen(
+        [sys.executable, "-c",
+         "import signal, sys; "
+         "signal.signal(signal.SIGTERM, lambda *a: sys.exit(3)); "
+         "import jax; print(jax.devices()[0].platform)"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        out, _ = p.communicate(timeout=PROBE_WINDOW_S)
+        ok = p.returncode == 0 and "cpu" not in (out or "")
+        log(f"probe -> rc={p.returncode} out={out!r}")
+        return ok
+    except subprocess.TimeoutExpired:
+        log(f"probe still hung after {PROBE_WINDOW_S}s; "
+            "SIGTERM + grace")
+        p.terminate()
+        try:
+            p.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+        return False
+
+
+def run_and_save(cmd: list[str], out_path: str, log_path: str) -> int:
+    with open(out_path, "wb") as out, open(log_path, "wb") as err:
+        r = subprocess.run(cmd, stdout=out, stderr=err)
+    log(f"{' '.join(cmd[:2])} -> rc={r.returncode} ({out_path})")
+    return r.returncode
+
+
+def main() -> int:
+    max_hours = float(sys.argv[1]) if len(sys.argv) > 1 else 9.0
+    os.makedirs(ART, exist_ok=True)
+    deadline = time.monotonic() + max_hours * 3600
+    while time.monotonic() < deadline:
+        if probe_once():
+            log("TPU is back — running bench")
+            run_and_save([sys.executable, "bench.py"],
+                         f"{ART}/BENCH_tpu.json",
+                         f"{ART}/BENCH_tpu.log")
+            log("bench done — running 10k profile")
+            run_and_save([sys.executable, "scripts/profile_device.py",
+                          "examples/tgen_10000.yaml", "2.5"],
+                         f"{ART}/PROFILE_tpu.json",
+                         f"{ART}/PROFILE_tpu.log")
+            return 0
+        time.sleep(SLEEP_BETWEEN_S)
+    log("gave up: TPU never recovered inside the window")
+    return 1
+
+
+if __name__ == "__main__":
+    signal.signal(signal.SIGTERM, lambda *a: sys.exit(3))
+    sys.exit(main())

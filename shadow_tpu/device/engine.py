@@ -621,6 +621,10 @@ class DeviceEngine:
         # fail the run — never silently lost (SURVEY hard-part #2).
         XF = ("t", "k", "m", "s", "v")
 
+        # Sorts move every operand through every bitonic pass, so the
+        # flush sorts ONLY (key, iota) and recovers payload rows later
+        # with gathers — the profiler showed the old 6-operand flat
+        # sort + 5-operand merge dominating round cost (~85%).
         def _flat_sorted(ob, gid):
             slot = jnp.arange(OB, dtype=jnp.int64)[None, :]
             okey = gid.astype(jnp.int64)[:, None] * OB + slot
@@ -632,9 +636,9 @@ class DeviceEngine:
             valid = flat["t"] < DROP_T
             skey = jnp.where(valid, fdst * SPAN + okey.reshape(F),
                              IMAX)
-            srt = lax.sort((skey,) + tuple(flat[f] for f in XF),
-                           num_keys=1)
-            return srt[0], dict(zip(XF, srt[1:]))
+            skey_s, perm = lax.sort(
+                (skey, jnp.arange(F, dtype=jnp.int64)), num_keys=1)
+            return skey_s, perm, flat
 
         def _count_paths(state, ob, host_vertex):
             """topology_incrementPathPacketCounter parity: a [V,V]
@@ -663,18 +667,21 @@ class DeviceEngine:
                 (prefix[edges[1:]] - prefix[edges[:-1]])[None, :]
             return state
 
-        def _seg_take(skey_s, rows, starts, counts, width):
-            """Contiguous per-segment windows: row i of the result is
-            rows[starts[i] : starts[i]+width], masked past counts."""
-            G = skey_s.shape[0]
+        def _seg_take(perm, rows, starts, counts, width):
+            """Contiguous per-segment windows of the SORTED order: row
+            i of the result is sorted-rows[starts[i]:starts[i]+width],
+            masked past counts — realized as a two-hop gather through
+            the sort permutation (rows stay unsorted)."""
+            G = perm.shape[0]
             idx = starts[:, None] + jnp.arange(width,
                                                dtype=starts.dtype)
             ok = jnp.arange(width)[None, :] < \
                 jnp.minimum(counts, width)[:, None]
             cidx = jnp.clip(idx, 0, G - 1).reshape(-1)
+            pidx = jnp.take(perm, cidx)
             out = {}
             for f in XF:
-                v = jnp.take(rows[f], cidx).reshape(idx.shape)
+                v = jnp.take(rows[f], pidx).reshape(idx.shape)
                 fillv = INF if f == "t" else (IMAX if f == "k" else 0)
                 out[f] = jnp.where(ok, v, fillv)
             return out
@@ -682,7 +689,7 @@ class DeviceEngine:
         def _exchange(state, ob, gid, my_shard, host_vertex):
             if CP:
                 state = _count_paths(state, ob, host_vertex)
-            skey, rows = _flat_sorted(ob, gid)
+            skey, perm, rows = _flat_sorted(ob, gid)
             G = H_loc * OB
 
             if n_shards > 1 and cfg.exchange == "all_to_all":
@@ -711,7 +718,7 @@ class DeviceEngine:
                     lk, jnp.arange(H_loc + 1, dtype=jnp.int64))
                 state["x_overflow"] = state["x_overflow"] + \
                     (hb[1:] - hb[:-1]).astype(jnp.int32)
-                win = _seg_take(skey, rows, starts, counts, CAP)
+                win = _seg_take(perm, rows, starts, counts, CAP)
                 kidx = jnp.clip(
                     starts[:, None] + jnp.arange(CAP,
                                                  dtype=jnp.int64),
@@ -728,20 +735,23 @@ class DeviceEngine:
                 kmoved = lax.all_to_all(
                     kwin, AXIS, split_axis=0,
                     concat_axis=0).reshape(n_shards * CAP)
-                srt = lax.sort((kmoved,) + tuple(moved[f]
-                                                 for f in XF),
-                               num_keys=1)
-                skey, rows = srt[0], dict(zip(XF, srt[1:]))
                 G = n_shards * CAP
+                skey, perm = lax.sort(
+                    (kmoved, jnp.arange(G, dtype=jnp.int64)),
+                    num_keys=1)
+                rows = moved
             elif n_shards > 1:
-                # all_gather fallback: replicate every shard's sorted
-                # rows, then one global re-sort (debug / hub-heavy)
-                gath = {f: lax.all_gather(rows[f], AXIS)
+                # all_gather fallback: replicate every shard's rows,
+                # then one global key re-sort (debug / hub-heavy)
+                rows = {f: lax.all_gather(rows[f], AXIS)
                         .reshape(n_shards * G) for f in XF}
                 kg = lax.all_gather(skey, AXIS).reshape(n_shards * G)
-                srt = lax.sort((kg,) + tuple(gath[f] for f in XF),
-                               num_keys=1)
-                skey, rows = srt[0], dict(zip(XF, srt[1:]))
+                pg = (lax.all_gather(perm, AXIS)
+                      .reshape(n_shards, G)
+                      + (jnp.arange(n_shards, dtype=jnp.int64)
+                         * G)[:, None]).reshape(n_shards * G)
+                skey, perm = lax.sort(
+                    (kg, pg), num_keys=2)
                 G = n_shards * G
 
             # my hosts' contiguous arrival segments -> [H_loc, IN]
@@ -753,10 +763,11 @@ class DeviceEngine:
             counts = nxt - starts
             state["overflow"] = state["overflow"] + \
                 jnp.maximum(0, counts - IN).astype(jnp.int32)
-            inc = _seg_take(skey, rows, starts, counts, IN)
+            inc = _seg_take(perm, rows, starts, counts, IN)
 
             # merge: one lexicographic row sort of [live heap | inc]
-            # by (time, src<<32|seq); first E slots survive
+            # by (time, src<<32|seq) — keys + column iota only; the
+            # three payload columns follow via take_along_axis
             live = jnp.arange(E)[None, :] >= state["head"][:, None]
             mt = jnp.where(live, state["ht"], INF)
             mk = jnp.where(live, state["hk"], IMAX)
@@ -766,18 +777,22 @@ class DeviceEngine:
             inc_hw = (inc["v"] >> 32) & U32        # d2 (train survivors)
             ct = jnp.concatenate([mt, inc["t"]], axis=1)
             ck = jnp.concatenate([mk, inc["k"]], axis=1)
+            ci = jnp.broadcast_to(
+                jnp.arange(E + IN, dtype=jnp.int32)[None, :],
+                (H_loc, E + IN))
+            st, sk, si = lax.sort((ct, ck, ci), dimension=1,
+                                  num_keys=2)
+            state["overflow"] = state["overflow"] + \
+                (st[:, E:] < INF).sum(-1).astype(jnp.int32)
+            sie = si[:, :E]
             cm = jnp.concatenate([state["hm"], inc_hm], axis=1)
             cv = jnp.concatenate([state["hv"], inc_hv], axis=1)
             cw = jnp.concatenate([state["hw"], inc_hw], axis=1)
-            st, sk, sm, sv, sw = lax.sort((ct, ck, cm, cv, cw),
-                                          dimension=1, num_keys=2)
-            state["overflow"] = state["overflow"] + \
-                (st[:, E:] < INF).sum(-1).astype(jnp.int32)
             state["ht"] = st[:, :E]
             state["hk"] = sk[:, :E]
-            state["hm"] = sm[:, :E]
-            state["hv"] = sv[:, :E]
-            state["hw"] = sw[:, :E]
+            state["hm"] = jnp.take_along_axis(cm, sie, axis=1)
+            state["hv"] = jnp.take_along_axis(cv, sie, axis=1)
+            state["hw"] = jnp.take_along_axis(cw, sie, axis=1)
             state["head"] = jnp.zeros_like(state["head"])
             return state
 
@@ -883,6 +898,33 @@ class DeviceEngine:
                 _take_head(state["ht"], state["head"], INF).min())
             return state, nxt
 
+        # ---------------- phase-split profiling path -------------------
+        # the per-round cost hunt (BASELINE.md's 181 ms/round budget)
+        # needs pop-loop vs exchange vs merge attribution; these split
+        # jits let a host-side driver time each piece. They are traced
+        # lazily (first call), so the normal path pays nothing.
+        def _pop_shard(state, ob, host_vertex, lat, rel, win_end):
+            my_shard = lax.axis_index(AXIS)
+            gid = (my_shard * H_loc + hidx).astype(jnp.int32)
+            dirty = jnp.zeros((H_loc,), bool)
+
+            def cond(c):
+                state_, _, blk, dirty_ = c
+                nt = _take_head(state_["ht"], state_["head"], INF)
+                return ((nt < win_end) & ~dirty_).any() & (blk < B)
+
+            state, ob, blk, _ = lax.while_loop(
+                cond,
+                lambda c: _step(c, win_end, gid, host_vertex, lat,
+                                rel),
+                (state, ob, jnp.int32(0), dirty))
+            return state, ob, jnp.reshape(blk, (1,))
+
+        def _flush_shard(state, ob, host_vertex):
+            my_shard = lax.axis_index(AXIS)
+            gid = (my_shard * H_loc + hidx).astype(jnp.int32)
+            return _exchange(state, ob, gid, my_shard, host_vertex)
+
         spec_keys = ("ht", "hk", "hm", "hv", "hw", "head",
                      "event_seq", "packet_seq", "app_seq", "app",
                      "n_exec", "n_sent", "n_drop", "n_deliv",
@@ -890,6 +932,7 @@ class DeviceEngine:
             (NIC_KEYS if MB else ()) + \
             (("path_cnt",) if CP else ())
         specs = {k: self._shard_spec for k in spec_keys}
+        ob_specs = {f: self._shard_spec for f in XF}
         repl = self._repl_spec
         self._run = jax.jit(jax.shard_map(
             _run_shard, mesh=self.mesh,
@@ -903,6 +946,29 @@ class DeviceEngine:
             out_specs=(specs, repl),
             check_vma=False,
         ))
+        self._pop_phase = jax.jit(jax.shard_map(
+            _pop_shard, mesh=self.mesh,
+            in_specs=(specs, ob_specs, repl, repl, repl, repl),
+            out_specs=(specs, ob_specs, self._shard_spec),
+            check_vma=False,
+        ))
+        self._flush_phase = jax.jit(jax.shard_map(
+            _flush_shard, mesh=self.mesh,
+            in_specs=(specs, ob_specs, repl),
+            out_specs=specs,
+            check_vma=False,
+        ))
+        self._ob_shape_global = (H_pad, OB)
+
+        def _probe(state):
+            head = state["head"]
+            nt = jnp.take_along_axis(
+                state["ht"], jnp.minimum(head, E - 1)[:, None],
+                axis=1)[:, 0]
+            nt = jnp.where(head < E, nt, INF)
+            return nt.min(), head.sum()
+
+        self._probe = jax.jit(_probe)
 
     # ------------------------------------------------------------------
     def run(self, state: dict, stop: Optional[int] = None,
@@ -922,3 +988,74 @@ class DeviceEngine:
                            else stop)
         final_v = stop_v if final_stop is None else jnp.int64(final_stop)
         return self._run(state, hv, lat, rel, stop_v, final_v)
+
+    def profile(self, state: dict, stop: Optional[int] = None) -> dict:
+        """Phase-split run with host-side wall timing: the same round
+        structure as `run`, but each pop loop / flush executes as its
+        own jitted call with a block_until_ready fence, attributing
+        wall time to pop vs exchange+merge vs the host-sync probe.
+        Numbers include per-call dispatch + sync overhead the fused
+        `run` does not pay — use the breakdown for RATIOS and the
+        fused run for totals. Single- or multi-shard."""
+        import time as _time
+
+        repl = NamedSharding(self.mesh, self._repl_spec)
+        shard = NamedSharding(self.mesh, self._shard_spec)
+        hv = jax.device_put(jnp.asarray(self.host_vertex), repl)
+        lat = jax.device_put(jnp.asarray(self.latency), repl)
+        rel = jax.device_put(jnp.asarray(self.reliability), repl)
+        stop_t = self.config.stop_time if stop is None else stop
+        LA = max(1, self.config.lookahead)
+
+        def _ob():
+            ob = {"t": jax.device_put(
+                jnp.full(self._ob_shape_global, INF, jnp.int64),
+                shard)}
+            for f in ("k", "m", "s", "v"):
+                ob[f] = jax.device_put(
+                    jnp.zeros(self._ob_shape_global, jnp.int64), shard)
+            return ob
+
+        prof = {"rounds": 0, "phases": 0, "events": 0,
+                "pop_s": 0.0, "flush_s": 0.0, "probe_s": 0.0,
+                "compile_s": 0.0}
+        # compile both split programs up front so timings are steady
+        t0 = _time.perf_counter()
+        win0 = jnp.int64(0)
+        s_w, ob_w, _ = self._pop_phase(state, _ob(), hv, lat, rel,
+                                       win0)
+        jax.block_until_ready(self._flush_phase(s_w, ob_w, hv))
+        jax.block_until_ready(self._probe(state))
+        prof["compile_s"] = _time.perf_counter() - t0
+
+        exec0 = int(jnp.sum(state["n_exec"]))
+        t0 = _time.perf_counter()
+        nxt, _ = map(int, self._probe(state))
+        prof["probe_s"] += _time.perf_counter() - t0
+        t_all = _time.perf_counter()
+        while nxt < stop_t and prof["rounds"] < 10_000:
+            win_end = jnp.int64(min(nxt + LA, stop_t))
+            while True:
+                t0 = _time.perf_counter()
+                state, ob, _ = self._pop_phase(state, _ob(), hv, lat,
+                                               rel, win_end)
+                jax.block_until_ready(state)
+                prof["pop_s"] += _time.perf_counter() - t0
+
+                t0 = _time.perf_counter()
+                state = self._flush_phase(state, ob, hv)
+                jax.block_until_ready(state)
+                prof["flush_s"] += _time.perf_counter() - t0
+                prof["phases"] += 1
+
+                t0 = _time.perf_counter()
+                nu, _ = map(int, self._probe(state))
+                prof["probe_s"] += _time.perf_counter() - t0
+                if nu >= int(win_end):
+                    break
+            prof["rounds"] += 1
+            nxt = nu
+        prof["wall_s"] = _time.perf_counter() - t_all
+        prof["events"] = int(jnp.sum(state["n_exec"])) - exec0
+        prof["final_state"] = state
+        return prof
