@@ -1,4 +1,4 @@
-"""Ptrace interposition backend (PTRACE_SYSEMU).
+"""Ptrace interposition backend (PTRACE_SYSEMU, multi-tracee).
 
 The rebuild of the reference's second interposition method
 (src/main/host/thread_ptrace.c): instead of a preloaded shim funneling
@@ -11,21 +11,46 @@ instruction and step through with PTRACE_SYSCALL — the reference's
 "deliver to native" path, thread_ptrace.c:1074 onward).
 
 Linux requires every ptrace request (and the waitpid noticing tracee
-stops) to come from the tracer task itself, so each PtraceProcess owns
+stops) to come from the tracer task itself, so each process TREE owns
 a dedicated tracer thread holding the fork/exec, the SYSEMU loop, and
 all register access; the simulation threads talk to it over a command
 queue. This mirrors the reference's per-worker fork-proxy +
 tracer-affinity workarounds (thread_ptrace.c:39-56,
 utility/fork_proxy.c).
 
+Threads (thread_ptrace.c:36-56's multi-tracee waitpid machinery):
+PTRACE_O_TRACECLONE auto-attaches cloned threads to the same tracer;
+the suppressed clone is re-executed natively, the event stop yields
+the new tid, the child is held at its initial stop until the simulator
+schedules it, and the clone return + (SET/CLEAR)TID words are rewritten
+to the child's VIRTUAL tid — getpid/gettid/tgkill stay fully virtual,
+exactly like the preload backend. Each ManagedThread maps to one
+native tid; one thread of a process runs at a time (strict ping-pong).
+
+Fork: PTRACE_O_TRACEFORK catches the new PROCESS the same way (vfork
+is rewritten to fork at re-execution — same COW degradation as the
+preload shim); the child PtraceProcess shares the parent's tracer
+thread, commands routed by native tid.
+
+Signals: virtual queues/masks/dispositions live in ManagedProcess
+(signal.c analogue); DELIVERY uses the kernel — rt_sigaction is
+recorded virtually and also installed natively, and a deliverable
+virtual signal is injected at a syscall boundary via
+PTRACE_SYSEMU(sig): the kernel builds the real handler frame, the
+handler's own syscalls trap through the normal funnel, and
+rt_sigreturn runs native. A parked (blocked) syscall interrupted by a
+signal gets -EINTR poked (or %rip rewound for SA_RESTART) before the
+injection resumes it — the reference delivers through the shim's
+process_signals instead (thread_ptrace.c handles the same cases with
+its own pending-signal forwarding).
+
 TSC emulation (src/lib/tsc/tsc.c): the child sets
 prctl(PR_SET_TSC, PR_TSC_SIGSEGV) before exec (the flag survives
 execve), so `rdtsc`/`rdtscp` raise SIGSEGV; the tracer decodes the
-instruction at %rip (0F 31 / 0F 01 F9), writes a deterministic
-cycle count derived from simulated time into %edx:%eax (nominal
-1 GHz ⇒ cycles == nanoseconds), advances %rip, and resumes — plugin
-time reads are pure functions of sim time, like the reference's
-Tsc_emulateRdtsc.
+instruction at %rip, writes a deterministic cycle count derived from
+simulated time into %edx:%eax (nominal 1 GHz ⇒ cycles == nanoseconds),
+advances %rip, and resumes — plugin time reads are pure functions of
+sim time, like the reference's Tsc_emulateRdtsc.
 """
 
 from __future__ import annotations
@@ -38,9 +63,14 @@ import struct
 import threading
 from typing import Optional
 
-from shadow_tpu.host.process import ManagedProcess, RECV_TIMEOUT_MS
+from shadow_tpu.host.process import (
+    ManagedProcess,
+    ManagedThread,
+    RECV_TIMEOUT_MS,
+    _NO_RESTART,
+)
 from shadow_tpu.host.memory import ProcessMemory
-from shadow_tpu.host.syscalls import NATIVE, NR_NAME, Blocked
+from shadow_tpu.host.syscalls import APPLIED, NATIVE, NR, NR_NAME, Blocked
 from shadow_tpu.utils.slog import get_logger
 
 log = get_logger("ptrace")
@@ -58,16 +88,30 @@ GETREGS = 12
 SETREGS = 13
 SYSCALL = 24
 SYSEMU = 31
+POKEDATA = 5
+SEIZE = 0x4206              # PTRACE_SEIZE
+GETEVENTMSG = 0x4201
+GET_SYSCALL_INFO = 0x420E   # PTRACE_GET_SYSCALL_INFO (kernel 5.3+)
 
 OPT_SYSGOOD = 0x1           # PTRACE_O_TRACESYSGOOD
-OPT_TRACEEXEC = 0x10        # PTRACE_O_TRACEEXEC
+OPT_TRACEFORK = 0x2
+OPT_TRACEVFORK = 0x4
+OPT_TRACECLONE = 0x8
+OPT_TRACEEXEC = 0x10
+OPT_TRACEEXIT = 0x40
 OPT_EXITKILL = 0x00100000   # PTRACE_O_EXITKILL
-SEIZE = 0x4206              # PTRACE_SEIZE
-EVENT_EXEC = 4              # PTRACE_EVENT_EXEC
+
+EVENT_FORK = 1
+EVENT_VFORK = 2
+EVENT_CLONE = 3
+EVENT_EXEC = 4
+EVENT_EXIT = 6
+
+WALL = 0x40000000           # __WALL: wait for clone children too
 
 SYSCALL_TRAP = signal.SIGTRAP | 0x80     # sysgood syscall stop
 
-POKEDATA = 5
+NR_FORK = 57
 
 # vDSO fast paths bypass the syscall instruction entirely, so SYSEMU
 # never sees them; like rr, overwrite each exported vDSO function with
@@ -90,6 +134,11 @@ _VDSO_STUBS = {
 
 NOMINAL_TSC_HZ = 1_000_000_000           # 1 GHz: cycles == sim ns
 
+# clone flag bits the tracer needs
+CLONE_PARENT_SETTID = 0x00100000
+CLONE_CHILD_CLEARTID = 0x00200000
+CLONE_CHILD_SETTID = 0x01000000
+
 
 class UserRegs(ctypes.Structure):
     _fields_ = [(n, ctypes.c_ulonglong) for n in (
@@ -110,20 +159,41 @@ def _ptrace(req: int, pid: int, addr=None, data=None) -> int:
     return r
 
 
+def _decode_wstatus(status: int) -> int:
+    if os.WIFEXITED(status):
+        return os.WEXITSTATUS(status)
+    if os.WIFSIGNALED(status):
+        return 128 + os.WTERMSIG(status)
+    return -1
+
+
 class _TraceeExited(Exception):
-    def __init__(self, code: int):
+    """A specific tracee (thread or whole process) died."""
+
+    def __init__(self, tid: int, code: int):
+        self.tid = tid
         self.code = code
 
 
 class _Tracer(threading.Thread):
-    """Owns all ptrace operations for one tracee.
+    """Owns all ptrace operations for one tracee TREE (a process and
+    every thread/fork descendant auto-attached to it).
 
     Commands (cmd, payload) on self.cmds; replies on self.replies:
       spawn  -> ("pid", pid) | ("error", msg)
-      step   -> payload (result|None, native: bool, sim_ns) ; applies
-                the pending syscall result, resumes, and replies
-                ("syscall", nr, args) | ("exit", code)
-      kill   -> ("exit", code)
+      step   -> payload (tid, result|None, native, rewind, inject,
+                sim_ns); applies the pending result (or rewinds %rip
+                for a restart), resumes — injecting `inject` as a real
+                signal if nonzero — and replies
+                ("syscall", tid, nr, args, execd) |
+                ("dead", tid, code) | ("error", msg)
+      clone  -> (tid, new_vid, kind): natively re-executes the
+                suppressed clone/fork at tid's entry stop, captures the
+                auto-attached child at its first stop, rewrites the
+                parent return + tid words to the virtual id, and
+                replies ("cloned", new_tid) | ("clone_fail", err)
+      kill   -> (tids,): SIGKILL + reap every given tid;
+                replies ("killed", code)
     """
 
     def __init__(self, argv, env, cwd, stdout_path, stderr_path,
@@ -138,8 +208,11 @@ class _Tracer(threading.Thread):
         self.cmds: queue.Queue = queue.Queue()
         self.replies: queue.Queue = queue.Queue()
         self.pid: Optional[int] = None
+        self.tracees: set[int] = set()
+        self.group: dict[int, int] = {}     # tid -> its leader pid
         self.exited = threading.Event()
         self.sim_ns = 0
+        self._execd = False
 
     # -- spawn + seize (replaces the old fork/TRACEME path) ------------
     def _spawn_seize(self) -> int:
@@ -173,13 +246,15 @@ class _Tracer(threading.Thread):
         # the stop (or an early death) in one blocking wait
         _, status = os.waitpid(pid, os.WUNTRACED)
         if os.WIFEXITED(status):
-            raise _TraceeExited(os.WEXITSTATUS(status))
+            raise _TraceeExited(pid, os.WEXITSTATUS(status))
         if os.WIFSIGNALED(status):
-            raise _TraceeExited(128 + os.WTERMSIG(status))
+            raise _TraceeExited(pid, 128 + os.WTERMSIG(status))
 
         _ptrace(SEIZE, pid, None,
                 ctypes.c_void_p(OPT_SYSGOOD | OPT_EXITKILL |
-                                OPT_TRACEEXEC))
+                                OPT_TRACEEXEC | OPT_TRACECLONE |
+                                OPT_TRACEFORK | OPT_TRACEVFORK |
+                                OPT_TRACEEXIT))
         # consume the post-SEIZE ptrace (group-)stop notification if
         # the kernel reports one before we resume; a CONT issued in
         # the stop-to-ptrace-trap transition window returns ESRCH,
@@ -191,9 +266,9 @@ class _Tracer(threading.Thread):
                 # a tracee killed in this window must surface its exit
                 # code, not a stale-pid SIGCONT failure
                 if os.WIFEXITED(st):
-                    raise _TraceeExited(os.WEXITSTATUS(st))
+                    raise _TraceeExited(pid, os.WEXITSTATUS(st))
                 if os.WIFSIGNALED(st):
-                    raise _TraceeExited(128 + os.WTERMSIG(st))
+                    raise _TraceeExited(pid, 128 + os.WTERMSIG(st))
                 break
             _time.sleep(0.001)
         os.kill(pid, signal.SIGCONT)
@@ -215,28 +290,31 @@ class _Tracer(threading.Thread):
             deliver = 0
             _, status = os.waitpid(pid, 0)
             if os.WIFEXITED(status):
-                raise _TraceeExited(os.WEXITSTATUS(status))
+                raise _TraceeExited(pid, os.WEXITSTATUS(status))
             if os.WIFSIGNALED(status):
-                raise _TraceeExited(128 + os.WTERMSIG(status))
+                raise _TraceeExited(pid, 128 + os.WTERMSIG(status))
             if (status >> 8) == (signal.SIGTRAP | (EVENT_EXEC << 8)):
                 break               # the real program's first moment
             sig = os.WSTOPSIG(status)
             if sig not in (signal.SIGSTOP, signal.SIGCONT,
                            signal.SIGTRAP):
                 deliver = sig
+        self.tracees.add(pid)
+        self.group[pid] = pid
         return pid
 
-    # -- vDSO patching (tracer thread, at the exec stop) ----------------
-    def _patch_vdso(self) -> None:
+    # -- vDSO patching (tracer thread, at an exec stop) ----------------
+    def _patch_vdso(self, pid: Optional[int] = None) -> None:
         try:
-            self._patch_vdso_inner()
+            self._patch_vdso_inner(pid if pid is not None
+                                   else self.pid)
         except Exception as e:     # malformed ELF must not kill the
             log.warning("vdso patch skipped: %s", e)   # tracer thread
 
-    def _patch_vdso_inner(self) -> None:
+    def _patch_vdso_inner(self, pid: int) -> None:
         base = size = None
         try:
-            with open(f"/proc/{self.pid}/maps") as f:
+            with open(f"/proc/{pid}/maps") as f:
                 for line in f:
                     if "[vdso]" in line:
                         lo, hi = line.split()[0].split("-")
@@ -248,7 +326,7 @@ class _Tracer(threading.Thread):
         if base is None:
             return
         try:
-            img = ProcessMemory(self.pid).read(base, size)
+            img = ProcessMemory(pid).read(base, size)
         except OSError:
             return
         if img[:4] != b"\x7fELF":
@@ -288,7 +366,7 @@ class _Tracer(threading.Thread):
                 + b"\x0f\x05\xc3"
             word, = struct.unpack("<q", stub)
             try:
-                _ptrace(POKEDATA, self.pid,
+                _ptrace(POKEDATA, pid,
                         ctypes.c_void_p(base + st_value),
                         ctypes.c_void_p(word & (2**64 - 1)))
                 patched += 1
@@ -297,28 +375,66 @@ class _Tracer(threading.Thread):
         log.debug("patched %d vDSO entries", patched)
 
     # -- tracee helpers (tracer thread only) ----------------------------
-    def _getregs(self) -> UserRegs:
+    def _getregs(self, tid: int) -> UserRegs:
         regs = UserRegs()
-        _ptrace(GETREGS, self.pid, None, ctypes.byref(regs))
+        _ptrace(GETREGS, tid, None, ctypes.byref(regs))
         return regs
 
-    def _setregs(self, regs: UserRegs) -> None:
-        _ptrace(SETREGS, self.pid, None, ctypes.byref(regs))
+    def _setregs(self, tid: int, regs: UserRegs) -> None:
+        _ptrace(SETREGS, tid, None, ctypes.byref(regs))
 
-    def _wait(self) -> int:
-        """waitpid; raises _TraceeExited on termination."""
-        _, status = os.waitpid(self.pid, 0)
-        if os.WIFEXITED(status):
-            raise _TraceeExited(os.WEXITSTATUS(status))
-        if os.WIFSIGNALED(status):
-            raise _TraceeExited(128 + os.WTERMSIG(status))
-        return os.WSTOPSIG(status)
+    def _geteventmsg(self, tid: int) -> int:
+        v = ctypes.c_ulong()
+        _ptrace(GETEVENTMSG, tid, None, ctypes.byref(v))
+        return v.value
 
-    def _try_emulate_tsc(self) -> bool:
+    def _wait(self, tid: int) -> tuple[str, int]:
+        """waitpid classification: ("sig", stopsig) | ("event", ev);
+        raises _TraceeExited on termination."""
+        _, status = os.waitpid(tid, WALL)
+        if os.WIFEXITED(status) or os.WIFSIGNALED(status):
+            raise _TraceeExited(tid, _decode_wstatus(status))
+        sig = os.WSTOPSIG(status)
+        ev = status >> 16
+        if sig == signal.SIGTRAP and ev:
+            return ("event", ev)
+        return ("sig", sig)
+
+    def _on_event(self, tid: int, ev: int) -> None:
+        """Events that can surface during any resume: exec re-patches
+        the vDSO (new image) and is flagged to the simulator; a thread
+        hitting EVENT_EXIT is let die and reported via _TraceeExited."""
+        if ev == EVENT_EXEC:
+            # patch the EXEC'ING process's fresh vDSO (tid may be a
+            # forked child, not the root tracee)
+            self._patch_vdso(tid)
+            self._execd = True
+            return
+        if ev == EVENT_EXIT:
+            wstatus = self._geteventmsg(tid)
+            code = _decode_wstatus(wstatus)
+            try:
+                _ptrace(CONT, tid)
+            except OSError:
+                pass
+            # reap the dead thread so it doesn't zombie — EXCEPT a
+            # thread-group leader with siblings still alive: waitpid
+            # on a zombie leader blocks until the whole group dies
+            leader = self.group.get(tid) == tid
+            siblings = any(t != tid and self.group.get(t) == tid
+                           for t in self.tracees)
+            if not (leader and siblings):
+                try:
+                    os.waitpid(tid, WALL)
+                except ChildProcessError:
+                    pass
+            raise _TraceeExited(tid, code)
+
+    def _try_emulate_tsc(self, tid: int) -> bool:
         """At a SIGSEGV stop: if %rip is rdtsc/rdtscp, emulate it."""
-        regs = self._getregs()
+        regs = self._getregs(tid)
         try:
-            code = ProcessMemory(self.pid).read(regs.rip, 3)
+            code = ProcessMemory(tid).read(regs.rip, 3)
         except OSError:
             return False
         cycles = self.sim_ns  # 1 GHz nominal
@@ -331,59 +447,159 @@ class _Tracer(threading.Thread):
             return False
         regs.rax = cycles & 0xFFFFFFFF
         regs.rdx = (cycles >> 32) & 0xFFFFFFFF
-        self._setregs(regs)
+        self._setregs(tid, regs)
         return True
 
-    def _resume_to_syscall(self, first_sig: int = 0):
+    def _resume_to_syscall(self, tid: int, first_sig: int = 0):
         """SYSEMU-resume until the next syscall-entry stop; emulate
         rdtsc SIGSEGVs and forward other signals along the way."""
         deliver = first_sig
         while True:
-            _ptrace(SYSEMU, self.pid, None,
+            _ptrace(SYSEMU, tid, None,
                     ctypes.c_void_p(deliver) if deliver else None)
             deliver = 0
-            sig = self._wait()
+            kind, v = self._wait(tid)
+            if kind == "event":
+                self._on_event(tid, v)
+                continue
+            sig = v
             if sig == SYSCALL_TRAP:
-                regs = self._getregs()
+                regs = self._getregs(tid)
                 nr = ctypes.c_long(regs.orig_rax).value
                 args = (regs.rdi, regs.rsi, regs.rdx, regs.r10,
                         regs.r8, regs.r9)
                 return nr, args
             if sig == signal.SIGSEGV and self.emulate_tsc \
-                    and self._try_emulate_tsc():
+                    and self._try_emulate_tsc(tid):
                 continue
-            if sig == signal.SIGTRAP:
-                continue                       # exec stop etc.
-            deliver = sig                      # forward to the tracee
+            if sig in (signal.SIGTRAP, signal.SIGSTOP,
+                       signal.SIGCHLD):
+                # exec / initial stops; real SIGCHLD from dead native
+                # children is swallowed — the VIRTUAL signal layer
+                # owns SIGCHLD (real arrival times are wall-clock)
+                continue
+            deliver = sig                  # forward to the tracee
 
-    def _run_native(self) -> None:
-        """Re-execute the suppressed syscall natively (rewind %rip to
-        the `syscall` instruction, then two PTRACE_SYSCALL hops:
-        entry stop, real execution, exit stop)."""
-        regs = self._getregs()
+    def _stop_op(self, tid: int) -> int:
+        """PTRACE_GET_SYSCALL_INFO op at a syscall trap:
+        1 = entry stop, 2 = exit stop, 0 = none."""
+        buf = (ctypes.c_uint8 * 128)()
+        _ptrace(GET_SYSCALL_INFO, tid, ctypes.c_void_p(128),
+                ctypes.byref(buf))
+        return buf[0]
+
+    def _run_to_exit(self, tid: int, on_clone_event=None) -> None:
+        """From a SYSEMU entry stop whose %rip was rewound:
+        PTRACE_SYSCALL until the re-issued syscall's TRUE exit stop.
+        Resuming a SYSEMU entry stop with PTRACE_SYSCALL first
+        reports a GHOST exit stop for the suppressed call (no
+        execution happened); GET_SYSCALL_INFO distinguishes it — the
+        real exit is the first exit stop AFTER a real entry stop.
+        Clone/fork events between entry and exit go to
+        `on_clone_event` (the new tid capture); everything else is
+        serviced as usual."""
+        deliver = 0
+        seen_entry = False
+        while True:
+            _ptrace(SYSCALL, tid, None,
+                    ctypes.c_void_p(deliver) if deliver else None)
+            deliver = 0
+            kind, v = self._wait(tid)
+            if kind == "event":
+                if on_clone_event is not None and \
+                        v in (EVENT_FORK, EVENT_VFORK, EVENT_CLONE):
+                    on_clone_event(self._geteventmsg(tid))
+                else:
+                    self._on_event(tid, v)
+                continue
+            if v == SYSCALL_TRAP:
+                op = self._stop_op(tid)
+                if op == 1:
+                    seen_entry = True
+                elif op == 2 and seen_entry:
+                    return
+                # else: the suppressed call's ghost exit stop
+                continue
+            if v == signal.SIGSEGV and self.emulate_tsc \
+                    and self._try_emulate_tsc(tid):
+                continue
+            if v in (signal.SIGTRAP, signal.SIGSTOP,
+                     signal.SIGCHLD):
+                continue               # see _resume_to_syscall
+            deliver = v                # forward real faults/signals
+
+    def _run_native(self, tid: int) -> None:
+        """Re-execute the suppressed syscall natively: rewind %rip to
+        the `syscall` instruction (restoring %rax = the nr) and run to
+        the real exit stop."""
+        regs = self._getregs(tid)
         regs.rax = regs.orig_rax
         regs.rip -= 2
-        self._setregs(regs)
-        for _ in range(2):
-            deliver = 0
-            while True:
-                _ptrace(SYSCALL, self.pid, None,
-                        ctypes.c_void_p(deliver) if deliver else None)
-                deliver = 0
-                sig = self._wait()
-                if sig == SYSCALL_TRAP:
-                    break
-                if sig == signal.SIGSEGV and self.emulate_tsc \
-                        and self._try_emulate_tsc():
-                    continue
-                if sig == signal.SIGTRAP:
-                    continue
-                deliver = sig              # forward real faults/signals
+        self._setregs(tid, regs)
+        self._run_to_exit(tid)
+
+    # -- clone / fork (TRACECLONE/TRACEFORK auto-attach) ----------------
+    def _do_clone(self, tid: int, new_vid: int, kind: str) -> None:
+        """At tid's suppressed clone/fork entry stop: re-execute
+        natively, capture the auto-attached child at its initial stop,
+        hold it there, and rewrite the parent's return value (and the
+        PARENT_SETTID / CHILD_SETTID words) to the VIRTUAL id. vfork
+        is rewritten to fork — the parent must not block on the child
+        (the preload shim applies the same COW degradation)."""
+        entry = self._getregs(tid)
+        nr = ctypes.c_long(entry.orig_rax).value
+        flags = int(entry.rdi) if nr == NR["clone"] else 0
+        ptid = int(entry.rdx) if nr == NR["clone"] else 0
+        ctid = int(entry.r10) if nr == NR["clone"] else 0
+        entry.rax = NR_FORK if nr == NR["vfork"] else entry.orig_rax
+        entry.rip -= 2
+        self._setregs(tid, entry)
+
+        new_tid = [None]
+        self._run_to_exit(tid, on_clone_event=lambda t:
+                          new_tid.__setitem__(0, t))
+
+        regs = self._getregs(tid)
+        real = ctypes.c_long(regs.rax).value
+        if real < 0 or new_tid[0] is None:
+            self.replies.put(("clone_fail",
+                              real if real < 0 else -11))
+            return
+        child = int(new_tid[0])
+        # the auto-attached child is in (or headed to) its initial
+        # stop; consume the notification so later waits are clean
+        try:
+            os.waitpid(child, WALL)
+        except ChildProcessError:
+            pass
+        self.tracees.add(child)
+        self.group[child] = self.group.get(tid, tid) \
+            if kind == "thread" else child
+
+        # virtualize the visible ids: parent return, PARENT_SETTID
+        # word (glibc's pd->tid for threads), CHILD_SETTID word (the
+        # child's own copy — same address pre-CoW for threads, the
+        # child's private page after fork)
+        regs.rax = new_vid
+        self._setregs(tid, regs)
+        word = struct.pack("<i", new_vid) + b"\x00\x00\x00\x00"
+        if flags & CLONE_PARENT_SETTID and ptid:
+            try:
+                ProcessMemory(tid).write(ptid, word[:4])
+            except OSError:
+                pass
+        if flags & CLONE_CHILD_SETTID and ctid:
+            try:
+                ProcessMemory(child).write(ctid, word[:4])
+            except OSError:
+                pass
+        self.replies.put(("cloned", child))
 
     # -- thread main ----------------------------------------------------
     def run(self) -> None:
         while True:
             cmd, payload = self.cmds.get()
+            tid = None
             try:
                 if cmd == "spawn":
                     # NO os.fork() of the (JAX-threaded) simulator: a
@@ -401,52 +617,104 @@ class _Tracer(threading.Thread):
                     self._patch_vdso()
                     self.replies.put(("pid", pid))
                 elif cmd == "step":
-                    result, native, sim_ns = payload
+                    (tid, result, native, rewind, inject,
+                     sim_ns) = payload
                     self.sim_ns = sim_ns
                     if native:
-                        self._run_native()
+                        self._run_native(tid)
+                    elif rewind:
+                        regs = self._getregs(tid)
+                        regs.rax = regs.orig_rax
+                        regs.rip -= 2
+                        self._setregs(tid, regs)
                     elif result is not None:
-                        regs = self._getregs()
+                        regs = self._getregs(tid)
                         regs.rax = result & 0xFFFFFFFFFFFFFFFF
-                        self._setregs(regs)
-                    nr, args = self._resume_to_syscall()
-                    self.replies.put(("syscall", nr, args))
+                        self._setregs(tid, regs)
+                    self._execd = False
+                    nr, args = self._resume_to_syscall(tid, inject)
+                    self.replies.put(("syscall", tid, nr, args,
+                                      self._execd))
+                elif cmd == "clone":
+                    tid, new_vid, kind = payload
+                    self._do_clone(tid, new_vid, kind)
                 elif cmd == "kill":
-                    if self.pid is not None and not self.exited.is_set():
+                    tids = payload[0]
+                    code = -1
+                    for t in tids:
+                        if t not in self.tracees:
+                            continue
                         try:
-                            os.kill(self.pid, signal.SIGKILL)
+                            os.kill(t, signal.SIGKILL)
                         except ProcessLookupError:
                             pass
+                        # the tracee may be sitting in ANY ptrace stop
+                        # (incl. EVENT_EXIT): service stops until the
+                        # kill lands — a blocked waitpid on a stopped
+                        # tracee would wedge the whole tracer
                         try:
                             while True:
-                                self._wait()
-                        except _TraceeExited as e:
-                            self.exited.set()
-                            self.replies.put(("exit", e.code))
-                            continue
-                    self.replies.put(("exit", -1))
+                                try:
+                                    k, v = self._wait(t)
+                                    if k == "event":
+                                        self._on_event(t, v)
+                                    else:
+                                        _ptrace(CONT, t, None,
+                                                ctypes.c_void_p(
+                                                    signal.SIGKILL))
+                                except OSError:
+                                    break
+                        except (_TraceeExited, ChildProcessError) as e:
+                            if isinstance(e, _TraceeExited) \
+                                    and e.tid == t:
+                                code = e.code
+                        self.tracees.discard(t)
+                        self.group.pop(t, None)
+                    if self.pid not in self.tracees:
+                        self.exited.set()
+                    self.replies.put(("killed", code))
                 elif cmd == "quit":
                     return
             except _TraceeExited as e:
-                self.exited.set()
-                self.replies.put(("exit", e.code))
+                self.tracees.discard(e.tid)
+                self.group.pop(e.tid, None)
+                # an exit_group (or fatal signal) may have taken
+                # siblings down with it: reap whatever else is dead
+                self._drain_dead()
+                if not self.tracees:
+                    self.exited.set()
+                self.replies.put(("dead", e.tid, e.code))
             except OSError as e:
-                self.exited.set()
-                self.replies.put(("error", str(e)))
+                self.replies.put(("error", f"tid={tid}: {e}"))
+
+    def _drain_dead(self) -> None:
+        for t in list(self.tracees):
+            try:
+                r, status = os.waitpid(t, WALL | os.WNOHANG)
+            except ChildProcessError:
+                self.tracees.discard(t)
+                self.group.pop(t, None)
+                continue
+            if r == t and (os.WIFEXITED(status)
+                           or os.WIFSIGNALED(status)):
+                self.tracees.discard(t)
+                self.group.pop(t, None)
 
 
 class PtraceProcess(ManagedProcess):
     """A real executable driven by PTRACE_SYSEMU instead of the
     preload shim (same app interface, same SyscallHandler)."""
 
-    supports_threads = False       # SYSEMU multi-tracee: roadmap
-    supports_fork = False          # fork needs the preload channel
-    supports_signals = False       # IPC_SIGNAL needs the preload shim
+    supports_threads = True        # TRACECLONE multi-tracee SYSEMU
+    supports_fork = True           # TRACEFORK (shared tracer thread)
+    supports_signals = True        # kernel injection at boundaries
+    supports_exec = True           # native execve under TRACEEXEC
+    signal_style = "inject"        # vs the preload backend's "ipc"
+    interpose_style = "ptrace"
 
     def __init__(self, runtime, path: str, args, environment: str = ""):
         super().__init__(runtime, path, args, environment)
         self.tracer: Optional[_Tracer] = None
-        self._pending: Optional[tuple] = None   # (result, native)
         self._native_pid: Optional[int] = None
 
     @property
@@ -481,29 +749,226 @@ class PtraceProcess(ManagedProcess):
         self.maps.refresh()
         self._native_pid = pid
         self.alive = True
-        # single pseudo-thread: park/resume and per-syscall state flow
-        # through the same thread objects as the preload backend
-        from shadow_tpu.host.process import ManagedThread
         main = ManagedThread(self, self.vpid, None)
+        main.native_tid = pid
+        main._pt_pending = (None, False, False)
+        main._pt_inject = 0
         self.threads = {self.vpid: main}
         self.current = main
-        self._pending = (None, False)
         log.debug("ptrace-spawned %s pid=%d vpid=%d on %s", self.path,
                   pid, self.vpid, self.host.name)
-        self._continue(ctx)
+        self._continue(ctx, main)
+
+    # -- managed threads (TRACECLONE flavor of spawn_thread) ------------
+    def spawn_thread(self, ctx, flags: int, args):
+        vtid = self.runtime.next_vpid()
+        cur = self.current
+        self.tracer.cmds.put(("clone",
+                              (cur.native_tid, vtid, "thread")))
+        try:
+            reply = self.tracer.replies.get(
+                timeout=RECV_TIMEOUT_MS / 1000)
+        except queue.Empty:
+            raise RuntimeError("tracer unresponsive during clone")
+        if reply[0] == "clone_fail":
+            return reply[1]
+        if reply[0] == "dead":
+            # the tracee died mid-clone (fatal signal during the
+            # native re-execution): surface the real exit
+            if self.exit_code is None:
+                self.exit_code = reply[2]
+            self._finalize_exit(ctx)
+            return APPLIED          # process gone; nothing to apply
+        if reply[0] != "cloned":
+            log.warning("clone under ptrace failed: %s", reply)
+            return -11              # EAGAIN
+        th = ManagedThread(self, vtid, None)
+        th.native_tid = reply[1]
+        th._pt_pending = (None, False, False)
+        th._pt_inject = 0
+        th.sigmask = cur.sigmask     # clone inherits the mask
+        if flags & CLONE_CHILD_CLEARTID:
+            th.clear_ctid = args[3]
+        self.threads[vtid] = th
+        self._push_task(ctx.now,
+                        lambda ctx2, ev: self._start_child(ctx2, th))
+        log.debug("ptrace clone: vtid=%d tid=%d on %s", vtid,
+                  th.native_tid, self.host.name)
+        return APPLIED              # %rax already rewritten to vtid
+
+    def _start_child(self, ctx, th: ManagedThread) -> None:
+        """First scheduling of a cloned thread: SYSEMU it out of its
+        initial stop into app code (no IPC announcement to wait for)."""
+        if not self.alive or not th.alive:
+            return
+        self._continue(ctx, th)
+
+    # -- fork (TRACEFORK flavor of spawn_fork) --------------------------
+    def spawn_fork(self, ctx):
+        # a REAL constructor call (vs hand-copying __init__'s fields):
+        # allocates the child vpid and every base field; the clone
+        # below rewrites the parent's %rax to that vpid
+        child = PtraceProcess(self.runtime, self.path, self.args,
+                              self.environment)
+        cur = self.current
+        self.tracer.cmds.put(("clone",
+                              (cur.native_tid, child.vpid, "fork")))
+        try:
+            reply = self.tracer.replies.get(
+                timeout=RECV_TIMEOUT_MS / 1000)
+        except queue.Empty:
+            raise RuntimeError("tracer unresponsive during fork")
+        if reply[0] == "clone_fail":
+            return reply[1]
+        if reply[0] == "dead":
+            if self.exit_code is None:
+                self.exit_code = reply[2]
+            self._finalize_exit(ctx)
+            return APPLIED
+        if reply[0] != "cloned":
+            log.warning("fork under ptrace failed: %s", reply)
+            return -11
+        child_pid = reply[1]
+
+        # wire the already-running native child to the fresh object:
+        # fork semantics — own fd table (shared descriptions), copied
+        # dispositions, inherited mask, shared tracer thread
+        from shadow_tpu.host.memmap import ProcessMaps
+        from shadow_tpu.host.syscalls import SyscallHandler
+
+        child.host = self.host
+        child.manager = self.manager
+        child._native_pid = child_pid
+        child.mem = ProcessMemory(child_pid)
+        child.table = self.table.fork_clone()
+        child.handler = SyscallHandler(child)
+        child.alive = True
+        main = ManagedThread(child, child.vpid, None)
+        main.native_tid = child_pid
+        main._pt_pending = (None, False, False)
+        main._pt_inject = 0
+        main.sigmask = cur.sigmask
+        child.threads = {child.vpid: main}
+        child.current = main
+        child.parent_proc = self
+        child.maps = ProcessMaps(child_pid)
+        child.sigactions = dict(self.sigactions)
+        child.tracer = self.tracer      # SHARED tracer thread
+        self.children[child.vpid] = child
+        child._push_task(ctx.now,
+                         lambda c2, ev: child._start_forked_ptrace(c2))
+        log.debug("ptrace fork: vpid=%d -> child vpid=%d pid=%d on %s",
+                  self.vpid, child.vpid, child_pid, self.host.name)
+        return APPLIED              # parent %rax already = child vpid
+
+    def _start_forked_ptrace(self, ctx) -> None:
+        """First scheduling of a forked child: it resumes out of its
+        initial stop inside the fork return path (kernel already set
+        its %rax to 0)."""
+        main = self.current
+        if not self.alive or not main.alive:
+            return
+        self._continue(ctx, main)
+
+    # -- signal delivery (kernel injection) -----------------------------
+    def _next_inject(self, ctx, th: ManagedThread) -> Optional[int]:
+        """Dequeue pending virtual signals until one has a real
+        handler to inject; ignored signals are discarded, fatal
+        defaults kill the process (returns None then)."""
+        while self.alive and th.alive:
+            sig = self._dequeue_deliverable(th)
+            if sig is None:
+                return None
+            act = self.sigactions.get(sig)
+            handler = act[0] if act else self.SIG_DFL
+            if handler == self.SIG_IGN:
+                continue
+            if handler == self.SIG_DFL:
+                if sig in self._DEFAULT_IGNORE:
+                    continue
+                log.debug("vpid=%d: fatal signal %d (default action)",
+                          self.vpid, sig)
+                self.term_signal = sig
+                self.exit_code = 128 + sig
+                self._kill(ctx)
+                return None
+            return sig
+        return None
+
+    def _interrupt_parked(self, ctx, th: ManagedThread) -> None:
+        """A deliverable virtual signal interrupts a parked syscall:
+        poke -EINTR (or rewind for SA_RESTART) and resume with the
+        signal injected — the kernel builds the handler frame, the
+        handler runs (its syscalls trap normally), rt_sigreturn
+        restores, and the 'syscall' returns with our poked result (or
+        re-issues itself after the rewind — kernel restart order)."""
+        from shadow_tpu.host.syscalls import EINTR
+
+        nr, args = th.parked
+        th.parked = None
+        sig = self._next_inject(ctx, th)
+        if not self.alive or not th.alive:
+            return
+        if sig is None:
+            th.parked = (nr, args)      # nothing actually deliverable
+            return
+        if th.restore_mask is not None:
+            # sigsuspend epilogue: handler fires, original mask returns
+            th.sigmask = th.restore_mask
+            th.restore_mask = None
+        th.sigwait = None
+        act = self.sigactions.get(sig)
+        restartable = nr not in _NO_RESTART
+        if restartable and act is not None \
+                and act[1] & self.SA_RESTART:
+            th._pt_pending = (None, False, True)     # rewind+reissue
+        else:
+            th._pt_pending = (-EINTR, False, False)
+        th._pt_inject = sig
+        self.current = th
+        th.syscall_state = {}
+        self._continue(ctx, th)
 
     # -- transport ------------------------------------------------------
-    def _reply(self, res, nr: int, args) -> None:
+    def _reply_to(self, th: ManagedThread, res) -> None:
+        """Stage the result on the thread; the next step applies it.
+        (Also the target of generic machinery like _complete_sigwait.)"""
+        if th.restore_mask is not None:
+            # a p-variant wait's temporary mask ends with the call
+            th.sigmask = th.restore_mask
+            th.restore_mask = None
         if res is NATIVE:
-            self._pending = (None, True)
+            th._pt_pending = (None, True, False)
+        elif res is APPLIED:
+            th._pt_pending = (None, False, False)
         else:
-            self._pending = (int(res), False)
+            th._pt_pending = (int(res), False, False)
 
-    def _continue(self, ctx, th=None) -> None:
+    def _reply(self, res, nr: int, args) -> None:
+        self._reply_to(self.current, res)
+
+    def _continue(self, ctx, th: Optional[ManagedThread] = None) -> None:
         while True:
-            result, native = self._pending or (None, False)
-            self._pending = None
-            self.tracer.cmds.put(("step", (result, native, ctx.now)))
+            if th is None:
+                th = self.current
+            pend = th._pt_pending or (None, False, False)
+            th._pt_pending = None
+            inject = th._pt_inject or 0
+            th._pt_inject = 0
+            # boundary delivery: pending virtual signals with real
+            # handlers ride the resume as a kernel injection (one per
+            # boundary; the rest follow at the handler's syscalls)
+            if not inject and th.alive and self.alive \
+                    and self._has_deliverable(th):
+                s = self._next_inject(ctx, th)
+                if not self.alive:
+                    return
+                if s:
+                    inject = s
+            result, native, rewind = pend
+            self.tracer.cmds.put(("step",
+                                  (th.native_tid, result, native,
+                                   rewind, inject, ctx.now)))
             try:
                 reply = self.tracer.replies.get(
                     timeout=RECV_TIMEOUT_MS / 1000)
@@ -514,63 +979,143 @@ class PtraceProcess(ManagedProcess):
                 self._kill(ctx)
                 return
             kind = reply[0]
-            if kind == "exit":
-                self.tracer.exited.set()
-                if self.exit_code is None:
-                    self.exit_code = reply[1]
-                self._finalize_exit(ctx)
+            if kind == "dead":
+                _, tid, code = reply
+                if self.exiting or \
+                        not any(t.alive for t in self.threads.values()
+                                if t is not th):
+                    if self.exit_code is None:
+                        self.exit_code = code
+                    self._finalize_exit(ctx)
+                    return
+                # a non-last thread died: CLEARTID + joiner wakeups
+                # (the kernel confirmed death — no guard wait needed)
+                th.alive = False
+                self._finish_ptrace_thread_exit(ctx, th)
                 return
             if kind == "error":
                 log.warning("tracer error on %s: %s", self.path,
                             reply[1])
                 self._kill(ctx)
                 return
-            _, nr, args = reply
+            _, tid, nr, args, execd = reply
+            if execd:
+                self._complete_exec_ptrace(ctx, th)
+            elif getattr(self, "exec_pending", None) is not None:
+                # a normal syscall after an approved execve means the
+                # native exec failed — the old image lives on
+                self.exec_pending = None
             name = NR_NAME.get(nr, str(nr))
             self.syscall_counts[name] = \
                 self.syscall_counts.get(name, 0) + 1
+            self.current = th
             try:
                 res = self.handler.dispatch(ctx, nr, args)
             except Blocked as b:
-                self._pending = (None, False)
+                th._pt_pending = (None, False, False)
                 self._park(ctx, b, nr, args)
                 return
             except Exception:
                 log.exception("syscall %s(%s) handler crashed", name,
                               args)
                 res = -38
+            if not self.alive:
+                # the handler finalized us (e.g. death mid-clone)
+                return
             self._reply(res, nr, args)
-            self.syscall_state = {}
+            th.syscall_state = {}
+            # an exiting thread's NATIVE exit executes on the next
+            # loop turn and comes back as ("dead", ...)
 
-    # (_resume_task is inherited: the parent's park/resume logic calls
+    # (_resume_thread is inherited: the base park/resume logic calls
     # our _reply/_continue overrides.)
+
+    def _finish_ptrace_thread_exit(self, ctx,
+                                   th: ManagedThread) -> None:
+        """The kernel cleared the native CLEARTID word and futex-woke
+        it for real at thread death; mirror both into the EMULATED
+        futex table so virtual pthread_join'ers wake."""
+        if th.clear_ctid:
+            try:
+                self.mem.write(th.clear_ctid, struct.pack("<I", 0))
+            except OSError:
+                pass
+            fx = self.futexes.get(th.clear_ctid)
+            if fx is not None:
+                fx.wake(ctx, 1 << 30)
+
+    def _complete_exec_ptrace(self, ctx, th: ManagedThread) -> None:
+        """A native execve succeeded (EVENT_EXEC seen): apply the
+        kernel's exec semantics to the virtual state — sibling threads
+        are gone, close-on-exec descriptors close, caught dispositions
+        reset (ignored ones stay) — and refresh the maps snapshot.
+        The tracer already re-patched the new image's vDSO."""
+        new_path = getattr(self, "exec_pending", None)
+        if new_path is not None:
+            log.debug("vpid=%d: execve -> %s (ptrace)", self.vpid,
+                      new_path)
+            self.exec_path = new_path
+        self.exec_pending = None
+        for t in list(self.threads.values()):
+            if t is not th:
+                t.alive = False       # the kernel killed them on exec
+        self.threads = {th.vtid: t for t in (th,)}
+        self.current = th
+        th.parked = None
+        th.syscall_state = {}
+        th.sigwait = None
+        th.restore_mask = None
+        for fd in sorted(self.table.cloexec):
+            self.table.close_fd(ctx, fd)
+        self.sigactions = {
+            sig: act for sig, act in self.sigactions.items()
+            if act[0] == self.SIG_IGN}
+        if self.maps is not None:
+            self.maps.dirty = True
 
     # -- teardown -------------------------------------------------------
     def _finalize_exit(self, ctx) -> None:
         if not self.alive:
             return
         self.alive = False
+        for t in self.threads.values():
+            t.alive = False
         log.debug("%s on %s exited code=%s (%d syscalls, ptrace)",
                   self.path, self.host.name, self.exit_code,
                   sum(self.syscall_counts.values()))
         if self.table is not None:
             self.table.close_all(ctx)
-        if self.tracer is not None:
-            self.tracer.cmds.put(("quit", None))
+        for child in list(self.children.values()):
+            if child.alive:
+                child._kill(ctx)
+        if self.term_signal is not None:
+            self.wstatus = self.term_signal & 0x7F
+        else:
+            self.wstatus = ((self.exit_code or 0) & 0xFF) << 8
+        if self.parent_proc is not None and self.parent_proc.alive:
+            self.parent_proc.child_exited(ctx, self)
+        if self.parent_proc is None and self.tracer is not None:
+            # the root process owns the tracer thread's lifetime
+            if not any(c.alive for c in self.children.values()):
+                self.tracer.cmds.put(("quit", None))
 
     def _kill(self, ctx) -> None:
         if not self.alive or self.tracer is None:
             return
+        tids = [t.native_tid for t in self.threads.values()
+                if getattr(t, "native_tid", None) is not None]
         # kill(2) is not a ptrace request: send it directly so a tracee
         # spinning in userspace (tracer blocked in waitpid) still dies.
-        try:
-            os.kill(self._native_pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-        self.tracer.cmds.put(("kill", None))
+        for t in tids:
+            try:
+                os.kill(t, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        self.tracer.cmds.put(("kill", (tids,)))
         try:
             reply = self.tracer.replies.get(timeout=10)
-            if self.exit_code is None and reply[0] == "exit":
+            if self.exit_code is None and reply[0] == "killed" \
+                    and reply[1] >= 0:
                 self.exit_code = reply[1]
         except queue.Empty:
             pass

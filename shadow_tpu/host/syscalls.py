@@ -115,6 +115,9 @@ EFD_SEMAPHORE, EFD_NONBLOCK = 1, 0x800
 UDP_MAX_PAYLOAD = simtime.CONFIG_MTU - simtime.CONFIG_HEADER_SIZE_UDPIPETH
 
 NATIVE = object()          # sentinel: shim executes the syscall for real
+APPLIED = object()         # sentinel: result already poked into %rax
+#                            (ptrace clone/fork rewrite it at the exit
+#                            stop); the backend resumes with no reply
 
 
 class CloneGo:
@@ -339,17 +342,24 @@ class SyscallHandler:
 
     def sys_clone(self, ctx, a):
         """Managed thread creation (clone.c:30: CLONE_THREAD-style
-        clones only; anything else is refused). The heavy lifting —
-        child IPC channel, scheduling, the shim's two-stack native
-        clone — lives in ManagedProcess.spawn_thread."""
+        clones; fork-style clones — no CLONE_THREAD, e.g. glibc's
+        fork() — route to the fork path under ptrace, where no shim
+        pre-normalizes them). The heavy lifting lives in the backend's
+        spawn_thread/spawn_fork."""
         flags = int(a[0])
+        if not flags & self.CLONE_THREAD:
+            # fork-style clone: only reaches us under ptrace (the
+            # preload shim rewrites these to SYS_fork client-side)
+            if getattr(self.p, "interpose_style", "") == "ptrace":
+                return self.sys_fork(ctx, a)
+            return -EOPNOTSUPP
         required = (self.CLONE_VM | self.CLONE_FS | self.CLONE_FILES |
                     self.CLONE_SIGHAND | self.CLONE_THREAD |
                     self.CLONE_SYSVSEM | self.CLONE_SETTLS)
         if (flags & required) != required:
             return -EOPNOTSUPP
         if not getattr(self.p, "supports_threads", False):
-            return -ENOSYS      # ptrace backend: threads on roadmap
+            return -ENOSYS
         return self.p.spawn_thread(ctx, flags, a)
 
     def sys_clone3(self, ctx, a):
@@ -445,10 +455,26 @@ class SyscallHandler:
         SIGSEGV for TSC emulation and chains app handlers itself;
         SIGSYS is load-bearing and silently ignored."""
         if not getattr(self.p, "supports_signals", False):
-            return NATIVE       # ptrace backend: kernel semantics
+            return NATIVE       # backend without signal support
         signum, act_ptr, old_ptr = _s32(a[0]), a[1], a[2]
         SIGKILL, SIGSTOP, SIGSYS = 9, 19, 31
         SIGSEGV = 11
+        if getattr(self.p, "signal_style", "ipc") == "inject":
+            # ptrace backend: record the disposition virtually (it
+            # gates delivery decisions) AND install it natively — an
+            # injected signal runs the kernel-built handler frame.
+            # The tracer consumes TSC SIGSEGVs before delivery, so
+            # even SEGV handlers are safe to keep native.
+            if signum in (SIGKILL, SIGSTOP) and act_ptr:
+                return -EINVAL
+            if signum < 1 or signum > 64:
+                return -EINVAL
+            if act_ptr:
+                handler, flags, restorer, mask = struct.unpack(
+                    "<QQQQ", self.mem.read(act_ptr, 32))
+                self.p.sigactions[signum] = (handler, flags,
+                                             restorer, mask)
+            return NATIVE       # kernel installs + fills oldact
         HW_NATIVE = (4, 7, 8)   # ILL, BUS, FPE: shim doesn't own these
         if signum in HW_NATIVE:
             return NATIVE
@@ -523,6 +549,11 @@ class SyscallHandler:
             return NATIVE
         how, set_ptr, size = _s32(a[0]), a[1], a[3]
         th = self.p.current
+        if getattr(self.p, "signal_style", "ipc") == "inject" \
+                and a[2] and size >= 8:
+            # no shim wrote the old set natively (the ptrace kernel
+            # mask is untouched) — report the VIRTUAL mask
+            self.mem.write(a[2], struct.pack("<Q", th.sigmask))
         if set_ptr and size >= 8:
             s = struct.unpack("<Q", self.mem.read(set_ptr, 8))[0]
             s &= ~self._UNBLOCKABLE
@@ -630,14 +661,31 @@ class SyscallHandler:
         containing the SHADOWTPU_* variables (i.e. its own environ) —
         a clean envp would produce an unmanaged image, so it is
         refused."""
-        if not getattr(self.p, "supports_fork", False):
-            return -ENOSYS          # ptrace backend: not yet wired
         if self.p.current is not self.p.threads.get(self.p.vpid):
-            # exec from a secondary thread would announce on the main
-            # channel while the simulator listens on the caller's —
-            # refuse rather than stall-kill (documented limitation)
+            # exec from a secondary thread: the kernel kills siblings
+            # and the exec'ing thread TAKES OVER the leader's tid —
+            # tid bookkeeping neither backend models; refuse loudly
+            # (preload: wrong announce channel; ptrace: stale
+            # native_tid would ESRCH the tracer)
             log.warning("execve from a non-main thread is not "
-                        "supported under the preload backend")
+                        "supported")
+            return -ENOSYS
+        if getattr(self.p, "interpose_style", "") == "ptrace":
+            # no shim to re-announce: let the kernel exec run native;
+            # the tracer sees PTRACE_EVENT_EXEC, re-patches the new
+            # image's vDSO, and flags the step reply so the process
+            # layer applies exec bookkeeping (_complete_exec_ptrace)
+            path_ptr = a[0]
+            if not path_ptr:
+                return -EFAULT
+            try:
+                xpath = self.mem.read_cstr(path_ptr).decode(
+                    errors="replace")
+            except OSError:
+                return -EFAULT
+            self.p.exec_pending = xpath
+            return NATIVE
+        if not getattr(self.p, "supports_fork", False):
             return -ENOSYS
         path_ptr, envp_ptr = a[0], a[2]
         if not path_ptr:

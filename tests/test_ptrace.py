@@ -164,3 +164,112 @@ def test_preload_vs_ptrace_equivalence(plugins, tmp_path):
         outs[method] = (read_stdout(data, "client", "udp_ping"),
                         read_stdout(data, "server", "udp_echo"))
     assert outs["preload"] == outs["ptrace"]
+
+
+def test_pthreads_under_ptrace(plugins, tmp_path):
+    """TRACECLONE multi-tracee threads: virtual tids in creation
+    order, per-thread simulated sleeps, futex-backed join — the same
+    assertions as the preload backend's test (ref thread_ptrace.c
+    drives multithreaded tracees, :36-56)."""
+    data = str(tmp_path / "shadow.data")
+    cfg = ptrace_cfg(data) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['threads_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    out = read_stdout(data, "alice", "threads_check")
+    lines = out.splitlines()
+    assert lines[0] == "main tid==pid: 1"
+    assert "thread 0 dtid=1 slept=10ms counter=1" in lines
+    assert "thread 1 dtid=2 slept=20ms counter=2" in lines
+    assert "thread 2 dtid=3 slept=30ms counter=3" in lines
+    assert "joined 0 ret=1" in lines
+    assert "joined 2 ret=3" in lines
+    assert lines[-1] == "all joined: counter=3 elapsed_ms=30"
+    assert stats.ok
+
+
+def test_pthreads_deterministic_under_ptrace(plugins, tmp_path):
+    outs = []
+    for run in range(2):
+        data = str(tmp_path / f"r{run}" / "shadow.data")
+        cfg = ptrace_cfg(data) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['threads_check']}
+      start_time: 1s
+"""
+        run_sim(cfg, tmp_path / f"r{run}")
+        outs.append(read_stdout(data, "alice", "threads_check"))
+    assert outs[0] == outs[1]
+
+
+def test_signals_under_ptrace(plugins, tmp_path):
+    """Kernel-injected virtual signals + TRACEFORK children: the same
+    assertions as the preload backend's signal test — self-kill runs
+    the handler (with its own trapped syscall) before kill returns, a
+    forked child's SIGUSR2 EINTRs the parent's nanosleep at the exact
+    simulated instant, SIGKILL'd children report WIFSIGNALED."""
+    data = str(tmp_path / "shadow.data")
+    cfg = ptrace_cfg(data) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['signal_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    out = read_stdout(data, "alice", "signal_check").splitlines()
+    assert out[0] == "self got1 10 handler_syscall_ok 1"
+    assert out[1] == "ignored ok"
+    assert out[2] == "eintr 1 errno_ok 1 got2 13 t_ms 150"
+    assert out[3] == "sigkill ok 1 signaled 1 sig 9 t_ms 50"
+    assert out[4] == "done"
+    assert stats.ok
+
+
+def test_sigmask_under_ptrace(plugins, tmp_path):
+    """Blocked-signal contract under ptrace injection: pending-while-
+    blocked, sigsuspend's atomic swap, sigtimedwait's synchronous
+    consumption, temp p-masks, and thread-directed tgkill."""
+    data = str(tmp_path / "shadow.data")
+    cfg = ptrace_cfg(data) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['sigmask_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    out = read_stdout(data, "alice", "sigmask_check").splitlines()
+    assert out[0] == "blocked 1 pending 1 after_unblock 1"
+    assert out[1] == "sigsuspend 1 errno_ok 1 got2 1 mask_restored 1"
+    assert out[2] == "sigtimedwait 1 si_signo 15 handler_ran 0 t_ms 100"
+    assert out[3] == "reaper 1 instant 1"
+    assert out[4] == "timeout 1 errno_ok 1 t_ms 250"
+    assert out[5] == "ppoll_eintr 1 got1 1 t_ms 80 mask_back 1"
+    assert out[6] == "directed held 1 delivered 1"
+    assert out[7] == "main_held 1"
+    assert out[8] == "done"
+    assert stats.ok
+
+
+def test_fork_under_ptrace(plugins, tmp_path):
+    """TRACEFORK: COW fork with virtual pids, wait4 reaping, pipes
+    across the fork — same assertions as the preload fork test."""
+    data = str(tmp_path / "shadow.data")
+    cfg = ptrace_cfg(data) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['fork_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    out = read_stdout(data, "alice", "fork_check")
+    assert "echild 1" in out
+    assert stats.ok
