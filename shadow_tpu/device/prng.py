@@ -71,7 +71,14 @@ def uniform01(key):
 def chain_key(seed_pair, purpose, ids, seqs):
     """fold(fold(fold(seed, purpose), id), seq) — vectorized over
     ids/seqs arrays (matches utils.rng.packet_key / nprng.packet_uniform:
-    each fold_in(k, d) is threefry(k, (0, uint32(d)))."""
+    each fold_in(k, d) is threefry(k, (0, uint32(d))).
+
+    The optimization_barriers between folds are value-identity: three
+    chained threefrys (~150 add/xor/rotate ops) send XLA's algebraic
+    simplifier into a canonicalization loop ("stuck in a circular
+    simplification loop", 50-run bailout on every compile); breaking
+    the expression at the fold boundaries stops the churn. Two-deep
+    chains don't trigger it, so one barrier pair suffices."""
     ids = jnp.asarray(ids).astype(jnp.uint32)
     seqs = jnp.asarray(seqs).astype(jnp.uint32)
     shape = jnp.broadcast_shapes(ids.shape, seqs.shape)
@@ -81,6 +88,8 @@ def chain_key(seed_pair, purpose, ids, seqs):
     k1 = jnp.broadcast_to(seed_pair[0], shape)
     k2 = jnp.broadcast_to(seed_pair[1], shape)
     k = threefry2x32(k1, k2, zero, jnp.full(shape, purpose, jnp.uint32))
+    k = jax.lax.optimization_barrier(k)
     k = threefry2x32(k[0], k[1], zero, ids)
+    k = jax.lax.optimization_barrier(k)
     k = threefry2x32(k[0], k[1], zero, seqs)
     return k

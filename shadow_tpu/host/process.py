@@ -171,6 +171,7 @@ class ManagedProcess:
     supports_threads = True        # preload backend handles clone
     supports_fork = True           # IPC fork handshake (spawn_fork)
     supports_signals = True        # IPC_SIGNAL handler injection
+    supports_exec = True           # IPC_EXEC_DONE re-announce
 
     def __init__(self, runtime: ManagedRuntime, path: str, args,
                  environment: str = ""):
@@ -656,27 +657,20 @@ class ManagedProcess:
         return any(not th.sigmask & (1 << (s - 1))
                    for s in th.pending + self.pending_signals)
 
-    def _complete_exec(self, ctx, th: "ManagedThread") -> None:
-        """The post-execve image announced itself (IPC_EXEC_DONE):
-        finish the kernel's exec semantics — sibling threads are gone,
-        close-on-exec descriptors close, caught signal dispositions
-        reset to default (ignored ones stay ignored, masks and pending
-        signals survive) — then release the new image into app code.
+    def _apply_exec_rules(self, ctx, th: "ManagedThread") -> None:
+        """The kernel's exec semantics, shared by both backends:
+        sibling threads are gone, close-on-exec descriptors close,
+        caught signal dispositions reset to default (ignored ones stay
+        ignored, masks and pending signals survive).
         Ref: the exec handling of process.c + kernel exec.c rules."""
-        new_path = getattr(self, "exec_pending", None)
-        if new_path is None:
-            log.warning("vpid=%d: unexpected IPC_EXEC_DONE", self.vpid)
-        else:
-            log.debug("vpid=%d: execve -> %s", self.vpid, new_path)
-            self.exec_path = new_path
-        self.exec_pending = None
         for t in list(self.threads.values()):
             if t is not th:
                 t.alive = False     # the kernel killed them on exec
                 # their stacks/futexes lived in the REPLACED address
                 # space — no CLEARTID writes; just unblock any
                 # simulator-side wait on their channels
-                t.channel.mark_plugin_exited()
+                if t.channel is not None:
+                    t.channel.mark_plugin_exited()
         self.threads = {th.vtid: th}
         self.current = th
         th.parked = None
@@ -688,6 +682,19 @@ class ManagedProcess:
         self.sigactions = {
             sig: act for sig, act in self.sigactions.items()
             if act[0] == self.SIG_IGN}
+
+    def _complete_exec(self, ctx, th: "ManagedThread") -> None:
+        """The post-execve image announced itself (IPC_EXEC_DONE):
+        apply the exec rules, then release the new image into app
+        code."""
+        new_path = getattr(self, "exec_pending", None)
+        if new_path is None:
+            log.warning("vpid=%d: unexpected IPC_EXEC_DONE", self.vpid)
+        else:
+            log.debug("vpid=%d: execve -> %s", self.vpid, new_path)
+            self.exec_path = new_path
+        self.exec_pending = None
+        self._apply_exec_rules(ctx, th)
         self._reply_to(th, 0)
 
     def _complete_sigwait(self, ctx, th: "ManagedThread",

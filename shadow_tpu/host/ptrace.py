@@ -210,7 +210,6 @@ class _Tracer(threading.Thread):
         self.pid: Optional[int] = None
         self.tracees: set[int] = set()
         self.group: dict[int, int] = {}     # tid -> its leader pid
-        self.exited = threading.Event()
         self.sim_ns = 0
         self._execd = False
 
@@ -551,7 +550,19 @@ class _Tracer(threading.Thread):
         flags = int(entry.rdi) if nr == NR["clone"] else 0
         ptid = int(entry.rdx) if nr == NR["clone"] else 0
         ctid = int(entry.r10) if nr == NR["clone"] else 0
-        entry.rax = NR_FORK if nr == NR["vfork"] else entry.orig_rax
+        if kind == "fork":
+            # EVERY fork-style creation is re-issued as a plain COW
+            # fork: vfork and CLONE_VFORK/CLONE_VM clones (glibc
+            # posix_spawn/system) would block the parent until the
+            # child execs — but the child is held at its auto-attach
+            # stop, deadlocking the tracer; and a shared-VM "fork"
+            # would corrupt the COW child the simulator models. The
+            # (SET/CLEAR)TID effects glibc expects are applied below
+            # from the ORIGINAL flags. Same degradation as the
+            # preload shim's fork normalization.
+            entry.rax = NR_FORK
+        else:
+            entry.rax = entry.orig_rax
         entry.rip -= 2
         self._setregs(tid, entry)
 
@@ -670,8 +681,6 @@ class _Tracer(threading.Thread):
                                 code = e.code
                         self.tracees.discard(t)
                         self.group.pop(t, None)
-                    if self.pid not in self.tracees:
-                        self.exited.set()
                     self.replies.put(("killed", code))
                 elif cmd == "quit":
                     return
@@ -681,8 +690,6 @@ class _Tracer(threading.Thread):
                 # an exit_group (or fatal signal) may have taken
                 # siblings down with it: reap whatever else is dead
                 self._drain_dead()
-                if not self.tracees:
-                    self.exited.set()
                 self.replies.put(("dead", e.tid, e.code))
             except OSError as e:
                 self.replies.put(("error", f"tid={tid}: {e}"))
@@ -981,16 +988,22 @@ class PtraceProcess(ManagedProcess):
             kind = reply[0]
             if kind == "dead":
                 _, tid, code = reply
-                if self.exiting or \
-                        not any(t.alive for t in self.threads.values()
-                                if t is not th):
+                # an UNEXPECTED death (th still marked alive — no
+                # sys_exit preceded it) is a fatal signal: the kernel
+                # killed the WHOLE thread group, not one thread
+                group_died = th.alive or self.exiting or \
+                    not any(t.alive for t in self.threads.values()
+                            if t is not th)
+                if group_died:
                     if self.exit_code is None:
                         self.exit_code = code
+                    if th.alive and code > 128:
+                        self.term_signal = code - 128
                     self._finalize_exit(ctx)
                     return
-                # a non-last thread died: CLEARTID + joiner wakeups
-                # (the kernel confirmed death — no guard wait needed)
-                th.alive = False
+                # a non-last thread's voluntary exit: CLEARTID +
+                # joiner wakeups (kernel confirmed death — no guard
+                # wait needed)
                 self._finish_ptrace_thread_exit(ctx, th)
                 return
             if kind == "error":
@@ -1046,30 +1059,15 @@ class PtraceProcess(ManagedProcess):
 
     def _complete_exec_ptrace(self, ctx, th: ManagedThread) -> None:
         """A native execve succeeded (EVENT_EXEC seen): apply the
-        kernel's exec semantics to the virtual state — sibling threads
-        are gone, close-on-exec descriptors close, caught dispositions
-        reset (ignored ones stay) — and refresh the maps snapshot.
-        The tracer already re-patched the new image's vDSO."""
+        shared exec rules and refresh the maps snapshot. The tracer
+        already re-patched the new image's vDSO."""
         new_path = getattr(self, "exec_pending", None)
         if new_path is not None:
             log.debug("vpid=%d: execve -> %s (ptrace)", self.vpid,
                       new_path)
             self.exec_path = new_path
         self.exec_pending = None
-        for t in list(self.threads.values()):
-            if t is not th:
-                t.alive = False       # the kernel killed them on exec
-        self.threads = {th.vtid: t for t in (th,)}
-        self.current = th
-        th.parked = None
-        th.syscall_state = {}
-        th.sigwait = None
-        th.restore_mask = None
-        for fd in sorted(self.table.cloexec):
-            self.table.close_fd(ctx, fd)
-        self.sigactions = {
-            sig: act for sig, act in self.sigactions.items()
-            if act[0] == self.SIG_IGN}
+        self._apply_exec_rules(ctx, th)
         if self.maps is not None:
             self.maps.dirty = True
 
@@ -1094,9 +1092,19 @@ class PtraceProcess(ManagedProcess):
             self.wstatus = ((self.exit_code or 0) & 0xFF) << 8
         if self.parent_proc is not None and self.parent_proc.alive:
             self.parent_proc.child_exited(ctx, self)
-        if self.parent_proc is None and self.tracer is not None:
-            # the root process owns the tracer thread's lifetime
-            if not any(c.alive for c in self.children.values()):
+        # the LAST live process of the tracer's process TREE retires
+        # the tracer thread (the root may well exit before a forked
+        # child — the daemonize pattern)
+        if self.tracer is not None:
+            root = self
+            while root.parent_proc is not None:
+                root = root.parent_proc
+            stack, any_alive = [root], False
+            while stack and not any_alive:
+                p = stack.pop()
+                any_alive = p.alive
+                stack.extend(p.children.values())
+            if not any_alive:
                 self.tracer.cmds.put(("quit", None))
 
     def _kill(self, ctx) -> None:
@@ -1112,11 +1120,17 @@ class PtraceProcess(ManagedProcess):
             except (ProcessLookupError, PermissionError):
                 pass
         self.tracer.cmds.put(("kill", (tids,)))
+        # drain until the kill's own ack: an aborted in-flight step
+        # may have queued a stale ("dead"/"error") reply first, and
+        # leaving the ("killed") behind would desync every process
+        # sharing this tracer (the next step would unpack a 2-tuple)
         try:
-            reply = self.tracer.replies.get(timeout=10)
-            if self.exit_code is None and reply[0] == "killed" \
-                    and reply[1] >= 0:
-                self.exit_code = reply[1]
+            for _ in range(8):
+                reply = self.tracer.replies.get(timeout=10)
+                if reply[0] == "killed":
+                    if self.exit_code is None and reply[1] >= 0:
+                        self.exit_code = reply[1]
+                    break
         except queue.Empty:
             pass
         self._finalize_exit(ctx)

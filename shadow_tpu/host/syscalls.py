@@ -661,6 +661,8 @@ class SyscallHandler:
         containing the SHADOWTPU_* variables (i.e. its own environ) —
         a clean envp would produce an unmanaged image, so it is
         refused."""
+        if not getattr(self.p, "supports_exec", False):
+            return -ENOSYS
         if self.p.current is not self.p.threads.get(self.p.vpid):
             # exec from a secondary thread: the kernel kills siblings
             # and the exec'ing thread TAKES OVER the leader's tid —
