@@ -31,6 +31,7 @@
  */
 
 #define _GNU_SOURCE
+#include <dlfcn.h>
 #include <errno.h>
 #include <ifaddrs.h>
 #include <stdarg.h>
@@ -860,6 +861,13 @@ static const int kTrapSyscalls[] = {
      * (the stale filter would kill the new image). Thread CLEARTID
      * words are captured from clone flags instead; the ptrace
      * backend still sees it (every syscall stops there). */
+    /* NOT trapped: open/openat — the dynamic loader of a POST-EXECVE
+     * image issues them before its shim constructor can install a
+     * SIGSYS handler, and the stale stacked filter would force-kill
+     * the new image (same startup window as clock_gettime above).
+     * The special paths the simulator must own (/dev/urandom, the
+     * simulated /etc/hosts) are caught by the open/openat/fopen
+     * SYMBOL overrides below via the explicit funnel instead. */
     SYS_gettid,       SYS_tgkill,
     SYS_rt_sigprocmask, SYS_wait4,      SYS_kill,
     SYS_rt_sigaction, SYS_pause,       SYS_rt_sigpending,
@@ -1058,6 +1066,107 @@ ssize_t getrandom(void *buf, size_t buflen, unsigned int flags) {
     return -1;
   }
   return (ssize_t)r;
+}
+
+/* ---- special-path file opens --------------------------------------- */
+/* The simulator owns these files' CONTENT: the RNG devices must serve
+ * the host's seeded deterministic stream (native reads are real
+ * randomness), and /etc/hosts must be the SIMULATED name map. Routed
+ * through the explicit funnel at the SYMBOL level — trapping
+ * open/openat in seccomp would kill post-execve images in the loader
+ * startup window (see kTrapSyscalls). Raw-syscall opens of exactly
+ * these paths bypass virtualization (documented, like raw
+ * clock_gettime). */
+static int shim_special_path(const char *p) {
+  if (!p)
+    return 0;
+  return strcmp(p, "/dev/urandom") == 0 ||
+         strcmp(p, "/dev/random") == 0 || strcmp(p, "/etc/hosts") == 0 ||
+         strcmp(p, "/etc/resolv.conf") == 0 ||
+         strcmp(p, "/etc/nsswitch.conf") == 0;
+}
+
+static int shim_openat_impl(int dirfd, const char *path, int flags,
+                            mode_t mode) {
+  if (g_enabled && shim_special_path(path)) {
+    long args[6] = {dirfd, (long)path, flags, (long)mode, 0, 0};
+    return ret_errno(shim_emulated_syscall(SYS_openat, args));
+  }
+  return ret_errno(shim_rawsyscall(SYS_openat, dirfd, (long)path,
+                                   flags, (long)mode, 0, 0));
+}
+
+int open(const char *path, int flags, ...) {
+  mode_t mode = 0;
+  if (flags & (O_CREAT | O_TMPFILE)) {
+    va_list ap;
+    va_start(ap, flags);
+    mode = va_arg(ap, mode_t);
+    va_end(ap);
+  }
+  return shim_openat_impl(AT_FDCWD, path, flags, mode);
+}
+
+int open64(const char *path, int flags, ...) {
+  mode_t mode = 0;
+  if (flags & (O_CREAT | O_TMPFILE)) {
+    va_list ap;
+    va_start(ap, flags);
+    mode = va_arg(ap, mode_t);
+    va_end(ap);
+  }
+  return shim_openat_impl(AT_FDCWD, path, flags, mode);
+}
+
+int openat(int dirfd, const char *path, int flags, ...) {
+  mode_t mode = 0;
+  if (flags & (O_CREAT | O_TMPFILE)) {
+    va_list ap;
+    va_start(ap, flags);
+    mode = va_arg(ap, mode_t);
+    va_end(ap);
+  }
+  return shim_openat_impl(dirfd, path, flags, mode);
+}
+
+int openat64(int dirfd, const char *path, int flags, ...) {
+  mode_t mode = 0;
+  if (flags & (O_CREAT | O_TMPFILE)) {
+    va_list ap;
+    va_start(ap, flags);
+    mode = va_arg(ap, mode_t);
+    va_end(ap);
+  }
+  return shim_openat_impl(dirfd, path, flags, mode);
+}
+
+/* fopen reaches the kernel via glibc-internal open (no PLT), so the
+ * special paths are caught at the stream level and re-wrapped around
+ * the virtual fd (fd-gated seccomp serves its read/fstat/seek). */
+FILE *fopen(const char *path, const char *mode) {
+  if (g_enabled && shim_special_path(path)) {
+    int fd = shim_openat_impl(AT_FDCWD, path, O_RDONLY, 0);
+    return fd < 0 ? NULL : fdopen(fd, mode);
+  }
+  static FILE *(*real_fopen)(const char *, const char *);
+  if (!real_fopen)
+    real_fopen =
+        (FILE * (*)(const char *, const char *))(uintptr_t)
+            dlsym(RTLD_NEXT, "fopen");
+  return real_fopen ? real_fopen(path, mode) : NULL;
+}
+
+FILE *fopen64(const char *path, const char *mode) {
+  if (g_enabled && shim_special_path(path)) {
+    int fd = shim_openat_impl(AT_FDCWD, path, O_RDONLY, 0);
+    return fd < 0 ? NULL : fdopen(fd, mode);
+  }
+  static FILE *(*real_fopen64)(const char *, const char *);
+  if (!real_fopen64)
+    real_fopen64 =
+        (FILE * (*)(const char *, const char *))(uintptr_t)
+            dlsym(RTLD_NEXT, "fopen64");
+  return real_fopen64 ? real_fopen64(path, mode) : NULL;
 }
 
 /* ---- name resolution (preload_libraries.c:30-120 analogue) --------- */

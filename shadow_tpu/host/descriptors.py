@@ -391,6 +391,37 @@ class TimerfdDesc(Descriptor):
         return R if self.expirations > 0 else 0
 
 
+class VirtualFileDesc(Descriptor):
+    """An emulated regular/char file served simulator-side (the
+    RegularFile slice of ref file.c for paths the SIMULATOR must own):
+    deterministic RNG devices (/dev/urandom — native reads would be
+    real randomness, breaking run-to-run determinism) and the
+    simulated /etc/hosts (under ptrace there is no shim getaddrinfo
+    override, so libc reads the file raw — it must see the simulated
+    name map, not the machine's). Finite `content` with a seek
+    position, or an endless `generator(n) -> bytes` device."""
+
+    def __init__(self, content: bytes = b"", generator=None,
+                 mode: int = 0o100644):
+        super().__init__()
+        self.content = content
+        self.generator = generator
+        self.mode = mode
+        self.pos = 0
+
+    def read_at(self, n: int, pos: Optional[int] = None) -> bytes:
+        if self.generator is not None:
+            return self.generator(n)
+        p = self.pos if pos is None else pos
+        data = self.content[p:p + n]
+        if pos is None:
+            self.pos += len(data)
+        return data
+
+    def size(self) -> int:
+        return len(self.content)
+
+
 class EventfdDesc(Descriptor):
     def __init__(self, initval: int, semaphore: bool):
         super().__init__()

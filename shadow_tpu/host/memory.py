@@ -3,11 +3,22 @@
 The reference reaches into managed-process address spaces two ways:
 a MemoryCopier over process_vm_readv/writev and a MemoryMapper that
 remaps the plugin heap into Shadow (src/main/host/memory_manager/
-mod.rs:1-17, memory_copier.rs). This is the copier path — sufficient
-because syscall arguments here are small (sockaddrs, timespecs,
-iovecs) or bounded buffers; a shared-memory mapper is a later
-optimization. Works on direct children without privileges (Yama
-ptrace_scope 1 allows parent->child).
+mod.rs:1-17, memory_copier.rs). This is the copier path.
+
+A zero-copy mapper port was evaluated and DELIBERATELY not built:
+measured on a 2 MB managed TCP transfer (tcp_client/tcp_server under
+the preload shim), the copier accounts for 1.2% of simulation wall
+time (1690 ops / 4 MB / 35 ms of 2.94 s) — the hot path in this
+simulator is the IPC ping-pong + dispatch, not the copies the
+reference's mapper eliminates. The mapper's machinery (rewriting
+plugin mmap/brk to MAP_SHARED shmem files; memory_mapper.rs:22-35)
+would buy at most that 1% here while adding an in-plugin remap
+protocol to both interposition backends. Revisit only if a profile
+shows the copier share growing past ~10% (e.g. a syscall-dense
+workload moving large iovecs).
+
+Works on direct children without privileges (Yama ptrace_scope 1
+allows parent->child).
 
 Also holds the struct codecs for the kernel ABI types the syscall
 handler marshals (sockaddr_in, timespec, epoll_event, pollfd, iovec,

@@ -548,6 +548,7 @@ class _Tracer(threading.Thread):
         entry = self._getregs(tid)
         nr = ctypes.c_long(entry.orig_rax).value
         flags = int(entry.rdi) if nr == NR["clone"] else 0
+        stack = int(entry.rsi) if nr == NR["clone"] else 0
         ptid = int(entry.rdx) if nr == NR["clone"] else 0
         ctid = int(entry.r10) if nr == NR["clone"] else 0
         if kind == "fork":
@@ -586,6 +587,18 @@ class _Tracer(threading.Thread):
         self.tracees.add(child)
         self.group[child] = self.group.get(tid, tid) \
             if kind == "thread" else child
+
+        if kind == "fork" and stack:
+            # a fork-style clone WITH a stack argument (glibc __clone:
+            # posix_spawn/system): the child branch of clone.S pops
+            # fn/arg off the NEW stack, but the fork rewrite left the
+            # child on the parent's %rsp — redirect it to the
+            # requested stack (glibc already pushed fn/arg there; the
+            # COW copy has them). CLONE_VM's shared-memory error
+            # reporting degrades with COW, like the vfork rewrite.
+            cregs = self._getregs(child)
+            cregs.rsp = stack
+            self._setregs(child, cregs)
 
         # virtualize the visible ids: parent return, PARENT_SETTID
         # word (glibc's pd->tid for threads), CHILD_SETTID word (the

@@ -43,6 +43,7 @@ from shadow_tpu.host.descriptors import (
     TimerfdDesc,
     UdpDesc,
     VFD_BASE,
+    VirtualFileDesc,
     W,
 )
 from shadow_tpu.utils.slog import get_logger
@@ -83,6 +84,7 @@ NR = dict(
     rt_sigprocmask=14, rt_sigpending=127, rt_sigtimedwait=128,
     rt_sigsuspend=130, tkill=200, execve=59,
     mmap=9, mprotect=10, munmap=11, brk=12, mremap=25,
+    open=2, openat=257,
 )
 NR_NAME = {v: k for k, v in NR.items()}
 
@@ -1144,6 +1146,13 @@ class SyscallHandler:
             return self._eventfd_read(ctx, desc, buf, n)
         if isinstance(desc, TimerfdDesc):
             return self._timerfd_read(ctx, desc, buf, n)
+        if isinstance(desc, VirtualFileDesc):
+            # short reads are allowed: bound what the simulator
+            # materializes (the kernel caps reads at 0x7ffff000 too)
+            data = desc.read_at(min(n, 1 << 20))
+            if data:
+                self.mem.write(buf, data)
+            return len(data)
         return -EINVAL
 
     def sys_write(self, ctx, a):
@@ -1159,6 +1168,10 @@ class SyscallHandler:
             return self._pipe_write(ctx, desc, buf, n)
         if isinstance(desc, EventfdDesc):
             return self._eventfd_write(ctx, desc, buf, n)
+        if isinstance(desc, VirtualFileDesc):
+            if desc.generator is not None:
+                return n        # writes to /dev/urandom: accepted+ignored
+            return -EBADF       # the emulated files are read-only
         return -EINVAL
 
     def _gather_iov(self, a):
@@ -1197,8 +1210,17 @@ class SyscallHandler:
         return self._iov_loop(ctx, a, self.sys_write)
 
     def sys_pread64(self, ctx, a):
-        if self._desc(_s32(a[0])) is None:
+        desc = self._desc(_s32(a[0]))
+        if desc is None:
             return self._no_desc(_s32(a[0]))
+        if isinstance(desc, VirtualFileDesc):
+            off = _s64(a[3])
+            if off < 0:
+                return -EINVAL
+            data = desc.read_at(min(int(a[2]), 1 << 20), pos=off)
+            if data:
+                self.mem.write(a[1], data)
+            return len(data)
         return -ESPIPE
 
     def sys_pwrite64(self, ctx, a):
@@ -1207,8 +1229,20 @@ class SyscallHandler:
         return -ESPIPE
 
     def sys_lseek(self, ctx, a):
-        if self._desc(_s32(a[0])) is None:
+        desc = self._desc(_s32(a[0]))
+        if desc is None:
             return self._no_desc(_s32(a[0]))
+        if isinstance(desc, VirtualFileDesc):
+            off, whence = _s64(a[1]), _s32(a[2])
+            if whence not in (0, 1, 2):
+                return -EINVAL
+            base = (0 if whence == 0 else
+                    desc.pos if whence == 1 else desc.size())
+            pos = base + off
+            if pos < 0:
+                return -EINVAL
+            desc.pos = pos
+            return pos
         return -ESPIPE
 
     def sys_close(self, ctx, a):
@@ -1217,13 +1251,69 @@ class SyscallHandler:
             return self._no_desc(fd)
         return 0 if self.table.close_fd(ctx, fd) else -EBADF
 
+    # -- file opens (the special-path slice of ref file.c/fileat.c) ----
+    AT_FDCWD = -100
+
+    def sys_openat(self, ctx, a):
+        return self._open_path(ctx, _s32(a[0]), a[1], _s32(a[2]))
+
+    def sys_open(self, ctx, a):
+        return self._open_path(ctx, self.AT_FDCWD, a[0], _s32(a[1]))
+
+    def _open_path(self, ctx, dirfd, path_ptr, flags):
+        """Paths whose CONTENT the simulator must own are emulated
+        through the descriptor table; everything else runs native
+        (each plugin's real cwd IS its host data dir, so relative
+        paths are per-host isolated already — tests pin that):
+
+        * /dev/urandom, /dev/random — native reads would be REAL
+          randomness; served from the host's seeded deterministic
+          stream instead (the openssl-preload RNG override's file
+          cousin)
+        * /etc/hosts — the SIMULATED name map (dns.write_hosts_file);
+          critical under ptrace, where no shim getaddrinfo override
+          exists and libc reads the file raw
+        * /etc/resolv.conf, /etc/nsswitch.conf — pinned to files-based
+          resolution with no nameservers
+
+        Ref: src/main/host/syscall/file.c + fileat.c emulate the whole
+        family through their descriptor table."""
+        if not path_ptr:
+            return -EFAULT
+        try:
+            path = self.mem.read_cstr(path_ptr).decode(
+                errors="surrogateescape")
+        except OSError:
+            return -EFAULT
+        if path in ("/dev/urandom", "/dev/random"):
+            return self.table.alloc(VirtualFileDesc(
+                generator=self.p.deterministic_bytes, mode=0o20666))
+        if path == "/etc/hosts":
+            hosts = os.path.join(
+                getattr(self.p.runtime, "data_dir", ""), "etc_hosts")
+            if os.path.exists(hosts):
+                with open(hosts, "rb") as f:
+                    return self.table.alloc(VirtualFileDesc(f.read()))
+            return NATIVE
+        if path == "/etc/resolv.conf":
+            return self.table.alloc(VirtualFileDesc(b""))
+        if path == "/etc/nsswitch.conf":
+            return self.table.alloc(VirtualFileDesc(
+                b"hosts: files\n"))
+        return NATIVE
+
     def sys_fstat(self, ctx, a):
         fd = _s32(a[0])
         desc = self._desc(fd)
         if desc is None:
             return self._no_desc(fd)
         st = bytearray(144)
-        mode = 0o140777 if not isinstance(desc, PipeDesc) else 0o10600
+        if isinstance(desc, VirtualFileDesc):
+            mode = desc.mode
+            struct.pack_into("<q", st, 48, desc.size())   # st_size
+        else:
+            mode = 0o140777 if not isinstance(desc, PipeDesc) \
+                else 0o10600
         struct.pack_into("<I", st, 24, mode)
         struct.pack_into("<Q", st, 16, 1)      # nlink
         self.mem.write(a[1], bytes(st))

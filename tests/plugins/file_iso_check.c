@@ -1,0 +1,28 @@
+/* Per-host file isolation: the same RELATIVE path on two hosts must
+ * land in each host's own data directory (plugin cwd == host dir).
+ * Writes argv[1] into state.txt, reads it back, prints it; also
+ * prints the first line of /etc/hosts (the SIMULATED name map). */
+#include <stdio.h>
+#include <string.h>
+
+int main(int argc, char **argv) {
+  const char *tag = argc > 1 ? argv[1] : "none";
+  FILE *f = fopen("state.txt", "w");
+  if (!f) { perror("fopen w"); return 1; }
+  fprintf(f, "%s", tag);
+  fclose(f);
+  char buf[256] = {0};
+  f = fopen("state.txt", "r");
+  if (!f) { perror("fopen r"); return 1; }
+  fgets(buf, sizeof buf, f);
+  fclose(f);
+  printf("state %s\n", buf);
+  f = fopen("/etc/hosts", "r");
+  if (!f) { perror("hosts"); return 1; }
+  int hosts_lines = 0;
+  while (fgets(buf, sizeof buf, f)) hosts_lines++;
+  fclose(f);
+  printf("hosts_lines %d\n", hosts_lines);
+  printf("done\n");
+  return 0;
+}

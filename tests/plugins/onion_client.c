@@ -1,0 +1,58 @@
+/* Onion-routed source: connects to the entry relay, writes one
+ * stacked forwarding header per hop (each relay peels one line),
+ * then streams <nbytes> of the tcp_client pattern. args:
+ *   <entry_ip> <entry_port> <nbytes> [next_ip next_port]... */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 4 || (argc - 4) % 2 != 0) {
+    fprintf(stderr, "usage: onion_client <ip> <port> <nbytes> "
+                    "[next_ip next_port]...\n");
+    return 2;
+  }
+  long nbytes = atol(argv[3]);
+  int s = socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in dst;
+  memset(&dst, 0, sizeof dst);
+  dst.sin_family = AF_INET;
+  dst.sin_port = htons(atoi(argv[2]));
+  dst.sin_addr.s_addr = inet_addr(argv[1]);
+  if (connect(s, (struct sockaddr *)&dst, sizeof dst) != 0) {
+    perror("connect");
+    return 1;
+  }
+  printf("connected\n");
+  for (int i = 4; i + 1 < argc; i += 2) {
+    char hdr[128];
+    int n = snprintf(hdr, sizeof hdr, "%s %s\n", argv[i], argv[i + 1]);
+    if (write(s, hdr, (size_t)n) != n) { perror("hdr"); return 1; }
+  }
+  char buf[8192];
+  unsigned long sum = 0;
+  long sent = 0;
+  while (sent < nbytes) {
+    long chunk = nbytes - sent;
+    if (chunk > (long)sizeof buf) chunk = (long)sizeof buf;
+    for (long i = 0; i < chunk; i++)
+      buf[i] = (char)((sent + i) * 131 + 7);
+    long off = 0;
+    while (off < chunk) {
+      ssize_t w = write(s, buf + off, (size_t)(chunk - off));
+      if (w < 0) { perror("write"); return 1; }
+      off += w;
+    }
+    for (long i = 0; i < chunk; i++)
+      sum = (sum * 31 + (unsigned char)buf[i]) & 0xFFFFFFFFUL;
+    sent += chunk;
+  }
+  printf("sent %ld bytes sum %lu\n", sent, sum);
+  close(s);
+  fflush(stdout);
+  return 0;
+}

@@ -1,0 +1,76 @@
+/* Multi-hop relay: accepts a connection, reads a one-line forwarding
+ * header "IP PORT\n", connects onward, and pipes the remaining bytes
+ * downstream until EOF. Chained three deep this is the honest Tor
+ * analogue (ref src/test/tor runs the real tor binary): REAL
+ * processes forwarding through the EMULATED TCP stack, not an
+ * idealized circuit model. args: <listen_port> [circuits] */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+static int read_line(int fd, char *buf, int cap) {
+  int n = 0;
+  while (n < cap - 1) {
+    ssize_t r = read(fd, buf + n, 1);
+    if (r <= 0) return -1;
+    if (buf[n] == '\n') { buf[n] = 0; return n; }
+    n++;
+  }
+  return -1;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 2) { fprintf(stderr, "usage: relay <port> [circuits]\n"); return 2; }
+  int port = atoi(argv[1]);
+  int circuits = argc > 2 ? atoi(argv[2]) : 1;
+  int s = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(s, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in a;
+  memset(&a, 0, sizeof a);
+  a.sin_family = AF_INET;
+  a.sin_port = htons(port);
+  a.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (bind(s, (struct sockaddr *)&a, sizeof a) != 0) { perror("bind"); return 1; }
+  if (listen(s, 8) != 0) { perror("listen"); return 1; }
+  for (int c = 0; c < circuits; c++) {
+    int up = accept(s, NULL, NULL);
+    if (up < 0) { perror("accept"); return 1; }
+    char hdr[128];
+    if (read_line(up, hdr, sizeof hdr) < 0) { close(up); continue; }
+    char ip[64]; int nport;
+    if (sscanf(hdr, "%63s %d", ip, &nport) != 2) { close(up); continue; }
+    int down = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in d;
+    memset(&d, 0, sizeof d);
+    d.sin_family = AF_INET;
+    d.sin_port = htons(nport);
+    d.sin_addr.s_addr = inet_addr(ip);
+    if (connect(down, (struct sockaddr *)&d, sizeof d) != 0) {
+      perror("connect"); close(up); close(down); continue;
+    }
+    unsigned long fwd = 0;
+    char buf[8192];
+    for (;;) {
+      ssize_t r = read(up, buf, sizeof buf);
+      if (r <= 0) break;
+      long off = 0;
+      while (off < r) {
+        ssize_t w = write(down, buf + off, (size_t)(r - off));
+        if (w < 0) { perror("write"); return 1; }
+        off += w;
+      }
+      fwd += (unsigned long)r;
+    }
+    close(up);
+    close(down);          /* EOF propagates down the chain */
+    printf("circuit %d forwarded %lu\n", c, fwd);
+  }
+  close(s);
+  fflush(stdout);
+  return 0;
+}

@@ -1,0 +1,10 @@
+#include <stdio.h>
+#include <stdlib.h>
+#include <sys/wait.h>
+int main(void) {
+  int rc = system("echo spawned-ok");
+  printf("system rc=%d exited=%d status=%d\n", rc,
+         WIFEXITED(rc), WEXITSTATUS(rc));
+  fflush(stdout);
+  return 0;
+}

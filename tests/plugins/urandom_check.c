@@ -1,0 +1,35 @@
+/* /dev/urandom determinism: the simulator serves the RNG devices from
+ * the host's seeded stream (native reads would be real randomness and
+ * break run-to-run determinism). Prints hex of reads via open/read,
+ * pread, and fstat's file type. */
+#include <fcntl.h>
+#include <stdio.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+static void hex(const char *tag, unsigned char *b, int n) {
+  printf("%s ", tag);
+  for (int i = 0; i < n; i++) printf("%02x", b[i]);
+  printf("\n");
+}
+
+int main(void) {
+  int fd = open("/dev/urandom", O_RDONLY);
+  if (fd < 0) { perror("open"); return 1; }
+  unsigned char a[16], b[8];
+  if (read(fd, a, sizeof a) != sizeof a) return 1;
+  hex("r1", a, sizeof a);
+  if (pread(fd, b, sizeof b, 0) != sizeof b) return 1;
+  hex("r2", b, sizeof b);
+  struct stat st;
+  if (fstat(fd, &st) != 0) return 1;
+  printf("chardev %d\n", S_ISCHR(st.st_mode) ? 1 : 0);
+  close(fd);
+  int fd2 = open("/dev/random", O_RDONLY);
+  if (fd2 < 0) { perror("open2"); return 1; }
+  if (read(fd2, b, sizeof b) != sizeof b) return 1;
+  hex("r3", b, sizeof b);
+  close(fd2);
+  printf("done\n");
+  return 0;
+}

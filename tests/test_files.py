@@ -1,0 +1,109 @@
+"""File-syscall emulation (the special-path slice of ref file.c /
+fileat.c): deterministic RNG devices, the simulated /etc/hosts, and
+per-host relative-path isolation — under BOTH interposition backends.
+"""
+
+import os
+
+import pytest
+
+from test_managed import (  # noqa: F401  (fixture re-export)
+    base_cfg,
+    plugins,
+    read_stdout,
+    run_sim,
+)
+
+
+def _cfg(data: str, method: str) -> str:
+    return base_cfg(data).replace(
+        "hosts:\n",
+        f"experimental:\n  interpose_method: {method}\nhosts:\n")
+
+
+METHODS = ["preload", "ptrace"]
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_urandom_deterministic(plugins, tmp_path, method):
+    """open/read/pread of /dev/urandom and /dev/random serve the
+    host's seeded stream: bit-identical across runs, chardev fstat."""
+    outs = []
+    for run in range(2):
+        data = str(tmp_path / f"{method}{run}" / "shadow.data")
+        cfg = _cfg(data, method) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['urandom_check']}
+      start_time: 1s
+"""
+        stats, _ = run_sim(cfg, tmp_path / f"{method}{run}")
+        assert stats.ok
+        out = read_stdout(data, "alice", "urandom_check")
+        assert "done" in out
+        lines = out.splitlines()
+        assert lines[0].startswith("r1 ") and len(lines[0]) == 35
+        assert lines[2] == "chardev 1"
+        outs.append(out)
+    assert outs[0] == outs[1]
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_relative_path_isolation(plugins, tmp_path, method):
+    """The same relative path ("state.txt") on two hosts lands in each
+    host's own data dir; /etc/hosts reads the SIMULATED name map."""
+    data = str(tmp_path / "shadow.data")
+    cfg = _cfg(data, method) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['file_iso_check']}
+      args: from-alice
+      start_time: 1s
+  bob:
+    network_node_id: 1
+    processes:
+    - path: {plugins['file_iso_check']}
+      args: from-bob
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    assert stats.ok
+    out_a = read_stdout(data, "alice", "file_iso_check")
+    out_b = read_stdout(data, "bob", "file_iso_check")
+    assert "state from-alice" in out_a
+    assert "state from-bob" in out_b
+    # the files physically live in separate host dirs
+    assert open(os.path.join(data, "hosts", "alice",
+                             "state.txt")).read() == "from-alice"
+    assert open(os.path.join(data, "hosts", "bob",
+                             "state.txt")).read() == "from-bob"
+    # simulated hosts file: localhost + alice + bob = 3 lines
+    assert "hosts_lines 3" in out_a
+    assert "hosts_lines 3" in out_b
+
+
+def test_getaddrinfo_under_ptrace(plugins, tmp_path):
+    """Name resolution under ptrace has no shim override: libc reads
+    /etc/hosts & friends raw, so the emulated files must steer it to
+    the simulated map (resolver_check connects BY NAME to prove it)."""
+    data = str(tmp_path / "shadow.data")
+    cfg = _cfg(data, "ptrace") + f"""
+  server:
+    network_node_id: 0
+    processes:
+    - path: {plugins['tcp_server']}
+      args: 9000
+      start_time: 1s
+  client:
+    network_node_id: 1
+    processes:
+    - path: {plugins['resolver_check']}
+      args: server 9000
+      start_time: 2s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    out = read_stdout(data, "client", "resolver_check")
+    assert "hostname client" in out
+    assert "resolved server 11.0.0.1:9000" in out

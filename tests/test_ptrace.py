@@ -273,3 +273,23 @@ def test_fork_under_ptrace(plugins, tmp_path):
     out = read_stdout(data, "alice", "fork_check")
     assert "echild 1" in out
     assert stats.ok
+
+
+def test_system_spawns_shell_under_ptrace(plugins, tmp_path):
+    """system() = posix_spawn = __clone(CLONE_VM|CLONE_VFORK, new
+    stack): the fork rewrite + child %rsp redirect must give the COW
+    child the clone stack glibc pushed fn/arg onto, the child execs
+    /bin/sh (TRACEEXEC), and wait4 reports its exit."""
+    data = str(tmp_path / "shadow.data")
+    cfg = ptrace_cfg(data) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['spawn_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    out = read_stdout(data, "alice", "spawn_check")
+    assert "spawned-ok" in out
+    assert "system rc=0 exited=1 status=0" in out
+    assert stats.ok
