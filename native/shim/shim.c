@@ -933,6 +933,22 @@ static int shim_install_seccomp(void) {
   for (size_t i = 0; i < sizeof(kTrapSyscalls) / sizeof(int); i++)
     EMIT(BPF_JMP | BPF_JEQ | BPF_K, (uint32_t)kTrapSyscalls[i], TGT_TRAP,
          TGT_NONE);
+  /* SHADOWTPU_STRICT_TRAPS=1: also trap the startup-window syscalls
+   * (clock_gettime/gettimeofday/time/getpid/getrandom/set_tid_address
+   * + open/openat) so raw-syscall users of time/randomness fail into
+   * the funnel instead of silently reading native values. ONLY for
+   * workloads that never execve — a post-execve image dies in the
+   * loader window under this filter (documented trade). */
+  const char *strict = getenv("SHADOWTPU_STRICT_TRAPS");
+  if (strict && strict[0] == '1') {
+    static const int kStrict[] = {
+        SYS_clock_gettime, SYS_gettimeofday, SYS_time,   SYS_getpid,
+        SYS_getrandom,     SYS_set_tid_address, SYS_open, SYS_openat,
+    };
+    for (size_t i = 0; i < sizeof(kStrict) / sizeof(int); i++)
+      EMIT(BPF_JMP | BPF_JEQ | BPF_K, (uint32_t)kStrict[i], TGT_TRAP,
+           TGT_NONE);
+  }
   for (size_t i = 0; i < sizeof(kFdGatedSyscalls) / sizeof(int); i++)
     EMIT(BPF_JMP | BPF_JEQ | BPF_K, (uint32_t)kFdGatedSyscalls[i],
          TGT_FDGATE, TGT_NONE);
@@ -1086,6 +1102,33 @@ static int shim_special_path(const char *p) {
          strcmp(p, "/etc/nsswitch.conf") == 0;
 }
 
+static int shim_statat_impl(const char *path, void *st) {
+  /* stat of a special path must agree with what open() serves (the
+   * real file's size/mtime would leak machine state) */
+  long args[6] = {AT_FDCWD, (long)path, (long)st, 0, 0, 0};
+  if (g_enabled && shim_special_path(path))
+    return ret_errno(shim_emulated_syscall(SYS_newfstatat, args));
+  return ret_errno(shim_rawsyscall(SYS_newfstatat, AT_FDCWD,
+                                   (long)path, (long)st, 0, 0, 0));
+}
+
+int stat(const char *path, struct stat *st) {
+  return shim_statat_impl(path, st);
+}
+
+int stat64(const char *path, struct stat64 *st) {
+  return shim_statat_impl(path, st);
+}
+
+int lstat(const char *path, struct stat *st) {
+  /* the special paths are not symlinks: identical result */
+  return shim_statat_impl(path, st);
+}
+
+int lstat64(const char *path, struct stat64 *st) {
+  return shim_statat_impl(path, st);
+}
+
 static int shim_openat_impl(int dirfd, const char *path, int flags,
                             mode_t mode) {
   if (g_enabled && shim_special_path(path)) {
@@ -1098,7 +1141,7 @@ static int shim_openat_impl(int dirfd, const char *path, int flags,
 
 int open(const char *path, int flags, ...) {
   mode_t mode = 0;
-  if (flags & (O_CREAT | O_TMPFILE)) {
+  if ((flags & O_CREAT) || (flags & O_TMPFILE) == O_TMPFILE) {
     va_list ap;
     va_start(ap, flags);
     mode = va_arg(ap, mode_t);
@@ -1109,7 +1152,7 @@ int open(const char *path, int flags, ...) {
 
 int open64(const char *path, int flags, ...) {
   mode_t mode = 0;
-  if (flags & (O_CREAT | O_TMPFILE)) {
+  if ((flags & O_CREAT) || (flags & O_TMPFILE) == O_TMPFILE) {
     va_list ap;
     va_start(ap, flags);
     mode = va_arg(ap, mode_t);
@@ -1120,7 +1163,7 @@ int open64(const char *path, int flags, ...) {
 
 int openat(int dirfd, const char *path, int flags, ...) {
   mode_t mode = 0;
-  if (flags & (O_CREAT | O_TMPFILE)) {
+  if ((flags & O_CREAT) || (flags & O_TMPFILE) == O_TMPFILE) {
     va_list ap;
     va_start(ap, flags);
     mode = va_arg(ap, mode_t);
@@ -1131,7 +1174,7 @@ int openat(int dirfd, const char *path, int flags, ...) {
 
 int openat64(int dirfd, const char *path, int flags, ...) {
   mode_t mode = 0;
-  if (flags & (O_CREAT | O_TMPFILE)) {
+  if ((flags & O_CREAT) || (flags & O_TMPFILE) == O_TMPFILE) {
     va_list ap;
     va_start(ap, flags);
     mode = va_arg(ap, mode_t);
@@ -1145,6 +1188,10 @@ int openat64(int dirfd, const char *path, int flags, ...) {
  * the virtual fd (fd-gated seccomp serves its read/fstat/seek). */
 FILE *fopen(const char *path, const char *mode) {
   if (g_enabled && shim_special_path(path)) {
+    if (strchr(mode, 'w') || strchr(mode, 'a') || strchr(mode, '+')) {
+      errno = EACCES; /* the emulated files are read-only streams */
+      return NULL;
+    }
     int fd = shim_openat_impl(AT_FDCWD, path, O_RDONLY, 0);
     return fd < 0 ? NULL : fdopen(fd, mode);
   }
@@ -1158,6 +1205,10 @@ FILE *fopen(const char *path, const char *mode) {
 
 FILE *fopen64(const char *path, const char *mode) {
   if (g_enabled && shim_special_path(path)) {
+    if (strchr(mode, 'w') || strchr(mode, 'a') || strchr(mode, '+')) {
+      errno = EACCES;
+      return NULL;
+    }
     int fd = shim_openat_impl(AT_FDCWD, path, O_RDONLY, 0);
     return fd < 0 ? NULL : fdopen(fd, mode);
   }

@@ -69,6 +69,10 @@ class NetworkModel:
         loss statistics are bit-identical to per-packet sends. Returns
         (survivor_bitmask, deliver_time, latency_ns); bit j set means
         packet pkt_seq0+j survived."""
+        # numpy uint64 shifts are undefined past 63 and would corrupt
+        # the survivor mask silently — fail loudly instead
+        assert count <= 64, \
+            f"judge_train count={count} exceeds the 64-bit mask"
         sv = int(self.host_vertex[src_host])
         dv = int(self.host_vertex[dst_host])
         latency = int(self.topology.latency_ns[sv, dv])

@@ -168,6 +168,16 @@ class TgenDevice(DeviceApp):
         self.max_train = self.chunk
         self.max_timers = 1
         self.max_draws = 1              # no randomness consumed
+        # CLIENT-LOCAL args may vary per host (heterogeneous configs:
+        # scalars broadcast, arrays pass through); `size` shapes the
+        # SERVER's response and must stay uniform
+        shape = np.shape(self.roles)
+        self._count = np.broadcast_to(
+            np.asarray(self.count, np.int32), shape)
+        self._pause = np.broadcast_to(
+            np.asarray(self.pause_ns, np.int64), shape)
+        self._retry = np.broadcast_to(
+            np.asarray(self.retry_ns, np.int64), shape)
 
     def init_state(self, n_hosts: int) -> jnp.ndarray:
         # n_hosts may exceed len(roles): shard padding hosts are inert
@@ -192,9 +202,14 @@ class TgenDevice(DeviceApp):
         is_server = role == 0
         is_client = role == 1
 
+        cg = jnp.clip(gid, 0, len(self._count) - 1)
+        count_h = jnp.asarray(self._count)[cg]
+        pause_h = jnp.asarray(self._pause)[cg]
+        retry_h = jnp.asarray(self._retry)[cg]
+
         is_req = is_server & (kind == KIND_PACKET) & (d0 == self.TAG_REQ)
         is_data = is_client & (kind == KIND_PACKET) & (d0 == self.TAG_DATA)
-        is_boot = is_client & (kind == KIND_BOOT) & (self.count > 0)
+        is_boot = is_client & (kind == KIND_BOOT) & (count_h > 0)
         is_timer = is_client & (kind == KIND_TIMER)
         timer_pause = is_timer & (d0 < 0)
         timer_retry = is_timer & (d0 >= 0) & (d0 == gen)
@@ -280,11 +295,11 @@ class TgenDevice(DeviceApp):
             jnp.int32)
 
         # ---- timers (pause and retry are mutually exclusive) ----
-        pause_valid = dl_done & (new_done < self.count)
-        retry_valid = send_req & (self.retry_ns > 0)
+        pause_valid = dl_done & (new_done < count_h)
+        retry_valid = send_req & (retry_h > 0)
         timer_valid = (pause_valid | retry_valid)[:, None]
-        timer_delay = jnp.where(pause_valid, self.pause_ns,
-                                self.retry_ns)[:, None].astype(jnp.int64)
+        timer_delay = jnp.where(pause_valid, pause_h,
+                                retry_h)[:, None].astype(jnp.int64)
         timer_d0 = jnp.where(pause_valid, -1,
                              new_gen)[:, None].astype(jnp.int32)
 
@@ -337,6 +352,15 @@ class TorDevice(DeviceApp):
         self.max_timers = 1
         self.max_draws = 1              # no stateful randomness
         self.seed_pair = prng.seed_key(self.seed)
+        # client-local args vary per host; `cells` shapes the exit
+        # relays' DATA service and must stay uniform
+        shape = np.shape(self.roles)
+        self._count = np.broadcast_to(
+            np.asarray(self.count, np.int32), shape)
+        self._pause = np.broadcast_to(
+            np.asarray(self.pause_ns, np.int64), shape)
+        self._retry = np.broadcast_to(
+            np.asarray(self.retry_ns, np.int64), shape)
 
     def init_state(self, n_hosts: int) -> jnp.ndarray:
         st = np.zeros((n_hosts, self.n_state_words), np.int32)
@@ -399,8 +423,13 @@ class TorDevice(DeviceApp):
         # ---- client window progress (tgen dedup rules) ----
         my_route = self._route(me)
         my_guard = my_route[0]
+        cg = jnp.clip(gid, 0, len(self._count) - 1)
+        count_h = jnp.asarray(self._count)[cg]
+        pause_h = jnp.asarray(self._pause)[cg]
+        retry_h = jnp.asarray(self._retry)[cg]
+
         c_data = is_client & is_pkt & (d0 == self.TAG_DATA)
-        c_boot = is_client & (kind == KIND_BOOT) & (self.count > 0)
+        c_boot = is_client & (kind == KIND_BOOT) & (count_h > 0)
         c_timer = is_client & (kind == KIND_TIMER)
         timer_pause = c_timer & (d0 < 0)
         timer_retry = c_timer & (d0 >= 0) & (d0 == gen)
@@ -465,11 +494,11 @@ class TorDevice(DeviceApp):
                       req_d1[:, None])).astype(jnp.int32)
 
         # ---- timers ----
-        pause_valid = dl_done & (new_done < self.count)
-        retry_valid = send_req & (self.retry_ns > 0)
+        pause_valid = dl_done & (new_done < count_h)
+        retry_valid = send_req & (retry_h > 0)
         timer_valid = (pause_valid | retry_valid)[:, None]
-        timer_delay = jnp.where(pause_valid, self.pause_ns,
-                                self.retry_ns)[:, None].astype(jnp.int64)
+        timer_delay = jnp.where(pause_valid, pause_h,
+                                retry_h)[:, None].astype(jnp.int64)
         timer_d0 = jnp.where(pause_valid, -1,
                              new_gen)[:, None].astype(jnp.int32)
 

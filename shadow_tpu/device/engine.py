@@ -119,6 +119,11 @@ class DeviceEngine:
                  bw_down_bits: Optional[np.ndarray] = None):
         self.config = config
         self.app = app
+        # d2 survivor bitmasks are one uint32 word: a larger train
+        # would silently lose packets (ADVICE r3 #2 — fail loudly)
+        assert getattr(app, "max_train", 1) <= 32, \
+            f"app.max_train={app.max_train} exceeds the 32-bit " \
+            "survivor mask"
         if mesh is None:
             devs = jax.devices()
             mesh = Mesh(np.array(devs), (AXIS,))

@@ -5,11 +5,13 @@ scheduler policy slotting in beside the CPU thread policies, exactly as
 the north-star design places it (a new policy alongside
 src/main/core/scheduler's five).
 
-v1 restriction: all hosts must run the *same* model app (with identical
-args), because the device program dispatches one vectorized app.
-Heterogeneous-app device dispatch (per-host app ids + lax.switch) and
-real-process hybrid execution land later; mixed configs run on the CPU
-policies meanwhile.
+Heterogeneity: client-LOCAL args (count/pause/retry) vary per host —
+the device apps carry them as per-host arrays, covering the
+tornettools shape (varied client behavior over a shared relay/server
+fabric). Args that shape SHARED hosts' responses (tgen `size`, tor
+`cells`) must stay uniform, and hosts must all belong to one model
+family; mixed-family configs run hybrid (CPU host emulation + device
+network judgments) via the NoDeviceTwin fallback.
 """
 
 from __future__ import annotations
@@ -74,15 +76,23 @@ def device_twin(sim) -> DeviceApp:
         if not clients:
             raise ValueError("tpu policy: tgen config has no clients")
         first = clients[0]
+        # client-LOCAL args (count/pause/retry) vary per host; `size`
+        # shapes the server's response and must stay uniform
         for c in clients:
-            if (c.size, c.count, c.pause_ns, c.retry_ns) != (
-                    first.size, first.count, first.pause_ns,
-                    first.retry_ns):
-                raise ValueError("tpu policy: tgen client args must "
-                                 "match across hosts")
+            if c.size != first.size:
+                raise ValueError(
+                    "tpu policy: tgen client `size` must match across "
+                    "hosts (it shapes the shared servers' responses); "
+                    "count/pause/retry may vary")
+        count = np.zeros(n_hosts, np.int32)
+        pause = np.zeros(n_hosts, np.int64)
+        retry = np.zeros(n_hosts, np.int64)
         for h in sim.hosts:
             if isinstance(h.app, TgenClientApp):
                 roles[h.host_id] = 1
+                count[h.host_id] = h.app.count
+                pause[h.host_id] = h.app.pause_ns
+                retry[h.host_id] = h.app.retry_ns
                 try:
                     # same name-or-group rule as the CPU ctx.resolve
                     server_gid[h.host_id] = resolve_host_ref(
@@ -93,26 +103,33 @@ def device_twin(sim) -> DeviceApp:
                         f"tgen client on {h.name}: unknown server "
                         f"{h.app.server_name!r}")
         return TgenDevice(roles=roles, server_gid=server_gid,
-                          size=first.size, count=first.count,
-                          pause_ns=first.pause_ns,
-                          retry_ns=first.retry_ns)
+                          size=first.size, count=count,
+                          pause_ns=pause, retry_ns=retry)
 
     if classes <= {TorRelayApp, TorClientApp}:
         clients = [a for a in real if isinstance(a, TorClientApp)]
         if not clients:
             raise ValueError("tpu policy: tor config has no clients")
         first = clients[0]
+        # `cells` shapes the exit relays' DATA service: uniform;
+        # count/pause/retry are client-local and may vary
         for c in clients:
-            if (c.cells, c.count, c.pause_ns, c.retry_ns) != (
-                    first.cells, first.count, first.pause_ns,
-                    first.retry_ns):
-                raise ValueError("tpu policy: tor client args must "
-                                 "match across hosts")
+            if c.cells != first.cells:
+                raise ValueError(
+                    "tpu policy: tor client `cells` must match across "
+                    "hosts (it shapes the exit relays' responses); "
+                    "count/pause/retry may vary")
         roles = np.zeros(n_hosts, np.int32)
+        count = np.zeros(n_hosts, np.int32)
+        pause = np.zeros(n_hosts, np.int64)
+        retry = np.zeros(n_hosts, np.int64)
         relay_gids = []
         for h in sim.hosts:
             if isinstance(h.app, TorClientApp):
                 roles[h.host_id] = 1
+                count[h.host_id] = h.app.count
+                pause[h.host_id] = h.app.pause_ns
+                retry[h.host_id] = h.app.retry_ns
             elif isinstance(h.app, TorRelayApp):
                 relay_gids.append(h.host_id)
         if len(relay_gids) < 3:
@@ -120,9 +137,8 @@ def device_twin(sim) -> DeviceApp:
         return TorDevice(roles=roles,
                          relay_gids=np.array(relay_gids, np.int64),
                          seed=sim.cfg.general.seed,
-                         cells=first.cells, count=first.count,
-                         pause_ns=first.pause_ns,
-                         retry_ns=first.retry_ns)
+                         cells=first.cells, count=count,
+                         pause_ns=pause, retry_ns=retry)
 
     names = sorted(c.__name__ for c in classes)
     raise NoDeviceTwin(f"no device twin registered for {names}; "

@@ -538,19 +538,19 @@ class _Tracer(threading.Thread):
         self._run_to_exit(tid)
 
     # -- clone / fork (TRACECLONE/TRACEFORK auto-attach) ----------------
-    def _do_clone(self, tid: int, new_vid: int, kind: str) -> None:
-        """At tid's suppressed clone/fork entry stop: re-execute
-        natively, capture the auto-attached child at its initial stop,
-        hold it there, and rewrite the parent's return value (and the
-        PARENT_SETTID / CHILD_SETTID words) to the VIRTUAL id. vfork
-        is rewritten to fork — the parent must not block on the child
-        (the preload shim applies the same COW degradation)."""
+    def _do_clone(self, tid: int, new_vid: int, kind: str,
+                  flags: int, ptid: int, ctid: int,
+                  stack: int) -> None:
+        """At tid's suppressed clone/clone3/fork entry stop:
+        re-execute natively, capture the auto-attached child at its
+        initial stop, hold it there, and rewrite the parent's return
+        value (and the PARENT_SETTID / CHILD_SETTID words) to the
+        VIRTUAL id. flags/ptid/ctid/stack are pre-parsed by the
+        syscall layer (registers for clone, struct clone_args for
+        clone3). vfork is rewritten to fork — the parent must not
+        block on the child (the preload shim applies the same COW
+        degradation)."""
         entry = self._getregs(tid)
-        nr = ctypes.c_long(entry.orig_rax).value
-        flags = int(entry.rdi) if nr == NR["clone"] else 0
-        stack = int(entry.rsi) if nr == NR["clone"] else 0
-        ptid = int(entry.rdx) if nr == NR["clone"] else 0
-        ctid = int(entry.r10) if nr == NR["clone"] else 0
         if kind == "fork":
             # EVERY fork-style creation is re-issued as a plain COW
             # fork: vfork and CLONE_VFORK/CLONE_VM clones (glibc
@@ -660,8 +660,10 @@ class _Tracer(threading.Thread):
                     self.replies.put(("syscall", tid, nr, args,
                                       self._execd))
                 elif cmd == "clone":
-                    tid, new_vid, kind = payload
-                    self._do_clone(tid, new_vid, kind)
+                    tid, new_vid, kind, flags, ptid, ctid, stack = \
+                        payload
+                    self._do_clone(tid, new_vid, kind, flags, ptid,
+                                   ctid, stack)
                 elif cmd == "kill":
                     tids = payload[0]
                     code = -1
@@ -780,11 +782,17 @@ class PtraceProcess(ManagedProcess):
         self._continue(ctx, main)
 
     # -- managed threads (TRACECLONE flavor of spawn_thread) ------------
-    def spawn_thread(self, ctx, flags: int, args):
+    def spawn_thread(self, ctx, flags: int, args,
+                     parsed: Optional[tuple] = None):
+        """`parsed` = (ptid, ctid, stack) pre-extracted from a clone3
+        struct; for classic clone they come from the register args."""
+        ptid, ctid, stack = parsed if parsed is not None else \
+            (args[2], args[3], args[1])
         vtid = self.runtime.next_vpid()
         cur = self.current
         self.tracer.cmds.put(("clone",
-                              (cur.native_tid, vtid, "thread")))
+                              (cur.native_tid, vtid, "thread",
+                               flags, ptid, ctid, stack)))
         try:
             reply = self.tracer.replies.get(
                 timeout=RECV_TIMEOUT_MS / 1000)
@@ -808,7 +816,7 @@ class PtraceProcess(ManagedProcess):
         th._pt_inject = 0
         th.sigmask = cur.sigmask     # clone inherits the mask
         if flags & CLONE_CHILD_CLEARTID:
-            th.clear_ctid = args[3]
+            th.clear_ctid = ctid
         self.threads[vtid] = th
         self._push_task(ctx.now,
                         lambda ctx2, ev: self._start_child(ctx2, th))
@@ -824,7 +832,9 @@ class PtraceProcess(ManagedProcess):
         self._continue(ctx, th)
 
     # -- fork (TRACEFORK flavor of spawn_fork) --------------------------
-    def spawn_fork(self, ctx):
+    def spawn_fork(self, ctx, flags: int = 0,
+                   parsed: Optional[tuple] = None):
+        ptid, ctid, stack = parsed if parsed is not None else (0, 0, 0)
         # a REAL constructor call (vs hand-copying __init__'s fields):
         # allocates the child vpid and every base field; the clone
         # below rewrites the parent's %rax to that vpid
@@ -832,7 +842,8 @@ class PtraceProcess(ManagedProcess):
                               self.environment)
         cur = self.current
         self.tracer.cmds.put(("clone",
-                              (cur.native_tid, child.vpid, "fork")))
+                              (cur.native_tid, child.vpid, "fork",
+                               flags, ptid, ctid, stack)))
         try:
             reply = self.tracer.replies.get(
                 timeout=RECV_TIMEOUT_MS / 1000)

@@ -351,9 +351,14 @@ class SyscallHandler:
         flags = int(a[0])
         if not flags & self.CLONE_THREAD:
             # fork-style clone: only reaches us under ptrace (the
-            # preload shim rewrites these to SYS_fork client-side)
+            # preload shim rewrites these to SYS_fork client-side);
+            # pass the stack/tid words so the tracer can redirect the
+            # COW child onto the requested clone stack
             if getattr(self.p, "interpose_style", "") == "ptrace":
-                return self.sys_fork(ctx, a)
+                if not getattr(self.p, "supports_fork", False):
+                    return -ENOSYS
+                return self.p.spawn_fork(ctx, flags=flags,
+                                         parsed=(a[2], a[3], a[1]))
             return -EOPNOTSUPP
         required = (self.CLONE_VM | self.CLONE_FS | self.CLONE_FILES |
                     self.CLONE_SIGHAND | self.CLONE_THREAD |
@@ -365,8 +370,43 @@ class SyscallHandler:
         return self.p.spawn_thread(ctx, flags, a)
 
     def sys_clone3(self, ctx, a):
-        # glibc falls back to classic clone on ENOSYS
-        return -ENOSYS
+        """clone3 (musl/Go issue it natively): parse struct
+        clone_args and route to the thread/fork paths. Supported on
+        the ptrace backend (every syscall traps with full memory
+        access); the preload shim refuses with ENOSYS, which glibc
+        answers by falling back to classic clone."""
+        if getattr(self.p, "interpose_style", "") != "ptrace":
+            return -ENOSYS
+        ptr, size = a[0], int(a[1])
+        if not ptr:
+            return -EFAULT
+        if size < 64:
+            return -EINVAL
+        try:
+            raw = self.mem.read(ptr, 64)
+        except OSError:
+            return -EFAULT
+        (flags, _pidfd, child_tid, parent_tid, _exit_sig, stack,
+         stack_size, _tls) = struct.unpack("<8Q", raw)
+        stack_top = (stack + stack_size) if stack else 0
+        flags = int(flags)
+        if flags & self.CLONE_THREAD:
+            required = (self.CLONE_VM | self.CLONE_FS |
+                        self.CLONE_FILES | self.CLONE_SIGHAND |
+                        self.CLONE_THREAD | self.CLONE_SYSVSEM)
+            if (flags & required) != required:
+                return -EOPNOTSUPP
+            if not getattr(self.p, "supports_threads", False):
+                return -ENOSYS
+            return self.p.spawn_thread(
+                ctx, flags, a,
+                parsed=(int(parent_tid), int(child_tid),
+                        int(stack_top)))
+        if not getattr(self.p, "supports_fork", False):
+            return -ENOSYS
+        return self.p.spawn_fork(
+            ctx, flags=flags,
+            parsed=(int(parent_tid), int(child_tid), int(stack_top)))
 
     def sys_fork(self, ctx, a):
         """fork / vfork / fork-style clone: the shim normalizes all
@@ -1288,6 +1328,9 @@ class SyscallHandler:
         if path in ("/dev/urandom", "/dev/random"):
             return self.table.alloc(VirtualFileDesc(
                 generator=self.p.deterministic_bytes, mode=0o20666))
+        if path in ("/etc/hosts", "/etc/resolv.conf",
+                    "/etc/nsswitch.conf") and (flags & 3) != 0:
+            return -13          # EACCES: read-only emulated files
         if path == "/etc/hosts":
             hosts = os.path.join(
                 getattr(self.p.runtime, "data_dir", ""), "etc_hosts")
@@ -1319,9 +1362,53 @@ class SyscallHandler:
         self.mem.write(a[1], bytes(st))
         return 0
 
+    # virtual special-file stat shapes: (mode, size_fn) — size -1
+    # means "the served content's length" (resolved at stat time)
+    _SPECIAL_MODES = {
+        "/dev/urandom": 0o20666, "/dev/random": 0o20666,
+        "/etc/hosts": 0o100644, "/etc/resolv.conf": 0o100644,
+        "/etc/nsswitch.conf": 0o100644,
+    }
+
+    def _special_stat(self, path: str):
+        """(mode, size) for a virtualized special path, or None. The
+        stat must agree with what open() of the same path serves —
+        the REAL file's size/mtime would leak machine state."""
+        mode = self._SPECIAL_MODES.get(path)
+        if mode is None:
+            return None
+        if path == "/etc/hosts":
+            hosts = os.path.join(
+                getattr(self.p.runtime, "data_dir", ""), "etc_hosts")
+            if not os.path.exists(hosts):
+                return None         # open() would pass NATIVE too
+            size = os.path.getsize(hosts)
+        elif path == "/etc/nsswitch.conf":
+            size = len(b"hosts: files\n")
+        else:
+            size = 0
+        return mode, size
+
+    def _write_stat(self, ptr: int, mode: int, size: int) -> int:
+        st = bytearray(144)
+        struct.pack_into("<I", st, 24, mode)
+        struct.pack_into("<Q", st, 16, 1)          # nlink
+        struct.pack_into("<q", st, 48, size)       # st_size
+        self.mem.write(ptr, bytes(st))
+        return 0
+
     def sys_newfstatat(self, ctx, a):
         dirfd = _s32(a[0])
         if dirfd < VFD_BASE:
+            if dirfd == self.AT_FDCWD and a[1]:
+                try:
+                    path = self.mem.read_cstr(a[1]).decode(
+                        errors="surrogateescape")
+                except OSError:
+                    return -EFAULT
+                sp = self._special_stat(path)
+                if sp is not None:
+                    return self._write_stat(a[2], sp[0], sp[1])
             return NATIVE           # path-relative stat on native dirs
         # AT_EMPTY_PATH fstat on a virtual fd (glibc's fstat() ABI)
         path = self.mem.read_cstr(a[1], 8) if a[1] else b""
@@ -1332,6 +1419,20 @@ class SyscallHandler:
     def sys_statx(self, ctx, a):
         dirfd = _s32(a[0])
         if dirfd < VFD_BASE:
+            if dirfd == self.AT_FDCWD and a[1]:
+                try:
+                    path = self.mem.read_cstr(a[1]).decode(
+                        errors="surrogateescape")
+                except OSError:
+                    return -EFAULT
+                sp = self._special_stat(path)
+                if sp is not None:
+                    stx = bytearray(256)
+                    struct.pack_into("<I", stx, 0, 0x7FF)  # stx_mask
+                    struct.pack_into("<H", stx, 28, sp[0])
+                    struct.pack_into("<Q", stx, 40, sp[1])  # stx_size
+                    self.mem.write(a[4], bytes(stx))
+                    return 0
             return NATIVE
         desc = self._desc(dirfd)
         if desc is None:

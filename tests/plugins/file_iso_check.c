@@ -4,6 +4,7 @@
  * prints the first line of /etc/hosts (the SIMULATED name map). */
 #include <stdio.h>
 #include <string.h>
+#include <sys/stat.h>
 
 int main(int argc, char **argv) {
   const char *tag = argc > 1 ? argv[1] : "none";
@@ -20,9 +21,20 @@ int main(int argc, char **argv) {
   f = fopen("/etc/hosts", "r");
   if (!f) { perror("hosts"); return 1; }
   int hosts_lines = 0;
-  while (fgets(buf, sizeof buf, f)) hosts_lines++;
+  long hosts_bytes = 0;
+  while (fgets(buf, sizeof buf, f)) {
+    hosts_lines++;
+    hosts_bytes += (long)strlen(buf);
+  }
   fclose(f);
   printf("hosts_lines %d\n", hosts_lines);
+  /* path-stat must agree with the SERVED content, not the real file */
+  struct stat st;
+  if (stat("/etc/hosts", &st) != 0) { perror("stat"); return 1; }
+  printf("stat_coherent %d\n", (long)st.st_size == hosts_bytes);
+  f = fopen("/etc/hosts", "a");
+  printf("hosts_readonly %d\n", f == NULL);
+  if (f) fclose(f);
   printf("done\n");
   return 0;
 }

@@ -82,6 +82,9 @@ def test_relative_path_isolation(plugins, tmp_path, method):
     # simulated hosts file: localhost + alice + bob = 3 lines
     assert "hosts_lines 3" in out_a
     assert "hosts_lines 3" in out_b
+    # path-stat agrees with the served content; writes are refused
+    assert "stat_coherent 1" in out_a
+    assert "hosts_readonly 1" in out_a
 
 
 def test_getaddrinfo_under_ptrace(plugins, tmp_path):
@@ -107,3 +110,4 @@ def test_getaddrinfo_under_ptrace(plugins, tmp_path):
     out = read_stdout(data, "client", "resolver_check")
     assert "hostname client" in out
     assert "resolved server 11.0.0.1:9000" in out
+    assert stats.ok

@@ -316,3 +316,24 @@ def test_tcp_transfer_checksum(plugins, tmp_path):
     assert recv_n == sent_n
     assert recv_sum == sent_sum
     assert stats.ok
+
+
+def test_strict_traps_mode(plugins, tmp_path):
+    """SHADOWTPU_STRICT_TRAPS=1 traps the startup-window syscalls too:
+    raw-syscall time reads virtualize (timecheck still sees exact
+    simulated clocks) instead of silently reading native values."""
+    data = str(tmp_path / "shadow.data")
+    cfg = base_cfg(data) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['timecheck']}
+      environment: SHADOWTPU_STRICT_TRAPS=1
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    out = read_stdout(data, "alice", "timecheck")
+    lines = out.splitlines()
+    assert lines[0] == "t0 1.000000000"
+    assert lines[1] == "t1 1.100000000"
+    assert stats.ok

@@ -293,3 +293,25 @@ def test_system_spawns_shell_under_ptrace(plugins, tmp_path):
     assert "spawned-ok" in out
     assert "system rc=0 exited=1 status=0" in out
     assert stats.ok
+
+
+def test_clone3_under_ptrace(plugins, tmp_path):
+    """Raw clone3 (the musl/Go path, no glibc fallback): thread
+    flavor with stack/SETTID/CLEARTID through struct clone_args, and
+    fork flavor with wait4 — both fully virtualized."""
+    data = str(tmp_path / "shadow.data")
+    cfg = ptrace_cfg(data) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['clone3_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    out = read_stdout(data, "alice", "clone3_check")
+    assert "t-child ran" in out
+    assert "thread vtid_delta=1 cleared=1" in out
+    assert "f-child pid_delta=2" in out
+    assert "fork rc=1 exited=1 code=7" in out
+    assert "done" in out
+    assert stats.ok

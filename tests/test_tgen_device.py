@@ -83,3 +83,66 @@ def test_tgen_lossy_retry_completes():
                     count=1, extra="retry=300ms")
     for h in hosts[1:]:
         assert h.app.downloads_done == 1
+
+
+HET_YAML = """
+general:
+  stop_time: 10s
+  seed: 3
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ directed 0
+        node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.02 ]
+        edge [ source 0 target 1 latency "20 ms" packet_loss 0.02 ]
+        edge [ source 1 target 1 latency "10 ms" packet_loss 0.02 ]
+      ]
+experimental:
+  scheduler_policy: {policy}
+  event_capacity: 192
+  outbox_capacity: 256
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - {{path: model:tgen_server, start_time: 10ms}}
+  fast:
+    quantity: 3
+    network_node_id: 1
+    processes:
+    - {{path: model:tgen_client,
+       args: server=server size=200KiB count=3 pause=100ms retry=300ms,
+       start_time: 100ms}}
+  slow:
+    quantity: 3
+    network_node_id: 1
+    processes:
+    - {{path: model:tgen_client,
+       args: server=server size=200KiB count=1 pause=900ms retry=800ms,
+       start_time: 200ms}}
+"""
+
+
+def test_tgen_heterogeneous_client_args_on_device():
+    """count/pause/retry vary per host (the tor_large/tornettools
+    shape); the device twin's per-host arg arrays must bit-match the
+    serial oracle. Only `size` (the servers' response shape) must
+    stay uniform."""
+    outs = {}
+    for policy in ("serial", "tpu"):
+        c = Controller(load_config_str(HET_YAML.format(policy=policy)))
+        stats = c.run()
+        assert stats.ok, policy
+        outs[policy] = ([h.trace_checksum for h in c.sim.hosts],
+                        stats.packets_sent, stats.packets_dropped)
+    assert outs["serial"] == outs["tpu"]
+
+
+def test_tgen_heterogeneous_size_still_refused():
+    yaml = HET_YAML.format(policy="tpu").replace(
+        "size=200KiB count=1", "size=100KiB count=1")
+    with pytest.raises(ValueError, match="size.*must match"):
+        Controller(load_config_str(yaml))

@@ -158,3 +158,26 @@ def test_tor_large_config_builds():
     stats = c2.run()
     assert stats.ok
     assert stats.packets_delivered > 500
+
+
+def test_tor_heterogeneous_client_args_on_device():
+    """count/pause/retry vary per client group (the tornettools
+    shape): the device twin's per-host arg arrays bit-match the
+    serial oracle; only `cells` must stay uniform."""
+    extra = """  client_slow:
+    quantity: 8
+    network_node_id: 0
+    processes:
+    - {path: model:tor_client, args: cells=48 count=1 pause=2s retry=900ms, start_time: 2s}
+"""
+    outs = {}
+    for policy in ("serial", "tpu"):
+        yaml = TOR_YAML.format(
+            policy=policy, seed=5, loss=0.02, relays=8, clients=8,
+            cells=48, stop="20s", retry=" retry=400ms") + extra
+        c = Controller(load_config_str(yaml))
+        stats = c.run()
+        assert stats.ok, policy
+        outs[policy] = ([h.trace_checksum for h in c.sim.hosts],
+                        stats.packets_sent, stats.packets_dropped)
+    assert outs["serial"] == outs["tpu"]
