@@ -1102,31 +1102,79 @@ static int shim_special_path(const char *p) {
          strcmp(p, "/etc/nsswitch.conf") == 0;
 }
 
-static int shim_statat_impl(const char *path, void *st) {
+static int shim_statat_impl(const char *path, void *st, int flags) {
   /* stat of a special path must agree with what open() serves (the
    * real file's size/mtime would leak machine state) */
-  long args[6] = {AT_FDCWD, (long)path, (long)st, 0, 0, 0};
+  long args[6] = {AT_FDCWD, (long)path, (long)st, flags, 0, 0};
   if (g_enabled && shim_special_path(path))
     return ret_errno(shim_emulated_syscall(SYS_newfstatat, args));
   return ret_errno(shim_rawsyscall(SYS_newfstatat, AT_FDCWD,
-                                   (long)path, (long)st, 0, 0, 0));
+                                   (long)path, (long)st, flags, 0, 0));
 }
 
 int stat(const char *path, struct stat *st) {
-  return shim_statat_impl(path, st);
+  return shim_statat_impl(path, st, 0);
 }
 
 int stat64(const char *path, struct stat64 *st) {
-  return shim_statat_impl(path, st);
+  return shim_statat_impl(path, st, 0);
 }
 
 int lstat(const char *path, struct stat *st) {
-  /* the special paths are not symlinks: identical result */
-  return shim_statat_impl(path, st);
+  /* the special paths are not symlinks, but the general fallback
+   * must keep lstat semantics */
+  return shim_statat_impl(path, st, AT_SYMLINK_NOFOLLOW);
 }
 
 int lstat64(const char *path, struct stat64 *st) {
-  return shim_statat_impl(path, st);
+  return shim_statat_impl(path, st, AT_SYMLINK_NOFOLLOW);
+}
+
+/* pre-glibc-2.33 binaries call the __xstat family */
+int __xstat(int ver, const char *path, struct stat *st) {
+  (void)ver;
+  return shim_statat_impl(path, st, 0);
+}
+
+int __lxstat(int ver, const char *path, struct stat *st) {
+  (void)ver;
+  return shim_statat_impl(path, st, AT_SYMLINK_NOFOLLOW);
+}
+
+int __xstat64(int ver, const char *path, struct stat64 *st) {
+  (void)ver;
+  return shim_statat_impl(path, st, 0);
+}
+
+int __lxstat64(int ver, const char *path, struct stat64 *st) {
+  (void)ver;
+  return shim_statat_impl(path, st, AT_SYMLINK_NOFOLLOW);
+}
+
+int fstatat(int dirfd, const char *path, struct stat *st, int flags) {
+  if (g_enabled && shim_special_path(path)) {
+    long args[6] = {AT_FDCWD, (long)path, (long)st, flags, 0, 0};
+    return ret_errno(shim_emulated_syscall(SYS_newfstatat, args));
+  }
+  return ret_errno(shim_rawsyscall(SYS_newfstatat, dirfd, (long)path,
+                                   (long)st, flags, 0, 0));
+}
+
+int fstatat64(int dirfd, const char *path, struct stat64 *st,
+              int flags) {
+  return fstatat(dirfd, path, (struct stat *)st, flags);
+}
+
+struct statx;
+int statx(int dirfd, const char *path, int flags, unsigned int mask,
+          struct statx *stxbuf) {
+  if (g_enabled && shim_special_path(path)) {
+    long args[6] = {AT_FDCWD, (long)path, flags, (long)mask,
+                    (long)stxbuf, 0};
+    return ret_errno(shim_emulated_syscall(SYS_statx, args));
+  }
+  return ret_errno(shim_rawsyscall(SYS_statx, dirfd, (long)path, flags,
+                                   (long)mask, (long)stxbuf, 0));
 }
 
 static int shim_openat_impl(int dirfd, const char *path, int flags,

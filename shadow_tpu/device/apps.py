@@ -58,6 +58,25 @@ class DeviceApp:
     survivor bitmask in d2."""
 
     n_state_words: int = 1
+
+    def _set_client_args(self, count, pause_ns, retry_ns,
+                         shape) -> None:
+        """CLIENT-LOCAL args may vary per host (heterogeneous
+        configs): scalars broadcast, arrays pass through."""
+        self._count = np.broadcast_to(
+            np.asarray(count, np.int32), shape)
+        self._pause = np.broadcast_to(
+            np.asarray(pause_ns, np.int64), shape)
+        self._retry = np.broadcast_to(
+            np.asarray(retry_ns, np.int64), shape)
+
+    def _client_args_at(self, gid):
+        """(count, pause_ns, retry_ns) gathered per host; padded
+        (out-of-range) hosts clip to the last entry — they are inert."""
+        cg = jnp.clip(gid, 0, len(self._count) - 1)
+        return (jnp.asarray(self._count)[cg],
+                jnp.asarray(self._pause)[cg],
+                jnp.asarray(self._retry)[cg])
     max_sends: int = 1
     max_timers: int = 0
     max_draws: int = 1
@@ -168,16 +187,10 @@ class TgenDevice(DeviceApp):
         self.max_train = self.chunk
         self.max_timers = 1
         self.max_draws = 1              # no randomness consumed
-        # CLIENT-LOCAL args may vary per host (heterogeneous configs:
-        # scalars broadcast, arrays pass through); `size` shapes the
-        # SERVER's response and must stay uniform
-        shape = np.shape(self.roles)
-        self._count = np.broadcast_to(
-            np.asarray(self.count, np.int32), shape)
-        self._pause = np.broadcast_to(
-            np.asarray(self.pause_ns, np.int64), shape)
-        self._retry = np.broadcast_to(
-            np.asarray(self.retry_ns, np.int64), shape)
+        # `size` shapes the SERVER's response and must stay uniform;
+        # count/pause/retry are client-local and may vary per host
+        self._set_client_args(self.count, self.pause_ns,
+                              self.retry_ns, np.shape(self.roles))
 
     def init_state(self, n_hosts: int) -> jnp.ndarray:
         # n_hosts may exceed len(roles): shard padding hosts are inert
@@ -202,10 +215,7 @@ class TgenDevice(DeviceApp):
         is_server = role == 0
         is_client = role == 1
 
-        cg = jnp.clip(gid, 0, len(self._count) - 1)
-        count_h = jnp.asarray(self._count)[cg]
-        pause_h = jnp.asarray(self._pause)[cg]
-        retry_h = jnp.asarray(self._retry)[cg]
+        count_h, pause_h, retry_h = self._client_args_at(gid)
 
         is_req = is_server & (kind == KIND_PACKET) & (d0 == self.TAG_REQ)
         is_data = is_client & (kind == KIND_PACKET) & (d0 == self.TAG_DATA)
@@ -352,15 +362,10 @@ class TorDevice(DeviceApp):
         self.max_timers = 1
         self.max_draws = 1              # no stateful randomness
         self.seed_pair = prng.seed_key(self.seed)
-        # client-local args vary per host; `cells` shapes the exit
-        # relays' DATA service and must stay uniform
-        shape = np.shape(self.roles)
-        self._count = np.broadcast_to(
-            np.asarray(self.count, np.int32), shape)
-        self._pause = np.broadcast_to(
-            np.asarray(self.pause_ns, np.int64), shape)
-        self._retry = np.broadcast_to(
-            np.asarray(self.retry_ns, np.int64), shape)
+        # `cells` shapes the exit relays' DATA service and must stay
+        # uniform; count/pause/retry are client-local per-host
+        self._set_client_args(self.count, self.pause_ns,
+                              self.retry_ns, np.shape(self.roles))
 
     def init_state(self, n_hosts: int) -> jnp.ndarray:
         st = np.zeros((n_hosts, self.n_state_words), np.int32)
@@ -423,10 +428,7 @@ class TorDevice(DeviceApp):
         # ---- client window progress (tgen dedup rules) ----
         my_route = self._route(me)
         my_guard = my_route[0]
-        cg = jnp.clip(gid, 0, len(self._count) - 1)
-        count_h = jnp.asarray(self._count)[cg]
-        pause_h = jnp.asarray(self._pause)[cg]
-        retry_h = jnp.asarray(self._retry)[cg]
+        count_h, pause_h, retry_h = self._client_args_at(gid)
 
         c_data = is_client & is_pkt & (d0 == self.TAG_DATA)
         c_boot = is_client & (kind == KIND_BOOT) & (count_h > 0)

@@ -697,14 +697,33 @@ class DeviceEngine:
             skey, perm, rows = _flat_sorted(ob, gid)
             G = H_loc * OB
 
+            inc2 = None
             if n_shards > 1 and cfg.exchange == "all_to_all":
-                # pack each destination shard's contiguous run into
-                # [n_shards, CAP] and all_to_all only those rows
+                # SELF-SHARD rows (timers, model-NIC READY reinserts,
+                # local sends — often half the outbox) never need to
+                # move: they bypass the pack entirely (zero ICI, zero
+                # CAP consumption) and reach the merge as a second
+                # incoming block below. Only genuinely remote rows
+                # pack into [n_shards, CAP] for the all_to_all.
                 bound = (jnp.arange(n_shards + 1, dtype=jnp.int64)
                          * H_loc * SPAN)
                 edges = jnp.searchsorted(skey, bound)
                 starts, nxt = edges[:-1], edges[1:]
                 counts = nxt - starts
+
+                # my own range: straight per-host windows (IN each)
+                base_ = my_shard.astype(jnp.int64) * H_loc
+                hb2 = (base_ + jnp.arange(H_loc + 1,
+                                          dtype=jnp.int64)) * SPAN
+                e2 = jnp.searchsorted(skey, hb2)
+                s2, c2 = e2[:-1], e2[1:] - e2[:-1]
+                state["overflow"] = state["overflow"] + \
+                    jnp.maximum(0, c2 - IN).astype(jnp.int32)
+                inc2 = _seg_take(perm, rows, s2, c2, IN)
+
+                # remote rows: mask my own slot out of the pack
+                remote = jnp.arange(n_shards) != my_shard
+                counts = jnp.where(remote, counts, 0)
                 # overflow attributed to the SENDING host (it owns the
                 # sizing knob): per-shard ranks via segment scan, then
                 # a 1-key sort + searchsorted histogram of the lost
@@ -715,7 +734,8 @@ class DeviceEngine:
                     [jnp.array([True]), shard_of[1:] != shard_of[:-1]])
                 seg0 = lax.associative_scan(
                     jnp.maximum, jnp.where(is_new, idx, 0))
-                lost_mask = (skey < IMAX) & ((idx - seg0) >= CAP)
+                lost_mask = (skey < IMAX) & ((idx - seg0) >= CAP) & \
+                    (shard_of != my_shard.astype(jnp.int64))
                 src_loc = (skey % SPAN) // OB \
                     - my_shard.astype(jnp.int64) * H_loc
                 lk = lax.sort(jnp.where(lost_mask, src_loc, IMAX))
@@ -770,29 +790,40 @@ class DeviceEngine:
                 jnp.maximum(0, counts - IN).astype(jnp.int32)
             inc = _seg_take(perm, rows, starts, counts, IN)
 
-            # merge: one lexicographic row sort of [live heap | inc]
-            # by (time, src<<32|seq) — keys + column iota only; the
-            # three payload columns follow via take_along_axis
+            # merge: one lexicographic row sort of [live heap | inc
+            # (| self-shard inc)] by (time, src<<32|seq) — keys +
+            # column iota only; payload columns follow via
+            # take_along_axis
+            def _inc_cols(b):
+                kindb = lo32(b["m"]) & 0xFF    # strip the train count
+                return (b["t"], b["k"],
+                        pack2(kindb, hi32(b["s"])),
+                        pack2(lo32(b["s"]), lo32(b["v"])),
+                        (b["v"] >> 32) & U32)  # d2 (train survivors)
+
+            blocks = [_inc_cols(inc)]
+            if inc2 is not None:
+                blocks.append(_inc_cols(inc2))
             live = jnp.arange(E)[None, :] >= state["head"][:, None]
             mt = jnp.where(live, state["ht"], INF)
             mk = jnp.where(live, state["hk"], IMAX)
-            inc_kind = lo32(inc["m"]) & 0xFF   # strip the train count
-            inc_hm = pack2(inc_kind, hi32(inc["s"]))
-            inc_hv = pack2(lo32(inc["s"]), lo32(inc["v"]))
-            inc_hw = (inc["v"] >> 32) & U32        # d2 (train survivors)
-            ct = jnp.concatenate([mt, inc["t"]], axis=1)
-            ck = jnp.concatenate([mk, inc["k"]], axis=1)
+            WID = E + IN * len(blocks)
+            ct = jnp.concatenate([mt] + [b[0] for b in blocks], axis=1)
+            ck = jnp.concatenate([mk] + [b[1] for b in blocks], axis=1)
             ci = jnp.broadcast_to(
-                jnp.arange(E + IN, dtype=jnp.int32)[None, :],
-                (H_loc, E + IN))
+                jnp.arange(WID, dtype=jnp.int32)[None, :],
+                (H_loc, WID))
             st, sk, si = lax.sort((ct, ck, ci), dimension=1,
                                   num_keys=2)
             state["overflow"] = state["overflow"] + \
                 (st[:, E:] < INF).sum(-1).astype(jnp.int32)
             sie = si[:, :E]
-            cm = jnp.concatenate([state["hm"], inc_hm], axis=1)
-            cv = jnp.concatenate([state["hv"], inc_hv], axis=1)
-            cw = jnp.concatenate([state["hw"], inc_hw], axis=1)
+            cm = jnp.concatenate([state["hm"]] + [b[2] for b in blocks],
+                                 axis=1)
+            cv = jnp.concatenate([state["hv"]] + [b[3] for b in blocks],
+                                 axis=1)
+            cw = jnp.concatenate([state["hw"]] + [b[4] for b in blocks],
+                                 axis=1)
             state["ht"] = st[:, :E]
             state["hk"] = sk[:, :E]
             state["hm"] = jnp.take_along_axis(cm, sie, axis=1)

@@ -383,11 +383,15 @@ class SyscallHandler:
         if size < 64:
             return -EINVAL
         try:
-            raw = self.mem.read(ptr, 64)
+            raw = self.mem.read(ptr, min(size, 4096))
         except OSError:
             return -EFAULT
+        if any(raw[64:]):
+            # extension fields we don't emulate (set_tid, cgroup):
+            # the kernel's rule for unknown nonzero trailing bytes
+            return -7           # E2BIG
         (flags, _pidfd, child_tid, parent_tid, _exit_sig, stack,
-         stack_size, _tls) = struct.unpack("<8Q", raw)
+         stack_size, _tls) = struct.unpack("<8Q", raw[:64])
         stack_top = (stack + stack_size) if stack else 0
         flags = int(flags)
         if flags & self.CLONE_THREAD:
@@ -1400,12 +1404,14 @@ class SyscallHandler:
     def sys_newfstatat(self, ctx, a):
         dirfd = _s32(a[0])
         if dirfd < VFD_BASE:
-            if dirfd == self.AT_FDCWD and a[1]:
+            if a[1]:
                 try:
                     path = self.mem.read_cstr(a[1]).decode(
                         errors="surrogateescape")
                 except OSError:
                     return -EFAULT
+                # the special paths are absolute — the kernel ignores
+                # dirfd for those, and so must the virtualization
                 sp = self._special_stat(path)
                 if sp is not None:
                     return self._write_stat(a[2], sp[0], sp[1])
@@ -1419,7 +1425,7 @@ class SyscallHandler:
     def sys_statx(self, ctx, a):
         dirfd = _s32(a[0])
         if dirfd < VFD_BASE:
-            if dirfd == self.AT_FDCWD and a[1]:
+            if a[1]:
                 try:
                     path = self.mem.read_cstr(a[1]).decode(
                         errors="surrogateescape")

@@ -284,3 +284,53 @@ def test_hub_skew_exchange(caplog):
         assert stats.packets_sent > 999     # requests + responses
         out[mode] = [h.trace_checksum for h in c.sim.hosts]
     assert out["all_to_all"] == out["all_gather"]
+
+
+def test_self_shard_rows_bypass_exchange_capacity():
+    """ADVICE r3 #4: self-shard rows (timers, local sends) never
+    enter the all_to_all pack — a fully shard-local workload runs
+    with exchange_capacity=1 and zero x_overflow (it used to consume
+    CAP and overflow)."""
+    yaml = """
+general:
+  stop_time: 4s
+  seed: 2
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ directed 0
+        node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.01 ]
+      ]
+experimental:
+  scheduler_policy: tpu
+  exchange: all_to_all
+  exchange_capacity: 1
+hosts:
+"""
+    # 8 adjacent (server, client) pairs -> 16 hosts over the 8-device
+    # mesh (H_loc=2): every pair is shard-local, all traffic self-shard
+    for i in range(8):
+        yaml += f"""  server{i}:
+    network_node_id: 0
+    processes: [{{path: model:tgen_server, start_time: 10ms}}]
+  client{i}:
+    network_node_id: 0
+    processes:
+    - {{path: model:tgen_client, args: server=server{i} size=64KiB count=2 pause=100ms, start_time: 100ms}}
+"""
+    c = Controller(load_config_str(yaml))
+    stats = c.run()
+    assert stats.ok
+    assert int(np.asarray(c.runner.final_state["x_overflow"]).sum()) \
+        == 0
+    assert stats.packets_sent > 0
+    # and the serial oracle agrees bit-for-bit
+    c2 = Controller(load_config_str(
+        yaml.replace("scheduler_policy: tpu",
+                     "scheduler_policy: serial")))
+    s2 = c2.run()
+    assert s2.ok
+    assert [h.trace_checksum for h in c2.sim.hosts] == \
+        [h.trace_checksum for h in c.sim.hosts]
