@@ -868,6 +868,8 @@ static const int kTrapSyscalls[] = {
      * The special paths the simulator must own (/dev/urandom, the
      * simulated /etc/hosts) are caught by the open/openat/fopen
      * SYMBOL overrides below via the explicit funnel instead. */
+    SYS_getrusage,    SYS_times,       SYS_sched_getaffinity,
+    SYS_sched_setaffinity, SYS_getcpu,
     SYS_gettid,       SYS_tgkill,
     SYS_rt_sigprocmask, SYS_wait4,      SYS_kill,
     SYS_rt_sigaction, SYS_pause,       SYS_rt_sigpending,
@@ -1102,6 +1104,8 @@ static int shim_special_path(const char *p) {
          strcmp(p, "/etc/nsswitch.conf") == 0;
 }
 
+int fstatat(int dirfd, const char *path, struct stat *st, int flags);
+
 static int shim_statat_impl(const char *path, void *st, int flags) {
   /* stat of a special path must agree with what open() serves (the
    * real file's size/mtime would leak machine state) */
@@ -1149,6 +1153,18 @@ int __xstat64(int ver, const char *path, struct stat64 *st) {
 int __lxstat64(int ver, const char *path, struct stat64 *st) {
   (void)ver;
   return shim_statat_impl(path, st, AT_SYMLINK_NOFOLLOW);
+}
+
+int __fxstatat(int ver, int dirfd, const char *path, struct stat *st,
+               int flags) {
+  (void)ver;
+  return fstatat(dirfd, path, st, flags);
+}
+
+int __fxstatat64(int ver, int dirfd, const char *path,
+                 struct stat64 *st, int flags) {
+  (void)ver;
+  return fstatat(dirfd, path, (struct stat *)st, flags);
 }
 
 int fstatat(int dirfd, const char *path, struct stat *st, int flags) {

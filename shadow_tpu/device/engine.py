@@ -691,6 +691,19 @@ class DeviceEngine:
                 out[f] = jnp.where(ok, v, fillv)
             return out
 
+        def _host_windows(state, skey, perm, rows, my_shard):
+            """Per-host contiguous arrival segments -> [H_loc, IN]
+            windows + overflow accounting (shared by the self-shard
+            bypass and the post-exchange arrival step)."""
+            base = my_shard.astype(jnp.int64) * H_loc
+            hb = (base + jnp.arange(H_loc + 1, dtype=jnp.int64)) \
+                * SPAN
+            edges = jnp.searchsorted(skey, hb)
+            starts, counts = edges[:-1], edges[1:] - edges[:-1]
+            state["overflow"] = state["overflow"] + \
+                jnp.maximum(0, counts - IN).astype(jnp.int32)
+            return state, _seg_take(perm, rows, starts, counts, IN)
+
         def _exchange(state, ob, gid, my_shard, host_vertex):
             if CP:
                 state = _count_paths(state, ob, host_vertex)
@@ -712,14 +725,8 @@ class DeviceEngine:
                 counts = nxt - starts
 
                 # my own range: straight per-host windows (IN each)
-                base_ = my_shard.astype(jnp.int64) * H_loc
-                hb2 = (base_ + jnp.arange(H_loc + 1,
-                                          dtype=jnp.int64)) * SPAN
-                e2 = jnp.searchsorted(skey, hb2)
-                s2, c2 = e2[:-1], e2[1:] - e2[:-1]
-                state["overflow"] = state["overflow"] + \
-                    jnp.maximum(0, c2 - IN).astype(jnp.int32)
-                inc2 = _seg_take(perm, rows, s2, c2, IN)
+                state, inc2 = _host_windows(state, skey, perm, rows,
+                                            my_shard)
 
                 # remote rows: mask my own slot out of the pack
                 remote = jnp.arange(n_shards) != my_shard
@@ -780,15 +787,8 @@ class DeviceEngine:
                 G = n_shards * G
 
             # my hosts' contiguous arrival segments -> [H_loc, IN]
-            base = my_shard.astype(jnp.int64) * H_loc
-            hb = (base + jnp.arange(H_loc + 1, dtype=jnp.int64)) \
-                * SPAN
-            edges = jnp.searchsorted(skey, hb)
-            starts, nxt = edges[:-1], edges[1:]
-            counts = nxt - starts
-            state["overflow"] = state["overflow"] + \
-                jnp.maximum(0, counts - IN).astype(jnp.int32)
-            inc = _seg_take(perm, rows, starts, counts, IN)
+            state, inc = _host_windows(state, skey, perm, rows,
+                                       my_shard)
 
             # merge: one lexicographic row sort of [live heap | inc
             # (| self-shard inc)] by (time, src<<32|seq) — keys +

@@ -78,6 +78,8 @@ NR = dict(
     timerfd_settime=286, timerfd_gettime=287, accept4=288, eventfd2=290,
     epoll_create1=291, dup3=292, pipe2=293, recvmmsg=299, sendmmsg=307,
     getrandom=318, newfstatat=262, statx=332,
+    getrusage=98, times=100, sched_setaffinity=203,
+    sched_getaffinity=204, getcpu=309,
     sched_yield=24, gettid=186, sysinfo=99, futex=202,
     set_tid_address=218, sendfile=40, tgkill=234, clone3=435,
     wait4=61, kill=62, rt_sigaction=13, pause=34,
@@ -94,6 +96,7 @@ ECHILD = 10
 ENOTTY, ESPIPE, EPIPE, ENOSYS, ENOTSOCK, EDESTADDRREQ = 25, 29, 32, 38, 88, 89
 EMSGSIZE, ENOPROTOOPT, EPROTONOSUPPORT, EOPNOTSUPP, EAFNOSUPPORT = \
     90, 92, 93, 95, 97
+E2BIG, EACCES = 7, 13
 EADDRINUSE, ENETUNREACH, ECONNRESET, EISCONN, ENOTCONN = 98, 101, 104, 106, 107
 ETIMEDOUT, ECONNREFUSED, EINPROGRESS, EALREADY = 110, 111, 115, 114
 
@@ -389,7 +392,7 @@ class SyscallHandler:
         if any(raw[64:]):
             # extension fields we don't emulate (set_tid, cgroup):
             # the kernel's rule for unknown nonzero trailing bytes
-            return -7           # E2BIG
+            return -E2BIG
         (flags, _pidfd, child_tid, parent_tid, _exit_sig, stack,
          stack_size, _tls) = struct.unpack("<8Q", raw[:64])
         stack_top = (stack + stack_size) if stack else 0
@@ -1334,7 +1337,7 @@ class SyscallHandler:
                 generator=self.p.deterministic_bytes, mode=0o20666))
         if path in ("/etc/hosts", "/etc/resolv.conf",
                     "/etc/nsswitch.conf") and (flags & 3) != 0:
-            return -13          # EACCES: read-only emulated files
+            return -EACCES      # read-only emulated files
         if path == "/etc/hosts":
             hosts = os.path.join(
                 getattr(self.p.runtime, "data_dir", ""), "etc_hosts")
@@ -1985,6 +1988,53 @@ class SyscallHandler:
     # scheduling / identity odds and ends (unistd.c, sysinfo.c)
     # ==================================================================
     def sys_sched_yield(self, ctx, a):
+        return 0
+
+    # -- deterministic resource/topology views -------------------------
+    # Native getrusage/times return REAL CPU time and the scheduler
+    # calls expose the REAL machine topology — all nondeterministic
+    # inputs a managed program could branch on. The simulated view:
+    # one CPU, and "CPU time" == simulated elapsed time (the manager's
+    # heartbeat uses getrusage on itself, manager.c:587-613; plugins
+    # get the virtual clock).
+    def sys_getrusage(self, ctx, a):
+        who = _s32(a[0])
+        if who not in (0, -1, 1):   # SELF, CHILDREN, THREAD
+            return -EINVAL
+        if not a[1]:
+            return -EFAULT
+        ru = bytearray(144)
+        if who != -1:
+            # SELF/THREAD: simulated elapsed time; CHILDREN stays
+            # zero (child CPU time isn't tracked — deterministic and
+            # strictly less wrong than the parent's total)
+            now = ctx.now
+            struct.pack_into("<qq", ru, 0, now // 10**9,
+                             (now % 10**9) // 1000)     # ru_utime
+        self.mem.write(a[1], bytes(ru))
+        return 0
+
+    def sys_times(self, ctx, a):
+        ticks = ctx.now * 100 // 10**9              # 100 Hz clock_t
+        if a[0]:
+            self.mem.write(a[0], struct.pack("<qqqq", ticks, 0, 0, 0))
+        return ticks
+
+    def sys_sched_getaffinity(self, ctx, a):
+        size, mask_ptr = int(a[1]), a[2]
+        if size < 8 or not mask_ptr:
+            return -EINVAL
+        self.mem.write(mask_ptr, struct.pack("<Q", 1))  # one CPU: #0
+        return 8
+
+    def sys_sched_setaffinity(self, ctx, a):
+        return 0                # accepted, inert (one simulated CPU)
+
+    def sys_getcpu(self, ctx, a):
+        if a[0]:
+            self.mem.write(a[0], struct.pack("<I", 0))
+        if a[1]:
+            self.mem.write(a[1], struct.pack("<I", 0))
         return 0
 
     def sys_gettid(self, ctx, a):

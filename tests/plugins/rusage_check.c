@@ -1,0 +1,35 @@
+/* Deterministic resource/topology views: getrusage/times report
+ * SIMULATED elapsed time, the scheduler sees ONE cpu — nothing the
+ * real machine can leak through. */
+#define _GNU_SOURCE
+#include <sched.h>
+#include <stdio.h>
+#include <sys/resource.h>
+#include <sys/times.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(void) {
+  struct timespec ts = {0, 250 * 1000 * 1000};
+  nanosleep(&ts, NULL);                  /* sim t = 1.25s */
+  struct rusage ru;
+  if (getrusage(RUSAGE_SELF, &ru) != 0) { perror("rusage"); return 1; }
+  printf("utime %ld.%06ld stime %ld\n", (long)ru.ru_utime.tv_sec,
+         (long)ru.ru_utime.tv_usec, (long)ru.ru_stime.tv_sec);
+  struct tms t;
+  long ticks = times(&t);
+  printf("ticks %ld utime_t %ld\n", ticks, (long)t.tms_utime);
+  cpu_set_t cs;
+  CPU_ZERO(&cs);
+  if (sched_getaffinity(0, sizeof cs, &cs) != 0) {
+    perror("affinity");
+    return 1;
+  }
+  printf("ncpu %d cpu0 %d\n", CPU_COUNT(&cs), CPU_ISSET(0, &cs));
+  printf("nproc_conf %ld\n", sysconf(_SC_NPROCESSORS_ONLN));
+  unsigned cpu = 99, node = 99;
+  getcpu(&cpu, &node);
+  printf("getcpu %u %u\n", cpu, node);
+  printf("done\n");
+  return 0;
+}

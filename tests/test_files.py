@@ -111,3 +111,29 @@ def test_getaddrinfo_under_ptrace(plugins, tmp_path):
     assert "hostname client" in out
     assert "resolved server 11.0.0.1:9000" in out
     assert stats.ok
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_deterministic_rusage_topology(plugins, tmp_path, method):
+    """getrusage/times report SIMULATED time; the scheduler sees one
+    CPU; getcpu pins to 0 — real-machine resource/topology state
+    cannot leak into plugin decisions."""
+    data = str(tmp_path / "shadow.data")
+    cfg = _cfg(data, method) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['rusage_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    out = read_stdout(data, "alice", "rusage_check")
+    lines = out.splitlines()
+    # start 1s + 250ms sleep = sim t 1.25s
+    assert lines[0] == "utime 1.250000 stime 0"
+    assert lines[1] == "ticks 125 utime_t 125"
+    assert lines[2] == "ncpu 1 cpu0 1"
+    assert lines[3] == "nproc_conf 1"
+    assert lines[4] == "getcpu 0 0"
+    assert lines[5] == "done"
+    assert stats.ok
