@@ -871,7 +871,7 @@ static const int kTrapSyscalls[] = {
     SYS_getrusage,    SYS_times,       SYS_sched_getaffinity,
     SYS_sched_setaffinity, SYS_getcpu,
     SYS_gettid,       SYS_tgkill,
-    SYS_rt_sigprocmask, SYS_wait4,      SYS_kill,
+    SYS_rt_sigprocmask, SYS_wait4,      SYS_waitid,   SYS_kill,
     SYS_rt_sigaction, SYS_pause,       SYS_rt_sigpending,
     SYS_rt_sigtimedwait, SYS_rt_sigsuspend, SYS_tkill,
     SYS_execve,

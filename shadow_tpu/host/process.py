@@ -820,7 +820,7 @@ class ManagedProcess:
         from shadow_tpu.host.syscalls import NR
         for th in self.threads.values():
             if th.alive and th.parked is not None and \
-                    th.parked[0] == NR["wait4"]:
+                    th.parked[0] in (NR["wait4"], NR["waitid"]):
                 th.schedule_continue(ctx)
                 break
 

@@ -82,7 +82,7 @@ NR = dict(
     sched_getaffinity=204, getcpu=309,
     sched_yield=24, gettid=186, sysinfo=99, futex=202,
     set_tid_address=218, sendfile=40, tgkill=234, clone3=435,
-    wait4=61, kill=62, rt_sigaction=13, pause=34,
+    wait4=61, kill=62, waitid=247, rt_sigaction=13, pause=34,
     rt_sigprocmask=14, rt_sigpending=127, rt_sigtimedwait=128,
     rt_sigsuspend=130, tkill=200, execve=59,
     mmap=9, mprotect=10, munmap=11, brk=12, mremap=25,
@@ -452,6 +452,48 @@ class SyscallHandler:
                 del children[c.vpid]
                 return c.vpid
         if options & WNOHANG:
+            return 0
+        raise Blocked()          # child_exited wakes the parked thread
+
+    def sys_waitid(self, ctx, a):
+        """waitid over virtual children (modern glibc posix_spawn
+        waits this way): P_ALL/P_PID, WEXITED reaping (WNOWAIT keeps
+        the zombie), CLD_EXITED/CLD_KILLED siginfo."""
+        P_ALL, P_PID = 0, 1
+        WNOHANG, WEXITED, WNOWAIT = 1, 4, 0x01000000
+        idtype, vid, info_ptr, options = (_s32(a[0]), _s32(a[1]),
+                                          a[2], _s32(a[3]))
+        if idtype not in (P_ALL, P_PID) or not options & WEXITED:
+            return -EINVAL
+        children = getattr(self.p, "children", None)
+        if children is None:
+            return -ECHILD
+        matching = [c for c in children.values()
+                    if idtype == P_ALL or c.vpid == vid]
+        if not matching:
+            return -ECHILD
+        for c in matching:
+            if c.wstatus is not None:
+                if info_ptr:
+                    CLD_EXITED, CLD_KILLED = 1, 2
+                    if c.term_signal is not None:
+                        code, status = CLD_KILLED, c.term_signal
+                    else:
+                        code, status = CLD_EXITED, (c.wstatus >> 8) \
+                            & 0xFF
+                    # glibc siginfo_t SIGCHLD layout: signo, errno,
+                    # code, pad, pid, uid, status, utime, stime
+                    SIGCHLD = 17
+                    info = struct.pack("<iii4xiii", SIGCHLD, 0, code,
+                                       c.vpid, 0, status)
+                    info = info + b"\x00" * (128 - len(info))
+                    self.mem.write(info_ptr, info)
+                if not options & WNOWAIT:
+                    del children[c.vpid]
+                return 0
+        if options & WNOHANG:
+            if info_ptr:
+                self.mem.write(info_ptr, b"\x00" * 128)
             return 0
         raise Blocked()          # child_exited wakes the parked thread
 

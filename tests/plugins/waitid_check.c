@@ -1,0 +1,33 @@
+/* waitid over virtual children: WNOHANG empty, blocking WEXITED
+ * reap with CLD_EXITED siginfo, WNOWAIT keeping the zombie. */
+#define _GNU_SOURCE
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+int main(void) {
+  pid_t child = fork();
+  if (child == 0) {
+    usleep(100 * 1000);
+    _exit(42);
+  }
+  siginfo_t si;
+  memset(&si, 0, sizeof si);
+  si.si_pid = -1;
+  int r = waitid(P_PID, (id_t)child, &si, WEXITED | WNOHANG);
+  printf("nohang r=%d pid=%d\n", r, (int)si.si_pid);
+  memset(&si, 0, sizeof si);
+  r = waitid(P_PID, (id_t)child, &si, WEXITED | WNOWAIT);
+  printf("nowait r=%d pid_match=%d code_exited=%d status=%d\n", r,
+         si.si_pid == child, si.si_code == CLD_EXITED, si.si_status);
+  memset(&si, 0, sizeof si);
+  r = waitid(P_ALL, 0, &si, WEXITED);
+  printf("reap r=%d pid_match=%d status=%d\n", r, si.si_pid == child,
+         si.si_status);
+  r = waitid(P_ALL, 0, &si, WEXITED | WNOHANG);
+  printf("after r=%d echild=%d\n", r, r == -1);
+  printf("done\n");
+  return 0;
+}

@@ -33,7 +33,8 @@ def _indent(text: str, n: int) -> str:
 def bins(tmp_path_factory):
     out = tmp_path_factory.mktemp("plugins")
     built = {}
-    for name in ("fork_check", "signal_check", "sigmask_check"):
+    for name in ("fork_check", "signal_check", "sigmask_check",
+                 "waitid_check"):
         exe = out / name
         subprocess.run(
             ["cc", "-O1", "-pthread", "-o", str(exe),
@@ -255,3 +256,38 @@ def test_execve_deterministic(exec_bins, tmp_path):
         assert stats.ok
         outs.append(stdout_of(data, "alice", "exec_check"))
     assert outs[0] == outs[1]
+
+
+@pytest.mark.parametrize("method", ["preload", "ptrace"])
+def test_waitid_virtual_children(bins, tmp_path, method):
+    """waitid (modern glibc posix_spawn's wait): WNOHANG on a live
+    child, WNOWAIT peeking without reaping, CLD_EXITED siginfo, and
+    ECHILD after the reap — over VIRTUAL pids on both backends."""
+    data = str(tmp_path / "shadow.data")
+    cfg = load_config_str(f"""
+general:
+  stop_time: 30s
+  seed: 1
+  data_directory: {data}
+network:
+  graph:
+    type: gml
+    inline: |
+{_indent(GML, 6)}
+experimental:
+  interpose_method: {method}
+hosts:
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {bins['waitid_check']}
+      start_time: 1s
+""")
+    stats = Controller(cfg).run()
+    assert stats.ok
+    out = stdout_of(data, "alice", "waitid_check").splitlines()
+    assert out[0] == "nohang r=0 pid=0"
+    assert out[1] == "nowait r=0 pid_match=1 code_exited=1 status=42"
+    assert out[2] == "reap r=0 pid_match=1 status=42"
+    assert out[3] == "after r=-1 echild=1"
+    assert out[4] == "done"
