@@ -39,6 +39,7 @@ from shadow_tpu.host.syscalls import (
     NR,
     Blocked,
     CloneGo,
+    FatalDivergence,
     NR_NAME,
     SyscallHandler,
 )
@@ -425,6 +426,8 @@ class ManagedProcess:
         except Blocked as b:
             self._park(ctx, b, nr, args)
             return
+        except FatalDivergence:
+            raise
         except Exception:
             log.exception("resumed syscall %s(%s) handler crashed",
                           NR_NAME.get(nr, nr), args)
@@ -764,6 +767,8 @@ class ManagedProcess:
                 except Blocked:
                     from shadow_tpu.host.syscalls import EINTR
                     res = -EINTR
+                except FatalDivergence:
+                    raise
                 except Exception:
                     log.exception("handler-context syscall crashed")
                     res = -38
@@ -802,6 +807,8 @@ class ManagedProcess:
             except Blocked as b:
                 self._park(ctx, b, nr, args)
                 return
+            except FatalDivergence:
+                raise
             except Exception:
                 log.exception("restarted syscall failed")
                 res = -38
@@ -938,6 +945,8 @@ class ManagedProcess:
             except Blocked as b:
                 self._park(ctx, b, nr, args)
                 return
+            except FatalDivergence:
+                raise
             except Exception:
                 log.exception("syscall %s(%s) handler crashed", name,
                               args)

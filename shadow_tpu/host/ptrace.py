@@ -70,7 +70,8 @@ from shadow_tpu.host.process import (
     _NO_RESTART,
 )
 from shadow_tpu.host.memory import ProcessMemory
-from shadow_tpu.host.syscalls import APPLIED, NATIVE, NR, NR_NAME, Blocked
+from shadow_tpu.host.syscalls import (APPLIED, NATIVE, NR, NR_NAME,
+                                      Blocked, FatalDivergence)
 from shadow_tpu.utils.slog import get_logger
 
 log = get_logger("ptrace")
@@ -573,9 +574,28 @@ class _Tracer(threading.Thread):
 
         regs = self._getregs(tid)
         real = ctypes.c_long(regs.rax).value
-        if real < 0 or new_tid[0] is None:
-            self.replies.put(("clone_fail",
-                              real if real < 0 else -11))
+        if real < 0:
+            self.replies.put(("clone_fail", real))
+            return
+        if new_tid[0] is None:
+            # the kernel created a child but TRACECLONE never reported
+            # it: a live, UNTRACED native task now exists outside the
+            # simulation. Reporting EAGAIN to the app would paper over
+            # the divergence — kill and fail loudly (the caller raises
+            # FatalDivergence; the run aborts). For a missed THREAD,
+            # `real` is a non-leader tid that kill(2) can't address
+            # (and SIGKILL is group-directed anyway) — take down the
+            # whole tracee group via its leader.
+            target = self.group.get(tid, tid) if kind == "thread" \
+                else real
+            try:
+                os.kill(target, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            self.replies.put((
+                "error",
+                f"clone returned id {real} but no PTRACE clone "
+                "event was captured; stray native task killed"))
             return
         child = int(new_tid[0])
         # the auto-attached child is in (or headed to) its initial
@@ -644,6 +664,11 @@ class _Tracer(threading.Thread):
                     (tid, result, native, rewind, inject,
                      sim_ns) = payload
                     self.sim_ns = sim_ns
+                    # clear the exec flag BEFORE any resume: a NATIVE
+                    # execve fires EVENT_EXEC inside _run_native, and
+                    # clearing afterwards would wipe it out of the
+                    # reply (stale fd table / sigactions in the sim)
+                    self._execd = False
                     if native:
                         self._run_native(tid)
                     elif rewind:
@@ -655,7 +680,6 @@ class _Tracer(threading.Thread):
                         regs = self._getregs(tid)
                         regs.rax = result & 0xFFFFFFFFFFFFFFFF
                         self._setregs(tid, regs)
-                    self._execd = False
                     nr, args = self._resume_to_syscall(tid, inject)
                     self.replies.put(("syscall", tid, nr, args,
                                       self._execd))
@@ -807,6 +831,10 @@ class PtraceProcess(ManagedProcess):
                 self.exit_code = reply[2]
             self._finalize_exit(ctx)
             return APPLIED          # process gone; nothing to apply
+        if reply[0] == "error":
+            # kernel/simulator divergence (e.g. stray untraced child,
+            # ADVICE r4 #3): not recoverable as EAGAIN
+            raise FatalDivergence(f"clone under ptrace: {reply[1]}")
         if reply[0] != "cloned":
             log.warning("clone under ptrace failed: %s", reply)
             return -11              # EAGAIN
@@ -856,6 +884,8 @@ class PtraceProcess(ManagedProcess):
                 self.exit_code = reply[2]
             self._finalize_exit(ctx)
             return APPLIED
+        if reply[0] == "error":
+            raise FatalDivergence(f"fork under ptrace: {reply[1]}")
         if reply[0] != "cloned":
             log.warning("fork under ptrace failed: %s", reply)
             return -11
@@ -1052,6 +1082,8 @@ class PtraceProcess(ManagedProcess):
                 th._pt_pending = (None, False, False)
                 self._park(ctx, b, nr, args)
                 return
+            except FatalDivergence:
+                raise
             except Exception:
                 log.exception("syscall %s(%s) handler crashed", name,
                               args)

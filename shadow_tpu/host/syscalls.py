@@ -146,6 +146,11 @@ class Blocked(Exception):
         self.deadline = deadline
 
 
+class FatalDivergence(RuntimeError):
+    """Kernel/simulator state divergence that must abort the run —
+    never degraded to an errno by the dispatch crash guards."""
+
+
 def _s32(v: int) -> int:
     """Syscall args arrive as u64; recover signed 32-bit values."""
     v &= 0xFFFFFFFF
@@ -385,8 +390,11 @@ class SyscallHandler:
             return -EFAULT
         if size < 64:
             return -EINVAL
+        if size > 4096:
+            # kernel rejects size > PAGE_SIZE outright (ADVICE r4 #4)
+            return -E2BIG
         try:
-            raw = self.mem.read(ptr, min(size, 4096))
+            raw = self.mem.read(ptr, size)
         except OSError:
             return -EFAULT
         if any(raw[64:]):
@@ -640,22 +648,26 @@ class SyscallHandler:
             return NATIVE
         how, set_ptr, size = _s32(a[0]), a[1], a[3]
         th = self.p.current
+        # validate + read the new set BEFORE touching oldset: the
+        # kernel writes oldset only on success (ADVICE r4 #2)
+        s = None
+        if set_ptr and size >= 8:
+            if how not in (0, 1, 2):
+                return -EINVAL
+            s = struct.unpack("<Q", self.mem.read(set_ptr, 8))[0]
+            s &= ~self._UNBLOCKABLE
         if getattr(self.p, "signal_style", "ipc") == "inject" \
                 and a[2] and size >= 8:
             # no shim wrote the old set natively (the ptrace kernel
             # mask is untouched) — report the VIRTUAL mask
             self.mem.write(a[2], struct.pack("<Q", th.sigmask))
-        if set_ptr and size >= 8:
-            s = struct.unpack("<Q", self.mem.read(set_ptr, 8))[0]
-            s &= ~self._UNBLOCKABLE
+        if s is not None:
             if how == 0:                    # SIG_BLOCK
                 th.sigmask |= s
             elif how == 1:                  # SIG_UNBLOCK
                 th.sigmask &= ~s
-            elif how == 2:                  # SIG_SETMASK
+            else:                           # SIG_SETMASK
                 th.sigmask = s
-            else:
-                return -EINVAL
         # the post-dispatch boundary flush delivers newly unblocked
         # pending signals before this result lands
         return 0
