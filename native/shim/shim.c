@@ -885,16 +885,40 @@ static const int kFdGatedSyscalls[] = {
     SYS_fstat, SYS_lseek, SYS_ioctl,   SYS_fcntl,    SYS_dup,
     SYS_dup2,  SYS_dup3,  SYS_pread64, SYS_pwrite64, SYS_newfstatat,
     SYS_statx, SYS_sendfile,
+    /* fd-mediated file family: these reach the handler only when the
+     * fd (or dirfd, arg0) is one of OUR virtual descriptors — native
+     * fds keep full-speed kernel execution, and the post-execve
+     * loader window never holds a VFD so the stale-filter hazard of
+     * trapping unconditionally does not apply. */
+    SYS_getdents,  SYS_getdents64, SYS_ftruncate, SYS_fsync,
+    SYS_fdatasync, SYS_fallocate,  SYS_flock,     SYS_fchmod,
+    SYS_fchown,    SYS_fgetxattr,  SYS_fsetxattr, SYS_flistxattr,
+    SYS_fremovexattr, SYS_fchdir,
+    /* dirfd(arg0)-relative path family (ref fileat.c): */
+    SYS_unlinkat,  SYS_mkdirat,    SYS_readlinkat, SYS_faccessat,
+#ifdef SYS_faccessat2
+    SYS_faccessat2,
+#endif
+    SYS_fchmodat,  SYS_fchownat,   SYS_utimensat,  SYS_futimesat,
 };
 
-enum { TGT_NONE = 0, TGT_ALLOW, TGT_TRAP, TGT_KILL, TGT_NRCHK, TGT_FDGATE };
+/* renameat/renameat2/linkat carry a SECOND dirfd in arg2 (and
+ * symlinkat's only dirfd is arg1): gated on those args separately.
+ * None are issued by the post-execve loader window. */
+static const int kFd2GatedSyscalls[] = {
+    SYS_renameat, SYS_renameat2, SYS_linkat,
+};
+
+enum { TGT_NONE = 0, TGT_ALLOW, TGT_TRAP, TGT_KILL, TGT_NRCHK,
+       TGT_FDGATE, TGT_FD2GATE, TGT_FD2ARG2, TGT_SYMGATE,
+       TGT_MMAPGATE };
 
 typedef struct {
   struct sock_filter f;
   int jt_tgt, jf_tgt; /* symbolic jump targets (TGT_*) */
 } Ins;
 
-#define MAX_INS 160
+#define MAX_INS 224
 
 static int shim_install_seccomp(void) {
   Ins prog[MAX_INS];
@@ -954,10 +978,53 @@ static int shim_install_seccomp(void) {
   for (size_t i = 0; i < sizeof(kFdGatedSyscalls) / sizeof(int); i++)
     EMIT(BPF_JMP | BPF_JEQ | BPF_K, (uint32_t)kFdGatedSyscalls[i],
          TGT_FDGATE, TGT_NONE);
+  for (size_t i = 0; i < sizeof(kFd2GatedSyscalls) / sizeof(int); i++)
+    EMIT(BPF_JMP | BPF_JEQ | BPF_K, (uint32_t)kFd2GatedSyscalls[i],
+         TGT_FD2GATE, TGT_NONE);
+  EMIT(BPF_JMP | BPF_JEQ | BPF_K, (uint32_t)SYS_symlinkat,
+       TGT_SYMGATE, TGT_NONE);
+  EMIT(BPF_JMP | BPF_JEQ | BPF_K, (uint32_t)SYS_mmap, TGT_MMAPGATE,
+       TGT_NONE);
   EMIT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW, 0, 0);
 
   int fdgate_idx = n;
   EMIT(BPF_LD | BPF_W | BPF_ABS, 16, 0, 0); /* args[0] low dword */
+  EMIT(BPF_JMP | BPF_JGE | BPF_K, SHADOWTPU_VFD_BASE, TGT_NONE,
+       TGT_ALLOW);
+  EMIT(BPF_JMP | BPF_JGE | BPF_K, SHADOWTPU_VFD_END, TGT_ALLOW,
+       TGT_TRAP);
+
+  /* renameat/renameat2/linkat: trap when EITHER dirfd (arg0/arg2) is
+   * virtual */
+  int fd2gate_idx = n;
+  EMIT(BPF_LD | BPF_W | BPF_ABS, 16, 0, 0); /* args[0] low dword */
+  EMIT(BPF_JMP | BPF_JGE | BPF_K, SHADOWTPU_VFD_BASE, TGT_NONE,
+       TGT_FD2ARG2);
+  EMIT(BPF_JMP | BPF_JGE | BPF_K, SHADOWTPU_VFD_END, TGT_FD2ARG2,
+       TGT_TRAP);
+  int fd2gate_arg2_idx = n;
+  EMIT(BPF_LD | BPF_W | BPF_ABS, 32, 0, 0); /* args[2] low dword */
+  EMIT(BPF_JMP | BPF_JGE | BPF_K, SHADOWTPU_VFD_BASE, TGT_NONE,
+       TGT_ALLOW);
+  EMIT(BPF_JMP | BPF_JGE | BPF_K, SHADOWTPU_VFD_END, TGT_ALLOW,
+       TGT_TRAP);
+
+  /* symlinkat: the only dirfd is arg1 */
+  int symgate_idx = n;
+  EMIT(BPF_LD | BPF_W | BPF_ABS, 24, 0, 0); /* args[1] low dword */
+  EMIT(BPF_JMP | BPF_JGE | BPF_K, SHADOWTPU_VFD_BASE, TGT_NONE,
+       TGT_ALLOW);
+  EMIT(BPF_JMP | BPF_JGE | BPF_K, SHADOWTPU_VFD_END, TGT_ALLOW,
+       TGT_TRAP);
+
+  /* mmap: fd lives in arg4; anonymous mappings (arg3 & MAP_ANONYMOUS)
+   * never reference it and stay native (the post-execve loader's
+   * file mmaps use native fds, so they pass the range check) */
+  int mmapgate_idx = n;
+  EMIT(BPF_LD | BPF_W | BPF_ABS, 40, 0, 0); /* args[3] low dword */
+  EMIT(BPF_JMP | BPF_JSET | BPF_K, 0x20 /* MAP_ANONYMOUS */,
+       TGT_ALLOW, TGT_NONE);
+  EMIT(BPF_LD | BPF_W | BPF_ABS, 48, 0, 0); /* args[4] low dword */
   EMIT(BPF_JMP | BPF_JGE | BPF_K, SHADOWTPU_VFD_BASE, TGT_NONE,
        TGT_ALLOW);
   EMIT(BPF_JMP | BPF_JGE | BPF_K, SHADOWTPU_VFD_END, TGT_ALLOW,
@@ -997,6 +1064,18 @@ static int shim_install_seccomp(void) {
       case TGT_FDGATE:
         idx = fdgate_idx;
         break;
+      case TGT_FD2GATE:
+        idx = fd2gate_idx;
+        break;
+      case TGT_FD2ARG2:
+        idx = fd2gate_arg2_idx;
+        break;
+      case TGT_SYMGATE:
+        idx = symgate_idx;
+        break;
+      case TGT_MMAPGATE:
+        idx = mmapgate_idx;
+        break;
       default:
         return -1;
       }
@@ -1008,7 +1087,9 @@ static int shim_install_seccomp(void) {
   }
 
   struct sock_fprog fprog = {.len = (unsigned short)n, .filter = out};
-  if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0)
+  /* raw on purpose: the prctl SYMBOL below funnels once g_enabled */
+  if (shim_rawsyscall(SYS_prctl, PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0, 0)
+      != 0)
     return -1;
   if (syscall(SYS_seccomp, SECCOMP_SET_MODE_FILTER, 0, &fprog) != 0)
     return -1;
@@ -1084,6 +1165,75 @@ ssize_t getrandom(void *buf, size_t buflen, unsigned int flags) {
     return -1;
   }
   return (ssize_t)r;
+}
+
+/* ---- cwd tracking --------------------------------------------------
+ * Every data-dir open now funnels and resolves RELATIVE paths against
+ * the handler's tracked cwd, so chdir/fchdir must funnel too or the
+ * tracked cwd goes stale (the handler replies NATIVE for chdir, so
+ * the REAL cwd still moves below). Symbol-level: seccomp cannot trap
+ * chdir (glibc startup hazard class) and fchdir's native-fd case
+ * never hits the fd gate. */
+int chdir(const char *path) {
+  if (g_enabled) {
+    long args[6] = {(long)path, 0, 0, 0, 0, 0};
+    return ret_errno(shim_emulated_syscall(SYS_chdir, args));
+  }
+  return ret_errno(shim_rawsyscall(SYS_chdir, (long)path, 0, 0, 0, 0,
+                                   0));
+}
+
+int fchdir(int fd) {
+  if (g_enabled) {
+    long args[6] = {fd, 0, 0, 0, 0, 0};
+    return ret_errno(shim_emulated_syscall(SYS_fchdir, args));
+  }
+  return ret_errno(shim_rawsyscall(SYS_fchdir, fd, 0, 0, 0, 0, 0));
+}
+
+/* ---- resource limits + prctl ---------------------------------------
+ * glibc STARTUP raw-calls prlimit64 (RLIMIT_STACK probe), so seccomp
+ * cannot trap these without killing post-execve images in the loader
+ * window — symbol-level funnels instead, like getpid/getrandom. The
+ * handler serves DETERMINISTIC limits (the real machine's must never
+ * steer plugin decisions); raw-syscall users bypass (documented). */
+struct rlimit;
+int getrlimit(int res, struct rlimit *rl) {
+  return ret_errno(shim_time_syscall(SYS_getrlimit, res, (long)rl, 0,
+                                     0));
+}
+
+int setrlimit(int res, const struct rlimit *rl) {
+  return ret_errno(shim_time_syscall(SYS_setrlimit, res, (long)rl, 0,
+                                     0));
+}
+
+int prlimit(pid_t pid, int res, const struct rlimit *nl,
+            struct rlimit *ol) {
+  long args[6] = {pid, res, (long)nl, (long)ol, 0, 0};
+  if (!g_enabled)
+    return ret_errno(shim_rawsyscall(SYS_prlimit64, pid, res,
+                                     (long)nl, (long)ol, 0, 0));
+  return ret_errno(shim_emulated_syscall(SYS_prlimit64, args));
+}
+
+int prlimit64(pid_t pid, int res, const struct rlimit *nl,
+              struct rlimit *ol) {
+  return prlimit(pid, res, nl, ol);
+}
+
+int prctl(int option, ...) {
+  va_list ap;
+  va_start(ap, option);
+  long a1 = va_arg(ap, long), a2 = va_arg(ap, long);
+  long a3 = va_arg(ap, long), a4 = va_arg(ap, long);
+  va_end(ap);
+  if (!g_enabled)
+    return ret_errno(shim_rawsyscall(SYS_prctl, option, a1, a2, a3,
+                                     a4, 0));
+  /* a NATIVE reply (anything but PDEATHSIG/NAME) re-executes raw */
+  long args[6] = {option, a1, a2, a3, a4, 0};
+  return ret_errno(shim_emulated_syscall(SYS_prctl, args));
 }
 
 /* ---- special-path file opens --------------------------------------- */
@@ -1167,9 +1317,19 @@ int __fxstatat64(int ver, int dirfd, const char *path,
   return fstatat(dirfd, path, (struct stat *)st, flags);
 }
 
+static int shim_is_vfd(int fd) {
+  return fd >= (int)SHADOWTPU_VFD_BASE && fd < (int)SHADOWTPU_VFD_END;
+}
+
 int fstatat(int dirfd, const char *path, struct stat *st, int flags) {
   if (g_enabled && shim_special_path(path)) {
     long args[6] = {AT_FDCWD, (long)path, (long)st, flags, 0, 0};
+    return ret_errno(shim_emulated_syscall(SYS_newfstatat, args));
+  }
+  if (g_enabled && shim_is_vfd(dirfd)) {
+    /* dirfd-relative stat against an EMULATED directory: the raw
+     * escape below would hand the kernel a fd it has never seen */
+    long args[6] = {dirfd, (long)path, (long)st, flags, 0, 0};
     return ret_errno(shim_emulated_syscall(SYS_newfstatat, args));
   }
   return ret_errno(shim_rawsyscall(SYS_newfstatat, dirfd, (long)path,
@@ -1189,14 +1349,27 @@ int statx(int dirfd, const char *path, int flags, unsigned int mask,
                     (long)stxbuf, 0};
     return ret_errno(shim_emulated_syscall(SYS_statx, args));
   }
+  if (g_enabled && shim_is_vfd(dirfd)) {
+    long args[6] = {dirfd, (long)path, flags, (long)mask,
+                    (long)stxbuf, 0};
+    return ret_errno(shim_emulated_syscall(SYS_statx, args));
+  }
   return ret_errno(shim_rawsyscall(SYS_statx, dirfd, (long)path, flags,
                                    (long)mask, (long)stxbuf, 0));
 }
 
 static int shim_openat_impl(int dirfd, const char *path, int flags,
                             mode_t mode) {
-  if (g_enabled && shim_special_path(path)) {
+  /* EVERY open funnels (symbol-level interposition has no
+   * post-execve loader-window hazard): the handler emulates special
+   * paths and data-dir files through its descriptor table (os-backed
+   * HostFileDesc — dirfd resolution, deterministic sorted getdents)
+   * and answers NATIVE for system paths, which re-execute raw below.
+   * Raw-syscall openat of a data path bypasses mediation (documented,
+   * like raw clock_gettime; strict-traps mode catches it). */
+  if (g_enabled) {
     long args[6] = {dirfd, (long)path, flags, (long)mode, 0, 0};
+    /* a NATIVE reply re-executes raw inside shim_emulated_syscall */
     return ret_errno(shim_emulated_syscall(SYS_openat, args));
   }
   return ret_errno(shim_rawsyscall(SYS_openat, dirfd, (long)path,
@@ -1247,18 +1420,56 @@ int openat64(int dirfd, const char *path, int flags, ...) {
   return shim_openat_impl(dirfd, path, flags, mode);
 }
 
-/* fopen reaches the kernel via glibc-internal open (no PLT), so the
- * special paths are caught at the stream level and re-wrapped around
- * the virtual fd (fd-gated seccomp serves its read/fstat/seek). */
-FILE *fopen(const char *path, const char *mode) {
-  if (g_enabled && shim_special_path(path)) {
-    if (strchr(mode, 'w') || strchr(mode, 'a') || strchr(mode, '+')) {
-      errno = EACCES; /* the emulated files are read-only streams */
-      return NULL;
-    }
-    int fd = shim_openat_impl(AT_FDCWD, path, O_RDONLY, 0);
-    return fd < 0 ? NULL : fdopen(fd, mode);
+/* fopen reaches the kernel via glibc-internal open (no PLT), which
+ * would bypass the funnel — so EVERY fopen is caught at the stream
+ * level: the funnel-opened fd (emulated VFD for special/data-dir
+ * paths, raw native fd otherwise) is re-wrapped with fdopen, and the
+ * fd-gated seccomp filter serves the stream's read/write/fstat/seek
+ * on virtual fds. */
+static int shim_fopen_flags(const char *mode) {
+  int flags;
+  switch (mode[0]) {
+  case 'r':
+    flags = O_RDONLY;
+    break;
+  case 'w':
+    flags = O_WRONLY | O_CREAT | O_TRUNC;
+    break;
+  case 'a':
+    flags = O_WRONLY | O_CREAT | O_APPEND;
+    break;
+  default:
+    return -1;
   }
+  for (const char *m = mode + 1; *m; m++) {
+    if (*m == '+')
+      flags = (flags & ~(O_RDONLY | O_WRONLY)) | O_RDWR;
+    else if (*m == 'e')
+      flags |= O_CLOEXEC;
+    else if (*m == 'x')
+      flags |= O_EXCL;
+  }
+  return flags;
+}
+
+static FILE *shim_fopen_impl(const char *path, const char *mode) {
+  int flags = shim_fopen_flags(mode);
+  if (flags < 0) {
+    errno = EINVAL;
+    return NULL;
+  }
+  int fd = shim_openat_impl(AT_FDCWD, path, flags, 0666);
+  if (fd < 0)
+    return NULL;
+  FILE *f = fdopen(fd, mode);
+  if (!f)
+    close(fd);
+  return f;
+}
+
+FILE *fopen(const char *path, const char *mode) {
+  if (g_enabled)
+    return shim_fopen_impl(path, mode);
   static FILE *(*real_fopen)(const char *, const char *);
   if (!real_fopen)
     real_fopen =
@@ -1268,14 +1479,8 @@ FILE *fopen(const char *path, const char *mode) {
 }
 
 FILE *fopen64(const char *path, const char *mode) {
-  if (g_enabled && shim_special_path(path)) {
-    if (strchr(mode, 'w') || strchr(mode, 'a') || strchr(mode, '+')) {
-      errno = EACCES;
-      return NULL;
-    }
-    int fd = shim_openat_impl(AT_FDCWD, path, O_RDONLY, 0);
-    return fd < 0 ? NULL : fdopen(fd, mode);
-  }
+  if (g_enabled)
+    return shim_fopen_impl(path, mode);
   static FILE *(*real_fopen64)(const char *, const char *);
   if (!real_fopen64)
     real_fopen64 =

@@ -25,6 +25,7 @@ and blocked-syscall conditions (syscall_condition.c):
 
 from __future__ import annotations
 
+import os
 from collections import deque
 from typing import Optional
 
@@ -420,6 +421,90 @@ class VirtualFileDesc(Descriptor):
 
     def size(self) -> int:
         return len(self.content)
+
+
+class HostFileDesc(Descriptor):
+    """An os-backed regular file or directory: the SIMULATOR owns the
+    real fd (opened inside the host's data dir) and mediates every
+    plugin-visible operation through the descriptor table — the
+    fd-mediated file family of ref descriptor/file.c (struct _File's
+    osfile {fd, flags, mode, abspath}) and syscall/file.c. The real
+    fd is always O_CLOEXEC so it can never leak into spawned plugins;
+    the app-visible flags are tracked separately. The kernel offset of
+    the simulator-held fd IS the shared open-file-description offset
+    (dup/fork share this object, matching kernel semantics)."""
+
+    def __init__(self, osfd: int, abspath: str, flags: int,
+                 mode: int = 0o644):
+        super().__init__()
+        self.osfd = osfd
+        self.abspath = abspath
+        self.flags = flags          # app-visible open flags
+        self.mode = mode
+        self.is_dir = False
+        try:
+            self.is_dir = os.path.isdir(abspath)
+        except OSError:
+            pass
+        # getdents cursor: a sorted listing snapshot (real readdir
+        # order is filesystem-nondeterministic; sorting makes directory
+        # iteration a determinism WIN over native passthrough)
+        self._dirents: Optional[list] = None
+        self._dirpos = 0
+
+    def status(self) -> int:
+        return R | W                # regular files: always ready
+
+    def dirents(self) -> list:
+        """[(name, ino, dtype)] snapshot: '.', '..', then SORTED names
+        (real readdir order is filesystem-dependent; sorting makes the
+        iteration order deterministic). Inodes are the real ones so
+        d_ino agrees with the st_ino that fstat/stat pass through —
+        the same passthrough-identity policy as the stat family."""
+        if self._dirents is None:
+            def ino_of(p):
+                try:
+                    return os.stat(p).st_ino
+                except OSError:
+                    return 0
+            entries = [(".", ino_of(self.abspath), 4),
+                       ("..", ino_of(os.path.dirname(self.abspath)),
+                        4)]                           # DT_DIR
+            try:
+                with os.scandir(self.abspath) as it:
+                    found = []
+                    for e in it:
+                        if e.is_dir(follow_symlinks=False):
+                            dt = 4                    # DT_DIR
+                        elif e.is_symlink():
+                            dt = 10                   # DT_LNK
+                        elif e.is_file(follow_symlinks=False):
+                            dt = 8                    # DT_REG
+                        else:
+                            dt = 0                    # DT_UNKNOWN
+                        try:
+                            ino = e.inode()
+                        except OSError:
+                            ino = 0
+                        found.append((e.name, ino, dt))
+                    entries += sorted(found)
+            except OSError:
+                pass
+            self._dirents = entries
+        return self._dirents
+
+    def rewind_dir(self) -> None:
+        self._dirents = None
+        self._dirpos = 0
+
+    def close(self, ctx) -> None:
+        super().close(ctx)
+        if self.osfd >= 0:
+            try:
+                os.close(self.osfd)
+            except OSError:
+                pass
+            self.osfd = -1
 
 
 class EventfdDesc(Descriptor):

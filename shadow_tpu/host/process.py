@@ -996,8 +996,27 @@ class ManagedProcess:
                   sum(self.syscall_counts.values()))
         if self.table is not None:
             self.table.close_all(ctx)
-        # orphaned forked children die with us (no re-parenting model)
+        # orphaned forked children die with us (no re-parenting
+        # model); a child that armed PR_SET_PDEATHSIG gets its chosen
+        # signal VIRTUALLY first, and the no-orphans hard kill is
+        # DEFERRED one sim-millisecond so an installed handler gets a
+        # syscall boundary to actually run (default dispositions
+        # terminate during the delivery itself)
         for child in list(self.children.values()):
+            if not child.alive:
+                continue
+            sig = getattr(child, "pdeathsig", 0)
+            if sig:
+                try:
+                    child.deliver_signal(ctx, sig)
+                except Exception:
+                    log.exception("pdeathsig delivery failed")
+                if child.alive:
+                    child._push_task(
+                        ctx.now + 1_000_000,
+                        lambda ctx2, ev, c=child: (
+                            c._kill(ctx2) if c.alive else None))
+                    continue
             if child.alive:
                 child._kill(ctx)
         # become a zombie for the parent's wait4: WIFSIGNALED encodes

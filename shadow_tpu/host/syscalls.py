@@ -36,6 +36,7 @@ from shadow_tpu.host.descriptors import (
     EpollDesc,
     EventfdDesc,
     Futex,
+    HostFileDesc,
     PipeDesc,
     R,
     TcpDesc,
@@ -87,6 +88,22 @@ NR = dict(
     rt_sigsuspend=130, tkill=200, execve=59,
     mmap=9, mprotect=10, munmap=11, brk=12, mremap=25,
     open=2, openat=257,
+    # fd-mediated file family (ref syscall/file.c + fileat.c)
+    flock=73, fsync=74, fdatasync=75, truncate=76, ftruncate=77,
+    getdents=78, chdir=80, fchdir=81, rename=82, mkdir=83, rmdir=84,
+    creat=85, link=86, unlink=87, symlink=88, readlink=89,
+    chmod=90, fchmod=91, chown=92, fchown=93, lchown=94,
+    utime=132, getdents64=217, utimes=235,
+    mkdirat=258, fchownat=260, futimesat=261, unlinkat=263,
+    renameat=264, linkat=265, symlinkat=266, readlinkat=267,
+    fchmodat=268, faccessat=269, utimensat=280, fallocate=285,
+    renameat2=316, faccessat2=439,
+    setxattr=188, lsetxattr=189, fsetxattr=190, getxattr=191,
+    lgetxattr=192, fgetxattr=193, listxattr=194, llistxattr=195,
+    flistxattr=196, removexattr=197, lremovexattr=198,
+    fremovexattr=199,
+    prlimit64=302, prctl=157, set_robust_list=273,
+    get_robust_list=274, getrlimit=97, setrlimit=160,
 )
 NR_NAME = {v: k for k, v in NR.items()}
 
@@ -97,6 +114,8 @@ ENOTTY, ESPIPE, EPIPE, ENOSYS, ENOTSOCK, EDESTADDRREQ = 25, 29, 32, 38, 88, 89
 EMSGSIZE, ENOPROTOOPT, EPROTONOSUPPORT, EOPNOTSUPP, EAFNOSUPPORT = \
     90, 92, 93, 95, 97
 E2BIG, EACCES = 7, 13
+EEXIST, EXDEV, ENODEV, ENOTDIR, EISDIR, ENOTEMPTY = 17, 18, 19, 20, 21, 39
+ENAMETOOLONG, ELOOP, ERANGE, ENODATA = 36, 40, 34, 61
 EADDRINUSE, ENETUNREACH, ECONNRESET, EISCONN, ENOTCONN = 98, 101, 104, 106, 107
 ETIMEDOUT, ECONNREFUSED, EINPROGRESS, EALREADY = 110, 111, 115, 114
 
@@ -855,6 +874,18 @@ class SyscallHandler:
         # tracer does not surface native return values, and even a
         # MAP_FIXED request can fail — so never record at entry; mark
         # the snapshot stale and refresh from /proc on demand
+        MAP_ANONYMOUS = 0x20
+        if not _s32(a[3]) & MAP_ANONYMOUS:
+            fd = _s32(a[4])
+            if fd >= VFD_BASE:
+                # file-backed mapping of an emulated fd: the real fd
+                # lives in the SIMULATOR. ENODEV makes apps fall back
+                # to read() (ref mman.c maps via /proc/<pid>/fd —
+                # future work for the ptrace backend).
+                d = self._desc(fd)
+                if d is None:
+                    return -EBADF
+                return -ENODEV
         m = self._maps()
         if m is not None:
             m.dirty = True
@@ -1254,6 +1285,16 @@ class SyscallHandler:
             if data:
                 self.mem.write(buf, data)
             return len(data)
+        if isinstance(desc, HostFileDesc):
+            if desc.is_dir:
+                return -EISDIR
+            try:
+                data = os.read(desc.osfd, min(n, 1 << 20))
+            except OSError as e:
+                return -e.errno
+            if data:
+                self.mem.write(buf, data)
+            return len(data)
         return -EINVAL
 
     def sys_write(self, ctx, a):
@@ -1273,6 +1314,15 @@ class SyscallHandler:
             if desc.generator is not None:
                 return n        # writes to /dev/urandom: accepted+ignored
             return -EBADF       # the emulated files are read-only
+        if isinstance(desc, HostFileDesc):
+            try:
+                data = self.mem.read(buf, min(n, 1 << 20))
+            except OSError:
+                return -EFAULT
+            try:
+                return os.write(desc.osfd, data)
+            except OSError as e:
+                return -e.errno
         return -EINVAL
 
     def _gather_iov(self, a):
@@ -1322,11 +1372,36 @@ class SyscallHandler:
             if data:
                 self.mem.write(a[1], data)
             return len(data)
+        if isinstance(desc, HostFileDesc):
+            off = _s64(a[3])
+            if off < 0:
+                return -EINVAL
+            try:
+                data = os.pread(desc.osfd, min(int(a[2]), 1 << 20),
+                                off)
+            except OSError as e:
+                return -e.errno
+            if data:
+                self.mem.write(a[1], data)
+            return len(data)
         return -ESPIPE
 
     def sys_pwrite64(self, ctx, a):
-        if self._desc(_s32(a[0])) is None:
+        desc = self._desc(_s32(a[0]))
+        if desc is None:
             return self._no_desc(_s32(a[0]))
+        if isinstance(desc, HostFileDesc):
+            off = _s64(a[3])
+            if off < 0:
+                return -EINVAL
+            try:
+                data = self.mem.read(a[1], min(int(a[2]), 1 << 20))
+            except OSError:
+                return -EFAULT
+            try:
+                return os.pwrite(desc.osfd, data, off)
+            except OSError as e:
+                return -e.errno
         return -ESPIPE
 
     def sys_lseek(self, ctx, a):
@@ -1344,6 +1419,21 @@ class SyscallHandler:
                 return -EINVAL
             desc.pos = pos
             return pos
+        if isinstance(desc, HostFileDesc):
+            off, whence = _s64(a[1]), _s32(a[2])
+            if desc.is_dir:
+                # seekdir semantics on the snapshot cursor
+                if whence != 0 or off < 0:
+                    return -EINVAL
+                if off == 0:
+                    desc.rewind_dir()
+                else:
+                    desc._dirpos = off
+                return off
+            try:
+                return os.lseek(desc.osfd, off, whence)
+            except OSError as e:
+                return -e.errno
         return -ESPIPE
 
     def sys_close(self, ctx, a):
@@ -1352,33 +1442,107 @@ class SyscallHandler:
             return self._no_desc(fd)
         return 0 if self.table.close_fd(ctx, fd) else -EBADF
 
-    # -- file opens (the special-path slice of ref file.c/fileat.c) ----
+    # -- file opens + the fd-mediated family (ref file.c/fileat.c) -----
     AT_FDCWD = -100
+    AT_SYMLINK_NOFOLLOW = 0x100
+    AT_REMOVEDIR = 0x200
+    AT_SYMLINK_FOLLOW = 0x400
+    AT_EMPTY_PATH = 0x1000
+    O_CLOEXEC_FLAG = 0x80000
+    O_ACCMODE = 3
+
+    def _host_dir(self) -> str:
+        """The per-host data dir — the plugin's initial real cwd AND
+        the confinement root for every emulated path operation."""
+        hd = getattr(self.p, "_hostdir_cache", None)
+        if hd is None:
+            hd = os.path.realpath(os.path.join(
+                self.p.runtime.data_dir, "hosts", self.p.host.name))
+            self.p._hostdir_cache = hd
+        return hd
+
+    def _vcwd(self) -> Optional[str]:
+        """Tracked virtual cwd: None = the plugin left the data dir
+        (resolution falls back to NATIVE)."""
+        v = getattr(self.p, "vcwd", None)
+        if v is None:
+            return self._host_dir()
+        return None if v == "outside" else v
+
+    def _resolve_at(self, dirfd: int, path: str):
+        """dirfd-relative resolution confined to the host data dir
+        (ref fileat.c _syscallhandler_validateDirHelper + descriptor/
+        file.c _file_getAbsolutePath): returns a confined absolute
+        path to emulate, NATIVE to let the plugin run the call in its
+        own (data-dir) cwd, or -errno. The parent DIRECTORY is
+        realpath'd so symlink escapes are caught; the final component
+        stays lexical so symlink-ops act on the link itself."""
+        if len(path) > 4096:
+            return -ENAMETOOLONG
+        root = self._host_dir()
+        if path.startswith("/"):
+            ap = os.path.normpath(path)
+            if ap != root and not ap.startswith(root + "/"):
+                return NATIVE       # system path: plugin runs it raw
+        elif dirfd == self.AT_FDCWD:
+            base = self._vcwd()
+            if base is None:
+                return NATIVE       # cwd moved outside the data dir
+            ap = os.path.normpath(os.path.join(base, path)) \
+                if path else base
+        else:
+            d = self._desc(dirfd)
+            if d is None:
+                return self._no_desc(dirfd)
+            if not isinstance(d, HostFileDesc):
+                return -ENOTDIR
+            if path and not d.is_dir:
+                return -ENOTDIR
+            ap = os.path.normpath(os.path.join(d.abspath, path)) \
+                if path else d.abspath
+        if ap == root:
+            return ap               # the root itself (open("."), …)
+        head, tail = os.path.split(ap)
+        try:
+            rh = os.path.realpath(head)
+        except OSError:
+            return -ENOENT
+        if rh != root and not rh.startswith(root + "/"):
+            return -EACCES
+        return os.path.join(rh, tail) if tail else rh
+
+    def _confined(self, abspath: str) -> bool:
+        root = self._host_dir()
+        return abspath == root or abspath.startswith(root + "/")
 
     def sys_openat(self, ctx, a):
-        return self._open_path(ctx, _s32(a[0]), a[1], _s32(a[2]))
+        return self._open_path(ctx, _s32(a[0]), a[1], _s32(a[2]),
+                               int(a[3]) & 0o7777)
 
     def sys_open(self, ctx, a):
-        return self._open_path(ctx, self.AT_FDCWD, a[0], _s32(a[1]))
+        return self._open_path(ctx, self.AT_FDCWD, a[0], _s32(a[1]),
+                               int(a[2]) & 0o7777)
 
-    def _open_path(self, ctx, dirfd, path_ptr, flags):
-        """Paths whose CONTENT the simulator must own are emulated
-        through the descriptor table; everything else runs native
-        (each plugin's real cwd IS its host data dir, so relative
-        paths are per-host isolated already — tests pin that):
+    def sys_creat(self, ctx, a):
+        # open(path, O_CREAT|O_WRONLY|O_TRUNC, mode)
+        return self._open_path(ctx, self.AT_FDCWD, a[0],
+                               0x40 | 0x1 | 0x200, int(a[1]) & 0o7777)
 
-        * /dev/urandom, /dev/random — native reads would be REAL
-          randomness; served from the host's seeded deterministic
-          stream instead (the openssl-preload RNG override's file
-          cousin)
-        * /etc/hosts — the SIMULATED name map (dns.write_hosts_file);
-          critical under ptrace, where no shim getaddrinfo override
-          exists and libc reads the file raw
-        * /etc/resolv.conf, /etc/nsswitch.conf — pinned to files-based
-          resolution with no nameservers
+    def _open_path(self, ctx, dirfd, path_ptr, flags, mode=0o644):
+        """Two emulated classes (ref file.c/fileat.c mediate ALL opens
+        through their descriptor table; we split by path):
 
-        Ref: src/main/host/syscall/file.c + fileat.c emulate the whole
-        family through their descriptor table."""
+        * content the simulator must OWN — /dev/urandom (seeded
+          deterministic stream), the simulated /etc/hosts,
+          resolv.conf/nsswitch.conf — served as VirtualFileDesc;
+        * everything inside the host DATA DIR (relative paths, paths
+          under it, dirfd-relative paths) — os-backed HostFileDesc:
+          the simulator opens the real file (O_CLOEXEC) and mediates
+          every fd op, giving dirfd resolution, deterministic sorted
+          getdents, and per-host isolation with loud confinement.
+
+        Absolute system paths (/usr, /lib, ...) stay NATIVE so the
+        dynamic loader's open+mmap path keeps working."""
         if not path_ptr:
             return -EFAULT
         try:
@@ -1404,13 +1568,42 @@ class SyscallHandler:
         if path == "/etc/nsswitch.conf":
             return self.table.alloc(VirtualFileDesc(
                 b"hosts: files\n"))
-        return NATIVE
+        r = self._resolve_at(dirfd, path)
+        if r is NATIVE or isinstance(r, int):
+            return r
+        return self._open_host_file(r, flags, mode)
+
+    def _open_host_file(self, abspath: str, flags: int, mode: int):
+        # a symlink chain may point OUTSIDE the data dir: realpath the
+        # full target (if it exists) before opening through it
+        rp = os.path.realpath(abspath)
+        if os.path.exists(rp) and not self._confined(rp):
+            return -EACCES
+        try:
+            osfd = os.open(abspath,
+                           (flags & ~self.O_CLOEXEC_FLAG)
+                           | os.O_CLOEXEC, mode)
+        except OSError as e:
+            return -e.errno
+        d = HostFileDesc(osfd, abspath, flags, mode)
+        d.nonblock = bool(flags & O_NONBLOCK)
+        fd = self.table.alloc(d)
+        if flags & self.O_CLOEXEC_FLAG:
+            self.table.cloexec.add(fd)
+        return fd
 
     def sys_fstat(self, ctx, a):
         fd = _s32(a[0])
         desc = self._desc(fd)
         if desc is None:
             return self._no_desc(fd)
+        if isinstance(desc, HostFileDesc):
+            try:
+                st = os.fstat(desc.osfd)
+            except OSError as e:
+                return -e.errno
+            self.mem.write(a[1], self._pack_os_stat(st))
+            return 0
         st = bytearray(144)
         if isinstance(desc, VirtualFileDesc):
             mode = desc.mode
@@ -1458,26 +1651,73 @@ class SyscallHandler:
         self.mem.write(ptr, bytes(st))
         return 0
 
+    def _pack_os_stat(self, st: os.stat_result) -> bytes:
+        """Full x86_64 struct stat from a real os.stat_result —
+        passthrough (what the same call would return natively), so
+        fstat on an emulated fd and native path-stat of the same file
+        agree on identity (st_dev/st_ino comparisons)."""
+        b = bytearray(144)
+        struct.pack_into("<Q", b, 0, st.st_dev & (1 << 64) - 1)
+        struct.pack_into("<Q", b, 8, st.st_ino)
+        struct.pack_into("<Q", b, 16, st.st_nlink)
+        struct.pack_into("<I", b, 24, st.st_mode)
+        struct.pack_into("<I", b, 28, st.st_uid)
+        struct.pack_into("<I", b, 32, st.st_gid)
+        struct.pack_into("<Q", b, 40, st.st_rdev & (1 << 64) - 1)
+        struct.pack_into("<q", b, 48, st.st_size)
+        struct.pack_into("<q", b, 56, getattr(st, "st_blksize", 4096))
+        struct.pack_into("<q", b, 64, getattr(st, "st_blocks", 0))
+        struct.pack_into("<q", b, 72, int(st.st_atime))
+        struct.pack_into("<q", b, 80, st.st_atime_ns % 1_000_000_000)
+        struct.pack_into("<q", b, 88, int(st.st_mtime))
+        struct.pack_into("<q", b, 96, st.st_mtime_ns % 1_000_000_000)
+        struct.pack_into("<q", b, 104, int(st.st_ctime))
+        struct.pack_into("<q", b, 112, st.st_ctime_ns % 1_000_000_000)
+        return bytes(b)
+
+    def _stat_resolved(self, r, stat_ptr: int, follow: bool):
+        """Shared tail of newfstatat/stat/lstat once a confined path
+        is in hand."""
+        try:
+            st = os.stat(r) if follow else os.lstat(r)
+        except OSError as e:
+            return -e.errno
+        self.mem.write(stat_ptr, self._pack_os_stat(st))
+        return 0
+
     def sys_newfstatat(self, ctx, a):
         dirfd = _s32(a[0])
+        if not a[1]:
+            return -EFAULT
+        try:
+            path = self.mem.read_cstr(a[1]).decode(
+                errors="surrogateescape")
+        except OSError:
+            return -EFAULT
+        flags = _s32(a[3])
         if dirfd < VFD_BASE:
-            if a[1]:
-                try:
-                    path = self.mem.read_cstr(a[1]).decode(
-                        errors="surrogateescape")
-                except OSError:
-                    return -EFAULT
-                # the special paths are absolute — the kernel ignores
-                # dirfd for those, and so must the virtualization
-                sp = self._special_stat(path)
-                if sp is not None:
-                    return self._write_stat(a[2], sp[0], sp[1])
+            # the special paths are absolute — the kernel ignores
+            # dirfd for those, and so must the virtualization
+            sp = self._special_stat(path)
+            if sp is not None:
+                return self._write_stat(a[2], sp[0], sp[1])
             return NATIVE           # path-relative stat on native dirs
-        # AT_EMPTY_PATH fstat on a virtual fd (glibc's fstat() ABI)
-        path = self.mem.read_cstr(a[1], 8) if a[1] else b""
-        if path:
-            return -ENOENT          # no paths under a socket
-        return self.sys_fstat(ctx, (a[0], a[2]))
+        desc = self._desc(dirfd)
+        if desc is None:
+            return -EBADF
+        if not path:
+            if flags & self.AT_EMPTY_PATH:
+                return self.sys_fstat(ctx, (a[0], a[2]))
+            return -ENOENT
+        if isinstance(desc, HostFileDesc):
+            r = self._resolve_at(dirfd, path)
+            if r is NATIVE:
+                return NATIVE
+            if isinstance(r, int):
+                return r
+            return self._stat_resolved(
+                r, a[2], not flags & self.AT_SYMLINK_NOFOLLOW)
+        return -ENOTDIR             # paths under a socket/pipe fd
 
     def sys_statx(self, ctx, a):
         dirfd = _s32(a[0])
@@ -1500,13 +1740,680 @@ class SyscallHandler:
         desc = self._desc(dirfd)
         if desc is None:
             return -EBADF
+        path = b""
+        if a[1]:
+            try:
+                path = self.mem.read_cstr(a[1])
+            except OSError:
+                return -EFAULT
+        st = None
+        if isinstance(desc, HostFileDesc):
+            if path:
+                r = self._resolve_at(
+                    dirfd, path.decode(errors="surrogateescape"))
+                if r is NATIVE:
+                    return NATIVE
+                if isinstance(r, int):
+                    return r
+                follow = not _s32(a[2]) & self.AT_SYMLINK_NOFOLLOW
+                try:
+                    st = os.stat(r) if follow else os.lstat(r)
+                except OSError as e:
+                    return -e.errno
+            else:
+                try:
+                    st = os.fstat(desc.osfd)
+                except OSError as e:
+                    return -e.errno
+        elif path:
+            return -ENOTDIR
         stx = bytearray(256)
         struct.pack_into("<I", stx, 0, 0x7FF)          # stx_mask: basic
-        struct.pack_into("<H", stx, 28,
-                         0o140777 if not isinstance(desc, PipeDesc)
-                         else 0o10600)                 # stx_mode
+        if st is not None:
+            struct.pack_into("<I", stx, 4, 4096)       # blksize
+            struct.pack_into("<I", stx, 16, st.st_nlink)
+            struct.pack_into("<I", stx, 20, st.st_uid)
+            struct.pack_into("<I", stx, 24, st.st_gid)
+            struct.pack_into("<H", stx, 28, st.st_mode)
+            struct.pack_into("<Q", stx, 32, st.st_ino)
+            struct.pack_into("<Q", stx, 40, st.st_size)
+            struct.pack_into("<Q", stx, 48, st.st_blocks)
+            # atime/btime/ctime/mtime: four (s64 sec, u32 nsec, pad)
+            for off, (sec, ns) in (
+                    (64, (int(st.st_atime),
+                          st.st_atime_ns % 1_000_000_000)),
+                    (96, (int(st.st_ctime),
+                          st.st_ctime_ns % 1_000_000_000)),
+                    (112, (int(st.st_mtime),
+                           st.st_mtime_ns % 1_000_000_000))):
+                struct.pack_into("<qI", stx, off, sec, ns)
+        else:
+            struct.pack_into("<H", stx, 28,
+                             0o140777 if not isinstance(desc, PipeDesc)
+                             else 0o10600)             # stx_mode
         self.mem.write(a[4], bytes(stx))
         return 0
+
+    # -- the fd-mediated file family (ref file.c:1-499, fileat.c:1-539:
+    # every handler routes through the descriptor table, with dirfd-
+    # relative resolution confined to the host data dir) --------------
+    def _host_file(self, fd: int):
+        """desc lookup that must be an os-backed file: HostFileDesc,
+        NATIVE (native fd — the plugin runs the call raw), or errno."""
+        desc = self._desc(fd)
+        if desc is None:
+            return self._no_desc(fd)
+        if not isinstance(desc, HostFileDesc):
+            return -EINVAL
+        return desc
+
+    def _path_op(self, dirfd, path_ptr, fn):
+        """Shared resolve-then-act tail for single-path operations:
+        fn(confined_abspath) raising OSError maps to -errno."""
+        if not path_ptr:
+            return -EFAULT
+        try:
+            path = self.mem.read_cstr(path_ptr).decode(
+                errors="surrogateescape")
+        except OSError:
+            return -EFAULT
+        r = self._resolve_at(dirfd, path)
+        if r is NATIVE or isinstance(r, int):
+            return r
+        try:
+            ret = fn(r)
+            return 0 if ret is None else ret
+        except OSError as e:
+            return -e.errno
+
+    # getdents: served from a SORTED listing snapshot — real readdir
+    # order is filesystem-nondeterministic, so emulation here is a
+    # determinism win over native passthrough
+    def sys_getdents64(self, ctx, a):
+        return self._getdents(a, old_layout=False)
+
+    def sys_getdents(self, ctx, a):
+        return self._getdents(a, old_layout=True)
+
+    def _getdents(self, a, old_layout: bool):
+        fd, buf, count = _s32(a[0]), a[1], int(a[2])
+        desc = self._desc(fd)
+        if desc is None:
+            return self._no_desc(fd)
+        if not isinstance(desc, HostFileDesc) or not desc.is_dir:
+            return -ENOTDIR
+        ents = desc.dirents()
+        out = bytearray()
+        pos = desc._dirpos
+        while pos < len(ents):
+            name, ino, dtype = ents[pos]
+            nb = name.encode("utf-8", "surrogateescape")
+            if old_layout:
+                # struct linux_dirent: ino, off, reclen, name...,
+                # pad, d_type in the LAST byte
+                reclen = (18 + len(nb) + 2 + 7) & ~7
+                rec = struct.pack("<QqH", ino, pos + 1, reclen) + nb
+                rec += b"\x00" * (reclen - 1 - len(rec))
+                rec += bytes([dtype])
+            else:
+                # struct linux_dirent64: ino, off, reclen, d_type,
+                # name...
+                reclen = (19 + len(nb) + 1 + 7) & ~7
+                rec = struct.pack("<QqHB", ino, pos + 1, reclen,
+                                  dtype) + nb
+                rec += b"\x00" * (reclen - len(rec))
+            if len(out) + reclen > count:
+                break
+            out += rec
+            pos += 1
+        if not out and desc._dirpos < len(ents):
+            return -EINVAL          # buffer too small for one entry
+        desc._dirpos = pos
+        if out:
+            self.mem.write(buf, bytes(out))
+        return len(out)
+
+    # fd ops on the os-backed file -------------------------------------
+    def sys_ftruncate(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        ln = _s64(a[1])
+        if ln < 0:
+            return -EINVAL
+        try:
+            os.ftruncate(d.osfd, ln)
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    def sys_fsync(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        try:
+            os.fsync(d.osfd)
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    def sys_fdatasync(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        try:
+            os.fdatasync(d.osfd)
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    def sys_fallocate(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        mode, off, ln = _s32(a[1]), _s64(a[2]), _s64(a[3])
+        if off < 0 or ln <= 0:
+            return -EINVAL
+        if mode != 0:
+            return -EOPNOTSUPP      # punch-hole/zero-range: not yet
+        try:
+            os.posix_fallocate(d.osfd, off, ln)
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    def sys_fchmod(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        try:
+            os.fchmod(d.osfd, int(a[1]) & 0o7777)
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    def sys_fchown(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        try:
+            os.fchown(d.osfd, _s32(a[1]), _s32(a[2]))
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    # flock: a VIRTUAL per-host lock table keyed by the confined path
+    # (real blocking flock would stall the whole simulator thread);
+    # blocking waiters poll on a short sim-time deadline. Holders that
+    # closed their fd are pruned lazily.
+    LOCK_SH, LOCK_EX, LOCK_NB, LOCK_UN = 1, 2, 4, 8
+
+    def _flock_table(self) -> dict:
+        t = getattr(self.p.host, "_flock_table", None)
+        if t is None:
+            t = self.p.host._flock_table = {}
+        return t
+
+    def sys_flock(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        op = _s32(a[1])
+        kind = op & (self.LOCK_SH | self.LOCK_EX | self.LOCK_UN)
+        if kind not in (self.LOCK_SH, self.LOCK_EX, self.LOCK_UN):
+            return -EINVAL
+        table = self._flock_table()
+        key = os.path.realpath(d.abspath)
+        holders = table.setdefault(key, {})     # desc -> 'sh'|'ex'
+        for h in [h for h in holders if h.closed]:
+            del holders[h]
+        if kind == self.LOCK_UN:
+            holders.pop(d, None)
+            return 0
+        want = "sh" if kind == self.LOCK_SH else "ex"
+        others = {h: m for h, m in holders.items() if h is not d}
+        conflict = any(m == "ex" or want == "ex"
+                       for m in others.values())
+        if conflict:
+            if op & self.LOCK_NB:
+                return -EAGAIN      # EWOULDBLOCK
+            raise Blocked(deadline=ctx.now + 1_000_000)
+        holders[d] = want           # grant (also converts)
+        return 0
+
+    # path ops with dirfd-relative confined resolution ----------------
+    def sys_unlinkat(self, ctx, a):
+        flags = _s32(a[2])
+        op = os.rmdir if flags & self.AT_REMOVEDIR else os.unlink
+        return self._path_op(_s32(a[0]), a[1], op)
+
+    def sys_unlink(self, ctx, a):
+        return self._path_op(self.AT_FDCWD, a[0], os.unlink)
+
+    def sys_rmdir(self, ctx, a):
+        return self._path_op(self.AT_FDCWD, a[0], os.rmdir)
+
+    def sys_mkdirat(self, ctx, a):
+        mode = int(a[2]) & 0o7777
+        return self._path_op(_s32(a[0]), a[1],
+                             lambda p: os.mkdir(p, mode))
+
+    def sys_mkdir(self, ctx, a):
+        mode = int(a[1]) & 0o7777
+        return self._path_op(self.AT_FDCWD, a[0],
+                             lambda p: os.mkdir(p, mode))
+
+    def _rename(self, olddirfd, old_ptr, newdirfd, new_ptr,
+                flags: int):
+        RENAME_NOREPLACE, RENAME_EXCHANGE = 1, 2
+        if flags & ~(RENAME_NOREPLACE | RENAME_EXCHANGE):
+            return -EINVAL
+        if flags & RENAME_EXCHANGE:
+            return -EINVAL          # atomic exchange: not emulated
+        for ptr in (old_ptr, new_ptr):
+            if not ptr:
+                return -EFAULT
+        try:
+            old = self.mem.read_cstr(old_ptr).decode(
+                errors="surrogateescape")
+            new = self.mem.read_cstr(new_ptr).decode(
+                errors="surrogateescape")
+        except OSError:
+            return -EFAULT
+        ro = self._resolve_at(olddirfd, old)
+        rn = self._resolve_at(newdirfd, new)
+        if ro is NATIVE and rn is NATIVE:
+            return NATIVE
+        if isinstance(ro, int):
+            return ro
+        if isinstance(rn, int):
+            return rn
+        if ro is NATIVE or rn is NATIVE:
+            return -EXDEV       # confined <-> unconfined: refuse
+        if flags & RENAME_NOREPLACE and os.path.lexists(rn):
+            return -EEXIST
+        try:
+            os.rename(ro, rn)
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    def sys_renameat(self, ctx, a):
+        return self._rename(_s32(a[0]), a[1], _s32(a[2]), a[3], 0)
+
+    def sys_renameat2(self, ctx, a):
+        return self._rename(_s32(a[0]), a[1], _s32(a[2]), a[3],
+                            _s32(a[4]))
+
+    def sys_rename(self, ctx, a):
+        return self._rename(self.AT_FDCWD, a[0], self.AT_FDCWD,
+                            a[1], 0)
+
+    def _link(self, olddirfd, old_ptr, newdirfd, new_ptr, flags):
+        for ptr in (old_ptr, new_ptr):
+            if not ptr:
+                return -EFAULT
+        try:
+            old = self.mem.read_cstr(old_ptr).decode(
+                errors="surrogateescape")
+            new = self.mem.read_cstr(new_ptr).decode(
+                errors="surrogateescape")
+        except OSError:
+            return -EFAULT
+        ro = self._resolve_at(olddirfd, old)
+        rn = self._resolve_at(newdirfd, new)
+        if ro is NATIVE and rn is NATIVE:
+            return NATIVE
+        if isinstance(ro, int):
+            return ro
+        if isinstance(rn, int):
+            return rn
+        if ro is NATIVE or rn is NATIVE:
+            return -EXDEV
+        try:
+            os.link(ro, rn, follow_symlinks=bool(
+                flags & self.AT_SYMLINK_FOLLOW))
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    def sys_linkat(self, ctx, a):
+        return self._link(_s32(a[0]), a[1], _s32(a[2]), a[3],
+                          _s32(a[4]))
+
+    def sys_link(self, ctx, a):
+        return self._link(self.AT_FDCWD, a[0], self.AT_FDCWD, a[1],
+                          self.AT_SYMLINK_FOLLOW)
+
+    def sys_symlinkat(self, ctx, a):
+        # the TARGET string is stored verbatim (never resolved here;
+        # later opens through it hit the realpath confinement check)
+        if not a[0]:
+            return -EFAULT
+        try:
+            target = self.mem.read_cstr(a[0]).decode(
+                errors="surrogateescape")
+        except OSError:
+            return -EFAULT
+        return self._path_op(_s32(a[1]), a[2],
+                             lambda p: os.symlink(target, p))
+
+    def sys_symlink(self, ctx, a):
+        return self.sys_symlinkat(ctx, (a[0], self.AT_FDCWD, a[1]))
+
+    def sys_readlinkat(self, ctx, a):
+        bufp, bufsz = a[2], int(a[3])
+        if bufsz <= 0:
+            return -EINVAL
+
+        def do(p):
+            tgt = os.readlink(p).encode("utf-8", "surrogateescape")
+            out = tgt[:bufsz]
+            self.mem.write(bufp, out)
+            return len(out)         # no NUL terminator (kernel ABI)
+        return self._path_op(_s32(a[0]), a[1], do)
+
+    def sys_readlink(self, ctx, a):
+        return self.sys_readlinkat(ctx, (self.AT_FDCWD, a[0], a[1],
+                                         a[2]))
+
+    def sys_faccessat(self, ctx, a):
+        mode = _s32(a[2])
+
+        def do(p):
+            if not os.path.lexists(p):
+                return -ENOENT
+            ok = os.access(p, mode) if mode else os.path.exists(p)
+            return 0 if ok else -EACCES
+        return self._path_op(_s32(a[0]), a[1], do)
+
+    def sys_faccessat2(self, ctx, a):
+        AT_EACCESS = 0x200
+        mode, flags = _s32(a[2]), _s32(a[3])
+        if flags & ~(AT_EACCESS | self.AT_SYMLINK_NOFOLLOW):
+            return -EINVAL
+        if not flags & self.AT_SYMLINK_NOFOLLOW:
+            # AT_EACCESS is a no-op here: real and effective ids match
+            return self.sys_faccessat(ctx, a)
+
+        def do(p):
+            if not os.path.lexists(p):
+                return -ENOENT
+            if not mode:            # F_OK on the link itself
+                return 0
+            ok = os.access(p, mode, follow_symlinks=False)
+            return 0 if ok else -EACCES
+        return self._path_op(_s32(a[0]), a[1], do)
+
+    def sys_fchmodat(self, ctx, a):
+        mode = int(a[2]) & 0o7777
+        return self._path_op(_s32(a[0]), a[1],
+                             lambda p: os.chmod(p, mode))
+
+    def sys_chmod(self, ctx, a):
+        mode = int(a[1]) & 0o7777
+        return self._path_op(self.AT_FDCWD, a[0],
+                             lambda p: os.chmod(p, mode))
+
+    def sys_fchownat(self, ctx, a):
+        uid, gid, flags = _s32(a[2]), _s32(a[3]), _s32(a[4])
+        follow = not flags & self.AT_SYMLINK_NOFOLLOW
+        return self._path_op(
+            _s32(a[0]), a[1],
+            lambda p: os.chown(p, uid, gid, follow_symlinks=follow))
+
+    def sys_chown(self, ctx, a):
+        return self._path_op(
+            self.AT_FDCWD, a[0],
+            lambda p: os.chown(p, _s32(a[1]), _s32(a[2])))
+
+    def sys_lchown(self, ctx, a):
+        return self._path_op(
+            self.AT_FDCWD, a[0],
+            lambda p: os.lchown(p, _s32(a[1]), _s32(a[2])))
+
+    def sys_truncate(self, ctx, a):
+        ln = _s64(a[1])
+        if ln < 0:
+            return -EINVAL
+        return self._path_op(self.AT_FDCWD, a[0],
+                             lambda p: os.truncate(p, ln))
+
+    # file times: UTIME_NOW resolves to SIM time, so emulated
+    # timestamps stay deterministic
+    UTIME_NOW, UTIME_OMIT = (1 << 30) - 1, (1 << 30) - 2
+
+    def _read_timespec_pair(self, ctx, ptr):
+        """-> (atime_ns, mtime_ns) with None = omit."""
+        now = self._now_wall(ctx)
+        if not ptr:
+            return now, now
+        raw = self.mem.read(ptr, 32)
+        out = []
+        for i in (0, 16):
+            sec, ns = struct.unpack_from("<qq", raw, i)
+            if ns == self.UTIME_NOW:
+                out.append(now)
+            elif ns == self.UTIME_OMIT:
+                out.append(None)
+            elif not 0 <= ns < 1_000_000_000:
+                raise ValueError
+            else:
+                out.append(sec * 1_000_000_000 + ns)
+        return out[0], out[1]
+
+    def _apply_times(self, p, at, mt, follow=True):
+        if at is None or mt is None:
+            st = os.stat(p) if follow else os.lstat(p)
+            at = st.st_atime_ns if at is None else at
+            mt = st.st_mtime_ns if mt is None else mt
+        os.utime(p, ns=(at, mt), follow_symlinks=follow)
+
+    def sys_utimensat(self, ctx, a):
+        try:
+            at, mt = self._read_timespec_pair(ctx, a[2])
+        except ValueError:
+            return -EINVAL
+        except OSError:
+            return -EFAULT
+        flags = _s32(a[3])
+        follow = not flags & self.AT_SYMLINK_NOFOLLOW
+        if not a[1]:
+            # NULL path: futimens(fd) on the os-backed file
+            d = self._host_file(_s32(a[0]))
+            if not isinstance(d, HostFileDesc):
+                return d
+            try:
+                if at is None or mt is None:
+                    st = os.fstat(d.osfd)
+                    at = st.st_atime_ns if at is None else at
+                    mt = st.st_mtime_ns if mt is None else mt
+                os.utime(d.osfd, ns=(at, mt))
+                return 0
+            except OSError as e:
+                return -e.errno
+        return self._path_op(
+            _s32(a[0]), a[1],
+            lambda p: self._apply_times(p, at, mt, follow))
+
+    def _read_timeval_pair(self, ctx, ptr):
+        now = self._now_wall(ctx)
+        if not ptr:
+            return now, now
+        raw = self.mem.read(ptr, 32)
+        s0, u0, s1, u1 = struct.unpack_from("<qqqq", raw)
+        if not (0 <= u0 < 1_000_000 and 0 <= u1 < 1_000_000):
+            raise ValueError
+        return (s0 * 1_000_000_000 + u0 * 1000,
+                s1 * 1_000_000_000 + u1 * 1000)
+
+    def sys_futimesat(self, ctx, a):
+        try:
+            at, mt = self._read_timeval_pair(ctx, a[2])
+        except ValueError:
+            return -EINVAL
+        except OSError:
+            return -EFAULT
+        return self._path_op(_s32(a[0]), a[1],
+                             lambda p: self._apply_times(p, at, mt))
+
+    def sys_utimes(self, ctx, a):
+        return self.sys_futimesat(ctx, (self.AT_FDCWD, a[0], a[1]))
+
+    def sys_utime(self, ctx, a):
+        if a[1]:
+            try:
+                raw = self.mem.read(a[1], 16)
+            except OSError:
+                return -EFAULT
+            at_s, mt_s = struct.unpack("<qq", raw)
+            at, mt = at_s * 1_000_000_000, mt_s * 1_000_000_000
+        else:
+            at = mt = self._now_wall(ctx)
+        return self._path_op(self.AT_FDCWD, a[0],
+                             lambda p: self._apply_times(p, at, mt))
+
+    # cwd tracking: chdir inside the data dir keeps emulated AT_FDCWD
+    # resolution accurate; a chdir OUT of it flips resolution to
+    # NATIVE (the plugin's own kernel cwd stays authoritative)
+    def sys_chdir(self, ctx, a):
+        if not a[0]:
+            return -EFAULT
+        try:
+            path = self.mem.read_cstr(a[0]).decode(
+                errors="surrogateescape")
+        except OSError:
+            return -EFAULT
+        r = self._resolve_at(self.AT_FDCWD, path)
+        if r is NATIVE:
+            self.p.vcwd = "outside"
+            return NATIVE
+        if isinstance(r, int):
+            return r
+        if os.path.isdir(r):
+            self.p.vcwd = r
+        return NATIVE               # keep the REAL cwd in sync
+
+    def sys_fchdir(self, ctx, a):
+        fd = _s32(a[0])
+        if fd < VFD_BASE:
+            self.p.vcwd = "outside"     # can't see where it points
+            return NATIVE
+        d = self._desc(fd)
+        if d is None:
+            return -EBADF
+        if not isinstance(d, HostFileDesc) or not d.is_dir:
+            return -ENOTDIR
+        if getattr(self.p, "interpose_style", "") != "ptrace":
+            # the preload plugin's REAL cwd cannot follow a virtual
+            # dir fd; refuse loudly rather than diverge silently
+            return -EACCES
+        self.p.vcwd = d.abspath
+        return 0
+
+    # xattr family (confined paths / os-backed fds) --------------------
+    def _xattr_name(self, ptr):
+        return self.mem.read_cstr(ptr).decode(errors="surrogateescape")
+
+    def _xattr_get(self, target, name_ptr, val_ptr, size):
+        try:
+            val = os.getxattr(target, self._xattr_name(name_ptr))
+        except OSError as e:
+            return -e.errno
+        if size == 0:
+            return len(val)
+        if len(val) > size:
+            return -ERANGE
+        self.mem.write(val_ptr, val)
+        return len(val)
+
+    def _xattr_set(self, target, name_ptr, val_ptr, size, flags):
+        try:
+            val = self.mem.read(val_ptr, size) if size else b""
+            os.setxattr(target, self._xattr_name(name_ptr), val,
+                        flags)
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    def _xattr_list(self, target, buf_ptr, size):
+        try:
+            names = os.listxattr(target)
+        except OSError as e:
+            return -e.errno
+        blob = b"".join(n.encode() + b"\x00" for n in names)
+        if size == 0:
+            return len(blob)
+        if len(blob) > size:
+            return -ERANGE
+        if blob:
+            self.mem.write(buf_ptr, blob)
+        return len(blob)
+
+    def _xattr_remove(self, target, name_ptr):
+        try:
+            os.removexattr(target, self._xattr_name(name_ptr))
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    def sys_fgetxattr(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        return self._xattr_get(d.osfd, a[1], a[2], int(a[3]))
+
+    def sys_fsetxattr(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        return self._xattr_set(d.osfd, a[1], a[2], int(a[3]),
+                               _s32(a[4]))
+
+    def sys_flistxattr(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        return self._xattr_list(d.osfd, a[1], int(a[2]))
+
+    def sys_fremovexattr(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        return self._xattr_remove(d.osfd, a[1])
+
+    def sys_getxattr(self, ctx, a):
+        return self._path_op(
+            self.AT_FDCWD, a[0],
+            lambda p: self._xattr_get(p, a[1], a[2], int(a[3])))
+
+    def sys_lgetxattr(self, ctx, a):
+        return self.sys_getxattr(ctx, a)    # links: best effort
+
+    def sys_setxattr(self, ctx, a):
+        return self._path_op(
+            self.AT_FDCWD, a[0],
+            lambda p: self._xattr_set(p, a[1], a[2], int(a[3]),
+                                      _s32(a[4])))
+
+    def sys_lsetxattr(self, ctx, a):
+        return self.sys_setxattr(ctx, a)
+
+    def sys_listxattr(self, ctx, a):
+        return self._path_op(
+            self.AT_FDCWD, a[0],
+            lambda p: self._xattr_list(p, a[1], int(a[2])))
+
+    def sys_llistxattr(self, ctx, a):
+        return self.sys_listxattr(ctx, a)
+
+    def sys_removexattr(self, ctx, a):
+        return self._path_op(
+            self.AT_FDCWD, a[0],
+            lambda p: self._xattr_remove(p, a[1]))
+
+    def sys_lremovexattr(self, ctx, a):
+        return self.sys_removexattr(ctx, a)
 
     def sys_fcntl(self, ctx, a):
         fd, cmd, arg = _s32(a[0]), _s32(a[1]), int(a[2])
@@ -1528,9 +2435,26 @@ class SyscallHandler:
                 self.table.cloexec.discard(fd)
             return 0
         if cmd == F_GETFL:
+            if isinstance(desc, HostFileDesc):
+                return (desc.flags & ~O_NONBLOCK) \
+                    | (O_NONBLOCK if desc.nonblock else 0)
             return O_RDWR | (O_NONBLOCK if desc.nonblock else 0)
         if cmd == F_SETFL:
             desc.nonblock = bool(arg & O_NONBLOCK)
+            if isinstance(desc, HostFileDesc):
+                # O_APPEND is the only SETFL bit with real effect on
+                # the os-backed fd
+                import fcntl as _fcntl
+                O_APPEND = 0x400
+                try:
+                    cur = _fcntl.fcntl(desc.osfd, _fcntl.F_GETFL)
+                    _fcntl.fcntl(desc.osfd, _fcntl.F_SETFL,
+                                 (cur & ~O_APPEND)
+                                 | (arg & O_APPEND))
+                except OSError as e:
+                    return -e.errno
+                desc.flags = (desc.flags & ~(O_APPEND | O_NONBLOCK)) \
+                    | (arg & (O_APPEND | O_NONBLOCK))
             return 0
         return -EINVAL
 
@@ -2117,6 +3041,118 @@ class SyscallHandler:
         self.mem.write(a[0], bytes(si))
         return 0
 
+    # -- resource limits + prctl (ref syscall_handler.c:250-533 tail) --
+    RLIM_INFINITY = (1 << 64) - 1
+    # deterministic per-resource defaults (the REAL machine's limits
+    # must never leak into the plugin — same policy as the
+    # rusage/times/affinity views): a plausible fixed machine
+    _RLIMIT_DEFAULTS = {
+        3: (8 << 20, RLIM_INFINITY),        # STACK
+        7: (1024, 1 << 20),                 # NOFILE
+    }
+
+    def _rlimits(self) -> dict:
+        d = getattr(self.p, "rlimits", None)
+        if d is None:
+            d = self.p.rlimits = {}
+        return d
+
+    def sys_prlimit64(self, ctx, a):
+        pid, res = _s32(a[0]), _s32(a[1])
+        if pid not in (0, self.p.vpid):
+            return -EPERM           # cross-process limits: not modeled
+        if not 0 <= res < 16:
+            return -EINVAL
+        lims = self._rlimits()
+        cur = lims.get(res) or self._RLIMIT_DEFAULTS.get(
+            res, (self.RLIM_INFINITY, self.RLIM_INFINITY))
+        new = None
+        if a[2]:
+            try:
+                soft, hard = struct.unpack(
+                    "<QQ", self.mem.read(a[2], 16))
+            except OSError:
+                return -EFAULT
+            if soft > hard:
+                return -EINVAL
+            new = (soft, hard)
+        if a[3]:
+            try:
+                self.mem.write(a[3], struct.pack("<QQ", *cur))
+            except OSError:
+                return -EFAULT
+        if new is not None:
+            lims[res] = new
+        return 0
+
+    def sys_getrlimit(self, ctx, a):
+        return self.sys_prlimit64(ctx, (0, a[0], 0, a[1]))
+
+    def sys_setrlimit(self, ctx, a):
+        # struct rlimit is u64-based on x86_64: same layout
+        return self.sys_prlimit64(ctx, (0, a[0], a[1], 0))
+
+    def sys_prctl(self, ctx, a):
+        """Minimal prctl: PDEATHSIG is virtualized (delivered by the
+        VIRTUAL parent-death path — the native parent of every plugin
+        is the simulator, so the kernel's own delivery would fire at
+        the wrong moment); PR_SET_NAME is mirrored then run native.
+        Everything else passes through."""
+        PR_SET_PDEATHSIG, PR_GET_PDEATHSIG = 1, 2
+        PR_SET_NAME, PR_GET_NAME = 15, 16
+        opt = _s32(a[0])
+        if opt == PR_SET_PDEATHSIG:
+            sig = _s32(a[1])
+            if sig and not 1 <= sig <= 64:
+                return -EINVAL
+            self.p.pdeathsig = sig
+            return 0
+        if opt == PR_GET_PDEATHSIG:
+            if not a[1]:
+                return -EFAULT
+            self.mem.write(a[1], struct.pack(
+                "<i", getattr(self.p, "pdeathsig", 0)))
+            return 0
+        if opt == PR_SET_NAME:
+            try:
+                name = self.mem.read(a[1], 16).split(b"\x00")[0][:15]
+            except OSError:
+                return -EFAULT
+            self.p.current.comm = name
+            return NATIVE           # mirror into the real thread too
+        if opt == PR_GET_NAME:
+            comm = getattr(self.p.current, "comm", None)
+            if comm is None:
+                return NATIVE
+            if not a[1]:
+                return -EFAULT
+            self.mem.write(a[1], comm.ljust(16, b"\x00")[:16])
+            return 0
+        return NATIVE
+
+    def sys_set_robust_list(self, ctx, a):
+        """Deliberate kernel delegation: robust-futex list walking
+        happens at REAL thread death, and threads die for real under
+        both backends — the kernel's own handling is the correct one.
+        The head is mirrored for get_robust_list / introspection.
+        Ref: syscall_handler.c robust-list passthrough."""
+        if int(a[1]) != 24:         # sizeof(struct robust_list_head)
+            return -EINVAL
+        self.p.current.robust_list = int(a[0])
+        return NATIVE
+
+    def sys_get_robust_list(self, ctx, a):
+        pid = _s32(a[0])
+        if pid not in (0, self.p.vpid) and \
+                pid not in getattr(self.p, "threads", {}):
+            return -EPERM
+        head = getattr(self.p.current, "robust_list", 0)
+        if a[1]:
+            self.mem.write(a[1], struct.pack("<Q", head))
+        if a[2]:
+            self.mem.write(a[2], struct.pack("<Q", 24))
+        return 0
+
     # ==================================================================
     # futex (futex.c, futex_table.c)
     # ==================================================================
@@ -2177,8 +3213,11 @@ class SyscallHandler:
             return self._no_desc(out_fd)
         if not isinstance(out, TcpDesc):
             return -EINVAL
+        in_desc = None
         if in_fd >= VFD_BASE:
-            return -EINVAL          # in_fd must be a real file
+            in_desc = self._desc(in_fd)
+            if not isinstance(in_desc, HostFileDesc):
+                return -EINVAL      # in_fd must be a file
         # same connection-state gate as _tcp_write
         if out.connect_err:
             err = out.connect_err
@@ -2204,7 +3243,12 @@ class SyscallHandler:
                 # plugin's real fd position is advanced at finish via
                 # pidfd_getfd+lseek (shared file description).
                 st["sf_off"] = None
-                st["sf_base"] = self._native_file_offset(in_fd) or 0
+                if in_desc is not None:
+                    st["sf_base"] = os.lseek(in_desc.osfd, 0,
+                                             os.SEEK_CUR)
+                else:
+                    st["sf_base"] = \
+                        self._native_file_offset(in_fd) or 0
         space = out.send_space()
         if space <= 0:
             if out.nonblock:
@@ -2212,15 +3256,19 @@ class SyscallHandler:
                     if st["sf_sent"] else -EAGAIN
             raise Blocked([out])
         want = min(count - st["sf_sent"], space)
+        base = st["sf_off"] if st["sf_off"] is not None \
+            else st["sf_base"]
         try:
-            with open(f"/proc/{self.p.native_pid}/fd/{in_fd}",
-                      "rb") as f:
-                base = st["sf_off"] if st["sf_off"] is not None \
-                    else st["sf_base"]
-                f.seek(base + st["sf_sent"])
+            if in_desc is not None:
                 # read only what this pass can push: a blocked 100 MB
                 # transfer must not re-read the whole tail every wake
-                data = f.read(want)
+                data = os.pread(in_desc.osfd, want,
+                                base + st["sf_sent"])
+            else:
+                with open(f"/proc/{self.p.native_pid}/fd/{in_fd}",
+                          "rb") as f:
+                    f.seek(base + st["sf_sent"])
+                    data = f.read(want)
         except OSError:
             return -EBADF
         if not data:
@@ -2241,10 +3289,21 @@ class SyscallHandler:
             self.mem.write(off_ptr,
                            struct.pack("<q", st["sf_off"] + sent))
         elif sent and st["sf_off"] is None:
-            # NULL offset: the plugin's own fd position must advance by
-            # `sent`. /proc/pid/fd opens a NEW description, so seek the
-            # plugin's actual one via pidfd_getfd (shares the offset).
-            self._advance_plugin_fd(in_fd, st["sf_base"] + sent)
+            if in_fd >= VFD_BASE:
+                # emulated file: the simulator owns the offset
+                d = self._desc(in_fd)
+                if isinstance(d, HostFileDesc):
+                    try:
+                        os.lseek(d.osfd, st["sf_base"] + sent,
+                                 os.SEEK_SET)
+                    except OSError:
+                        pass
+            else:
+                # NULL offset: the plugin's own fd position must
+                # advance by `sent`. /proc/pid/fd opens a NEW
+                # description, so seek the plugin's actual one via
+                # pidfd_getfd (shares the offset).
+                self._advance_plugin_fd(in_fd, st["sf_base"] + sent)
         return sent
 
     _warned_pidfd = False

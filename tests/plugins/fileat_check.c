@@ -1,0 +1,125 @@
+/* The fd-mediated file family (ref file.c/fileat.c parity): dirfd-
+ * relative openat/mkdirat/renameat/unlinkat/linkat/symlinkat/
+ * readlinkat/faccessat, fd ops (ftruncate/fsync/fallocate/fchmod/
+ * flock/pread/pwrite), sorted deterministic getdents, and data-dir
+ * confinement of ".." escapes. Prints one "label value" line per
+ * check; the harness asserts exact output. */
+#define _GNU_SOURCE
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+static void check(const char *label, int ok) {
+  printf("%s %d\n", label, ok);
+}
+
+int main(void) {
+  /* -- a subdir opened as a dirfd anchors the whole at-family -- */
+  check("mkdir", mkdir("sub", 0755) == 0);
+  int dirfd = open("sub", O_RDONLY | O_DIRECTORY);
+  check("opendirfd", dirfd >= 0);
+
+  /* -- create/write/pread through the dirfd -- */
+  int fd = openat(dirfd, "a.txt", O_CREAT | O_RDWR, 0644);
+  check("openat", fd >= 0);
+  check("write", write(fd, "hello world", 11) == 11);
+  char buf[64] = {0};
+  check("pread", pread(fd, buf, 5, 6) == 5 && !strcmp(buf, "world"));
+  check("pwrite", pwrite(fd, "WORLD", 5, 6) == 5);
+  check("lseek", lseek(fd, 0, SEEK_SET) == 0);
+  memset(buf, 0, sizeof buf);
+  check("read", read(fd, buf, 11) == 11 &&
+        !strcmp(buf, "hello WORLD"));
+  struct stat st;
+  check("fstat_size", fstat(fd, &st) == 0 && st.st_size == 11);
+  check("ftruncate", ftruncate(fd, 5) == 0 && fstat(fd, &st) == 0 &&
+        st.st_size == 5);
+  check("fsync", fsync(fd) == 0);
+  check("fdatasync", fdatasync(fd) == 0);
+  check("fchmod", fchmod(fd, 0600) == 0 && fstat(fd, &st) == 0 &&
+        (st.st_mode & 07777) == 0600);
+
+  /* -- stat through the dirfd (fstatat) -- */
+  check("fstatat", fstatat(dirfd, "a.txt", &st, 0) == 0 &&
+        st.st_size == 5);
+
+  /* -- links -- */
+  check("symlinkat", symlinkat("a.txt", dirfd, "ln") == 0);
+  ssize_t n = readlinkat(dirfd, "ln", buf, sizeof buf);
+  check("readlinkat", n == 5 && !strncmp(buf, "a.txt", 5));
+  check("fstatat_nofollow",
+        fstatat(dirfd, "ln", &st, AT_SYMLINK_NOFOLLOW) == 0 &&
+        S_ISLNK(st.st_mode));
+  check("linkat", linkat(dirfd, "a.txt", dirfd, "hard", 0) == 0);
+  check("nlink2", fstatat(dirfd, "hard", &st, 0) == 0 &&
+        st.st_nlink == 2);
+  check("renameat", renameat(dirfd, "hard", dirfd, "hard2") == 0 &&
+        faccessat(dirfd, "hard2", F_OK, 0) == 0 &&
+        faccessat(dirfd, "hard", F_OK, 0) != 0);
+  check("faccessat_rw", faccessat(dirfd, "a.txt", R_OK | W_OK, 0) == 0);
+
+  /* -- sorted deterministic getdents -- */
+  DIR *d = fdopendir(openat(dirfd, ".", O_RDONLY | O_DIRECTORY));
+  check("fdopendir", d != NULL);
+  char order[256] = {0};
+  if (d) {
+    struct dirent *e;
+    while ((e = readdir(d)) != NULL) {
+      strncat(order, e->d_name, sizeof order - strlen(order) - 2);
+      strncat(order, ",", sizeof order - strlen(order) - 2);
+    }
+    closedir(d);
+  }
+  printf("dirents %s\n", order);
+
+  /* -- subdirectories via mkdirat / unlinkat(AT_REMOVEDIR) -- */
+  check("mkdirat", mkdirat(dirfd, "d2", 0755) == 0);
+  check("rmdirat", unlinkat(dirfd, "d2", AT_REMOVEDIR) == 0);
+
+  /* -- flock: EX held on one description conflicts with another -- */
+  int fd2 = openat(dirfd, "a.txt", O_RDWR);
+  check("flock_ex", flock(fd, LOCK_EX) == 0);
+  check("flock_conflict",
+        flock(fd2, LOCK_EX | LOCK_NB) == -1 && errno == EWOULDBLOCK);
+  check("flock_un", flock(fd, LOCK_UN) == 0);
+  check("flock_regrab", flock(fd2, LOCK_EX | LOCK_NB) == 0);
+  close(fd2);
+
+  /* -- confinement: ".." escapes out of the data dir are refused -- */
+  int esc = open("../../escape.txt", O_CREAT | O_WRONLY, 0644);
+  check("escape_rel", esc < 0 && errno == EACCES);
+  esc = openat(dirfd, "../../../escape.txt", O_CREAT | O_WRONLY, 0644);
+  check("escape_dirfd", esc < 0 && errno == EACCES);
+  check("unlinkat_ln", unlinkat(dirfd, "ln", 0) == 0);
+  check("unlinkat_hard2", unlinkat(dirfd, "hard2", 0) == 0);
+  close(fd);
+  close(dirfd);
+
+  /* -- chdir coherence: relative resolution must follow the cwd -- */
+  check("chdir", chdir("sub") == 0);
+  FILE *cf = fopen("cwdfile.txt", "w");
+  check("cwd_fopen", cf != NULL);
+  if (cf) { fputs("incwd", cf); fclose(cf); }
+  check("cwd_stat", stat("cwdfile.txt", &st) == 0);
+  check("chdir_up", chdir("..") == 0);
+  check("cwd_back", stat("sub/cwdfile.txt", &st) == 0);
+
+  /* -- dirent/stat identity: d_ino of a listed file equals st_ino -- */
+  d = opendir("sub");
+  long d_ino = -1;
+  if (d) {
+    struct dirent *e;
+    while ((e = readdir(d)) != NULL)
+      if (!strcmp(e->d_name, "a.txt")) d_ino = (long)e->d_ino;
+    closedir(d);
+  }
+  check("dino_matches_stat",
+        stat("sub/a.txt", &st) == 0 && d_ino == (long)st.st_ino);
+  printf("done\n");
+  return 0;
+}

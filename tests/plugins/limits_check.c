@@ -1,0 +1,36 @@
+/* Deterministic resource limits + minimal prctl virtualization:
+ * getrlimit must report the SIMULATED fixed machine (never the real
+ * one), setrlimit must round-trip, and PR_SET_NAME / PR_SET_PDEATHSIG
+ * must be visible through their getters. */
+#define _GNU_SOURCE
+#include <stdio.h>
+#include <string.h>
+#include <sys/prctl.h>
+#include <sys/resource.h>
+#include <sys/time.h>
+
+int main(void) {
+  struct rlimit rl;
+  if (getrlimit(RLIMIT_NOFILE, &rl) != 0) { perror("getrlimit"); return 1; }
+  printf("nofile %llu %llu\n", (unsigned long long)rl.rlim_cur,
+         (unsigned long long)rl.rlim_max);
+  rl.rlim_cur = 512;
+  printf("setrlimit %d\n", setrlimit(RLIMIT_NOFILE, &rl));
+  if (getrlimit(RLIMIT_NOFILE, &rl) != 0) return 1;
+  printf("nofile2 %llu %llu\n", (unsigned long long)rl.rlim_cur,
+         (unsigned long long)rl.rlim_max);
+  if (getrlimit(RLIMIT_STACK, &rl) != 0) return 1;
+  printf("stack_soft %llu\n", (unsigned long long)rl.rlim_cur);
+
+  if (prctl(PR_SET_PDEATHSIG, 15) != 0) { perror("pdeathsig"); return 1; }
+  int sig = 0;
+  prctl(PR_GET_PDEATHSIG, &sig);
+  printf("pdeathsig %d\n", sig);
+
+  prctl(PR_SET_NAME, "worker0");
+  char name[17] = {0};
+  prctl(PR_GET_NAME, name);
+  printf("name %s\n", name);
+  printf("done\n");
+  return 0;
+}

@@ -137,3 +137,91 @@ def test_deterministic_rusage_topology(plugins, tmp_path, method):
     assert lines[4] == "getcpu 0 0"
     assert lines[5] == "done"
     assert stats.ok
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_fileat_family(plugins, tmp_path, method):
+    """The fd-mediated file family (ref file.c/fileat.c): dirfd-
+    relative openat/mkdirat/renameat/unlinkat/linkat/symlinkat/
+    readlinkat/faccessat, ftruncate/fsync/fchmod/flock/pread/pwrite,
+    sorted deterministic getdents, and '..' confinement."""
+    data = str(tmp_path / "shadow.data")
+    cfg = _cfg(data, method) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['fileat_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    assert stats.ok
+    out = read_stdout(data, "alice", "fileat_check")
+    assert "done" in out, out
+    for line in out.splitlines():
+        parts = line.split()
+        if len(parts) == 2 and parts[1] in ("0", "1") \
+                and parts[0] != "dirents":
+            assert parts[1] == "1", f"{line!r} failed:\n{out}"
+    # getdents serves a SORTED snapshot ('.', '..', then names):
+    # deterministic across runs and filesystems
+    assert "dirents .,..,a.txt,hard2,ln," in out
+    # the confined ops physically landed inside alice's host dir
+    sub = os.path.join(data, "hosts", "alice", "sub")
+    assert os.path.isdir(sub)
+    assert open(os.path.join(sub, "a.txt")).read() == "hello"
+    # ... and the escape attempts did NOT create files outside it
+    assert not os.path.exists(os.path.join(data, "escape.txt"))
+    assert not os.path.exists(
+        os.path.join(data, "hosts", "escape.txt"))
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_fileat_two_host_isolation(plugins, tmp_path, method):
+    """dirfd-relative ops on two hosts stay inside each host's own
+    data dir (the isolation test extended to the at-family)."""
+    data = str(tmp_path / "shadow.data")
+    cfg = _cfg(data, method) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['fileat_check']}
+      start_time: 1s
+  bob:
+    network_node_id: 1
+    processes:
+    - path: {plugins['fileat_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    assert stats.ok
+    for host in ("alice", "bob"):
+        out = read_stdout(data, host, "fileat_check")
+        assert "done" in out, out
+        f = os.path.join(data, "hosts", host, "sub", "a.txt")
+        assert open(f).read() == "hello"
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_limits_prctl(plugins, tmp_path, method):
+    """prlimit64/getrlimit report DETERMINISTIC limits (never the real
+    machine's), set-then-get round-trips, and PR_SET_NAME/PDEATHSIG
+    are virtualized."""
+    data = str(tmp_path / "shadow.data")
+    cfg = _cfg(data, method) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['limits_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    assert stats.ok
+    out = read_stdout(data, "alice", "limits_check")
+    lines = out.splitlines()
+    assert lines[0] == "nofile 1024 1048576"
+    assert lines[1] == "setrlimit 0"
+    assert lines[2] == "nofile2 512 1048576"
+    assert lines[3] == "stack_soft 8388608"
+    assert lines[4] == "pdeathsig 15"
+    assert lines[5] == "name worker0"
+    assert lines[6] == "done"
