@@ -250,6 +250,11 @@ class ExperimentalOptions:
     # hybrid mode: which CPU policy drives host emulation while the
     # network model runs on device
     hybrid_cpu_policy: str = "serial"
+    # adaptive judge: rounds with fewer pending packets than this are
+    # judged synchronously on the CPU (one device dispatch costs
+    # ~1-2 ms over a tunneled TPU; a CPU judgment costs ~10 us/pkt,
+    # so small batches never pay for the trip). 0 = always device.
+    hybrid_judge_min_batch: int = 192
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -291,6 +296,7 @@ class ExperimentalOptions:
                               ("outbox_capacity", 1),
                               ("exchange_capacity", 0),
                               ("device_batch_rounds", 1),
+                              ("hybrid_judge_min_batch", 0),
                               ("preload_spin_max", 0)):
             if getattr(out, name) < minimum:
                 raise ValueError(

@@ -208,7 +208,8 @@ class Controller:
                 self.sim.topology,
                 self.sim.netmodel.host_vertex,
                 cfg.general.seed,
-                bootstrap_end=cfg.general.bootstrap_end_time)
+                bootstrap_end=cfg.general.bootstrap_end_time,
+                min_batch=cfg.experimental.hybrid_judge_min_batch)
             policy_name = cfg.experimental.hybrid_cpu_policy
         from shadow_tpu.core.manager import NetOptions
         self.manager = Manager(
@@ -274,9 +275,11 @@ class Controller:
         m.finalize()
         m.stats.end_time = stop
         if m.net_judge is not None:
+            j = m.net_judge
             log.info("hybrid perf: %d packets judged on device in %d "
-                     "batches (%.1f pkts/batch)", m.net_judge.packets,
-                     m.net_judge.batches,
-                     m.net_judge.packets / m.net_judge.batches
-                     if m.net_judge.batches else 0.0)
+                     "batches (%.1f pkts/batch); %d packets in %d "
+                     "sub-threshold rounds stayed on the CPU "
+                     "(min_batch=%d)", j.packets, j.batches,
+                     j.packets / j.batches if j.batches else 0.0,
+                     j.cpu_packets, j.cpu_batches, j.min_batch)
         return m.stats

@@ -43,7 +43,7 @@ class DeviceJudge:
     """Holds the topology matrices on device and a jitted batch-judge."""
 
     def __init__(self, topology, host_vertex: np.ndarray, seed: int,
-                 bootstrap_end: int = 0):
+                 bootstrap_end: int = 0, min_batch: int = 192):
         if (topology.latency_ns > np.iinfo(np.int64).max // 2).any():
             raise ValueError("latency overflow")
         self._hv = jnp.asarray(host_vertex.astype(np.int32))
@@ -61,9 +61,16 @@ class DeviceJudge:
             return ~dropped, now + lat[sv, dv]
 
         self._judge = jax.jit(_judge)
+        # adaptive crossover: rounds smaller than this are judged on
+        # the CPU (a device dispatch costs ~1-2 ms over a tunneled
+        # TPU; a CPU judgment ~10 us/pkt — the trip never pays below
+        # a couple hundred packets). The manager consults this.
+        self.min_batch = min_batch
         # rounds-trip counters for observability (perf-timer analogue)
         self.batches = 0
         self.packets = 0
+        self.cpu_batches = 0        # adaptive small-round fallbacks
+        self.cpu_packets = 0
 
     def judge_batch(self, now: np.ndarray, src: np.ndarray,
                     dst: np.ndarray, pkt_seq: np.ndarray

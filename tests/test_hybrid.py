@@ -212,10 +212,12 @@ def test_hybrid_managed_tcp_trace_equals_cpu(tcp_bins, tmp_path, loss):
         stats = c.run()
         assert stats.ok
         if policy == "tpu":
-            # fell back to hybrid: manager path, device judge live
+            # fell back to hybrid: manager path, judge live (small
+            # rounds may stay on the CPU side of the adaptive split)
             assert c.manager is not None
-            assert c.manager.net_judge is not None
-            assert c.manager.net_judge.packets > 0
+            j = c.manager.net_judge
+            assert j is not None
+            assert j.packets + j.cpu_packets > 0
         results[policy] = (
             [(h.name, h.trace_checksum, h.packets_sent,
               h.packets_dropped) for h in c.sim.hosts],
@@ -226,3 +228,36 @@ def test_hybrid_managed_tcp_trace_equals_cpu(tcp_bins, tmp_path, loss):
     assert results["serial"][1] == results["tpu"][1]
     # the transfer actually completed
     assert "sum" in results["tpu"][1]
+
+
+def test_adaptive_judge_trace_invariant():
+    """The adaptive CPU/device judge split (hybrid_judge_min_batch) is
+    a pure wall-clock decision: forcing every round to the device
+    (min_batch 0) and forcing every round to the CPU (min_batch 1e9)
+    both produce the serial oracle's exact trace, and the counters
+    prove each path actually ran."""
+    base = phold_cfg("hybrid", GML_LOSSY)
+    s_ser, t_ser, h_ser = run_cfg(phold_cfg("serial", GML_LOSSY))
+
+    cfg_dev = base.replace(
+        "  scheduler_policy: hybrid",
+        "  scheduler_policy: hybrid\n  hybrid_judge_min_batch: 0")
+    c = Controller(load_config_str(cfg_dev), trace=(t_dev := []))
+    c.run()
+    j = c.manager.net_judge
+    assert j.batches > 0 and j.cpu_batches == 0
+    assert t_dev == t_ser
+    assert [h.trace_checksum for h in c.sim.hosts] == \
+        [h.trace_checksum for h in h_ser]
+
+    cfg_cpu = base.replace(
+        "  scheduler_policy: hybrid",
+        "  scheduler_policy: hybrid\n"
+        "  hybrid_judge_min_batch: 1000000000")
+    c = Controller(load_config_str(cfg_cpu), trace=(t_cpu := []))
+    c.run()
+    j = c.manager.net_judge
+    assert j.cpu_batches > 0 and j.batches == 0
+    assert t_cpu == t_ser
+    assert [h.trace_checksum for h in c.sim.hosts] == \
+        [h.trace_checksum for h in h_ser]

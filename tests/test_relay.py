@@ -91,8 +91,10 @@ def test_relay_circuit_hybrid_matches_cpu_oracle(plugins, tmp_path):
         c, outs, chks = _run(policy, data, plugins)
         if policy == "tpu":
             assert c.manager is not None          # hybrid, not twin
-            assert c.manager.net_judge is not None
-            assert c.manager.net_judge.packets > 0
+            j = c.manager.net_judge
+            assert j is not None
+            # small rounds ride the CPU side of the adaptive split
+            assert j.packets + j.cpu_packets > 0
         results[policy] = (outs, chks)
 
     serial, tpu = results["serial"], results["tpu"]
