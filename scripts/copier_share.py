@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Copier-share measurement (host/memory.py's revisit threshold).
+
+Runs the 3-hop relay circuit — the most syscall/iovec-dense managed
+workload in the repo — with SHADOWTPU_COPY_TIMING=1 and reports what
+fraction of simulation wall time the ProcessMemory copier spent in
+process_vm_readv/writev. memory.py documents "revisit the zero-copy
+mapper if a profile shows the copier past ~10%": this script IS that
+profile, runnable any time.
+
+Usage: python scripts/copier_share.py
+Prints one JSON line: {"wall_s": W, "copy_ms": C, "copy_share": S,
+"copy_ops": N, "copy_bytes": B}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ["SHADOWTPU_COPY_TIMING"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests"))
+    from shadow_tpu.config import load_config_str
+    from shadow_tpu.core.controller import Controller
+    from test_relay import _circuit_cfg
+
+    tmp = tempfile.mkdtemp(prefix="copier_share_")
+    plug = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "plugins")
+    bins = {}
+    for name in ("tcp_server", "relay", "onion_client"):
+        exe = os.path.join(tmp, name)
+        subprocess.run(["cc", "-O1", "-o", exe,
+                        os.path.join(plug, f"{name}.c")], check=True,
+                       capture_output=True)
+        bins[name] = exe
+
+    data = os.path.join(tmp, "shadow.data")
+    cfg = load_config_str(_circuit_cfg("serial", data, bins))
+    c = Controller(cfg)
+    t0 = time.perf_counter()
+    stats = c.run()
+    wall = time.perf_counter() - t0
+    assert stats.ok
+
+    ops = by = ns = 0
+    for h in c.sim.hosts:
+        for app in h.apps:
+            stack = [app]
+            while stack:
+                p = stack.pop()
+                stack.extend(getattr(p, "children", {}).values())
+                mem = getattr(p, "mem", None)
+                if mem is not None:
+                    ops += mem.copy_ops
+                    by += mem.copy_bytes
+                    ns += mem.copy_ns
+    print(json.dumps({
+        "workload": "relay_circuit(3 hops, 60 KB, serial policy)",
+        "wall_s": round(wall, 3),
+        "copy_ms": round(ns / 1e6, 1),
+        "copy_share": round(ns / 1e9 / wall, 4),
+        "copy_ops": ops,
+        "copy_bytes": by,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,15 @@ class ProcessMemory:
         self._use_proc = False
         self._proc_r = None         # cached /proc/[pid]/mem handles
         self._proc_w = None
+        # copier-share telemetry: memory.py's "revisit the zero-copy
+        # mapper past ~10% of wall" threshold is MONITORED, not
+        # aspirational — the tracker heartbeat diffs these per
+        # interval, and SHADOWTPU_COPY_TIMING=1 adds wall-time
+        # accumulation (scripts/copier_share.py divides by run wall)
+        self.copy_ops = 0
+        self.copy_bytes = 0
+        self.copy_ns = 0
+        self._timed = bool(os.environ.get("SHADOWTPU_COPY_TIMING"))
 
     def _proc_read(self, addr: int, n: int) -> bytes:
         if self._proc_r is None:
@@ -82,6 +91,18 @@ class ProcessMemory:
     def read(self, addr: int, n: int) -> bytes:
         if n == 0:
             return b""
+        self.copy_ops += 1
+        self.copy_bytes += n
+        if self._timed:
+            import time
+            t0 = time.perf_counter_ns()
+            try:
+                return self._read_impl(addr, n)
+            finally:
+                self.copy_ns += time.perf_counter_ns() - t0
+        return self._read_impl(addr, n)
+
+    def _read_impl(self, addr: int, n: int) -> bytes:
         if self._use_proc:
             return self._proc_read(addr, n)
         buf = ctypes.create_string_buffer(n)
@@ -97,6 +118,18 @@ class ProcessMemory:
     def write(self, addr: int, data: bytes) -> int:
         if not data:
             return 0
+        self.copy_ops += 1
+        self.copy_bytes += len(data)
+        if self._timed:
+            import time
+            t0 = time.perf_counter_ns()
+            try:
+                return self._write_impl(addr, data)
+            finally:
+                self.copy_ns += time.perf_counter_ns() - t0
+        return self._write_impl(addr, data)
+
+    def _write_impl(self, addr: int, data: bytes) -> int:
         if self._use_proc:
             return self._proc_write(addr, data)
         buf = ctypes.create_string_buffer(data, len(data))

@@ -30,6 +30,8 @@ class Tracker:
     packets_dropped: int = 0
     bytes_sent: int = 0
     bytes_received: int = 0
+    copy_ops: int = 0           # ProcessMemory copier share (managed
+    copy_bytes: int = 0         # plugins only; model apps copy nothing)
     _last: dict = field(default_factory=dict)
     _socket_header_logged: bool = False
 
@@ -54,6 +56,18 @@ class Tracker:
         if host.net is not None:
             cur["bytes_sent"] = host.net.eth.bytes_sent
             cur["bytes_received"] = host.net.eth.bytes_received
+        ops = by = 0
+        for app in getattr(host, "apps", ()):
+            mem = getattr(app, "mem", None)
+            if mem is not None:
+                ops += mem.copy_ops
+                by += mem.copy_bytes
+            for child in getattr(app, "children", {}).values():
+                cmem = getattr(child, "mem", None)
+                if cmem is not None:
+                    ops += cmem.copy_ops
+                    by += cmem.copy_bytes
+        cur["copy_ops"], cur["copy_bytes"] = ops, by
         for k, v in cur.items():
             setattr(self, k, v - self._last.get(k, 0))
         self._last = cur
@@ -64,11 +78,12 @@ class Tracker:
             self._header_logged = True
             log.info("[shadow-heartbeat] [node-header] "
                      "time,name,events,packets-sent,packets-dropped,"
-                     "bytes-sent,bytes-received")
-        log.info("[shadow-heartbeat] [node] %d,%s,%d,%d,%d,%d,%d",
+                     "bytes-sent,bytes-received,copy-ops,copy-bytes")
+        log.info("[shadow-heartbeat] [node] %d,%s,%d,%d,%d,%d,%d,%d,%d",
                  now // simtime.SIMTIME_ONE_SECOND, self.host_name,
                  self.events, self.packets_sent, self.packets_dropped,
-                 self.bytes_sent, self.bytes_received)
+                 self.bytes_sent, self.bytes_received,
+                 self.copy_ops, self.copy_bytes)
         self.events = 0
         self._heartbeat_sockets(now, host)
 
