@@ -138,7 +138,20 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
                             raise ValueError(
                                 f"process executable not found: "
                                 f"{proc.path!r}")
-                        if cfg.experimental.interpose_method == "ptrace":
+                        from shadow_tpu.host.process import \
+                            elf_is_static
+                        use_ptrace = \
+                            cfg.experimental.interpose_method == \
+                            "ptrace"
+                        if not use_ptrace and elf_is_static(path):
+                            # LD_PRELOAD cannot enter a static binary;
+                            # the ptrace backend interposes it fully
+                            # (every syscall traps, vDSO patched)
+                            log.info("%s is statically linked: using "
+                                     "the ptrace backend (the preload "
+                                     "shim cannot load)", path)
+                            use_ptrace = True
+                        if use_ptrace:
                             from shadow_tpu.host.ptrace import (
                                 PtraceProcess,
                             )

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import shlex
+import struct
 import threading
 from typing import Optional
 
@@ -75,6 +76,39 @@ def _disable_aslr_inheritable() -> None:
     if cur != -1:
         libc.personality(cur | ADDR_NO_RANDOMIZE)
     _ASLR_OFF[0] = True
+
+
+def elf_is_static(path: str) -> bool:
+    """True when `path` is an ELF executable with no PT_INTERP — a
+    statically linked binary. LD_PRELOAD (the preload backend's whole
+    mechanism) is ignored by the kernel for these; the ptrace backend
+    interposes them fine (every syscall traps, vDSO patched)."""
+    try:
+        with open(path, "rb") as f:
+            hdr = f.read(64)
+            if len(hdr) < 52 or hdr[:4] != b"\x7fELF":
+                return False        # not ELF (scripts, etc.)
+            if hdr[4] == 2:         # ELFCLASS64
+                e_phoff, = struct.unpack_from("<Q", hdr, 0x20)
+                e_phentsize, = struct.unpack_from("<H", hdr, 0x36)
+                e_phnum, = struct.unpack_from("<H", hdr, 0x38)
+            elif hdr[4] == 1:
+                # ELFCLASS32: static i386 images ignore LD_PRELOAD
+                # just the same — detect them too
+                e_phoff, = struct.unpack_from("<I", hdr, 0x1C)
+                e_phentsize, = struct.unpack_from("<H", hdr, 0x2A)
+                e_phnum, = struct.unpack_from("<H", hdr, 0x2C)
+            else:
+                return False
+            f.seek(e_phoff)
+            phdrs = f.read(e_phentsize * e_phnum)
+        for i in range(e_phnum):
+            p_type, = struct.unpack_from("<I", phdrs, i * e_phentsize)
+            if p_type == 3:         # PT_INTERP
+                return False
+        return True
+    except (OSError, struct.error):
+        return False
 
 
 class ManagedRuntime:
@@ -169,6 +203,7 @@ class ManagedProcess:
     """One real executable on one simulated host (app-interface
     compatible with the model runtime: boot / on_stop hooks)."""
 
+    _bypass_warned = False      # one-time raw-syscall disclosure
     supports_threads = True        # preload backend handles clone
     supports_fork = True           # IPC fork handshake (spawn_fork)
     supports_signals = True        # IPC_SIGNAL handler injection
@@ -303,6 +338,19 @@ class ManagedProcess:
         hosts_file = os.path.join(self.runtime.data_dir, "etc_hosts")
         if os.path.exists(hosts_file):
             env["SHADOWTPU_HOSTS_FILE"] = os.path.abspath(hosts_file)
+
+        if env.get("SHADOWTPU_STRICT_TRAPS") != "1" \
+                and not ManagedProcess._bypass_warned:
+            # one-time disclosure (ADVICE r3 #3): outside strict-traps
+            # mode the startup-window syscalls stay untrapped, so RAW
+            # syscall users of exactly these bypass virtualization
+            ManagedProcess._bypass_warned = True
+            log.info(
+                "preload backend: raw-syscall users of clock_gettime/"
+                "gettimeofday/time/getpid/getrandom/open/openat bypass "
+                "virtualization (libc callers are interposed); set "
+                "SHADOWTPU_STRICT_TRAPS=1 in the process environment "
+                "for raw-syscall-heavy binaries that never execve")
 
         # determinism: disable ASLR in the child (main.c:287,
         # disable_aslr.c). Like the reference, set ADDR_NO_RANDOMIZE on

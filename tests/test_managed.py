@@ -337,3 +337,48 @@ def test_strict_traps_mode(plugins, tmp_path):
     assert lines[0] == "t0 1.000000000"
     assert lines[1] == "t1 1.100000000"
     assert stats.ok
+
+
+@pytest.fixture(scope="session")
+def static_plugin(tmp_path_factory):
+    """timecheck compiled -static: no PT_INTERP, LD_PRELOAD inert."""
+    out = tmp_path_factory.mktemp("static")
+    exe = out / "timecheck_static"
+    try:
+        subprocess.run(
+            ["cc", "-static", "-O1", "-o", str(exe),
+             os.path.join(PLUGIN_DIR, "timecheck.c")],
+            check=True, capture_output=True)
+    except subprocess.CalledProcessError as e:
+        pytest.skip(f"no static libc on this machine: "
+                    f"{e.stderr.decode(errors='replace')[:200]}")
+    return str(exe)
+
+
+@pytest.mark.parametrize("method", ["preload", "ptrace"])
+def test_static_binary_interposition(static_plugin, tmp_path, method):
+    """A statically linked binary runs under BOTH configured backends
+    with fully virtualized clocks: under ptrace directly (every
+    syscall traps, vDSO patched), and under preload via the automatic
+    static-ELF fallback to ptrace (LD_PRELOAD cannot enter a static
+    image — ref shim.c:393-506's dynamic-only injection)."""
+    from shadow_tpu.host.process import elf_is_static
+    assert elf_is_static(static_plugin)
+    data = str(tmp_path / "shadow.data")
+    cfg = base_cfg(data).replace(
+        "hosts:\n",
+        f"experimental:\n  interpose_method: {method}\nhosts:\n") + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {static_plugin}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    assert stats.ok
+    out = read_stdout(data, "alice", "timecheck_static")
+    lines = out.splitlines()
+    assert lines[0] == "t0 1.000000000"
+    assert lines[1] == "t1 1.100000000"
+    assert lines[3] == "host alice"
+    assert lines[4].startswith("pid 10")    # virtual pid space
