@@ -245,6 +245,17 @@ class ExperimentalOptions:
     outbox_capacity: int = 32       # device packet sends per host per round
     exchange: str = "all_to_all"    # all_to_all | all_gather
     exchange_capacity: int = 0      # per shard-pair rows; 0 = auto-size
+    # per-host arrivals accepted per flush (the merge-sort width is
+    # event_capacity + this, so it is a first-order term of flush
+    # cost); 0 = event_capacity. Too small fails LOUDLY via the
+    # overflow counter — size it to the worst per-window fan-in
+    # (e.g. every client of one server requesting in the same window)
+    exchange_in_capacity: int = 0
+    # per-host outbox rows surviving to the flush's global sort (the
+    # outbox is mostly empty; compaction shrinks the flat sort from
+    # H*outbox to H*this). 0 = off; too small fails loudly
+    # (x_overflow). Size to the busiest host's sends+timers per phase.
+    outbox_compact: int = 0
     mesh_axis: str = "hosts"
     device_batch_rounds: int = 64   # rounds fused into one device while_loop
     # hybrid mode: which CPU policy drives host emulation while the
@@ -295,6 +306,8 @@ class ExperimentalOptions:
         for name, minimum in (("event_capacity", 1),
                               ("outbox_capacity", 1),
                               ("exchange_capacity", 0),
+                              ("exchange_in_capacity", 0),
+                              ("outbox_compact", 0),
                               ("device_batch_rounds", 1),
                               ("hybrid_judge_min_batch", 0),
                               ("preload_spin_max", 0)):

@@ -81,6 +81,14 @@ class DeviceApp:
     max_timers: int = 0
     max_draws: int = 1
     max_train: int = 1
+    # burst de-skew (engine._step): > 1 declares that hosts selected
+    # by burst_mask are STATELESS responders whose consecutive
+    # KIND_PACKET events may be popped and answered P at a time, one
+    # send lane per popped event. Contract: handling order within the
+    # run must not feed back into the run (no state writes, no timers,
+    # no draws from burst columns), so the burst is bit-identical to
+    # P serial pops.
+    burst_pops: int = 1
 
     def init_state(self, n_hosts: int) -> jnp.ndarray:
         return jnp.zeros((n_hosts, self.n_state_words), jnp.int32)
@@ -88,6 +96,18 @@ class DeviceApp:
     def handle(self, gid, now, kind, src, size, d0, d1, d2, app_state,
                draws
                ) -> AppOut:
+        raise NotImplementedError
+
+    def burst_mask(self, app_state) -> jnp.ndarray:
+        """[H] bool: hosts whose packet handling is stateless (may be
+        burst-popped). Only consulted when burst_pops > 1."""
+        raise NotImplementedError
+
+    def handle_burst(self, gid, nowP, kindP, srcP, sizeP, d0P, d1P,
+                     d2P, app_state, draws) -> AppOut:
+        """All event args are [H, burst_pops] columns (inactive
+        columns carry kind == -1); returns send lanes [H, burst_pops]
+        (lane j answers column j)."""
         raise NotImplementedError
 
 
@@ -187,6 +207,9 @@ class TgenDevice(DeviceApp):
         self.max_train = self.chunk
         self.max_timers = 1
         self.max_draws = 1              # no randomness consumed
+        # servers are stateless responders: a hub answering its whole
+        # REQ backlog 8 per iteration instead of 1 (burst de-skew)
+        self.burst_pops = 8
         # `size` shapes the SERVER's response and must stay uniform;
         # count/pause/retry are client-local and may vary per host
         self._set_client_args(self.count, self.pause_ns,
@@ -282,15 +305,8 @@ class TgenDevice(DeviceApp):
         st = st.at[:, 6].set(new_mask)
 
         # ---- sends (K == 1: one REQ row or one DATA train row) ----
-        # server answer: the whole chunk [d1, d1+cnt) as one train of
-        # cnt packets totalling nbytes (MSS each, last-packet
-        # remainder when the chunk reaches the end of the file)
-        srv_cnt = jnp.clip(self.npkts - d1, 0, self.chunk)
+        srv_cnt, srv_bytes = self._server_response(d1)
         srv_valid = is_req & (srv_cnt > 0)
-        ends_file = d1 + srv_cnt >= self.npkts
-        srv_bytes = jnp.where(
-            ends_file, (srv_cnt - 1) * self.MSS + self.last_sz,
-            srv_cnt * self.MSS)
 
         sv = is_server
         send_valid = jnp.where(sv, srv_valid, send_req)[:, None]
@@ -321,6 +337,53 @@ class TgenDevice(DeviceApp):
             n_draws=jnp.zeros((H,), jnp.int32),
             app_state=st,
             send_count=send_count,
+        )
+
+    def _server_response(self, d1):
+        """The stateless server answer to a REQ for chunk start d1:
+        (train packet count, total bytes) — the whole chunk
+        [d1, d1+cnt) as one train (MSS each, last-packet remainder
+        when the chunk reaches the end of the file). The SINGLE
+        source of truth for both the serial and the burst path — the
+        burst path's bit-identity depends on them never diverging."""
+        srv_cnt = jnp.clip(self.npkts - d1, 0, self.chunk)
+        ends_file = d1 + srv_cnt >= self.npkts
+        srv_bytes = jnp.where(
+            ends_file, (srv_cnt - 1) * self.MSS + self.last_sz,
+            srv_cnt * self.MSS)
+        return srv_cnt, srv_bytes
+
+    def burst_mask(self, app_state) -> jnp.ndarray:
+        return app_state[:, 0] == 0         # servers: stateless
+
+    def handle_burst(self, gid, nowP, kindP, srcP, sizeP, d0P, d1P,
+                     d2P, app_state, draws) -> AppOut:
+        """Column 0 runs the FULL role logic (client window progress,
+        timers, state — identical to the non-burst path); columns 1+
+        can only ever be burst-popped server REQ packets, answered by
+        the same stateless response computation, one lane each."""
+        base = self.handle(gid, nowP[:, 0], kindP[:, 0], srcP[:, 0],
+                           sizeP[:, 0], d0P[:, 0], d1P[:, 0],
+                           d2P[:, 0], app_state, draws)
+        is_server = (app_state[:, 0] == 0)[:, None]
+        is_req = is_server & (kindP == KIND_PACKET) & \
+            (d0P == self.TAG_REQ)
+        srv_cnt, srv_bytes = self._server_response(d1P)
+        valid = is_req & (srv_cnt > 0)
+        srv_bytes = srv_bytes.astype(jnp.int32)
+
+        def lanes(l0, rest):
+            return jnp.concatenate([l0, rest[:, 1:]], axis=1)
+
+        tag = jnp.full_like(d1P, self.TAG_DATA)
+        return base._replace(
+            send_dst=lanes(base.send_dst, srcP.astype(jnp.int32)),
+            send_size=lanes(base.send_size, srv_bytes),
+            send_d0=lanes(base.send_d0, tag.astype(jnp.int32)),
+            send_d1=lanes(base.send_d1, d1P.astype(jnp.int32)),
+            send_valid=lanes(base.send_valid, valid),
+            send_count=lanes(base.send_count,
+                             srv_cnt.astype(jnp.int32)),
         )
 
 

@@ -97,6 +97,16 @@ class EngineConfig:
     # outbox volume with 4x headroom for skewed traffic. Overflow is
     # counted per source host and fails the run, never silently lost.
     exchange_capacity: int = 0
+    # per-host arrivals accepted per flush (merge width = E + this);
+    # 0 = event_capacity. Overflow is counted and fails the run.
+    exchange_in_capacity: int = 0
+    # per-host outbox rows that survive to the flush's flat sort:
+    # the outbox is mostly empty (each of B iterations reserves its
+    # own column block), so compacting each host's row to its first
+    # `outbox_compact` valid entries before the GLOBAL sort shrinks
+    # the sort from H*OB to H*compact rows. 0 = off. Too small is
+    # LOUD (x_overflow, attributed to the sending host).
+    outbox_compact: int = 0
     # bandwidth + CoDel for raw sends (host/model_nic.py's fluid NIC):
     # TX serialization at send, RX serialization + event-driven CoDel
     # at delivery via a KIND_PACKET -> KIND_PACKET_READY two-stage pop
@@ -283,10 +293,26 @@ class DeviceEngine:
         C = max(1, getattr(app, "max_train", 1))
         CP = bool(cfg.count_paths)
         V = self.n_vertices
-        M_out = K + T + (1 if MB else 0)
+        # burst de-skew: an app may declare that its STATELESS
+        # responder hosts (app.burst_mask) can pop up to P consecutive
+        # in-window KIND_PACKET events per iteration, each answered on
+        # its own send lane — a busy hub no longer holds every lane
+        # hostage for N serial iterations (BASELINE round-3 diagnosis)
+        P = max(1, getattr(app, "burst_pops", 1))
+        if P > 1:
+            if K != 1:
+                raise ValueError("burst_pops requires max_sends == 1")
+            if MB:
+                raise ValueError("burst_pops with model_bandwidth is "
+                                 "not supported (sequential NIC state)")
+        K_eff = P if P > 1 else K
+        M_out = K_eff + T + (1 if MB else 0)
         B = max(1, cfg.outbox_capacity // M_out)
         OB = B * M_out
-        IN = E                        # per-flush arrivals per host
+        # per-flush arrivals per host: the merge width is E + IN (x2
+        # on the multi-shard bypass path), so a tight IN is a
+        # first-order flush win; too small is LOUD (overflow counter)
+        IN = cfg.exchange_in_capacity or E
         SPAN = np.int64(H_pad) * OB   # okey < SPAN
         if cfg.exchange == "all_to_all" and n_shards > 1:
             R = H_loc * OB
@@ -330,14 +356,32 @@ class DeviceEngine:
             return jnp.where(head < E, v, fill)
 
         # ---------------- inner loop body: one event per host ----------
+        # (up to P events for an app's declared burst hosts)
         def _step(carry, win_end, gid, host_vertex, lat, rel):
             state, ob, blk, dirty = carry
             head = state["head"]
-            pt = _take_head(state["ht"], head, INF)
-            pk2 = _take_head(state["hk"], head, IMAX)
-            pm = _take_head(state["hm"], head, jnp.int64(0))
-            pv = _take_head(state["hv"], head, jnp.int64(0))
-            pw = _take_head(state["hw"], head, jnp.int64(0))
+            if P > 1:
+                offs = jnp.arange(P, dtype=head.dtype)
+                idxs = head[:, None] + offs
+
+                def _take_heads(arr, fill):
+                    v = jnp.take_along_axis(
+                        arr, jnp.minimum(idxs, E - 1), axis=1)
+                    return jnp.where(idxs < E, v, fill)
+
+                ptP = _take_heads(state["ht"], INF)
+                pk2P = _take_heads(state["hk"], IMAX)
+                pmP = _take_heads(state["hm"], jnp.int64(0))
+                pvP = _take_heads(state["hv"], jnp.int64(0))
+                pwP = _take_heads(state["hw"], jnp.int64(0))
+                pt, pk2 = ptP[:, 0], pk2P[:, 0]
+                pm, pv, pw = pmP[:, 0], pvP[:, 0], pwP[:, 0]
+            else:
+                pt = _take_head(state["ht"], head, INF)
+                pk2 = _take_head(state["hk"], head, IMAX)
+                pm = _take_head(state["hm"], head, jnp.int64(0))
+                pv = _take_head(state["hv"], head, jnp.int64(0))
+                pw = _take_head(state["hw"], head, jnp.int64(0))
             psrc, pseq = hi32(pk2), lo32(pk2)
             pkind, psize = hi32(pm), lo32(pm)
             pd0, pd1 = hi32(pv), lo32(pv)
@@ -347,28 +391,71 @@ class DeviceEngine:
             # outbox (dirty) must stall until the flush lands it, or
             # it would pop later events first (order violation)
             runnable = (pt < win_end) & ~dirty
-            state["head"] = head + runnable
+            if P > 1:
+                # burst hosts pop their RUN of consecutive in-window
+                # packet events (the stateless-responder contract:
+                # handling order within the run cannot feed back into
+                # the run); everyone else pops one event as usual
+                bm = app.burst_mask(state["app"])
+                kindP = hi32(pmP)
+                eligP = (ptP < win_end) & (kindP == KIND_PACKET)
+                run = jnp.cumprod(eligP.astype(jnp.int32), axis=1)
+                popcnt = jnp.where(
+                    runnable,
+                    jnp.where(bm & eligP[:, 0], run.sum(-1), 1),
+                    0).astype(head.dtype)
+                activeP = offs[None, :] < popcnt[:, None]   # [H,P]
+            else:
+                popcnt = runnable.astype(head.dtype)
+            state["head"] = head + popcnt
 
-            state["n_exec"] = state["n_exec"] + runnable
+            state["n_exec"] = state["n_exec"] + \
+                popcnt.astype(jnp.int32)
             # with the model NIC, a packet pops twice: the RX stage
             # (KIND_PACKET: bandwidth+CoDel, no app) and the delivery
             # (KIND_PACKET_READY). Deliveries are the READY pops then.
             is_rx = runnable & (pkind == KIND_PACKET) if MB else \
                 jnp.zeros_like(runnable)
-            is_pkt = runnable & (pkind == (KIND_PACKET_READY if MB
-                                           else KIND_PACKET))
-            # delivered PACKETS: a train row carries popcount(d2)
-            # survivors (ordinary packets carry d2 == 1)
-            state["n_deliv"] = state["n_deliv"] + jnp.where(
-                is_pkt,
-                lax.population_count(pd2.astype(jnp.uint32))
-                .astype(jnp.int32), 0)
-            mix = (pt ^ (psrc.astype(jnp.int64) * CHK_SRC)
-                   ^ (pkind.astype(jnp.int64) * CHK_KIND)
-                   ^ (pseq.astype(jnp.int64) * CHK_SEQ)) & MASK63
-            state["chk"] = jnp.where(
-                runnable, (state["chk"] * CHK_MUL + mix) & MASK63,
-                state["chk"])
+            if P > 1:
+                # delivered PACKETS: popcount(d2) survivors per popped
+                # packet row, summed over the burst
+                is_pktP = activeP & (kindP == KIND_PACKET)
+                state["n_deliv"] = state["n_deliv"] + jnp.where(
+                    is_pktP,
+                    lax.population_count(lo32(pwP).astype(jnp.uint32))
+                    .astype(jnp.int32), 0).sum(-1, dtype=jnp.int32)
+                # the trace checksum folds each popped event exactly
+                # as the serial oracle does — stepwise (the inter-step
+                # MASK63 truncation makes a closed-form fold wrong)
+                chk = state["chk"]
+                srcPa, seqPa = hi32(pk2P), lo32(pk2P)
+                for j in range(P):
+                    mix_j = (ptP[:, j]
+                             ^ (srcPa[:, j].astype(jnp.int64)
+                                * CHK_SRC)
+                             ^ (kindP[:, j].astype(jnp.int64)
+                                * CHK_KIND)
+                             ^ (seqPa[:, j].astype(jnp.int64)
+                                * CHK_SEQ)) & MASK63
+                    chk = jnp.where(activeP[:, j],
+                                    (chk * CHK_MUL + mix_j) & MASK63,
+                                    chk)
+                state["chk"] = chk
+            else:
+                is_pkt = runnable & (pkind == (KIND_PACKET_READY if MB
+                                               else KIND_PACKET))
+                # delivered PACKETS: a train row carries popcount(d2)
+                # survivors (ordinary packets carry d2 == 1)
+                state["n_deliv"] = state["n_deliv"] + jnp.where(
+                    is_pkt,
+                    lax.population_count(pd2.astype(jnp.uint32))
+                    .astype(jnp.int32), 0)
+                mix = (pt ^ (psrc.astype(jnp.int64) * CHK_SRC)
+                       ^ (pkind.astype(jnp.int64) * CHK_KIND)
+                       ^ (pseq.astype(jnp.int64) * CHK_SEQ)) & MASK63
+                state["chk"] = jnp.where(
+                    runnable, (state["chk"] * CHK_MUL + mix) & MASK63,
+                    state["chk"])
 
             # app dispatch (batched); masked hosts see kind=-1. Under
             # the model NIC the RX stage is engine-internal (app sees
@@ -377,29 +464,47 @@ class DeviceEngine:
                 jnp.arange(D, dtype=jnp.int32)
             draws = prng.random_bits32(prng.chain_key(
                 seed_pair, PURPOSE_APP, gid[:, None], draw_seqs))
-            if MB:
-                app_kind = jnp.where(pkind == KIND_PACKET_READY,
-                                     jnp.int32(KIND_PACKET), pkind)
-                app_kind = jnp.where(runnable & ~is_rx, app_kind, -1)
+            if P > 1:
+                # burst dispatch: the app sees all P popped columns
+                # (inactive ones as kind=-1) and answers each on its
+                # own send lane
+                kindP_app = jnp.where(activeP, kindP, -1)
+                out = app.handle_burst(
+                    gid, ptP, kindP_app, srcPa, lo32(pmP),
+                    hi32(pvP), lo32(pvP), lo32(pwP), state["app"],
+                    draws)
+                app_on = runnable
             else:
-                app_kind = jnp.where(runnable, pkind, -1)
-            out = app.handle(gid, pt, app_kind,
-                             psrc, psize, pd0, pd1, pd2, state["app"],
-                             draws)
-            app_on = runnable & ~is_rx if MB else runnable
+                if MB:
+                    app_kind = jnp.where(pkind == KIND_PACKET_READY,
+                                         jnp.int32(KIND_PACKET), pkind)
+                    app_kind = jnp.where(runnable & ~is_rx, app_kind,
+                                         -1)
+                else:
+                    app_kind = jnp.where(runnable, pkind, -1)
+                out = app.handle(gid, pt, app_kind,
+                                 psrc, psize, pd0, pd1, pd2,
+                                 state["app"], draws)
+                app_on = runnable & ~is_rx if MB else runnable
             # apps may return [H,1] columns that broadcast over K/T
             out = out._replace(
-                send_dst=jnp.broadcast_to(out.send_dst, (H_loc, K)),
-                send_size=jnp.broadcast_to(out.send_size, (H_loc, K)),
-                send_d0=jnp.broadcast_to(out.send_d0, (H_loc, K)),
-                send_d1=jnp.broadcast_to(out.send_d1, (H_loc, K)),
-                send_valid=jnp.broadcast_to(out.send_valid, (H_loc, K)),
+                send_dst=jnp.broadcast_to(out.send_dst,
+                                          (H_loc, K_eff)),
+                send_size=jnp.broadcast_to(out.send_size,
+                                           (H_loc, K_eff)),
+                send_d0=jnp.broadcast_to(out.send_d0, (H_loc, K_eff)),
+                send_d1=jnp.broadcast_to(out.send_d1, (H_loc, K_eff)),
+                send_valid=jnp.broadcast_to(out.send_valid,
+                                            (H_loc, K_eff)),
                 timer_delay=jnp.broadcast_to(out.timer_delay,
                                              (H_loc, T)),
                 timer_d0=jnp.broadcast_to(out.timer_d0, (H_loc, T)),
                 timer_valid=jnp.broadcast_to(out.timer_valid,
                                              (H_loc, T)),
             )
+            # send lane j of a burst departs at ITS popped event's
+            # time (bit-identical bootstrap gating + delivery times)
+            lane_t = ptP if P > 1 else pt[:, None]
             state["app"] = jnp.where(app_on[:, None], out.app_state,
                                      state["app"])
             state["app_seq"] = state["app_seq"] + \
@@ -410,16 +515,16 @@ class DeviceEngine:
             vrank = jnp.cumsum(send_valid, axis=-1) - send_valid
             if C > 1:
                 counts = jnp.clip(
-                    jnp.broadcast_to(out.send_count, (H_loc, K))
+                    jnp.broadcast_to(out.send_count, (H_loc, K_eff))
                     if out.send_count is not None
-                    else jnp.ones((H_loc, K), jnp.int32), 1, C)
+                    else jnp.ones((H_loc, K_eff), jnp.int32), 1, C)
                 vcnt = counts * send_valid
                 ccum = jnp.cumsum(vcnt, axis=-1) - vcnt
                 pkt_seq = state["packet_seq"][:, None] + ccum
                 state["packet_seq"] = state["packet_seq"] + \
                     vcnt.sum(-1).astype(jnp.int32)
             else:
-                counts = jnp.ones((H_loc, K), jnp.int32)
+                counts = jnp.ones((H_loc, K_eff), jnp.int32)
                 vcnt = send_valid.astype(jnp.int32)
                 pkt_seq = state["packet_seq"][:, None] + vrank
                 state["packet_seq"] = state["packet_seq"] + \
@@ -438,7 +543,7 @@ class DeviceEngine:
                 js = jnp.arange(C, dtype=jnp.int32)              # [C]
                 seqs3 = pkt_seq[..., None] + js                  # [H,K,C]
                 drop3 = packet_drop_mask(
-                    seed_pair, BOOT_END, pt[:, None, None],
+                    seed_pair, BOOT_END, lane_t[..., None],
                     gid[:, None, None], seqs3, relv[..., None])
                 win3 = js[None, None, :] < counts[..., None]
                 lost3 = drop3 & win3 & send_valid[..., None]
@@ -452,7 +557,7 @@ class DeviceEngine:
                 n_lost = lost3.sum((-2, -1)).astype(jnp.int32)
             else:
                 dropped = send_valid & packet_drop_mask(
-                    seed_pair, BOOT_END, pt[:, None], gid[:, None],
+                    seed_pair, BOOT_END, lane_t, gid[:, None],
                     pkt_seq, relv)
                 surv = jnp.where(send_valid & ~dropped,
                                  jnp.uint32(1), jnp.uint32(0))
@@ -473,7 +578,7 @@ class DeviceEngine:
                 state["tx_free"] = jnp.where(
                     runnable, tx_base + cum[:, -1], state["tx_free"])
             else:
-                depart = pt[:, None]
+                depart = lane_t
             delivered = send_valid & ~dropped
             state["n_sent"] = state["n_sent"] + \
                 vcnt.sum(-1).astype(jnp.int32)
@@ -568,7 +673,7 @@ class DeviceEngine:
                 return jnp.concatenate(
                     parts[:2 + (1 if MB else 0)], axis=1)
 
-            gcol = jnp.broadcast_to(gid[:, None], (H_loc, K))
+            gcol = jnp.broadcast_to(gid[:, None], (H_loc, K_eff))
             gcolT = jnp.broadcast_to(gid[:, None], (H_loc, T))
             if CP:
                 # drop-rolled sends ride along under the reserved
@@ -590,7 +695,7 @@ class DeviceEngine:
             # packet-kind rows carry their train count in bits 8+ of
             # the kind field (histogram weight; kind itself is <256)
             bkind = cols(
-                jnp.full((H_loc, K), KIND_PACKET, jnp.int32)
+                jnp.full((H_loc, K_eff), KIND_PACKET, jnp.int32)
                 | (counts << 8),
                 jnp.full((H_loc, T), KIND_TIMER, jnp.int32),
                 jnp.full((H_loc, 1), KIND_PACKET_READY, jnp.int32))
@@ -630,20 +735,43 @@ class DeviceEngine:
         # flush sorts ONLY (key, iota) and recovers payload rows later
         # with gathers — the profiler showed the old 6-operand flat
         # sort + 5-operand merge dominating round cost (~85%).
-        def _flat_sorted(ob, gid):
+        CX = min(cfg.outbox_compact or OB, OB)
+
+        def _flat_sorted(state, ob, gid):
             slot = jnp.arange(OB, dtype=jnp.int64)[None, :]
-            okey = gid.astype(jnp.int64)[:, None] * OB + slot
-            F = H_loc * OB
-            flat = {f: ob[f].reshape(F) for f in XF}
-            fdst = hi32(flat["m"]).astype(jnp.int64)
+            okey2 = gid.astype(jnp.int64)[:, None] * OB + slot
+            fdst2 = hi32(ob["m"]).astype(jnp.int64)
             # DROP_T rows exist only for the path histogram — they are
             # never exchanged or delivered
-            valid = flat["t"] < DROP_T
-            skey = jnp.where(valid, fdst * SPAN + okey.reshape(F),
-                             IMAX)
+            valid2 = ob["t"] < DROP_T
+            skey2 = jnp.where(valid2, fdst2 * SPAN + okey2, IMAX)
+            if CX < OB:
+                # two-level flush: each host's row compacts to its
+                # first CX valid entries (a width-OB row sort — far
+                # cheaper than pushing the ~98%-empty outbox through
+                # the global sort), then the flat sort runs over
+                # H*CX rows. Keys are unchanged, so the final order
+                # is bit-identical whenever nothing overflows; the
+                # loss is counted against the SENDING host.
+                cols = jnp.broadcast_to(
+                    slot, (H_loc, OB)).astype(jnp.int64)
+                ssk, scol = lax.sort((skey2, cols), dimension=1,
+                                     num_keys=1)
+                state["x_overflow"] = state["x_overflow"] + \
+                    (ssk[:, CX:] < IMAX).sum(-1).astype(jnp.int32)
+                keep_col = scol[:, :CX].astype(jnp.int32)
+                F = H_loc * CX
+                flat = {f: jnp.take_along_axis(ob[f], keep_col,
+                                               axis=1).reshape(F)
+                        for f in XF}
+                skey = ssk[:, :CX].reshape(F)
+            else:
+                F = H_loc * OB
+                flat = {f: ob[f].reshape(F) for f in XF}
+                skey = skey2.reshape(F)
             skey_s, perm = lax.sort(
                 (skey, jnp.arange(F, dtype=jnp.int64)), num_keys=1)
-            return skey_s, perm, flat
+            return state, skey_s, perm, flat
 
         def _count_paths(state, ob, host_vertex):
             """topology_incrementPathPacketCounter parity: a [V,V]
@@ -707,8 +835,8 @@ class DeviceEngine:
         def _exchange(state, ob, gid, my_shard, host_vertex):
             if CP:
                 state = _count_paths(state, ob, host_vertex)
-            skey, perm, rows = _flat_sorted(ob, gid)
-            G = H_loc * OB
+            state, skey, perm, rows = _flat_sorted(state, ob, gid)
+            G = H_loc * CX
 
             inc2 = None
             if n_shards > 1 and cfg.exchange == "all_to_all":

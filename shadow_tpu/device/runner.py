@@ -166,11 +166,17 @@ class DeviceRunner:
         # event per phase, paying one collective exchange per event.
         # Give bursty apps 8 bursts of room unless the config asks for
         # more.
+        burst = max(1, getattr(self.app, "burst_pops", 1))
+        per_iter = self.app.max_sends * burst + self.app.max_timers
+        # floor the outbox at 8 iterations per phase — 4 when bursts
+        # drain backlogs P events at a time
         outbox = max(cfg.experimental.outbox_capacity,
-                     8 * self.app.max_sends)
+                     (4 if burst > 1 else 8) * per_iter)
         if outbox != cfg.experimental.outbox_capacity:
-            log.info("outbox_capacity raised %d -> %d (8x app burst)",
-                     cfg.experimental.outbox_capacity, outbox)
+            log.info("outbox_capacity raised %d -> %d (8 iterations "
+                     "of %d lanes)",
+                     cfg.experimental.outbox_capacity, outbox,
+                     per_iter)
         self.engine = DeviceEngine(
             EngineConfig(
                 n_hosts=len(sim.hosts),
@@ -182,6 +188,9 @@ class DeviceRunner:
                 seed=cfg.general.seed,
                 exchange=cfg.experimental.exchange,
                 exchange_capacity=cfg.experimental.exchange_capacity,
+                exchange_in_capacity=cfg.experimental
+                .exchange_in_capacity,
+                outbox_compact=cfg.experimental.outbox_compact,
                 model_bandwidth=cfg.experimental.model_bandwidth,
                 count_paths=cfg.experimental.count_paths,
             ),

@@ -146,3 +146,30 @@ def test_tgen_heterogeneous_size_still_refused():
         "size=200KiB count=1", "size=100KiB count=1")
     with pytest.raises(ValueError, match="size.*must match"):
         Controller(load_config_str(yaml))
+
+
+def test_outbox_compact_trace_invariant_and_loud_overflow():
+    """outbox_compact is a pure flush-cost knob: with compaction
+    forced on (width ample) the device trace is bit-identical to the
+    uncompacted run; with a width below the busiest host's emissions
+    the run fails LOUDLY via x_overflow instead of losing rows."""
+    def run_compact(cx):
+        yaml = TGEN_YAML.format(
+            policy="tpu", seed=3, loss=0.02, clients=6,
+            size="100KiB", count=2, stop="8s", extra="retry=500ms")
+        yaml = yaml.replace(
+            "  outbox_capacity: 256",
+            f"  outbox_capacity: 256\n  outbox_compact: {cx}")
+        c = Controller(load_config_str(yaml))
+        stats = c.run()
+        return stats, [(h.name, h.trace_checksum, h.packets_sent)
+                       for h in c.sim.hosts]
+
+    s_base, sig_base = run_compact(0)       # compaction off
+    assert s_base.ok
+    s_on, sig_on = run_compact(64)          # on, ample width
+    assert s_on.ok
+    assert sig_on == sig_base
+
+    s_tiny, _ = run_compact(1)              # far below the server's
+    assert not s_tiny.ok                    # per-phase emissions
