@@ -1,0 +1,30 @@
+/* Raw-syscall determinism probe: issues the startup-window syscalls
+ * via syscall(2) directly — no libc wrappers, no vDSO — the way
+ * static/musl/Go runtimes do. Outside strict-traps mode these bypass
+ * virtualization (documented); under SHADOWTPU_STRICT_TRAPS=1 (or the
+ * ptrace backend) they MUST trap and report simulated values. */
+#define _GNU_SOURCE
+#include <stdio.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(void) {
+  struct timespec ts;
+  long r = syscall(SYS_clock_gettime, CLOCK_MONOTONIC, &ts);
+  printf("raw_clock %ld %ld.%09ld\n", r, (long)ts.tv_sec, ts.tv_nsec);
+
+  long t = syscall(SYS_time, 0);
+  printf("raw_time %ld\n", t);
+
+  long pid = syscall(SYS_getpid);
+  printf("raw_pid %ld\n", pid);
+
+  unsigned char buf[8] = {0};
+  long n = syscall(SYS_getrandom, buf, sizeof buf, 0);
+  printf("raw_rand %ld ", n);
+  for (int i = 0; i < 8; i++) printf("%02x", buf[i]);
+  printf("\n");
+  printf("done\n");
+  return 0;
+}

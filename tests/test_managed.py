@@ -382,3 +382,42 @@ def test_static_binary_interposition(static_plugin, tmp_path, method):
     assert lines[1] == "t1 1.100000000"
     assert lines[3] == "host alice"
     assert lines[4].startswith("pid 10")    # virtual pid space
+
+
+@pytest.mark.parametrize("mode", ["strict_preload", "ptrace"])
+def test_raw_syscalls_virtualized(plugins, tmp_path, mode):
+    """Raw syscall(2) users of the startup-window set (the static/
+    musl/Go pattern) are fully virtualized under strict-traps preload
+    AND under ptrace: simulated clocks, virtual pid, deterministic
+    randomness — bit-identical across runs."""
+    outs = []
+    for run in range(2):
+        data = str(tmp_path / f"{mode}{run}" / "shadow.data")
+        cfg = base_cfg(data)
+        if mode == "ptrace":
+            cfg = cfg.replace(
+                "hosts:\n",
+                "experimental:\n  interpose_method: ptrace\nhosts:\n")
+            env = ""
+        else:
+            env = "\n      environment: SHADOWTPU_STRICT_TRAPS=1"
+        cfg += f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['rawsys_check']}{env}
+      start_time: 1s
+"""
+        stats, _ = run_sim(cfg, tmp_path / f"{mode}{run}")
+        assert stats.ok
+        out = read_stdout(data, "alice", "rawsys_check")
+        lines = out.splitlines()
+        assert lines[0] == "raw_clock 0 1.000000000", out
+        # raw time(2) reads the simulated wall clock (epoch offset)
+        assert lines[1].startswith("raw_time ")
+        assert int(lines[1].split()[1]) < 1_700_000_000
+        assert int(lines[2].split()[1]) >= 1000     # virtual pid
+        assert lines[3].startswith("raw_rand 8 ")
+        assert lines[4] == "done"
+        outs.append(out)
+    assert outs[0] == outs[1]
