@@ -168,6 +168,9 @@ def _decode_wstatus(status: int) -> int:
     return -1
 
 
+PATH_ARG = object()     # _inject_syscall: substitute the scratch path
+
+
 class _TraceeExited(Exception):
     """A specific tracee (thread or whole process) died."""
 
@@ -538,6 +541,34 @@ class _Tracer(threading.Thread):
         self._setregs(tid, regs)
         self._run_to_exit(tid)
 
+    def _inject_syscall(self, tid: int, nr: int, args,
+                        path: Optional[bytes] = None) -> int:
+        """Execute an EXTRA syscall in the tracee at its current
+        suppressed-entry (or post-native exit) stop, then restore its
+        registers exactly. `path` (if given) is written into dead
+        stack space beyond the red zone and substitutes any arg equal
+        to the PATH_ARG sentinel. The ref reaches the same effect
+        through its shim IPC native-syscall channel; under ptrace the
+        registers are ours to borrow. Returns the syscall's result."""
+        saved = self._getregs(tid)
+        regs = self._getregs(tid)
+        argv = list(args)
+        if path is not None:
+            scratch = (saved.rsp - 256 - len(path) - 1) & ~0xF
+            ProcessMemory(tid).write(scratch, path + b"\x00")
+            argv = [scratch if a is PATH_ARG else a for a in argv]
+        regs.rax = nr
+        regs.rip = saved.rip - 2        # the syscall insn
+        for reg, val in zip(("rdi", "rsi", "rdx", "r10", "r8", "r9"),
+                            argv):
+            setattr(regs, reg, val & 0xFFFFFFFFFFFFFFFF)
+        self._setregs(tid, regs)
+        self._run_to_exit(tid)
+        out = self._getregs(tid)
+        res = ctypes.c_long(out.rax).value
+        self._setregs(tid, saved)       # exactly as we found it
+        return res
+
     # -- clone / fork (TRACECLONE/TRACEFORK auto-attach) ----------------
     def _do_clone(self, tid: int, new_vid: int, kind: str,
                   flags: int, ptid: int, ctid: int,
@@ -683,6 +714,11 @@ class _Tracer(threading.Thread):
                     nr, args = self._resume_to_syscall(tid, inject)
                     self.replies.put(("syscall", tid, nr, args,
                                       self._execd))
+                elif cmd == "inject":
+                    tid, nr, args, path = payload
+                    self.replies.put(
+                        ("injected",
+                         self._inject_syscall(tid, nr, args, path)))
                 elif cmd == "clone":
                     tid, new_vid, kind, flags, ptid, ctid, stack = \
                         payload
@@ -762,6 +798,9 @@ class PtraceProcess(ManagedProcess):
         super().__init__(runtime, path, args, environment)
         self.tracer: Optional[_Tracer] = None
         self._native_pid: Optional[int] = None
+        # a death (or tracer wedge) observed by inject_syscall, to be
+        # finalized by the next _continue with its full machinery
+        self._inject_death: Optional[tuple] = None
 
     @property
     def native_pid(self):
@@ -990,6 +1029,36 @@ class PtraceProcess(ManagedProcess):
         th.syscall_state = {}
         self._continue(ctx, th)
 
+    def inject_syscall(self, nr: int, args, path: bytes | None = None):
+        """Run an extra syscall in the CURRENT thread at its suppressed
+        entry stop (registers restored afterwards). Returns the result,
+        or None on failure. Every reply is consumed IN PLACE — nothing
+        is re-queued and no further commands are issued for a dead tid,
+        so the shared tracer queue can never desync (sibling processes
+        share one tracer). A death observed here is stashed and
+        finalized by the next _continue. Used by the mmap handler to
+        realize file-backed mappings of EMULATED fds through
+        /proc/<simulator>/fd/<osfd> (ref mman.c:72-126)."""
+        if self._inject_death is not None or not self.alive:
+            return None
+        self.tracer.cmds.put(("inject",
+                              (self.current.native_tid, nr, list(args),
+                               path)))
+        try:
+            reply = self.tracer.replies.get(
+                timeout=RECV_TIMEOUT_MS / 1000)
+        except queue.Empty:
+            # wedged tracer: the next _continue's own timeout kills us;
+            # record the desync so no further injects are attempted
+            self._inject_death = ("timeout", None)
+            return None
+        if reply[0] == "injected":
+            return reply[1]
+        log.warning("inject_syscall(%d) failed: %s", nr, reply)
+        if reply[0] == "dead":
+            self._inject_death = (reply[1], reply[2])
+        return None
+
     # -- transport ------------------------------------------------------
     def _reply_to(self, th: ManagedThread, res) -> None:
         """Stage the result on the thread; the next step applies it.
@@ -1027,18 +1096,32 @@ class PtraceProcess(ManagedProcess):
                 if s:
                     inject = s
             result, native, rewind = pend
-            self.tracer.cmds.put(("step",
-                                  (th.native_tid, result, native,
-                                   rewind, inject, ctx.now)))
-            try:
-                reply = self.tracer.replies.get(
-                    timeout=RECV_TIMEOUT_MS / 1000)
-            except queue.Empty:
-                log.warning("%s pid=%s unresponsive for %ds; killing",
-                            self.path, self._native_pid,
-                            RECV_TIMEOUT_MS // 1000)
-                self._kill(ctx)
-                return
+            death = self._inject_death
+            if death is not None:
+                # a failure observed mid-inject_syscall: finalize it
+                # here with the normal reply machinery instead of
+                # issuing more commands for a dead/wedged tracee
+                self._inject_death = None
+                if death[0] == "timeout":
+                    log.warning("%s pid=%s tracer wedged during "
+                                "inject; killing", self.path,
+                                self._native_pid)
+                    self._kill(ctx)
+                    return
+                reply = ("dead", death[0], death[1])
+            else:
+                self.tracer.cmds.put(("step",
+                                      (th.native_tid, result, native,
+                                       rewind, inject, ctx.now)))
+                try:
+                    reply = self.tracer.replies.get(
+                        timeout=RECV_TIMEOUT_MS / 1000)
+                except queue.Empty:
+                    log.warning("%s pid=%s unresponsive for %ds; "
+                                "killing", self.path, self._native_pid,
+                                RECV_TIMEOUT_MS // 1000)
+                    self._kill(ctx)
+                    return
             kind = reply[0]
             if kind == "dead":
                 _, tid, code = reply

@@ -878,18 +878,50 @@ class SyscallHandler:
         if not _s32(a[3]) & MAP_ANONYMOUS:
             fd = _s32(a[4])
             if fd >= VFD_BASE:
-                # file-backed mapping of an emulated fd: the real fd
-                # lives in the SIMULATOR. ENODEV makes apps fall back
-                # to read() (ref mman.c maps via /proc/<pid>/fd —
-                # future work for the ptrace backend).
+                # file-backed mapping of an EMULATED fd: the real fd
+                # lives in the SIMULATOR. Under ptrace the mapping is
+                # realized in the plugin through /proc/<sim>/fd/<osfd>
+                # (ref mman.c:72-126's procfs technique) with three
+                # injected syscalls: openat -> the real mmap with the
+                # fd swapped -> close. Under preload there is no
+                # arg-rewriting channel: ENODEV makes apps fall back
+                # to read().
                 d = self._desc(fd)
                 if d is None:
                     return -EBADF
+                if isinstance(d, HostFileDesc) and not d.is_dir and \
+                        getattr(self.p, "interpose_style", "") == \
+                        "ptrace":
+                    return self._mmap_emulated_fd(a, d)
                 return -ENODEV
         m = self._maps()
         if m is not None:
             m.dirty = True
         return NATIVE
+
+    def _mmap_emulated_fd(self, a, d):
+        from shadow_tpu.host.ptrace import PATH_ARG
+
+        acc = d.flags & self.O_ACCMODE
+        path = f"/proc/{os.getpid()}/fd/{d.osfd}".encode()
+        inj = self.p.inject_syscall
+        fd2 = inj(NR["openat"],
+                  [self.AT_FDCWD, PATH_ARG, acc | os.O_CLOEXEC, 0],
+                  path=path)
+        if fd2 is None or fd2 < 0:
+            return -ENODEV
+        res = inj(NR["mmap"], [a[0], a[1], a[2], a[3], fd2, a[5]])
+        if res is None:
+            # tracee died mid-sequence: no further commands (the next
+            # _continue finalizes the death); fd2 died with it
+            return -ENODEV
+        inj(NR["close"], [fd2])
+        if res < 0:
+            return res
+        m = self._maps()
+        if m is not None:
+            m.dirty = True
+        return res
 
     def sys_munmap(self, ctx, a):
         m = self._maps()

@@ -1,0 +1,41 @@
+/* mmap of a DATA-DIR file (an emulated fd): under ptrace the mapping
+ * is realized through the simulator's /proc fd; under preload mmap
+ * fails with ENODEV and the app falls back to read() — both paths
+ * must see identical bytes. Also exercises MAP_SHARED write-through:
+ * bytes stored via the mapping must be visible to pread on the same
+ * (emulated) fd. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+int main(void) {
+  const char payload[] = "0123456789abcdef0123456789abcdef";
+  int fd = open("mapme.bin", O_CREAT | O_RDWR, 0644);
+  if (fd < 0) { perror("open"); return 1; }
+  if (write(fd, payload, 32) != 32) { perror("write"); return 1; }
+
+  void *m = mmap(NULL, 32, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (m == MAP_FAILED) {
+    printf("mmap_errno %d\n", errno);
+    char buf[33] = {0};
+    if (pread(fd, buf, 32, 0) != 32) { perror("pread"); return 1; }
+    printf("fallback_read %d\n", memcmp(buf, payload, 32) == 0);
+    printf("done\n");
+    return 0;
+  }
+  printf("mmap_errno 0\n");
+  printf("map_read %d\n", memcmp(m, payload, 32) == 0);
+  memcpy((char *)m + 8, "WRITTEN!", 8);
+  if (msync(m, 32, MS_SYNC) != 0) { perror("msync"); return 1; }
+  char buf[33] = {0};
+  if (pread(fd, buf, 32, 0) != 32) { perror("pread2"); return 1; }
+  printf("write_through %d\n", memcmp(buf + 8, "WRITTEN!", 8) == 0);
+  if (munmap(m, 32) != 0) { perror("munmap"); return 1; }
+  close(fd);
+  printf("done\n");
+  return 0;
+}

@@ -225,3 +225,38 @@ def test_limits_prctl(plugins, tmp_path, method):
     assert lines[4] == "pdeathsig 15"
     assert lines[5] == "name worker0"
     assert lines[6] == "done"
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_mmap_of_emulated_file(plugins, tmp_path, method):
+    """mmap of a data-dir file (an emulated fd): realized through the
+    simulator's /proc fd under ptrace (ref mman.c:72-126 procfs
+    technique) with MAP_SHARED write-through visible to pread on the
+    same fd; refused with ENODEV under preload, where the read()
+    fallback must see identical bytes."""
+    data = str(tmp_path / "shadow.data")
+    cfg = _cfg(data, method) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['mmap_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    assert stats.ok
+    out = read_stdout(data, "alice", "mmap_check")
+    assert "done" in out, out
+    if method == "ptrace":
+        assert "mmap_errno 0" in out
+        assert "map_read 1" in out
+        assert "write_through 1" in out
+    else:
+        assert "mmap_errno 19" in out       # ENODEV
+        assert "fallback_read 1" in out
+    # the mapped writes landed in the real per-host file
+    f = os.path.join(data, "hosts", "alice", "mapme.bin")
+    content = open(f, "rb").read()
+    if method == "ptrace":
+        assert content[8:16] == b"WRITTEN!"
+    else:
+        assert content[:8] == b"01234567"
