@@ -893,7 +893,7 @@ static const int kFdGatedSyscalls[] = {
     SYS_getdents,  SYS_getdents64, SYS_ftruncate, SYS_fsync,
     SYS_fdatasync, SYS_fallocate,  SYS_flock,     SYS_fchmod,
     SYS_fchown,    SYS_fgetxattr,  SYS_fsetxattr, SYS_flistxattr,
-    SYS_fremovexattr, SYS_fchdir,
+    SYS_fremovexattr, SYS_fchdir,  SYS_fstatfs,
     /* dirfd(arg0)-relative path family (ref fileat.c): */
     SYS_unlinkat,  SYS_mkdirat,    SYS_readlinkat, SYS_faccessat,
 #ifdef SYS_faccessat2

@@ -439,7 +439,8 @@ class HostFileDesc(Descriptor):
         super().__init__()
         self.osfd = osfd
         self.abspath = abspath
-        self.flags = flags          # app-visible open flags
+        self.realpath = abspath     # overwritten with the resolved
+        self.flags = flags          # path at open (lock-table key)
         self.mode = mode
         self.is_dir = False
         try:

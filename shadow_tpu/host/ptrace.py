@@ -1057,6 +1057,11 @@ class PtraceProcess(ManagedProcess):
         log.warning("inject_syscall(%d) failed: %s", nr, reply)
         if reply[0] == "dead":
             self._inject_death = (reply[1], reply[2])
+        else:
+            # a tracer error mid-inject may have left the tracee's
+            # registers pointing at the injected syscall — resuming
+            # it would be undefined; treat as fatal
+            self._inject_death = ("error", None)
         return None
 
     # -- transport ------------------------------------------------------
@@ -1102,10 +1107,10 @@ class PtraceProcess(ManagedProcess):
                 # here with the normal reply machinery instead of
                 # issuing more commands for a dead/wedged tracee
                 self._inject_death = None
-                if death[0] == "timeout":
-                    log.warning("%s pid=%s tracer wedged during "
-                                "inject; killing", self.path,
-                                self._native_pid)
+                if death[0] in ("timeout", "error"):
+                    log.warning("%s pid=%s tracer %s during inject; "
+                                "killing", self.path,
+                                self._native_pid, death[0])
                     self._kill(ctx)
                     return
                 reply = ("dead", death[0], death[1])

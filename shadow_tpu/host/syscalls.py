@@ -103,7 +103,7 @@ NR = dict(
     flistxattr=196, removexattr=197, lremovexattr=198,
     fremovexattr=199,
     prlimit64=302, prctl=157, set_robust_list=273,
-    get_robust_list=274, getrlimit=97, setrlimit=160,
+    get_robust_list=274, getrlimit=97, setrlimit=160, fstatfs=138,
 )
 NR_NAME = {v: k for k, v in NR.items()}
 
@@ -1470,9 +1470,20 @@ class SyscallHandler:
 
     def sys_close(self, ctx, a):
         fd = _s32(a[0])
-        if self.table.get(fd) is None:
+        desc = self.table.get(fd)
+        if desc is None:
             return self._no_desc(fd)
-        return 0 if self.table.close_fd(ctx, fd) else -EBADF
+        ok = self.table.close_fd(ctx, fd)
+        if ok and isinstance(desc, HostFileDesc):
+            # POSIX: closing ANY fd that refers to the file releases
+            # every record lock this PROCESS holds on it (OFD locks
+            # die with their description instead)
+            table = getattr(self.p.host, "_posix_locks", None)
+            if table:
+                locks = table.get(desc.realpath)
+                if locks:
+                    locks[:] = [e for e in locks if e[0] is not self.p]
+        return 0 if ok else -EBADF
 
     # -- file opens + the fd-mediated family (ref file.c/fileat.c) -----
     AT_FDCWD = -100
@@ -1618,6 +1629,7 @@ class SyscallHandler:
         except OSError as e:
             return -e.errno
         d = HostFileDesc(osfd, abspath, flags, mode)
+        d.realpath = rp             # lock-table key (cached once)
         d.nonblock = bool(flags & O_NONBLOCK)
         fd = self.table.alloc(d)
         if flags & self.O_CLOEXEC_FLAG:
@@ -1995,7 +2007,7 @@ class SyscallHandler:
         if kind not in (self.LOCK_SH, self.LOCK_EX, self.LOCK_UN):
             return -EINVAL
         table = self._flock_table()
-        key = os.path.realpath(d.abspath)
+        key = d.realpath
         holders = table.setdefault(key, {})     # desc -> 'sh'|'ex'
         for h in [h for h in holders if h.closed]:
             del holders[h]
@@ -2447,11 +2459,214 @@ class SyscallHandler:
     def sys_lremovexattr(self, ctx, a):
         return self.sys_removexattr(ctx, a)
 
+    def sys_fstatfs(self, ctx, a):
+        """struct statfs for an os-backed fd: DETERMINISTIC values (a
+        plausible fixed ext4 — the real filesystem's occupancy is
+        machine state that must never steer a plugin). Ref file.c:135
+        passes the real fstatfs through; the deviation follows the
+        same policy as the rusage/limits views."""
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        if not a[1]:
+            return -EFAULT
+        buf = bytearray(120)
+        struct.pack_into(
+            "<7q", buf, 0,
+            0xEF53,                     # f_type: ext4
+            4096,                       # f_bsize
+            1 << 28, 1 << 27, 1 << 27,  # blocks / bfree / bavail
+            1 << 24, 1 << 23)           # files / ffree
+        struct.pack_into("<qq", buf, 64, 255, 4096)  # namelen, frsize
+        self.mem.write(a[1], bytes(buf))
+        return 0
+
+    # POSIX record locks (fcntl F_GETLK/F_SETLK/F_SETLKW, ref
+    # fcntl.c:60-90): a VIRTUAL per-host table keyed by the confined
+    # path — the simulator owns every real fd, so kernel POSIX locks
+    # would all share one owner and never conflict; the virtual table
+    # restores per-PROCESS semantics with virtual pids in F_GETLK.
+    F_GETLK, F_SETLK, F_SETLKW = 5, 6, 7
+    F_OFD_GETLK, F_OFD_SETLK, F_OFD_SETLKW = 36, 37, 38
+    F_RDLCK, F_WRLCK, F_UNLCK = 0, 1, 2
+
+    def _posix_lock_table(self) -> dict:
+        t = getattr(self.p.host, "_posix_locks", None)
+        if t is None:
+            t = self.p.host._posix_locks = {}
+        return t
+
+    def _read_flock(self, ptr):
+        raw = self.mem.read(ptr, 32)
+        l_type, l_whence = struct.unpack_from("<hh", raw, 0)
+        l_start, l_len = struct.unpack_from("<qq", raw, 8)
+        return l_type, l_whence, l_start, l_len
+
+    def _lock_range(self, desc, whence, start, ln):
+        """absolute [lo, hi) — hi = 2^63-1 for 'to EOF' (l_len 0)."""
+        if whence == 1:                 # SEEK_CUR
+            base = os.lseek(desc.osfd, 0, os.SEEK_CUR)
+        elif whence == 2:               # SEEK_END
+            base = os.fstat(desc.osfd).st_size
+        else:
+            base = 0
+        lo = base + start
+        if ln > 0:
+            return lo, lo + ln
+        if ln < 0:
+            return lo + ln, lo
+        return lo, (1 << 63) - 1
+
+    @staticmethod
+    def _split_out(locks, owner, lo, hi):
+        """Remove owner's coverage of [lo, hi), splitting partial
+        overlaps (shared by unlock and the replace-then-add path)."""
+        new = []
+        for e in locks:
+            own, t, a_, b_ = e
+            if own is not owner or b_ <= lo or hi <= a_:
+                new.append(e)
+                continue
+            if a_ < lo:
+                new.append((own, t, a_, lo))
+            if hi < b_:
+                new.append((own, t, hi, b_))
+        return new
+
+    def _lock_deadlock(self, ctx, key, lo, hi, me):
+        """EDEADLK detection for F_SETLKW: walk the waits-for graph
+        (holder of my range -> the range IT waits on -> holders...)
+        through the per-host waiting map. Entries are trusted only
+        while FRESH (a parked waiter re-polls every sim-millisecond,
+        so anything older than a few polls is a stale leftover from an
+        interrupted wait, never a false cycle)."""
+        waiting = getattr(self.p.host, "_posix_waiting", None)
+        if waiting is None:
+            waiting = self.p.host._posix_waiting = {}
+        table = self._posix_lock_table()
+        seen = set()
+        frontier = [(key, lo, hi)]
+        while frontier:
+            k, a0, b0 = frontier.pop()
+            for own, _t, x, y in table.get(k, ()):
+                if own is me or x >= b0 or y <= a0 \
+                        or id(own) in seen:
+                    continue
+                seen.add(id(own))
+                w = waiting.get(own)
+                if w is None:
+                    continue
+                wk, wlo, whi, stamp = w
+                if ctx.now - stamp > 8_000_000:     # stale (> 8 polls)
+                    continue
+                # does MY holding set block this holder's wait?
+                if any(own2 is me and x2 < whi and wlo < y2
+                       for own2, _t2, x2, y2 in table.get(wk, ())):
+                    return True
+                frontier.append((wk, wlo, whi))
+        return False
+
+    def _fcntl_lock(self, ctx, desc, cmd, arg):
+        """Record locks over the virtual table. Ownership follows the
+        kernel: F_SETLK/F_GETLK/F_SETLKW locks are owned by the
+        PROCESS (virtual pid in F_GETLK); F_OFD_* locks are owned by
+        the open file DESCRIPTION (the shared desc object; l_pid
+        reports -1). Purged eagerly at sys_close (POSIX close-any-fd
+        release) and lazily when the owner dies."""
+        ofd_cmd = cmd in (self.F_OFD_GETLK, self.F_OFD_SETLK,
+                          self.F_OFD_SETLKW)
+        if not arg:
+            return -EFAULT
+        try:
+            raw = self.mem.read(arg, 32)
+        except OSError:
+            return -EFAULT
+        l_type, whence = struct.unpack_from("<hh", raw, 0)
+        start, ln = struct.unpack_from("<qq", raw, 8)
+        l_pid, = struct.unpack_from("<i", raw, 24)
+        if ofd_cmd and cmd != self.F_OFD_GETLK and l_pid != 0:
+            return -EINVAL          # kernel mandates l_pid == 0
+        if whence not in (0, 1, 2):
+            return -EINVAL
+        try:
+            lo, hi = self._lock_range(desc, whence, start, ln)
+        except OSError as e:
+            return -e.errno
+        if lo < 0 or (hi <= lo and l_type != self.F_UNLCK):
+            return -EINVAL
+        table = self._posix_lock_table()
+        key = desc.realpath
+        locks = table.setdefault(key, [])
+        me = desc if ofd_cmd else self.p
+
+        def owner_live(entry):
+            own = entry[0]
+            if isinstance(own, HostFileDesc):
+                return not own.closed       # OFD: dies with the desc
+            if not own.alive or own.table is None:
+                return False
+            return any(isinstance(x, HostFileDesc) and not x.closed
+                       and x.realpath == key
+                       for x in own.table._slots.values())
+        locks[:] = [e for e in locks if owner_live(e)]
+
+        def conflicts(entry):
+            own, t, a_, b_ = entry
+            return own is not me and a_ < hi and lo < b_ and \
+                (t == self.F_WRLCK or l_type == self.F_WRLCK)
+
+        if cmd in (self.F_GETLK, self.F_OFD_GETLK):
+            for e in locks:
+                if conflicts(e):
+                    own, t, a_, b_ = e
+                    out = bytearray(32)
+                    struct.pack_into("<hh", out, 0, t, 0)
+                    struct.pack_into("<qq", out, 8, a_,
+                                     0 if b_ >= (1 << 62) else b_ - a_)
+                    pid = -1 if isinstance(own, HostFileDesc) \
+                        else own.vpid
+                    struct.pack_into("<i", out, 24, pid)
+                    self.mem.write(arg, bytes(out))
+                    return 0
+            out = bytearray(raw)
+            struct.pack_into("<h", out, 0, self.F_UNLCK)
+            self.mem.write(arg, bytes(out))
+            return 0
+
+        waiting = getattr(self.p.host, "_posix_waiting", None)
+        if waiting is not None:
+            waiting.pop(me, None)           # any lock op ends a wait
+        if l_type == self.F_UNLCK:
+            locks[:] = self._split_out(locks, me, lo, hi)
+            return 0
+        if l_type not in (self.F_RDLCK, self.F_WRLCK):
+            return -EINVAL
+        if any(conflicts(e) for e in locks):
+            if cmd in (self.F_SETLKW, self.F_OFD_SETLKW):
+                if self._lock_deadlock(ctx, key, lo, hi, me):
+                    return -35              # EDEADLK
+                if waiting is None:
+                    waiting = self.p.host._posix_waiting = {}
+                waiting[me] = (key, lo, hi, ctx.now)
+                raise Blocked(deadline=ctx.now + 1_000_000)
+            return -EAGAIN
+        # previous locks of this owner in the range are replaced
+        # (POSIX merge semantics approximated by split-then-add)
+        locks[:] = self._split_out(locks, me, lo, hi)
+        locks.append((me, l_type, lo, hi))
+        return 0
+
     def sys_fcntl(self, ctx, a):
         fd, cmd, arg = _s32(a[0]), _s32(a[1]), int(a[2])
         desc = self._desc(fd)
         if desc is None:
             return self._no_desc(fd)
+        if cmd in (self.F_GETLK, self.F_SETLK, self.F_SETLKW,
+                   self.F_OFD_GETLK, self.F_OFD_SETLK,
+                   self.F_OFD_SETLKW):
+            if not isinstance(desc, HostFileDesc):
+                return -EBADF
+            return self._fcntl_lock(ctx, desc, cmd, arg)
         if cmd in (F_DUPFD, F_DUPFD_CLOEXEC):
             min_fd = arg - VFD_BASE if arg >= VFD_BASE else 0
             nfd = self.table.dup(fd, min_fd)

@@ -1,0 +1,78 @@
+/* POSIX record locks across two processes on one simulated host
+ * (virtual lock table; F_GETLK reports the holder's VIRTUAL pid) +
+ * deterministic fstatfs. mode=hold: write-lock [0,100) and sleep;
+ * mode=probe (started later): conflicting F_SETLK fails EAGAIN,
+ * F_GETLK names the holder, a disjoint range and a same-process
+ * re-lock succeed, and after the holder exits the range is free. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/vfs.h>
+#include <unistd.h>
+
+static int setlk(int fd, short type, long start, long len) {
+  struct flock fl = {0};
+  fl.l_type = type;
+  fl.l_whence = SEEK_SET;
+  fl.l_start = start;
+  fl.l_len = len;
+  return fcntl(fd, F_SETLK, &fl);
+}
+
+int main(int argc, char **argv) {
+  const char *mode = argc > 1 ? argv[1] : "hold";
+  int fd = open("lk.bin", O_CREAT | O_RDWR, 0644);
+  if (fd < 0) { perror("open"); return 1; }
+
+  if (!strcmp(mode, "hold")) {
+    if (setlk(fd, F_WRLCK, 0, 100) != 0) { perror("lock"); return 1; }
+    printf("held pid=%d\n", (int)getpid());
+    fflush(stdout);
+    usleep(500000);
+    printf("done\n");
+    return 0;
+  }
+
+  /* probe (starts while hold sleeps) */
+  printf("conflict %d\n",
+         setlk(fd, F_WRLCK, 50, 10) == -1 && errno == EAGAIN);
+  struct flock q = {0};
+  q.l_type = F_WRLCK;
+  q.l_whence = SEEK_SET;
+  q.l_start = 50;
+  q.l_len = 10;
+  if (fcntl(fd, F_GETLK, &q) != 0) { perror("getlk"); return 1; }
+  printf("getlk type=%d pid=%d\n", (int)q.l_type, (int)q.l_pid);
+  printf("disjoint %d\n", setlk(fd, F_WRLCK, 200, 10) == 0);
+  int fd2 = open("lk.bin", O_RDWR);
+  printf("same_process %d\n", setlk(fd2, F_WRLCK, 205, 10) == 0);
+
+  /* OFD locks are owned by the open file DESCRIPTION: the same
+   * process's second description conflicts, and GETLK reports -1 */
+  struct flock ofl = {0};
+  ofl.l_type = F_WRLCK;
+  ofl.l_whence = SEEK_SET;
+  ofl.l_start = 400;
+  ofl.l_len = 10;
+  printf("ofd_first %d\n", fcntl(fd, F_OFD_SETLK, &ofl) == 0);
+  struct flock ofl2 = ofl;
+  printf("ofd_conflict %d\n",
+         fcntl(fd2, F_OFD_SETLK, &ofl2) == -1 && errno == EAGAIN);
+  ofl2 = ofl;
+  if (fcntl(fd2, F_OFD_GETLK, &ofl2) != 0) { perror("ofdgetlk"); return 1; }
+  printf("ofd_getlk type=%d pid=%d\n", (int)ofl2.l_type,
+         (int)ofl2.l_pid);
+
+  struct statfs sf;
+  if (fstatfs(fd, &sf) != 0) { perror("fstatfs"); return 1; }
+  printf("fstatfs type=%lx bsize=%ld namelen=%ld\n",
+         (unsigned long)sf.f_type, (long)sf.f_bsize,
+         (long)sf.f_namelen);
+
+  usleep(600000);               /* the holder has exited by now */
+  printf("freed %d\n", setlk(fd, F_WRLCK, 50, 10) == 0);
+  printf("done\n");
+  return 0;
+}

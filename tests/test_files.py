@@ -260,3 +260,46 @@ def test_mmap_of_emulated_file(plugins, tmp_path, method):
         assert content[8:16] == b"WRITTEN!"
     else:
         assert content[:8] == b"01234567"
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_posix_record_locks(plugins, tmp_path, method):
+    """fcntl record locks across two processes on one host: conflicts
+    report EAGAIN, F_GETLK names the holder's VIRTUAL pid, disjoint
+    ranges and same-process re-locks succeed, locks die with their
+    owner; fstatfs reports the deterministic filesystem."""
+    data = str(tmp_path / "shadow.data")
+    cfg = _cfg(data, method) + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['lock_check']}
+      args: hold
+      start_time: 1s
+    - path: {plugins['lock_check']}
+      args: probe
+      start_time: 1100ms
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    assert stats.ok
+    d = os.path.join(data, "hosts", "alice")
+    outs = {}
+    for f in sorted(os.listdir(d)):
+        if f.endswith(".stdout"):
+            outs[f] = open(os.path.join(d, f)).read()
+    hold = next(v for v in outs.values() if "held" in v)
+    probe = next(v for v in outs.values() if "conflict" in v)
+    hold_pid = int(hold.split("pid=")[1].split()[0])
+    assert hold_pid >= 1000                  # virtual pid space
+    assert "conflict 1" in probe
+    assert f"getlk type=1 pid={hold_pid}" in probe
+    assert "disjoint 1" in probe
+    assert "same_process 1" in probe
+    # OFD: description-owned — the same process's second description
+    # conflicts and GETLK reports pid -1
+    assert "ofd_first 1" in probe
+    assert "ofd_conflict 1" in probe
+    assert "ofd_getlk type=1 pid=-1" in probe
+    assert "fstatfs type=ef53 bsize=4096 namelen=255" in probe
+    assert "freed 1" in probe
+    assert "done" in probe
