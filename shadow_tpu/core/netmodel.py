@@ -61,14 +61,16 @@ class NetworkModel:
                 self.path_packets[key] = self.path_packets.get(key, 0) + n
 
     def judge_train(self, now: int, src_host: int, dst_host: int,
-                    pkt_seq0: int, count: int
-                    ) -> tuple[int, int, int]:
+                    pkt_seq0: int, count: int,
+                    live: int = -1) -> tuple[int, int, int]:
         """Judge a packet TRAIN (count packets sharing one path and
         send instant, e.g. a tgen chunk): per-packet drop rolls with
         the same (src, pkt_seq0+j) keys individual sends would use, so
         loss statistics are bit-identical to per-packet sends. Returns
         (survivor_bitmask, deliver_time, latency_ns); bit j set means
-        packet pkt_seq0+j survived."""
+        packet pkt_seq0+j survived. `live` (< 0 = count) is the number
+        of lanes that actually carry packets (a masked forward) — the
+        path histogram counts only those, matching the device twin."""
         # numpy uint64 shifts are undefined past 63 and would corrupt
         # the survivor mask silently — fail loudly instead
         assert count <= 64, \
@@ -89,7 +91,7 @@ class NetworkModel:
         key = (sv, dv)
         with self._lock:
             self.path_packets[key] = self.path_packets.get(key, 0) \
-                + count
+                + (count if live < 0 else live)
         return surv, now + latency, latency
 
     def judge(self, now: int, src_host: int, dst_host: int,

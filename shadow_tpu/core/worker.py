@@ -126,7 +126,7 @@ class SimContext:
         return True
 
     def send_train(self, dst_host: int, size: int, data: tuple = (),
-                   count: int = 1) -> int:
+                   count: int = 1, mask: Optional[int] = None) -> int:
         """Send `count` packets as ONE train event (a tgen chunk):
         one event/one delivery, per-packet drop rolls with the same
         keys individual sends would use. The delivered event's data is
@@ -136,19 +136,28 @@ class SimContext:
         event count per chunk drops from `count` to 1 on both engines
         while loss statistics stay bit-identical.
 
+        `mask`: forwarding a previous hop's survivors — only its set
+        bits are real packets (sent/dropped/rolled into the result);
+        seq consumption and roll keys still span all `count` lanes so
+        the device twin's lane math lines up exactly.
+
         Trains are judged synchronously even under hybrid mode's
         deferred (device-batched) judgment — the verdict is a pure
         function of stable keys, so results are identical; deferral is
         a batching optimization for per-packet send() traffic."""
         count = max(1, count)
+        live = (1 << count) - 1 if mask is None \
+            else mask & ((1 << count) - 1)
         host = self.host
         pkt_seq0 = host._packet_seq
         host._packet_seq += count
         ev_seq = host.next_event_seq()
         surv, deliver, lat = self._m.netmodel.judge_train(
-            self.now, host.host_id, dst_host, pkt_seq0, count)
-        host.packets_sent += count
-        host.packets_dropped += count - surv.bit_count()
+            self.now, host.host_id, dst_host, pkt_seq0, count,
+            live=live.bit_count())
+        surv &= live
+        host.packets_sent += live.bit_count()
+        host.packets_dropped += live.bit_count() - surv.bit_count()
         if host.model_nic is not None:
             # dropped trains still consume uplink serialization (the
             # network drops them later) — device-engine parity

@@ -49,6 +49,12 @@ class AppOut(NamedTuple):
     # rolls one drop per packet with the same keys per-packet sends
     # would use and delivers a survivor bitmask as d2); None = all 1
     send_count: Optional[jnp.ndarray] = None
+    # train LIVE mask, each [H, K] u32/i32: bit j = lane j of the
+    # train actually carries a packet (forwarding a previous hop's
+    # survivors). Seq consumption and roll keys still cover all
+    # `send_count` lanes (twin alignment); only live lanes are sent,
+    # counted, or rolled into d2. None = all live.
+    send_mask: Optional[jnp.ndarray] = None
 
 
 class DeviceApp:
@@ -421,9 +427,14 @@ class TorDevice(DeviceApp):
         self.SEQ_BITS = SEQ_BITS
         self.SEQ_MASK = SEQ_MASK
         self.n_state_words = 6
-        self.max_sends = self.chunk
+        # cells travel as packet TRAINS (one row per chunk with a
+        # survivor bitmask, per-cell drop rolls): every app event
+        # emits at most ONE row, which also unlocks relay burst-pops
+        self.max_sends = 1
+        self.max_train = self.chunk
         self.max_timers = 1
         self.max_draws = 1              # no stateful randomness
+        self.burst_pops = 8             # relays: stateless responders
         self.seed_pair = prng.seed_key(self.seed)
         # `cells` shapes the exit relays' DATA service and must stay
         # uniform; count/pause/retry are client-local per-host
@@ -455,10 +466,83 @@ class TorDevice(DeviceApp):
         gids = jnp.asarray(self.relay_gids.astype(np.int32))
         return gids[g], gids[m], gids[e]
 
+    def _relay_lane(self, me, kind, d0, d1, d2):
+        """The stateless relay answer to one popped event — shared by
+        the serial path (column 0) and burst columns. All inputs are
+        same-shape arrays; returns per-element lane fields (valid,
+        dst, size, d0, d1, count, mask). d1 is ECHOED on every relay
+        hop ((circ << SEQ_BITS) | chunk start)."""
+        is_pkt = kind == KIND_PACKET
+        circ = jnp.right_shift(d1, self.SEQ_BITS)
+        start = d1 & self.SEQ_MASK
+        G, M, E = self._route(circ)
+        r_req = is_pkt & (d0 == self.TAG_REQ)
+        r_data = is_pkt & (d0 == self.TAG_DATA)
+        fwd_req_g = r_req & (me == G)        # -> M
+        fwd_req_m = r_req & (me == M)        # -> E
+        serve = r_req & (me == E)            # exit: DATA train
+        fwd_data_m = r_data & (me == M)      # -> G
+        fwd_data_g = r_data & (me == G)      # -> client (circ)
+        fwd_data = fwd_data_m | fwd_data_g
+
+        cnt = jnp.clip(self.cells - start, 0, self.chunk)
+        full = (jnp.left_shift(jnp.uint32(1), cnt.astype(jnp.uint32))
+                - jnp.uint32(1)).astype(jnp.int32)
+        surv_in = d2
+        live = lax.population_count(
+            surv_in.astype(jnp.uint32)).astype(jnp.int32)
+
+        valid = fwd_req_g | fwd_req_m | (serve & (cnt > 0)) | \
+            (fwd_data & (surv_in != 0))
+        dst = jnp.where(
+            fwd_req_g, M, jnp.where(
+                fwd_req_m, E, jnp.where(
+                    serve, M, jnp.where(fwd_data_m, G, circ))))
+        size = jnp.where(serve, self.CELL * cnt,
+                         jnp.where(fwd_data, self.CELL * live, 64))
+        out_d0 = jnp.where(serve, self.TAG_DATA, d0)
+        count = jnp.where(serve | fwd_data, self.chunk, 1)
+        lmask = jnp.where(serve, full,
+                          jnp.where(fwd_data, surv_in, 1))
+        return (valid, dst.astype(jnp.int32),
+                size.astype(jnp.int32), out_d0.astype(jnp.int32),
+                d1.astype(jnp.int32), count.astype(jnp.int32),
+                lmask.astype(jnp.int32))
+
+    def burst_mask(self, app_state) -> jnp.ndarray:
+        return app_state[:, 0] == 0         # relays: stateless
+
+    def handle_burst(self, gid, nowP, kindP, srcP, sizeP, d0P, d1P,
+                     d2P, app_state, draws) -> AppOut:
+        """Column 0 runs the full role logic; columns 1+ can only be
+        burst-popped RELAY packets — answered by the shared stateless
+        lane computation, one train row each."""
+        base = self.handle(gid, nowP[:, 0], kindP[:, 0], srcP[:, 0],
+                           sizeP[:, 0], d0P[:, 0], d1P[:, 0],
+                           d2P[:, 0], app_state, draws)
+        is_relay = (app_state[:, 0] == 0)[:, None]
+        me = gid[:, None]
+        valid, dst, size, d0o, d1o, count, lmask = self._relay_lane(
+            me, kindP, d0P, d1P, d2P)
+        valid = valid & is_relay
+
+        def lanes(l0, rest):
+            return jnp.concatenate([l0, rest[:, 1:]], axis=1)
+
+        return base._replace(
+            send_dst=lanes(base.send_dst, dst),
+            send_size=lanes(base.send_size, size),
+            send_d0=lanes(base.send_d0, d0o),
+            send_d1=lanes(base.send_d1, d1o),
+            send_valid=lanes(base.send_valid, valid),
+            send_count=lanes(base.send_count, count),
+            send_mask=lanes(base.send_mask, lmask),
+        )
+
     def handle(self, gid, now, kind, src, size, d0, d1, d2, app_state,
                draws
                ) -> AppOut:
-        H, K = draws.shape[0], self.max_sends
+        H = draws.shape[0]
         role = app_state[:, 0]
         chunk_start = app_state[:, 1]
         got = app_state[:, 2]
@@ -469,30 +553,19 @@ class TorDevice(DeviceApp):
         is_client = role == 1
 
         is_pkt = kind == KIND_PACKET
-        circ = jnp.right_shift(d1, self.SEQ_BITS)
-        field_ = d1 & self.SEQ_MASK
-        G, M, E = self._route(circ)
         me = gid
 
-        # ---- relay branches (stateless) ----
-        r_req = is_relay & is_pkt & (d0 == self.TAG_REQ)
-        r_data = is_relay & is_pkt & (d0 == self.TAG_DATA)
-        fwd_req_g = r_req & (me == G)        # -> M
-        fwd_req_m = r_req & (me == M)        # -> E
-        serve = r_req & (me == E)            # exit: emit DATA chunk
-        fwd_data_m = r_data & (me == M)      # -> G
-        fwd_data_g = r_data & (me == G)      # -> client (circ)
+        # ---- relay lane (stateless; trains forwarded by mask) ----
+        (r_valid, r_dst, r_size, r_d0, r_d1, r_count,
+         r_mask) = self._relay_lane(me, kind, d0, d1, d2)
+        r_valid = r_valid & is_relay
 
-        fwd = fwd_req_g | fwd_req_m | fwd_data_m | fwd_data_g
-        fwd_dst = jnp.where(
-            fwd_req_g, M, jnp.where(
-                fwd_req_m, E, jnp.where(fwd_data_m, G, circ)))
-
-        # ---- client window progress (tgen dedup rules) ----
+        # ---- client window progress (tgen train-fold rules) ----
         my_route = self._route(me)
         my_guard = my_route[0]
         count_h, pause_h, retry_h = self._client_args_at(gid)
 
+        start_f = d1 & self.SEQ_MASK
         c_data = is_client & is_pkt & (d0 == self.TAG_DATA)
         c_boot = is_client & (kind == KIND_BOOT) & (count_h > 0)
         c_timer = is_client & (kind == KIND_TIMER)
@@ -500,13 +573,29 @@ class TorDevice(DeviceApp):
         timer_retry = c_timer & (d0 >= 0) & (d0 == gen)
 
         chunk_len = jnp.minimum(self.chunk, self.cells - chunk_start)
-        off = field_ - chunk_start
-        in_win = c_data & (off >= 0) & (off < chunk_len)
-        bit = jnp.left_shift(jnp.int32(1),
-                             jnp.clip(off, 0, self.chunk - 1))
-        fresh = in_win & ((mask & bit) == 0)
-        new_mask = jnp.where(fresh, mask | bit, mask)
-        new_got = jnp.where(fresh, got + 1, got)
+        shift = start_f - chunk_start
+        surv_u = d2.astype(jnp.uint32)
+        up = jnp.left_shift(surv_u,
+                            jnp.clip(shift, 0, 31).astype(jnp.uint32))
+        down = jnp.right_shift(
+            surv_u, jnp.clip(-shift, 0, 31).astype(jnp.uint32))
+        aligned = jnp.where(shift >= 0, up, down)
+        aligned = jnp.where((shift >= 32) | (shift <= -32),
+                            jnp.uint32(0), aligned)
+        wmask = (jnp.left_shift(
+            jnp.uint32(1),
+            jnp.clip(chunk_len, 0, 31).astype(jnp.uint32))
+            - jnp.uint32(1))
+        window = aligned & wmask
+        fresh_bits = window & ~mask.astype(jnp.uint32)
+        fresh = c_data & (fresh_bits != 0)
+        new_mask = jnp.where(
+            fresh, (mask.astype(jnp.uint32) | fresh_bits)
+            .astype(jnp.int32), mask)
+        new_got = jnp.where(
+            fresh,
+            got + lax.population_count(fresh_bits).astype(jnp.int32),
+            got)
         complete = fresh & (new_got >= chunk_len)
         nxt = chunk_start + chunk_len
         dl_done = complete & (nxt >= self.cells)
@@ -527,36 +616,25 @@ class TorDevice(DeviceApp):
         st = st.at[:, 1].set(new_chunk_start)
         st = st.at[:, 2].set(new_got)
         st = st.at[:, 3].set(new_done)
-        st = st.at[:, 4].set(new_done * 0 + new_gen)
+        st = st.at[:, 4].set(new_gen)
         st = st.at[:, 5].set(new_mask)
 
-        # ---- sends ----
-        ks = jnp.arange(K, dtype=jnp.int32)[None, :]       # [1,K]
-        # exit chunk service: cells start..start+chunk-1 toward M
-        seqs = field_[:, None] + ks
-        srv_valid = serve[:, None] & (seqs < self.cells)
-        # slot 0: relay forward (1 cell) or client REQ
-        slot0 = (fwd | send_req)[:, None] & (ks == 0)
-        send_valid = srv_valid | slot0
-
+        # ---- the single send lane: relay row or client REQ ----
         req_d1 = jnp.left_shift(me, self.SEQ_BITS) | req_start
-        data_d1 = jnp.left_shift(circ[:, None], self.SEQ_BITS) | \
-            (seqs & self.SEQ_MASK)
-        send_dst = jnp.where(
-            serve[:, None], M[:, None],
-            jnp.where(fwd[:, None], fwd_dst[:, None],
-                      my_guard[:, None])).astype(jnp.int32)
-        send_size = jnp.where(
-            serve[:, None], self.CELL,
-            jnp.where(fwd[:, None], size[:, None], 64)).astype(jnp.int32)
-        send_d0 = jnp.where(
-            serve[:, None], self.TAG_DATA,
-            jnp.where(fwd[:, None], d0[:, None],
-                      self.TAG_REQ)).astype(jnp.int32)
-        send_d1 = jnp.where(
-            serve[:, None], data_d1,
-            jnp.where(fwd[:, None], d1[:, None],
-                      req_d1[:, None])).astype(jnp.int32)
+        rv = r_valid
+        send_valid = (rv | send_req)[:, None]
+        send_dst = jnp.where(rv, r_dst, my_guard)[:, None] \
+            .astype(jnp.int32)
+        send_size = jnp.where(rv, r_size, 64)[:, None] \
+            .astype(jnp.int32)
+        send_d0 = jnp.where(rv, r_d0, self.TAG_REQ)[:, None] \
+            .astype(jnp.int32)
+        send_d1 = jnp.where(rv, r_d1, req_d1)[:, None] \
+            .astype(jnp.int32)
+        send_count = jnp.where(rv, r_count, 1)[:, None] \
+            .astype(jnp.int32)
+        send_mask = jnp.where(rv, r_mask, 1)[:, None] \
+            .astype(jnp.int32)
 
         # ---- timers ----
         pause_valid = dl_done & (new_done < count_h)
@@ -574,4 +652,6 @@ class TorDevice(DeviceApp):
             timer_valid=timer_valid,
             n_draws=jnp.zeros((H,), jnp.int32),
             app_state=st,
+            send_count=send_count,
+            send_mask=send_mask,
         )

@@ -299,12 +299,15 @@ class DeviceEngine:
         # its own send lane — a busy hub no longer holds every lane
         # hostage for N serial iterations (BASELINE round-3 diagnosis)
         P = max(1, getattr(app, "burst_pops", 1))
-        if P > 1:
-            if K != 1:
-                raise ValueError("burst_pops requires max_sends == 1")
-            if MB:
-                raise ValueError("burst_pops with model_bandwidth is "
-                                 "not supported (sequential NIC state)")
+        if P > 1 and MB:
+            # the fluid-NIC CoDel/tx state is sequential per event:
+            # degrade to single pops rather than failing a config that
+            # worked without bursts
+            log.info("burst_pops=%d disabled: model_bandwidth needs "
+                     "sequential per-event NIC state", P)
+            P = 1
+        if P > 1 and K != 1:
+            raise ValueError("burst_pops requires max_sends == 1")
         K_eff = P if P > 1 else K
         M_out = K_eff + T + (1 if MB else 0)
         B = max(1, cfg.outbox_capacity // M_out)
@@ -546,15 +549,30 @@ class DeviceEngine:
                     seed_pair, BOOT_END, lane_t[..., None],
                     gid[:, None, None], seqs3, relv[..., None])
                 win3 = js[None, None, :] < counts[..., None]
-                lost3 = drop3 & win3 & send_valid[..., None]
+                if out.send_mask is not None:
+                    # forwarding a previous hop's survivors: only LIVE
+                    # lanes are packets (seq consumption + roll keys
+                    # still span all `counts` lanes — twin alignment)
+                    smask = jnp.broadcast_to(
+                        out.send_mask, (H_loc, K_eff)) \
+                        .astype(jnp.uint32)
+                    live3 = win3 & (jnp.right_shift(
+                        smask[..., None],
+                        js.astype(jnp.uint32)[None, None, :])
+                        & jnp.uint32(1)).astype(bool)
+                else:
+                    live3 = win3
+                lost3 = drop3 & live3 & send_valid[..., None]
                 surv = jnp.where(
-                    ~drop3 & win3,
+                    ~drop3 & live3,
                     jnp.left_shift(jnp.uint32(1),
                                    js.astype(jnp.uint32)),
                     jnp.uint32(0)).sum(-1, dtype=jnp.uint32)     # [H,K]
                 surv = jnp.where(send_valid, surv, 0)
                 dropped = send_valid & (surv == 0)
                 n_lost = lost3.sum((-2, -1)).astype(jnp.int32)
+                livecnt = (live3 & send_valid[..., None]).sum(
+                    -1, dtype=jnp.int32)                         # [H,K]
             else:
                 dropped = send_valid & packet_drop_mask(
                     seed_pair, BOOT_END, lane_t, gid[:, None],
@@ -562,6 +580,7 @@ class DeviceEngine:
                 surv = jnp.where(send_valid & ~dropped,
                                  jnp.uint32(1), jnp.uint32(0))
                 n_lost = dropped.sum(-1).astype(jnp.int32)
+                livecnt = vcnt
             if MB:
                 # TX fluid bucket (ModelNic.tx_depart): a burst's sends
                 # serialize in slot order; drop-rolled packets still
@@ -581,7 +600,7 @@ class DeviceEngine:
                 depart = lane_t
             delivered = send_valid & ~dropped
             state["n_sent"] = state["n_sent"] + \
-                vcnt.sum(-1).astype(jnp.int32)
+                livecnt.sum(-1).astype(jnp.int32)
             state["n_drop"] = state["n_drop"] + n_lost
 
             # event seq consumed per SEND (delivered or dropped alike),
@@ -696,7 +715,7 @@ class DeviceEngine:
             # the kind field (histogram weight; kind itself is <256)
             bkind = cols(
                 jnp.full((H_loc, K_eff), KIND_PACKET, jnp.int32)
-                | (counts << 8),
+                | (livecnt << 8),
                 jnp.full((H_loc, T), KIND_TIMER, jnp.int32),
                 jnp.full((H_loc, 1), KIND_PACKET_READY, jnp.int32))
             bm = pack2(bdst, bkind)

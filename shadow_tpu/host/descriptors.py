@@ -528,8 +528,9 @@ class DescriptorTable:
     handed out from VFD_BASE upward; lowest-free-slot reuse matches
     kernel fd allocation semantics within the virtual range."""
 
-    def __init__(self, manager):
+    def __init__(self, manager, owner=None):
         self.manager = manager
+        self.owner = owner          # owning ManagedProcess (lock purge)
         self._slots: dict[int, Descriptor] = {}
         self._next = 0
         # close-on-exec is a PER-FD flag (kernel fd table), not a
@@ -583,6 +584,20 @@ class DescriptorTable:
         d.refs -= 1
         if d.refs <= 0:
             d.close(ctx)
+        if isinstance(d, HostFileDesc) and self.owner is not None:
+            # POSIX: closing ANY fd that refers to the file releases
+            # every record lock the owning PROCESS holds on it (this
+            # is the chokepoint — dup2-over, cloexec and explicit
+            # closes all land here). OFD locks die with their
+            # description instead (pruned lazily via d.closed).
+            host = getattr(self.owner, "host", None)
+            table = getattr(host, "_posix_locks", None) if host \
+                else None
+            if table:
+                locks = table.get(d.realpath)
+                if locks:
+                    locks[:] = [e for e in locks
+                                if e[0] is not self.owner]
         return True
 
     def close_all(self, ctx) -> None:

@@ -299,7 +299,7 @@ class ManagedProcess:
         self.host = ctx.host
         self.manager = ctx._m
         self.mem = None
-        self.table = DescriptorTable(self.manager)
+        self.table = DescriptorTable(self.manager, owner=self)
         self.handler = SyscallHandler(self)
         self.channel = native.IpcChannel(self.runtime.arena,
                                          spin_max=self.runtime.spin_max)

@@ -813,7 +813,7 @@ class PtraceProcess(ManagedProcess):
 
         self.host = ctx.host
         self.manager = ctx._m
-        self.table = DescriptorTable(self.manager)
+        self.table = DescriptorTable(self.manager, owner=self)
         self.handler = SyscallHandler(self)
 
         host_dir, stdout_path, stderr_path = self._host_paths()

@@ -1470,20 +1470,11 @@ class SyscallHandler:
 
     def sys_close(self, ctx, a):
         fd = _s32(a[0])
-        desc = self.table.get(fd)
-        if desc is None:
+        if self.table.get(fd) is None:
             return self._no_desc(fd)
-        ok = self.table.close_fd(ctx, fd)
-        if ok and isinstance(desc, HostFileDesc):
-            # POSIX: closing ANY fd that refers to the file releases
-            # every record lock this PROCESS holds on it (OFD locks
-            # die with their description instead)
-            table = getattr(self.p.host, "_posix_locks", None)
-            if table:
-                locks = table.get(desc.realpath)
-                if locks:
-                    locks[:] = [e for e in locks if e[0] is not self.p]
-        return 0 if ok else -EBADF
+        # (record-lock release on close happens at the close_fd
+        # chokepoint — dup2-over and cloexec closes land there too)
+        return 0 if self.table.close_fd(ctx, fd) else -EBADF
 
     # -- file opens + the fd-mediated family (ref file.c/fileat.c) -----
     AT_FDCWD = -100
@@ -2497,10 +2488,12 @@ class SyscallHandler:
         return t
 
     def _read_flock(self, ptr):
+        """-> (raw_bytes, l_type, l_whence, l_start, l_len, l_pid)."""
         raw = self.mem.read(ptr, 32)
         l_type, l_whence = struct.unpack_from("<hh", raw, 0)
         l_start, l_len = struct.unpack_from("<qq", raw, 8)
-        return l_type, l_whence, l_start, l_len
+        l_pid, = struct.unpack_from("<i", raw, 24)
+        return raw, l_type, l_whence, l_start, l_len, l_pid
 
     def _lock_range(self, desc, whence, start, ln):
         """absolute [lo, hi) — hi = 2^63-1 for 'to EOF' (l_len 0)."""
@@ -2578,12 +2571,10 @@ class SyscallHandler:
         if not arg:
             return -EFAULT
         try:
-            raw = self.mem.read(arg, 32)
+            raw, l_type, whence, start, ln, l_pid = \
+                self._read_flock(arg)
         except OSError:
             return -EFAULT
-        l_type, whence = struct.unpack_from("<hh", raw, 0)
-        start, ln = struct.unpack_from("<qq", raw, 8)
-        l_pid, = struct.unpack_from("<i", raw, 24)
         if ofd_cmd and cmd != self.F_OFD_GETLK and l_pid != 0:
             return -EINVAL          # kernel mandates l_pid == 0
         if whence not in (0, 1, 2):

@@ -106,24 +106,37 @@ class TorRelayApp(ModelApp, TorMixin):
                 self.cells_relayed += 1
                 ctx.send(e, size, tuple(data))
             elif me == e:
-                # exit: serve the chunk back toward the client
+                # exit: serve the chunk back toward the client as ONE
+                # packet TRAIN (per-cell drop rolls, survivor bitmask
+                # — the tgen chunk optimization applied to cells)
                 n_cells = data[3]
-                for k in range(CHUNK_CELLS):
-                    seq = start + k
-                    if seq >= n_cells:
-                        break
-                    self.cells_served += 1
-                    ctx.send(m, CELL_BYTES, (TAG_TOR_DATA, circ, seq))
+                cnt = min(CHUNK_CELLS, n_cells - start)
+                if cnt > 0:
+                    self.cells_served += cnt
+                    ctx.send_train(
+                        m, CELL_BYTES * cnt,
+                        (TAG_TOR_DATA, circ, start),
+                        count=CHUNK_CELLS, mask=(1 << cnt) - 1)
         elif tag == TAG_TOR_DATA:
-            circ, seq = data[1], data[2]
+            # a DATA train: (circ, chunk start, survivor mask). Each
+            # hop forwards the SURVIVORS as a new masked train — roll
+            # keys still span all CHUNK_CELLS lanes (device parity)
+            circ, start, surv = data[1], data[2], data[3]
             g, m, e = self._route(ctx, circ)
             me = ctx.host_id
+            live = surv.bit_count()
+            if live == 0:
+                return
             if me == m:
-                self.cells_relayed += 1
-                ctx.send(g, size, (TAG_TOR_DATA, circ, seq))
+                self.cells_relayed += live
+                ctx.send_train(g, CELL_BYTES * live,
+                               (TAG_TOR_DATA, circ, start),
+                               count=CHUNK_CELLS, mask=surv)
             elif me == g:
-                self.cells_relayed += 1
-                ctx.send(circ, size, (TAG_TOR_DATA, circ, seq))
+                self.cells_relayed += live
+                ctx.send_train(circ, CELL_BYTES * live,
+                               (TAG_TOR_DATA, circ, start),
+                               count=CHUNK_CELLS, mask=surv)
 
 
 class TorClientApp(ModelApp, TorMixin):
@@ -173,17 +186,23 @@ class TorClientApp(ModelApp, TorMixin):
         tag = data[0] if data else 0
         if tag != TAG_TOR_DATA:
             return
-        seq = data[2]
+        # a DATA train: (circ, start, survivor mask). Only fresh
+        # in-window bits advance the window — duplicates from a
+        # premature retry must not complete a chunk (tgen rules)
+        start, surv = data[2], data[3]
         chunk_len = min(CHUNK_CELLS, self.cells - self._chunk_start)
-        off = seq - self._chunk_start
-        if off < 0 or off >= chunk_len:
-            return
-        bit = 1 << off
-        if self._mask & bit:
-            return                        # duplicate from a retry
-        self._mask |= bit
-        self._got += 1
-        self.cells_received += 1
+        shift = start - self._chunk_start
+        if shift > 0:
+            window = (surv << shift) & ((1 << chunk_len) - 1)
+        else:
+            window = (surv >> -shift) & ((1 << chunk_len) - 1)
+        fresh = window & ~self._mask
+        if not fresh:
+            return                        # stale chunk / duplicates
+        self._mask |= fresh
+        got_add = fresh.bit_count()
+        self._got += got_add
+        self.cells_received += got_add
         if self._got < chunk_len:
             return
         nxt = self._chunk_start + chunk_len
