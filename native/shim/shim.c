@@ -894,6 +894,10 @@ static const int kFdGatedSyscalls[] = {
     SYS_fdatasync, SYS_fallocate,  SYS_flock,     SYS_fchmod,
     SYS_fchown,    SYS_fgetxattr,  SYS_fsetxattr, SYS_flistxattr,
     SYS_fremovexattr, SYS_fchdir,  SYS_fstatfs,
+    SYS_preadv,    SYS_pwritev,
+#ifdef SYS_preadv2
+    SYS_preadv2,   SYS_pwritev2,
+#endif
     /* dirfd(arg0)-relative path family (ref fileat.c): */
     SYS_unlinkat,  SYS_mkdirat,    SYS_readlinkat, SYS_faccessat,
 #ifdef SYS_faccessat2

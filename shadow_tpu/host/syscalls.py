@@ -104,6 +104,7 @@ NR = dict(
     fremovexattr=199,
     prlimit64=302, prctl=157, set_robust_list=273,
     get_robust_list=274, getrlimit=97, setrlimit=160, fstatfs=138,
+    preadv=295, pwritev=296, preadv2=327, pwritev2=328,
 )
 NR_NAME = {v: k for k, v in NR.items()}
 
@@ -1391,6 +1392,47 @@ class SyscallHandler:
         if self._desc(_s32(a[0])) is None:
             return self._no_desc(_s32(a[0]))
         return self._iov_loop(ctx, a, self.sys_write)
+
+    def _p_iov(self, ctx, a, op):
+        """preadv/pwritev: positioned vector I/O — each iov chunk
+        advances the explicit offset, never the fd position. Per-chunk
+        dispatch through the pread64/pwrite64 handlers keeps the
+        per-type semantics (os-backed files, VirtualFileDesc, ESPIPE
+        for pipes/sockets) in ONE place (ref file.c handlers)."""
+        if self._desc(_s32(a[0])) is None:
+            return self._no_desc(_s32(a[0]))
+        off = _s64(a[3])
+        if off < 0:
+            return -EINVAL
+        total = 0
+        for base, ln in kmem.read_iovec(self.mem, a[1], _s32(a[2])):
+            if ln == 0:
+                continue
+            r = op(ctx, (a[0], base, ln, off + total))
+            if r is NATIVE or (isinstance(r, int) and r < 0):
+                return r if total == 0 else total
+            total += r
+            if r < ln:
+                break
+        return total
+
+    def sys_preadv(self, ctx, a):
+        return self._p_iov(ctx, a, self.sys_pread64)
+
+    def sys_pwritev(self, ctx, a):
+        return self._p_iov(ctx, a, self.sys_pwrite64)
+
+    def sys_preadv2(self, ctx, a):
+        # pos == -1: "use and update the current file offset" — the
+        # readv path; flags (RWF_*) are hint-only for regular files
+        if _s64(a[3]) == -1:
+            return self.sys_readv(ctx, a)
+        return self._p_iov(ctx, a, self.sys_pread64)
+
+    def sys_pwritev2(self, ctx, a):
+        if _s64(a[3]) == -1:
+            return self.sys_writev(ctx, a)
+        return self._p_iov(ctx, a, self.sys_pwrite64)
 
     def sys_pread64(self, ctx, a):
         desc = self._desc(_s32(a[0]))

@@ -12,6 +12,7 @@
 #include <string.h>
 #include <sys/file.h>
 #include <sys/stat.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 static void check(const char *label, int ok) {
@@ -30,6 +31,10 @@ int main(void) {
   check("write", write(fd, "hello world", 11) == 11);
   char buf[64] = {0};
   check("pread", pread(fd, buf, 5, 6) == 5 && !strcmp(buf, "world"));
+  memset(buf, 0, sizeof buf);
+  struct iovec iov[2] = {{buf, 3}, {buf + 8, 2}};
+  check("preadv", preadv(fd, iov, 2, 6) == 5 &&
+        !strncmp(buf, "wor", 3) && !strncmp(buf + 8, "ld", 2));
   check("pwrite", pwrite(fd, "WORLD", 5, 6) == 5);
   check("lseek", lseek(fd, 0, SEEK_SET) == 0);
   memset(buf, 0, sizeof buf);
