@@ -1365,6 +1365,9 @@ class SyscallHandler:
         """Shared readv/writev walk: only the FIRST iov may block (a
         later Blocked must not discard bytes already transferred —
         restart semantics would replay them)."""
+        cnt = _s32(a[2])
+        if cnt <= 0 or cnt > 1024:      # IOV_MAX
+            return -EINVAL
         iov = self._gather_iov(a)
         total = 0
         for base, ln in iov:
@@ -1401,11 +1404,14 @@ class SyscallHandler:
         for pipes/sockets) in ONE place (ref file.c handlers)."""
         if self._desc(_s32(a[0])) is None:
             return self._no_desc(_s32(a[0]))
+        cnt = _s32(a[2])
+        if cnt <= 0 or cnt > 1024:      # IOV_MAX
+            return -EINVAL
         off = _s64(a[3])
         if off < 0:
             return -EINVAL
         total = 0
-        for base, ln in kmem.read_iovec(self.mem, a[1], _s32(a[2])):
+        for base, ln in kmem.read_iovec(self.mem, a[1], cnt):
             if ln == 0:
                 continue
             r = op(ctx, (a[0], base, ln, off + total))
@@ -1422,17 +1428,38 @@ class SyscallHandler:
     def sys_pwritev(self, ctx, a):
         return self._p_iov(ctx, a, self.sys_pwrite64)
 
-    def sys_preadv2(self, ctx, a):
-        # pos == -1: "use and update the current file offset" — the
-        # readv path; flags (RWF_*) are hint-only for regular files
+    # RWF_* flags (uapi): HIPRI/DSYNC/SYNC are accepted as hints on
+    # the os-backed files; NOWAIT is honored only where it cannot
+    # block anyway; APPEND is refused (we do not move the offset)
+    RWF_HIPRI, RWF_DSYNC, RWF_SYNC = 1, 2, 4
+    RWF_NOWAIT, RWF_APPEND = 8, 16
+
+    def _rwf2(self, ctx, a, read: bool):
+        flags = _s32(a[5])
+        known = (self.RWF_HIPRI | self.RWF_DSYNC | self.RWF_SYNC
+                 | self.RWF_NOWAIT | self.RWF_APPEND)
+        if flags & ~known:
+            return -EOPNOTSUPP
+        if flags & self.RWF_APPEND:
+            return -EOPNOTSUPP
+        if flags & self.RWF_NOWAIT:
+            # only regular os-backed files (which never block here);
+            # a pipe/socket would need the kernel's EAGAIN semantics
+            d = self._desc(_s32(a[0]))
+            if not isinstance(d, HostFileDesc):
+                return -EOPNOTSUPP
         if _s64(a[3]) == -1:
-            return self.sys_readv(ctx, a)
-        return self._p_iov(ctx, a, self.sys_pread64)
+            # pos == -1: "use and update the current file offset"
+            return (self.sys_readv if read else self.sys_writev)(
+                ctx, a)
+        return self._p_iov(
+            ctx, a, self.sys_pread64 if read else self.sys_pwrite64)
+
+    def sys_preadv2(self, ctx, a):
+        return self._rwf2(ctx, a, read=True)
 
     def sys_pwritev2(self, ctx, a):
-        if _s64(a[3]) == -1:
-            return self.sys_writev(ctx, a)
-        return self._p_iov(ctx, a, self.sys_pwrite64)
+        return self._rwf2(ctx, a, read=False)
 
     def sys_pread64(self, ctx, a):
         desc = self._desc(_s32(a[0]))
