@@ -130,15 +130,22 @@ def load(config_path: str, policy: str, stop_s: float):
 
 
 def run_device(config_path: str, stop_s: float,
-               engine_cache: dict) -> tuple[float, int, float]:
+               engine_cache: dict,
+               segment_s: float = 0.0) -> tuple[float, int, float]:
     """Warm-compiled device run: (wall_s, packets, sim_s). Raises on
     overflow — a failed capacity plan must fail the bench. stop_time
     is a runtime scalar of the compiled program, so one short warm-up
-    run per config covers every slice length."""
+    run per config covers every slice length. segment_s bounds the
+    sim-time of each device dispatch (trace-identical splitting) —
+    tunneled TPU relays kill executions that run for minutes, so long
+    full runs must not go up as one mega-dispatch."""
     from shadow_tpu import simtime
     from shadow_tpu.core.controller import Controller
 
     cfg = load(config_path, "tpu", stop_s)
+    if segment_s:
+        cfg.experimental.dispatch_segment = \
+            simtime.from_seconds(segment_s)
     c = Controller(cfg)
     if config_path in engine_cache:
         c.runner.engine = engine_cache[config_path]
@@ -396,10 +403,11 @@ def main() -> int:
                 headline = "tgen_10000"
                 full_stop = 5.0
 
-        log(f"{headline}: device full run ({full_stop}s sim)")
+        log(f"{headline}: device full run ({full_stop}s sim, "
+            "2.5s-sim dispatch segments)")
         headline_path = dict((n, p) for n, p, _ in rungs)[headline]
         f_wall, f_pkts, f_sim = run_device(
-            headline_path, full_stop, engine_cache)
+            headline_path, full_stop, engine_cache, segment_s=2.5)
         sim_per_wall = f_sim / f_wall
         log(f"  full: {f_pkts} pkts in {f_wall:.2f}s "
             f"({f_pkts / f_wall:,.0f}/s; {sim_per_wall:.2f} "

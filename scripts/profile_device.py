@@ -32,6 +32,9 @@ def main() -> int:
     cfg = load_config(cfg_path)
     cfg.experimental.scheduler_policy = "tpu"
     cfg.general.stop_time = simtime.from_seconds(stop_s)
+    placement = sys.argv[3] if len(sys.argv) > 3 else None
+    if placement:
+        cfg.experimental.judge_placement = placement
     c = Controller(cfg)
     eng = c.runner.engine
     stop = simtime.from_seconds(stop_s)

@@ -256,6 +256,18 @@ class ExperimentalOptions:
     # H*outbox to H*this). 0 = off; too small fails loudly
     # (x_overflow). Size to the busiest host's sends+timers per phase.
     outbox_compact: int = 0
+    # network-judgment placement on the device engine: "auto" judges
+    # the phase's outbox at flush on TPU (fewer ops in the pop loop)
+    # and in-step on CPU; "flush"/"step" pin it. Bit-identical traces
+    # either way.
+    judge_placement: str = "auto"   # auto | flush | step
+    # max simulated time per device dispatch (ns; 0 = unbounded):
+    # long runs split into several invocations of the one compiled
+    # program with identical traces (window clamping stays on the
+    # global stop). Tunneled TPU relays kill executions that run for
+    # minutes, so bench full runs bound each dispatch to a few
+    # wall-seconds of work.
+    dispatch_segment: int = 0
     mesh_axis: str = "hosts"
     device_batch_rounds: int = 64   # rounds fused into one device while_loop
     # hybrid mode: which CPU policy drives host emulation while the
@@ -275,7 +287,7 @@ class ExperimentalOptions:
         for f in dataclasses.fields(cls):
             if f.name in d:
                 v = d[f.name]
-                if f.name == "runahead":
+                if f.name in ("runahead", "dispatch_segment"):
                     v = parse_time_ns(v)
                 elif f.name in ("interface_buffer", "socket_recv_buffer",
                                 "socket_send_buffer"):
@@ -295,6 +307,8 @@ class ExperimentalOptions:
                       out.router_queue, ("codel", "single", "static"))
         _check_choice("experimental", "exchange",
                       out.exchange, ("all_gather", "all_to_all"))
+        _check_choice("experimental", "judge_placement",
+                      out.judge_placement, ("auto", "flush", "step"))
         from shadow_tpu.host.tcp import CONGESTION_ALGORITHMS
         _check_choice("experimental", "tcp_congestion",
                       out.tcp_congestion,
@@ -304,6 +318,7 @@ class ExperimentalOptions:
                       [p for p in SCHEDULER_POLICIES
                        if p not in ("tpu", "hybrid")])
         for name, minimum in (("event_capacity", 1),
+                              ("dispatch_segment", 0),
                               ("outbox_capacity", 1),
                               ("exchange_capacity", 0),
                               ("exchange_in_capacity", 0),

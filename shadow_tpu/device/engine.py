@@ -55,7 +55,7 @@ from shadow_tpu.core.event import (
 from shadow_tpu.device import prng
 from shadow_tpu.device.apps import DeviceApp
 from shadow_tpu.device.netsem import packet_drop_mask
-from shadow_tpu.utils.rng import PURPOSE_APP
+from shadow_tpu.utils.rng import PURPOSE_APP, PURPOSE_PACKET_DROP
 
 from shadow_tpu.utils.checksum import (
     CHK_KIND,
@@ -116,6 +116,14 @@ class EngineConfig:
     # (drop-rolled included) accumulated at flush time. Costs one
     # extra flat sort per flush; requires V*V <= 65536.
     count_paths: bool = False
+    # network-judgment placement: True = judge the whole phase's
+    # outbox once at flush (fewer ops in the serial pop loop — the
+    # right trade on TPU, where per-op dispatch in the while body
+    # dominates); False = judge each pop iteration in-step (the right
+    # trade on one CPU core, where the loop is cheap and the batched
+    # judge's extra memory traffic is not). None = auto by platform.
+    # Traces are bit-identical either way (tests pin both).
+    judge_hoist: Optional[bool] = None
 
 
 class DeviceEngine:
@@ -167,6 +175,7 @@ class DeviceEngine:
 
         self._shard_spec = P(AXIS)
         self._repl_spec = P()
+        self._heap_builder = None       # jitted lazily by init_state
         self._build_program()
 
     # ------------------------------------------------------------------
@@ -188,51 +197,67 @@ class DeviceEngine:
         plus a per-host `head` cursor: slots < head are consumed; the
         next event of host h is always column head[h]. Rows re-sort
         only at flush (one lax.sort per phase) — no scatters anywhere.
-        """
+
+        The [H,E] heaps are BUILT ON DEVICE from [H] boot/stop vectors:
+        over a tunneled TPU the heap upload would otherwise dominate
+        small-slice wall time (~20 MB at the 10k rung, ~250 MB at
+        tor_large; the vectors are a few hundred KB)."""
         H, E = self.H_pad, self.config.event_capacity
-        t = np.full((H, E), INF, dtype=np.int64)
-        src = np.zeros((H, E), dtype=np.int64)
-        seq = np.zeros((H, E), dtype=np.int64)
-        kind = np.zeros((H, E), dtype=np.int64)
+        if E < 2:
+            raise ValueError("event_capacity must be >= 2 (boot+stop)")
+        t0s = np.full(H, INF, dtype=np.int64)
+        t1s = np.full(H, INF, dtype=np.int64)
         event_seq = np.zeros(H, dtype=np.int32)
-        fill = np.zeros(H, dtype=np.int32)
-
-        def _push(h, when, k):
-            slot = fill[h]
-            if slot >= E:
-                raise ValueError(f"host {h}: too many boot events for "
-                                 f"event_capacity={E}")
-            t[h, slot] = when
-            src[h, slot] = h
-            seq[h, slot] = event_seq[h]
-            kind[h, slot] = k
-            event_seq[h] += 1
-            fill[h] += 1
-
         for entry in starts:
-            host_id, t_start, t_stop = entry[0], entry[1], entry[2]
-            _push(host_id, t_start, KIND_BOOT)
+            h, t_start, t_stop = entry[0], entry[1], entry[2]
+            if t0s[h] != INF:
+                raise ValueError(
+                    f"host {h}: multiple processes per host are not "
+                    "supported by the device engine")
+            t0s[h] = t_start
+            event_seq[h] = 1
             if t_stop is not None and t_stop >= 0:
-                _push(host_id, t_stop, KIND_STOP)
+                if t_stop < t_start:
+                    raise ValueError(
+                        f"host {h}: stop_time {t_stop} precedes "
+                        f"start_time {t_start}")
+                t1s[h] = t_stop
+                event_seq[h] = 2
 
-        # sort rows by (t, src, seq): stable secondary-then-primary
-        k2 = (src << 32) | seq
-        k2[t >= INF] = IMAX
-        order = np.argsort(k2, axis=1, kind="stable")
-        t = np.take_along_axis(t, order, axis=1)
-        k2 = np.take_along_axis(k2, order, axis=1)
-        kind = np.take_along_axis(kind, order, axis=1)
-        order = np.argsort(t, axis=1, kind="stable")
-        t = np.take_along_axis(t, order, axis=1)
-        k2 = np.take_along_axis(k2, order, axis=1)
-        kind = np.take_along_axis(kind, order, axis=1)
+        shard = NamedSharding(self.mesh, self._shard_spec)
+
+        if self._heap_builder is None:
+            def _build(t0, t1):
+                hid = jnp.arange(H, dtype=jnp.int64)
+                padt = jnp.full((H, E - 2), INF, jnp.int64)
+                ht = jnp.concatenate([t0[:, None], t1[:, None], padt],
+                                     1)
+                # rows are (t, src, seq)-sorted by construction: boot
+                # (seq 0) precedes stop (seq 1), validated host-side
+                hk = jnp.concatenate([
+                    jnp.where(t0 < INF, hid << 32, IMAX)[:, None],
+                    jnp.where(t1 < INF, (hid << 32) | 1,
+                              IMAX)[:, None],
+                    jnp.full((H, E - 2), IMAX, jnp.int64)], 1)
+                padz = jnp.zeros((H, E - 2), jnp.int64)
+                hm = jnp.concatenate([
+                    jnp.where(t0 < INF,
+                              jnp.int64(KIND_BOOT) << 32, 0)[:, None],
+                    jnp.where(t1 < INF,
+                              jnp.int64(KIND_STOP) << 32, 0)[:, None],
+                    padz], 1)
+                z2 = jnp.zeros((H, E), jnp.int64)
+                return ht, hk, hm, z2, z2
+
+            self._heap_builder = jax.jit(_build,
+                                         out_shardings=(shard,) * 5)
+
+        ht, hk, hm, hv, hw = self._heap_builder(
+            jax.device_put(jnp.asarray(t0s), shard),
+            jax.device_put(jnp.asarray(t1s), shard))
 
         zeros_i32 = np.zeros(H, dtype=np.int32)
-        state = {
-            "ht": t, "hk": k2,
-            "hm": kind << 32,            # kind<<32 | size(=0)
-            "hv": np.zeros((H, E), dtype=np.int64),
-            "hw": np.zeros((H, E), dtype=np.int64),
+        small = {
             "head": zeros_i32.copy(),
             "event_seq": event_seq,
             "packet_seq": zeros_i32.copy(),
@@ -248,15 +273,16 @@ class DeviceEngine:
         }
         if self.config.count_paths:
             V = self.n_vertices
-            state["path_cnt"] = np.zeros((self.n_shards, V * V),
+            small["path_cnt"] = np.zeros((self.n_shards, V * V),
                                          dtype=np.int64)
         if self.config.model_bandwidth:
             # model-NIC scalars (host/model_nic.py ModelNic twin)
             for k in NIC_KEYS:
-                state[k] = np.zeros(H, dtype=np.int64)
-        shard = NamedSharding(self.mesh, self._shard_spec)
-        return {k: jax.device_put(jnp.asarray(v), shard)
-                for k, v in state.items()}
+                small[k] = np.zeros(H, dtype=np.int64)
+        state = {k: jax.device_put(jnp.asarray(v), shard)
+                 for k, v in small.items()}
+        state.update(ht=ht, hk=hk, hm=hm, hv=hv, hw=hw)
+        return state
 
     # ------------------------------------------------------------------
     # the jitted program (v2: scatter-free)
@@ -323,6 +349,25 @@ class DeviceEngine:
                 min(R, max(64, E, (4 * R + n_shards - 1) // n_shards))
         else:
             CAP = 0
+
+        # Judgment hoist: without the fluid NIC, a send's network
+        # judgment (latency gather + drop rolls + causality bump) does
+        # not feed back into the pop loop — the only in-loop consumer
+        # is the dirty bit, which needs just the host's SELF-latency.
+        # So the while-body writes raw send rows (depart time, train
+        # count, live mask) and the whole phase is judged ONCE over
+        # the outbox at flush time (_judge_outbox): ~40% fewer ops in
+        # the serial loop, identical keys and values, bit-identical
+        # traces. The fluid NIC keeps the legacy in-step path (its
+        # tx/rx buckets are sequential per event).
+        platform = self.mesh.devices.flat[0].platform
+        HOIST = (not MB) and (cfg.judge_hoist
+                              if cfg.judge_hoist is not None
+                              else platform == "tpu")
+        # statically lossless topologies (all reliability == 1) never
+        # drop: packet_drop_mask is False for every row regardless of
+        # the roll, so the threefry batch is skipped outright
+        ALL_REL1 = bool((self.reliability >= 1.0).all())
 
         # model-NIC constants (host/model_nic.py twins; keep in
         # lockstep with its arithmetic — trace equality depends on it)
@@ -522,32 +567,53 @@ class DeviceEngine:
                     if out.send_count is not None
                     else jnp.ones((H_loc, K_eff), jnp.int32), 1, C)
                 vcnt = counts * send_valid
-                ccum = jnp.cumsum(vcnt, axis=-1) - vcnt
-                pkt_seq = state["packet_seq"][:, None] + ccum
                 state["packet_seq"] = state["packet_seq"] + \
                     vcnt.sum(-1).astype(jnp.int32)
             else:
                 counts = jnp.ones((H_loc, K_eff), jnp.int32)
                 vcnt = send_valid.astype(jnp.int32)
-                pkt_seq = state["packet_seq"][:, None] + vrank
                 state["packet_seq"] = state["packet_seq"] + \
                     send_valid.sum(-1).astype(jnp.int32)
 
             dst = out.send_dst                                   # [H,K]
-            srcv = host_vertex[gid][:, None]
-            dstv = host_vertex[jnp.clip(dst, 0, H_pad - 1)]
-            latv = lat[srcv, dstv].astype(jnp.int64)             # [H,K]
-            relv = rel[srcv, dstv]
-            if C > 1:
+            if HOIST:
+                # raw rows only: depart time (== the popped event
+                # time — also the drop-roll key time the judge
+                # re-derives), train count, and the live-lane mask.
+                # _judge_outbox settles drops/latency once per phase.
+                depart = lane_t
+                if out.send_mask is not None:
+                    smask = jnp.broadcast_to(
+                        out.send_mask, (H_loc, K_eff)).astype(jnp.int32)
+                else:
+                    smask = jnp.full((H_loc, K_eff), -1, jnp.int32)
+            else:
+                if C > 1:
+                    ccum = jnp.cumsum(vcnt, axis=-1) - vcnt
+                    pkt_seq = state["packet_seq"][:, None] - \
+                        vcnt.sum(-1).astype(jnp.int32)[:, None] + ccum
+                else:
+                    pkt_seq = state["packet_seq"][:, None] - \
+                        send_valid.sum(-1).astype(jnp.int32)[:, None] \
+                        + vrank
+                srcv = host_vertex[gid][:, None]
+                dstv = host_vertex[jnp.clip(dst, 0, H_pad - 1)]
+                latv = lat[srcv, dstv].astype(jnp.int64)         # [H,K]
+                relv = rel[srcv, dstv]
+            if not HOIST and C > 1:
                 # packet TRAINS: one drop roll per packet, keyed by the
                 # exact (src, pkt_seq0+j) sequence individual sends
                 # would consume — loss statistics are bit-identical to
                 # per-packet sends; survivors become the d2 bitmask
                 js = jnp.arange(C, dtype=jnp.int32)              # [C]
-                seqs3 = pkt_seq[..., None] + js                  # [H,K,C]
-                drop3 = packet_drop_mask(
-                    seed_pair, BOOT_END, lane_t[..., None],
-                    gid[:, None, None], seqs3, relv[..., None])
+                if ALL_REL1:
+                    # statically lossless: the roll can never drop
+                    drop3 = jnp.zeros((H_loc, K_eff, C), bool)
+                else:
+                    seqs3 = pkt_seq[..., None] + js              # [H,K,C]
+                    drop3 = packet_drop_mask(
+                        seed_pair, BOOT_END, lane_t[..., None],
+                        gid[:, None, None], seqs3, relv[..., None])
                 win3 = js[None, None, :] < counts[..., None]
                 if out.send_mask is not None:
                     # forwarding a previous hop's survivors: only LIVE
@@ -573,10 +639,12 @@ class DeviceEngine:
                 n_lost = lost3.sum((-2, -1)).astype(jnp.int32)
                 livecnt = (live3 & send_valid[..., None]).sum(
                     -1, dtype=jnp.int32)                         # [H,K]
-            else:
-                dropped = send_valid & packet_drop_mask(
-                    seed_pair, BOOT_END, lane_t, gid[:, None],
-                    pkt_seq, relv)
+            elif not HOIST:
+                dropped = send_valid & (
+                    jnp.zeros((H_loc, K_eff), bool) if ALL_REL1
+                    else packet_drop_mask(
+                        seed_pair, BOOT_END, lane_t, gid[:, None],
+                        pkt_seq, relv))
                 surv = jnp.where(send_valid & ~dropped,
                                  jnp.uint32(1), jnp.uint32(0))
                 n_lost = dropped.sum(-1).astype(jnp.int32)
@@ -596,12 +664,13 @@ class DeviceEngine:
                 depart = tx_base[:, None] + (cum - ser_up)
                 state["tx_free"] = jnp.where(
                     runnable, tx_base + cum[:, -1], state["tx_free"])
-            else:
+            elif not HOIST:
                 depart = lane_t
-            delivered = send_valid & ~dropped
-            state["n_sent"] = state["n_sent"] + \
-                livecnt.sum(-1).astype(jnp.int32)
-            state["n_drop"] = state["n_drop"] + n_lost
+            if not HOIST:
+                delivered = send_valid & ~dropped
+                state["n_sent"] = state["n_sent"] + \
+                    livecnt.sum(-1).astype(jnp.int32)
+                state["n_drop"] = state["n_drop"] + n_lost
 
             # event seq consumed per SEND (delivered or dropped alike),
             # matching the CPU engines — lets the CPU side defer drop
@@ -609,14 +678,16 @@ class DeviceEngine:
             ev_seq = state["event_seq"][:, None] + vrank
             n_snt = send_valid.sum(-1).astype(jnp.int32)
 
-            deliver_t = depart + latv
-            cross = dst != gid[:, None]
-            # cross-host causality bump (host_single.c:174-220); self
-            # packets keep their true time — they may run this window
-            # (the flush + another phase makes them poppable)
-            deliver_t = jnp.where(cross,
-                                  jnp.maximum(deliver_t, win_end),
-                                  deliver_t)
+            if not HOIST:
+                deliver_t = depart + latv
+                cross = dst != gid[:, None]
+                # cross-host causality bump (host_single.c:174-220);
+                # self packets keep their true time — they may run this
+                # window (the flush + another phase makes them
+                # poppable)
+                deliver_t = jnp.where(cross,
+                                      jnp.maximum(deliver_t, win_end),
+                                      deliver_t)
 
             # model-NIC RX stage (ModelNic.rx_deliver twin): the popped
             # KIND_PACKET row passes the download bucket + event-driven
@@ -694,15 +765,27 @@ class DeviceEngine:
 
             gcol = jnp.broadcast_to(gid[:, None], (H_loc, K_eff))
             gcolT = jnp.broadcast_to(gid[:, None], (H_loc, T))
-            if CP:
+            if HOIST:
+                # raw rows: depart time, train COUNT in the kind field
+                # (the judge rewrites it with the live count), and the
+                # live-lane mask where the judge puts the survivors
+                bvalid_send = send_valid
+                send_t = depart
+                kcnt = counts
+                vhi = smask
+            elif CP:
                 # drop-rolled sends ride along under the reserved
                 # DROP_T marker so the flush's path histogram counts
                 # them (ref counts per SENT packet, worker.c:554)
                 bvalid_send = send_valid
                 send_t = jnp.where(delivered, deliver_t, DROP_T)
+                kcnt = livecnt
+                vhi = surv.astype(jnp.int32)
             else:
                 bvalid_send = delivered
                 send_t = deliver_t
+                kcnt = livecnt
+                vhi = surv.astype(jnp.int32)
             bvalid = cols(bvalid_send, timer_valid, rx_keep[:, None])
             bt = jnp.where(bvalid,
                            cols(send_t, timer_t,
@@ -715,7 +798,7 @@ class DeviceEngine:
             # the kind field (histogram weight; kind itself is <256)
             bkind = cols(
                 jnp.full((H_loc, K_eff), KIND_PACKET, jnp.int32)
-                | (livecnt << 8),
+                | (kcnt << 8),
                 jnp.full((H_loc, T), KIND_TIMER, jnp.int32),
                 jnp.full((H_loc, 1), KIND_PACKET_READY, jnp.int32))
             bm = pack2(bdst, bkind)
@@ -723,7 +806,7 @@ class DeviceEngine:
                             jnp.zeros((H_loc, T), jnp.int32),
                             psize[:, None]),
                        cols(out.send_d0, out.timer_d0, pd0[:, None]))
-            bv = pack2(cols(surv.astype(jnp.int32),
+            bv = pack2(cols(vhi,
                             jnp.zeros((H_loc, T), jnp.int32),
                             pd2[:, None]),
                        cols(out.send_d1,
@@ -736,9 +819,24 @@ class DeviceEngine:
                 ob[f] = lax.dynamic_update_slice(ob[f], block,
                                                  (jnp.int32(0), col0))
 
-            in_win = bvalid & (bt < win_end) & \
-                (bdst == gid[:, None])
-            dirty = dirty | (runnable & in_win.any(-1))
+            if HOIST:
+                # the judge hasn't run, so in-window detection uses the
+                # host's SELF-latency (self rows never take the bump);
+                # conservative over drop rolls — a later-dropped self
+                # send still stalls the host one phase, which only
+                # moves the phase boundary, never the per-host pop
+                # order (the trace is bit-identical either way)
+                selflat = lat[host_vertex[gid],
+                              host_vertex[gid]].astype(jnp.int64)
+                self_in = send_valid & (dst == gid[:, None]) & \
+                    (depart + selflat[:, None] < win_end)
+                tim_in = timer_valid & (timer_t < win_end)
+                dirty = dirty | (runnable &
+                                 (self_in.any(-1) | tim_in.any(-1)))
+            else:
+                in_win = bvalid & (bt < win_end) & \
+                    (bdst == gid[:, None])
+                dirty = dirty | (runnable & in_win.any(-1))
 
             return state, ob, blk + 1, dirty
 
@@ -851,7 +949,99 @@ class DeviceEngine:
                 jnp.maximum(0, counts - IN).astype(jnp.int32)
             return state, _seg_take(perm, rows, starts, counts, IN)
 
-        def _exchange(state, ob, gid, my_shard, host_vertex):
+        def _judge_outbox(state, ob, gid, host_vertex, lat, rel,
+                          win_end):
+            """Per-phase network judgment of the raw outbox — the
+            worker_sendPacket semantics (ref worker.c:520-579) hoisted
+            out of the pop loop: latency gather, per-packet drop rolls
+            under EXACTLY the keys the in-step path would use (src,
+            per-source packet seq, send time), causality bump, and the
+            sent/dropped counters. Runs once per phase over [H, OB]
+            instead of once per pop iteration over [H, K]."""
+            ft, fm, fv = ob["t"], ob["m"], ob["v"]
+            kindrow = lo32(fm)
+            is_send = (ft < INF) & ((kindrow & 0xFF) == KIND_PACKET)
+            cnt = jnp.where(is_send, kindrow >> 8, 0)        # [H,OB]
+            dst = hi32(fm)
+            srcv = host_vertex[gid][:, None]
+            dstv = host_vertex[jnp.clip(dst, 0, H_pad - 1)]
+            latv = lat[srcv, dstv].astype(jnp.int64)
+            relv = rel[srcv, dstv]
+
+            # per-row packet-seq base: state["packet_seq"] is already
+            # the END of the phase; outbox columns sit in consumption
+            # order (iteration block, then send lane), so an exclusive
+            # prefix over the train counts recovers each row's base
+            tot = cnt.sum(-1)
+            base = (state["packet_seq"] - tot)[:, None] + \
+                (jnp.cumsum(cnt, axis=-1) - cnt)
+
+            # live lanes are a 2D popcount (mask ∩ count window); the
+            # ONLY [H,OB,C] reduce is the survivor bitmask, and it is
+            # the single consumer of the threefry product — extra
+            # reduce roots would each re-read (or recompute) the
+            # materialized 3D tensor, which measured 3x the whole
+            # judge's budget on CPU
+            wbits = jnp.where(
+                cnt >= 32, jnp.uint32(0xFFFFFFFF),
+                jnp.left_shift(jnp.uint32(1),
+                               jnp.clip(cnt, 0, 31).astype(jnp.uint32))
+                - jnp.uint32(1))
+            livemask = hi32(fv).astype(jnp.uint32) & wbits   # [H,OB]
+            livecnt = lax.population_count(livemask) \
+                .astype(jnp.int32)
+            if ALL_REL1:
+                # statically lossless: the roll can never drop
+                surv = livemask
+            else:
+                js = jnp.arange(C, dtype=jnp.int32)
+                live3 = (jnp.right_shift(
+                    livemask[..., None],
+                    js.astype(jnp.uint32)[None, None, :])
+                    & jnp.uint32(1)).astype(bool)            # [H,OB,C]
+                seqs3 = base[..., None] + js
+                hk1, hk2 = prng.purpose_id_key(
+                    seed_pair, PURPOSE_PACKET_DROP, gid)     # [H] each
+                drop3 = packet_drop_mask(
+                    seed_pair, BOOT_END, ft[..., None],
+                    gid[:, None, None], seqs3, relv[..., None],
+                    src_key=(hk1[:, None, None], hk2[:, None, None]))
+                surv = jnp.where(
+                    live3 & ~drop3,
+                    jnp.left_shift(jnp.uint32(1),
+                                   js.astype(jnp.uint32)),
+                    jnp.uint32(0)).sum(-1, dtype=jnp.uint32)
+            lost = livecnt - lax.population_count(surv) \
+                .astype(jnp.int32)
+            state["n_sent"] = state["n_sent"] + \
+                livecnt.sum(-1).astype(jnp.int32)
+            state["n_drop"] = state["n_drop"] + \
+                lost.sum(-1).astype(jnp.int32)
+
+            deliver_t = ft + latv
+            cross = dst != gid[:, None]
+            # cross-host causality bump (host_single.c:174-220); self
+            # rows keep their true time
+            deliver_t = jnp.where(cross,
+                                  jnp.maximum(deliver_t, win_end),
+                                  deliver_t)
+            dead = is_send & (surv == 0)
+            dead_t = DROP_T if CP else INF
+            new_t = jnp.where(
+                is_send, jnp.where(dead, dead_t, deliver_t), ft)
+            new_m = jnp.where(
+                is_send,
+                pack2(dst, jnp.int32(KIND_PACKET) | (livecnt << 8)),
+                fm)
+            new_v = jnp.where(
+                is_send, pack2(surv.astype(jnp.int32), lo32(fv)), fv)
+            return state, {**ob, "t": new_t, "m": new_m, "v": new_v}
+
+        def _exchange(state, ob, gid, my_shard, host_vertex, lat, rel,
+                      win_end):
+            if HOIST:
+                state, ob = _judge_outbox(state, ob, gid, host_vertex,
+                                          lat, rel, win_end)
             if CP:
                 state = _count_paths(state, ob, host_vertex)
             state, skey, perm, rows = _flat_sorted(state, ob, gid)
@@ -1016,7 +1206,8 @@ class DeviceEngine:
                 return lax.cond(
                     go,
                     lambda s: _exchange(s, ob, gid, my_shard,
-                                        host_vertex),
+                                        host_vertex, lat, rel,
+                                        win_end),
                     lambda s: s,
                     state2)
 
@@ -1103,10 +1294,11 @@ class DeviceEngine:
                 (state, ob, jnp.int32(0), dirty))
             return state, ob, jnp.reshape(blk, (1,))
 
-        def _flush_shard(state, ob, host_vertex):
+        def _flush_shard(state, ob, host_vertex, lat, rel, win_end):
             my_shard = lax.axis_index(AXIS)
             gid = (my_shard * H_loc + hidx).astype(jnp.int32)
-            return _exchange(state, ob, gid, my_shard, host_vertex)
+            return _exchange(state, ob, gid, my_shard, host_vertex,
+                             lat, rel, win_end)
 
         spec_keys = ("ht", "hk", "hm", "hv", "hw", "head",
                      "event_seq", "packet_seq", "app_seq", "app",
@@ -1137,7 +1329,7 @@ class DeviceEngine:
         ))
         self._flush_phase = jax.jit(jax.shard_map(
             _flush_shard, mesh=self.mesh,
-            in_specs=(specs, ob_specs, repl),
+            in_specs=(specs, ob_specs, repl, repl, repl, repl),
             out_specs=specs,
             check_vma=False,
         ))
@@ -1207,7 +1399,8 @@ class DeviceEngine:
         win0 = jnp.int64(0)
         s_w, ob_w, _ = self._pop_phase(state, _ob(), hv, lat, rel,
                                        win0)
-        jax.block_until_ready(self._flush_phase(s_w, ob_w, hv))
+        jax.block_until_ready(self._flush_phase(s_w, ob_w, hv, lat,
+                                                rel, win0))
         jax.block_until_ready(self._probe(state))
         prof["compile_s"] = _time.perf_counter() - t0
 
@@ -1226,7 +1419,8 @@ class DeviceEngine:
                 prof["pop_s"] += _time.perf_counter() - t0
 
                 t0 = _time.perf_counter()
-                state = self._flush_phase(state, ob, hv)
+                state = self._flush_phase(state, ob, hv, lat, rel,
+                                          win_end)
                 jax.block_until_ready(state)
                 prof["flush_s"] += _time.perf_counter() - t0
                 prof["phases"] += 1

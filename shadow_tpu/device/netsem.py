@@ -20,12 +20,23 @@ from shadow_tpu.utils.rng import PURPOSE_PACKET_DROP
 
 
 def packet_drop_mask(seed_pair, boot_end, now, src, pkt_seq,
-                     reliability):
+                     reliability, src_key=None):
     """Elementwise drop decision; all args broadcastable arrays.
     `now` is the send time (i64), `reliability` the gathered per-path
-    value (f32). Returns a bool mask, True = dropped."""
-    u = prng.uniform01(prng.chain_key(
-        seed_pair, PURPOSE_PACKET_DROP, src, pkt_seq))
+    value (f32). Returns a bool mask, True = dropped.
+
+    `src_key` (optional): a precomputed
+    prng.purpose_id_key(seed_pair, PURPOSE_PACKET_DROP, src) — pass it
+    when `src` is a small array broadcast against a much larger
+    pkt_seq (the per-phase outbox judge) so the two id folds run once
+    at src's shape instead of the full broadcast. Bit-identical
+    either way."""
+    if src_key is None:
+        key = prng.chain_key(seed_pair, PURPOSE_PACKET_DROP, src,
+                             pkt_seq)
+    else:
+        key = prng.fold_seq(src_key, pkt_seq)
+    u = prng.uniform01(key)
     lossy = reliability < 1.0
     not_boot = now >= boot_end
     return lossy & not_boot & (u >= reliability)

@@ -68,6 +68,36 @@ def uniform01(key):
         - jnp.float32(1.0)
 
 
+def purpose_id_key(seed_pair, purpose, ids):
+    """The first two chain_key folds — (purpose, id) — computed at the
+    ids' own (small) shape. Combine with fold_seq for the final
+    per-seq fold: fold_seq(purpose_id_key(s, p, ids), seqs) is
+    bit-identical to chain_key(s, p, ids, seqs) but lets the caller
+    amortize the id folds when seqs is a much larger broadcast (the
+    optimization_barriers below otherwise force ALL three folds to
+    materialize at the broadcast shape)."""
+    ids = jnp.asarray(ids).astype(jnp.uint32)
+    zero = jnp.zeros_like(ids)
+    k1 = jnp.broadcast_to(seed_pair[0], ids.shape)
+    k2 = jnp.broadcast_to(seed_pair[1], ids.shape)
+    k = threefry2x32(k1, k2, zero,
+                     jnp.full(ids.shape, purpose, jnp.uint32))
+    k = jax.lax.optimization_barrier(k)
+    k = threefry2x32(k[0], k[1], zero, ids)
+    return jax.lax.optimization_barrier(k)
+
+
+def fold_seq(key, seqs):
+    """The last chain_key fold: fold_in(key, seqs) broadcast over
+    seqs. See purpose_id_key."""
+    seqs = jnp.asarray(seqs).astype(jnp.uint32)
+    shape = jnp.broadcast_shapes(key[0].shape, seqs.shape)
+    seqs = jnp.broadcast_to(seqs, shape)
+    zero = jnp.zeros(shape, jnp.uint32)
+    return threefry2x32(jnp.broadcast_to(key[0], shape),
+                        jnp.broadcast_to(key[1], shape), zero, seqs)
+
+
 def chain_key(seed_pair, purpose, ids, seqs):
     """fold(fold(fold(seed, purpose), id), seq) — vectorized over
     ids/seqs arrays (matches utils.rng.packet_key / nprng.packet_uniform:
@@ -82,14 +112,7 @@ def chain_key(seed_pair, purpose, ids, seqs):
     ids = jnp.asarray(ids).astype(jnp.uint32)
     seqs = jnp.asarray(seqs).astype(jnp.uint32)
     shape = jnp.broadcast_shapes(ids.shape, seqs.shape)
-    ids = jnp.broadcast_to(ids, shape)
-    seqs = jnp.broadcast_to(seqs, shape)
-    zero = jnp.zeros(shape, jnp.uint32)
-    k1 = jnp.broadcast_to(seed_pair[0], shape)
-    k2 = jnp.broadcast_to(seed_pair[1], shape)
-    k = threefry2x32(k1, k2, zero, jnp.full(shape, purpose, jnp.uint32))
-    k = jax.lax.optimization_barrier(k)
-    k = threefry2x32(k[0], k[1], zero, ids)
-    k = jax.lax.optimization_barrier(k)
-    k = threefry2x32(k[0], k[1], zero, seqs)
-    return k
+    return fold_seq(
+        purpose_id_key(seed_pair, purpose,
+                       jnp.broadcast_to(ids, shape)),
+        jnp.broadcast_to(seqs, shape))

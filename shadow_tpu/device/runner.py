@@ -193,6 +193,9 @@ class DeviceRunner:
                 outbox_compact=cfg.experimental.outbox_compact,
                 model_bandwidth=cfg.experimental.model_bandwidth,
                 count_paths=cfg.experimental.count_paths,
+                judge_hoist={"auto": None, "flush": True,
+                             "step": False}[
+                    cfg.experimental.judge_placement],
             ),
             self.app,
             host_vertex=sim.netmodel.host_vertex.astype(np.int32),
@@ -236,17 +239,26 @@ class DeviceRunner:
         state = self.engine.init_state(self.sim.starts)
         t0 = _time.perf_counter()
         hb = self.sim.cfg.general.heartbeat_interval
-        if hb:
+        seg = self.sim.cfg.experimental.dispatch_segment
+        if hb or seg:
             # pause the (single compiled) device program at each
-            # heartbeat boundary; window clamping stays on the global
-            # stop so the trace equals an unsegmented run
+            # heartbeat boundary and/or dispatch-segment boundary;
+            # window clamping stays on the global stop so the trace
+            # equals an unsegmented run
             rounds = 0
             budget = self.engine.config.max_rounds
-            t = min(hb, stop)
-            while True:
+            t = 0
+            next_hb = hb if hb else None
+            while t < stop:
+                nxt = stop
+                if next_hb is not None:
+                    nxt = min(nxt, next_hb)
+                if seg:
+                    nxt = min(nxt, t + seg)
                 state, seg_rounds = self.engine.run(
-                    state, stop=t, final_stop=stop)
+                    state, stop=nxt, final_stop=stop)
                 rounds += int(seg_rounds)
+                t = nxt
                 if rounds >= budget:
                     # the per-invocation cap would otherwise reset per
                     # segment; enforce it cumulatively and don't emit
@@ -255,17 +267,20 @@ class DeviceRunner:
                                 "heartbeat segmentation; stopping",
                                 budget)
                     break
-                if t >= stop:
-                    break
-                self._emit_heartbeats(t, state)
-                t = min(t + hb, stop)
-            final = jax.device_get(state)
+                if next_hb is not None and t >= next_hb and t < stop:
+                    self._emit_heartbeats(t, state)
+                    next_hb += hb
         else:
             # pass stop explicitly: a cached/reused engine may have
             # been built for a different stop_time (runtime scalar)
-            final, rounds = self.engine.run(state, stop=stop)
-            final = jax.device_get(final)
+            state, rounds = self.engine.run(state, stop=stop)
             rounds = int(rounds)
+        # fetch ONLY the stats the controller needs — the [H,E] event
+        # heaps are ~20 MB at the 10k rung (250 MB at tor_large) and
+        # dominate wall time over a tunneled TPU if pulled back
+        stat_keys = [k for k in state
+                     if k not in ("ht", "hk", "hm", "hv", "hw")]
+        final = jax.device_get({k: state[k] for k in stat_keys})
         wall = _time.perf_counter() - t0
         self.final_state = final
         H = len(self.sim.hosts)

@@ -1366,8 +1366,10 @@ class SyscallHandler:
         later Blocked must not discard bytes already transferred —
         restart semantics would replay them)."""
         cnt = _s32(a[2])
-        if cnt <= 0 or cnt > 1024:      # IOV_MAX
+        if cnt < 0 or cnt > 1024:       # IOV_MAX
             return -EINVAL
+        if cnt == 0:                    # kernel: zero segs reads 0
+            return 0
         iov = self._gather_iov(a)
         total = 0
         for base, ln in iov:
@@ -1405,8 +1407,10 @@ class SyscallHandler:
         if self._desc(_s32(a[0])) is None:
             return self._no_desc(_s32(a[0]))
         cnt = _s32(a[2])
-        if cnt <= 0 or cnt > 1024:      # IOV_MAX
+        if cnt < 0 or cnt > 1024:       # IOV_MAX
             return -EINVAL
+        if cnt == 0:                    # kernel: zero segs transfers 0
+            return 0
         off = _s64(a[3])
         if off < 0:
             return -EINVAL
@@ -1435,6 +1439,11 @@ class SyscallHandler:
     RWF_NOWAIT, RWF_APPEND = 8, 16
 
     def _rwf2(self, ctx, a, read: bool):
+        # the kernel resolves the fd before validating flags: a bad
+        # fd is EBADF even with unsupported RWF_* bits set
+        d = self._desc(_s32(a[0]))
+        if d is None:
+            return self._no_desc(_s32(a[0]))
         flags = _s32(a[5])
         known = (self.RWF_HIPRI | self.RWF_DSYNC | self.RWF_SYNC
                  | self.RWF_NOWAIT | self.RWF_APPEND)
@@ -1445,7 +1454,6 @@ class SyscallHandler:
         if flags & self.RWF_NOWAIT:
             # only regular os-backed files (which never block here);
             # a pipe/socket would need the kernel's EAGAIN semantics
-            d = self._desc(_s32(a[0]))
             if not isinstance(d, HostFileDesc):
                 return -EOPNOTSUPP
         if _s64(a[3]) == -1:

@@ -70,6 +70,30 @@ def test_tgen_device_matches_serial_oracle(loss, extra):
         assert sh.trace_checksum == dh.trace_checksum, sh.name
 
 
+def test_judge_placement_identical_traces():
+    """Flush-hoisted network judgment (one batched judge per phase)
+    vs the legacy in-step judgment: same drop-roll keys, same delivery
+    times, bit-identical traces — on the train-sending tgen app with
+    real loss (duplicates, retries, partial trains) and on the
+    8-device mesh. The hoist is the TPU-default path; this pins its
+    equivalence on the CPU mesh."""
+    outs = {}
+    for placement in ("step", "flush"):
+        yaml = TGEN_YAML.format(policy="tpu", seed=11, loss=0.15,
+                                clients=6, size="300KiB", count=2,
+                                stop="10s", extra="retry=150ms")
+        yaml = yaml.replace(
+            "experimental:",
+            f"experimental:\n  judge_placement: {placement}")
+        c = Controller(load_config_str(yaml))
+        stats = c.run()
+        assert stats.ok, placement
+        outs[placement] = (stats.events_executed, stats.packets_sent,
+                           stats.packets_dropped,
+                           [h.trace_checksum for h in c.sim.hosts])
+    assert outs["step"] == outs["flush"]
+
+
 def test_tgen_cpu_clients_complete_downloads():
     stats, hosts = _run("serial", clients=3, size="100KiB", count=3)
     for h in hosts[1:]:
