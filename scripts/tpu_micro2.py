@@ -1,0 +1,163 @@
+"""Follow-up on-chip micro: multi-operand sorts vs gather recovery.
+
+tpu_micro.py showed the flush's ~10 ms-per-gather takes dominate the
+round (sorts are 1.6-2.6 ms). This measures the alternatives:
+  - 6-operand flat sort (payload rides the sort, no perm gathers)
+  - 5-operand merge sort (no take_along_axis recovery)
+  - contiguous-window takes from sorted payload (1-hop)
+  - row-stacked gather layouts ([F, 8] lanes)
+  - the filler-sort "expand to fixed stride" construction
+
+Usage: python scripts/tpu_micro2.py [reps]
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+H = 10000
+OB = 36
+F = H * OB
+E = 48
+IN = 48
+W = E + IN
+
+
+def timed(label, fn, reps):
+    from shadow_tpu._jax import jax
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"  [{label}] {1e3 * dt:.3f} ms/call", file=sys.stderr,
+          flush=True)
+    return round(1e3 * dt, 3)
+
+
+def main() -> int:
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+    signal.signal(signal.SIGALRM, lambda *a: sys.exit(9))
+    signal.alarm(20 * 60)
+
+    import numpy as np
+    from shadow_tpu._jax import jax, jnp
+    from jax import lax
+
+    res = {"platform": jax.devices()[0].platform, "reps": reps}
+    rng = np.random.default_rng(0)
+
+    def arr64(shape, hi=1 << 60):
+        return jax.device_put(jnp.asarray(
+            rng.integers(0, hi, shape).astype(np.int64)))
+
+    skey = arr64(F)
+    p1, p2, p3, p4, p5 = (arr64(F) for _ in range(5))
+
+    # 6-operand flat sort: payload rides through the bitonic passes
+    sort6 = jax.jit(lambda k, a, b, c, d, e:
+                    lax.sort((k, a, b, c, d, e), num_keys=1))
+    res["flat_sort6_ms"] = timed(
+        "flat sort 6-op F=360k",
+        lambda: sort6(skey, p1, p2, p3, p4, p5), reps)
+
+    # 2-operand for reference at same F
+    sort2 = jax.jit(lambda k, a: lax.sort((k, a), num_keys=1))
+    res["flat_sort2_ms"] = timed(
+        "flat sort 2-op F=360k", lambda: sort2(skey, p1), reps)
+
+    # 5-operand merge sort [H, W]
+    ct = arr64((H, W))
+    ck = arr64((H, W))
+    cm = arr64((H, W))
+    cv = arr64((H, W))
+    cw = arr64((H, W))
+    msort5 = jax.jit(lambda t, k, m, v, w: lax.sort(
+        (t, k, m, v, w), dimension=1, num_keys=2))
+    res["merge_sort5_ms"] = timed(
+        "merge sort 5-op [10k,96]",
+        lambda: msort5(ct, ck, cm, cv, cw), reps)
+
+    # contiguous-window takes (1-hop, from sorted payload)
+    starts = jnp.sort(arr64(H, hi=F - IN))
+    idx = starts[:, None] + jnp.arange(IN, dtype=jnp.int64)[None, :]
+    cidx = jnp.clip(idx, 0, F - 1).reshape(-1)
+    win_take = jax.jit(lambda v: jnp.take(v, cidx).reshape(H, IN))
+    res["window_take_ms_x1"] = timed(
+        "contiguous window take x1", lambda: win_take(p1), reps)
+
+    # row-stacked gather: [F, 8] i64, gather H*IN rows
+    mat = arr64((F, 8))
+    ridx = jnp.asarray(rng.integers(0, F, H * IN).astype(np.int32))
+    row_gather = jax.jit(lambda m: jnp.take(m, ridx, axis=0))
+    res["row_gather_f8_ms"] = timed(
+        "row gather [F,8] x H*IN rows", lambda: row_gather(mat), reps)
+
+    # row-stacked CONTIGUOUS window rows
+    crow = jax.jit(lambda m: jnp.take(m, cidx.astype(jnp.int32),
+                                      axis=0))
+    res["row_gather_f8_contig_ms"] = timed(
+        "row gather [F,8] contiguous windows", lambda: crow(mat), reps)
+
+    # dynamic_slice-per-row via vmap (windows)
+    def _dsl(m, s):
+        return lax.dynamic_slice(m, (s,), (IN,))
+    vds = jax.jit(lambda v: jax.vmap(_dsl, (None, 0))(v, starts))
+    res["vmap_dynslice_ms_x1"] = timed(
+        "vmap dynamic_slice windows x1", lambda: vds(p1), reps)
+
+    # filler-sort expand: 2 stable sorts of (F + H*IN) x 6 operands
+    FE = F + H * IN
+    dkey = arr64(FE, hi=2 * H)
+    q1, q2, q3, q4, q5 = (arr64(FE) for _ in range(5))
+    sort6e = jax.jit(lambda k, a, b, c, d, e:
+                     lax.sort((k, a, b, c, d, e), num_keys=1))
+
+    def expand():
+        r = sort6e(dkey, q1, q2, q3, q4, q5)
+        return sort6e(r[1], r[0], r[2], r[3], r[4], r[5])
+
+    res["filler_expand_2sorts_ms"] = timed(
+        "filler expand 2x sort6 @840k", expand, reps)
+
+    # one-hot matmul take_along_axis [H, W] -> [H, E]
+    sie = jnp.asarray(rng.integers(0, W, (H, E)).astype(np.int32))
+
+    def onehot_gather(m):
+        oh = (sie[:, :, None] ==
+              jnp.arange(W, dtype=jnp.int32)[None, None, :]) \
+            .astype(jnp.float32)                      # [H, E, W]
+        lo = (m & 0xFFFFF).astype(jnp.float32)
+        mid = ((m >> 20) & 0xFFFFF).astype(jnp.float32)
+        hi = ((m >> 40) & 0xFFFFFF).astype(jnp.float32)
+        parts = jnp.stack([lo, mid, hi], axis=-1)     # [H, W, 3]
+        got = jnp.einsum("hew,hwc->hec", oh, parts,
+                         preferred_element_type=jnp.float32)
+        lo_, mid_, hi_ = (got[..., i].astype(jnp.int64)
+                          for i in range(3))
+        return lo_ | (mid_ << 20) | (hi_ << 40)
+
+    ohg = jax.jit(onehot_gather)
+    res["onehot_gather_ms_x1"] = timed(
+        "one-hot matmul take_along x1", lambda: ohg(cm), reps)
+
+    # searchsorted at F for the window starts
+    hb = jnp.arange(H + 1, dtype=jnp.int64) * OB
+    skey_sorted = jnp.sort(skey)
+    ss = jax.jit(lambda k: jnp.searchsorted(k, hb))
+    res["searchsorted_ms"] = timed(
+        "searchsorted F@10k+1", lambda: ss(skey_sorted), reps)
+
+    print(json.dumps(res), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

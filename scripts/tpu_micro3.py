@@ -1,0 +1,219 @@
+"""Candidate gatherless flush (double-sort merge) timed end-to-end.
+
+Composes the full replacement for flat-sort + seg_take + merge at the
+10k-rung shapes: one global sort of [outbox F | heap H*E] rows by
+(host, t, k), segmented-scan ranks, stable re-sort by target slot,
+reshape to [H, E]. Compares against the judge + the old path's
+measured pieces. Also validates the construction against a numpy
+oracle at a small shape.
+
+Usage: python scripts/tpu_micro3.py [reps]
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+H = 10000
+OB = 36
+E = 48
+F = H * OB
+N = F + H * E
+BIG = (1 << 62)
+
+
+def timed(label, fn, reps):
+    from shadow_tpu._jax import jax
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"  [{label}] {1e3 * dt:.3f} ms/call", file=sys.stderr,
+          flush=True)
+    return round(1e3 * dt, 3)
+
+
+def build(jnp, lax):
+    INF = jnp.int64(1) << jnp.int64(62)
+
+    def seg_scan_sum(flags_new, vals):
+        """Segmented cumsum: resets at rows where flags_new is True."""
+        def comb(a, b):
+            af, av = a
+            bf, bv = b
+            return af | bf, jnp.where(bf, bv, av + bv)
+        _, out = lax.associative_scan(comb, (flags_new, vals))
+        return out
+
+    def flush(ob_t, ob_host, ob_k, ob_m, ob_v, ob_w,
+              ht, hk, hm, hv, hw, head):
+        # heap rows: consumed slots (col < head) present as INF
+        live = jnp.arange(E)[None, :] >= head[:, None]
+        mt = jnp.where(live, ht, INF).reshape(-1)
+        mk = jnp.where(live, hk, (1 << 62) - 1).reshape(-1)
+        hrow = jnp.broadcast_to(
+            jnp.arange(H, dtype=jnp.int32)[:, None], (H, E)) \
+            .reshape(-1)
+        gt = jnp.concatenate([ob_t, mt])
+        gk = jnp.concatenate([ob_k, mk])
+        gm = jnp.concatenate([ob_m, hm.reshape(-1)])
+        gv = jnp.concatenate([ob_v, hv.reshape(-1)])
+        gw = jnp.concatenate([ob_w, hw.reshape(-1)])
+        ghost = jnp.concatenate([ob_host, hrow])
+
+        # sort1: (host, t, k) — 3 keys, payload rides
+        sh, st_, sk_, sm_, sv_, sw_ = lax.sort(
+            (ghost, gt, gk, gm, gv, gw), num_keys=3)
+
+        is_new = jnp.concatenate(
+            [jnp.ones((1,), bool), sh[1:] != sh[:-1]])
+        rank = seg_scan_sum(is_new, jnp.ones(N, jnp.int32)) - 1
+        kept = rank < E
+        is_real = st_ < INF
+        dropped_real = (~kept) & is_real
+        # per-host dropped count rides to slot [h, 0] on the rank-0 row
+        rev_new = jnp.concatenate(
+            [(sh[1:] != sh[:-1]), jnp.ones((1,), bool)])
+        rdrop = seg_scan_sum(rev_new[::-1],
+                             dropped_real[::-1].astype(jnp.int32))[::-1]
+        ov_carry = jnp.where(rank == 0, rdrop, 0)
+
+        tgt = sh.astype(jnp.int64) * E + rank
+        key2 = jnp.where(kept, tgt, BIG + jnp.arange(N,
+                                                     dtype=jnp.int64))
+        _, t2, k2, m2, v2, w2, ov2 = lax.sort(
+            (key2, st_, sk_, sm_, sv_, sw_, ov_carry), num_keys=1)
+        KEEP = H * E
+        new_ht = t2[:KEEP].reshape(H, E)
+        new_hk = k2[:KEEP].reshape(H, E)
+        new_hm = m2[:KEEP].reshape(H, E)
+        new_hv = v2[:KEEP].reshape(H, E)
+        new_hw = w2[:KEEP].reshape(H, E)
+        overflow = ov2[:KEEP].reshape(H, E)[:, 0]
+        return new_ht, new_hk, new_hm, new_hv, new_hw, overflow
+
+    return flush
+
+
+def main() -> int:
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+    signal.signal(signal.SIGALRM, lambda *a: sys.exit(9))
+    signal.alarm(20 * 60)
+
+    import numpy as np
+    from shadow_tpu._jax import jax, jnp
+    from jax import lax
+
+    res = {"platform": jax.devices()[0].platform, "reps": reps}
+    flush = jax.jit(build(jnp, lax))
+    rng = np.random.default_rng(0)
+    INF = np.int64(1) << np.int64(62)
+
+    # realistic sparsity: ~2% of outbox rows valid
+    valid = rng.random(F) < 0.02
+    ob_t = np.where(valid, rng.integers(0, 1 << 40, F), INF) \
+        .astype(np.int64)
+    ob_host = np.where(valid, rng.integers(0, H, F),
+                       np.int64(1 << 31)).astype(np.int64)
+    ob_k = rng.integers(0, 1 << 60, F).astype(np.int64)
+    ob_m = rng.integers(0, 1 << 60, F).astype(np.int64)
+    ob_v = rng.integers(0, 1 << 60, F).astype(np.int64)
+    ob_w = rng.integers(0, 1 << 30, F).astype(np.int64)
+    # heap ~25% full
+    ht = np.where(rng.random((H, E)) < 0.25,
+                  rng.integers(0, 1 << 40, (H, E)), INF) \
+        .astype(np.int64)
+    ht = np.sort(ht, axis=1)
+    hk = rng.integers(0, 1 << 60, (H, E)).astype(np.int64)
+    hm = rng.integers(0, 1 << 60, (H, E)).astype(np.int64)
+    hv = rng.integers(0, 1 << 60, (H, E)).astype(np.int64)
+    hw = rng.integers(0, 1 << 30, (H, E)).astype(np.int64)
+    head = rng.integers(0, 4, H).astype(np.int32)
+
+    args = [jax.device_put(jnp.asarray(a)) for a in
+            (ob_t, ob_host, ob_k, ob_m, ob_v, ob_w,
+             ht, hk, hm, hv, hw, head)]
+    res["gatherless_flush_ms"] = timed(
+        "gatherless flush @10k", lambda: flush(*args), reps)
+
+    # numpy oracle check at a tiny shape
+    import importlib
+    ok = check_small()
+    res["small_oracle_ok"] = ok
+    print(json.dumps(res), flush=True)
+    return 0 if ok else 1
+
+
+def check_small() -> bool:
+    global H, OB, E, F, N
+    H_, OB_, E_ = H, OB, E
+    H, OB, E = 7, 5, 4
+    F = H * OB
+    N = F + H * E
+    try:
+        import numpy as np
+        from shadow_tpu._jax import jax, jnp
+        from jax import lax
+        flush = jax.jit(build(jnp, lax))
+        rng = np.random.default_rng(7)
+        INF = np.int64(1) << np.int64(62)
+        valid = rng.random(F) < 0.4
+        ob_t = np.where(valid, rng.integers(0, 100, F), INF) \
+            .astype(np.int64)
+        ob_host = np.where(valid, rng.integers(0, H, F),
+                           np.int64(1 << 31)).astype(np.int64)
+        ob_k = rng.integers(0, 1 << 20, F).astype(np.int64)
+        ht = np.where(rng.random((H, E)) < 0.6,
+                      rng.integers(0, 100, (H, E)), INF) \
+            .astype(np.int64)
+        ht = np.sort(ht, axis=1)
+        hk = rng.integers(0, 1 << 20, (H, E)).astype(np.int64)
+        head = rng.integers(0, 2, H).astype(np.int32)
+        z = np.zeros(F, np.int64)
+        zh = np.zeros((H, E), np.int64)
+        out = flush(*[jnp.asarray(a) for a in
+                      (ob_t, ob_host, ob_k, z, z, z,
+                       ht, hk, zh, zh, zh, head)])
+        new_ht, new_hk = np.asarray(out[0]), np.asarray(out[1])
+        ovf = np.asarray(out[5])
+        # oracle
+        for h in range(H):
+            rows = []
+            for j in range(E):
+                if j >= head[h] and ht[h, j] < INF:
+                    rows.append((int(ht[h, j]), int(hk[h, j])))
+                elif j >= head[h]:
+                    rows.append((int(INF), int(hk[h, j])))
+            for i in range(F):
+                if ob_host[i] == h:
+                    rows.append((int(ob_t[i]), int(ob_k[i])))
+            rows.sort()
+            exp_drop = sum(1 for (t, _) in rows[E:] if t < INF)
+            rows = rows[:E]
+            got = [(int(new_ht[h, j]), int(new_hk[h, j]))
+                   for j in range(len(rows))]
+            if [r[0] for r in rows] != [g[0] for g in got]:
+                print(f"host {h}: time mismatch {rows} vs {got}",
+                      file=sys.stderr)
+                return False
+            if exp_drop != int(ovf[h]):
+                print(f"host {h}: overflow {exp_drop} vs {ovf[h]}",
+                      file=sys.stderr)
+                return False
+        return True
+    finally:
+        H, OB, E = H_, OB_, E_
+        F = H * OB
+        N = F + H * E
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -261,6 +261,14 @@ class ExperimentalOptions:
     # and in-step on CPU; "flush"/"step" pin it. Bit-identical traces
     # either way.
     judge_placement: str = "auto"   # auto | flush | step
+    # flush merge strategy: "global" regroups arrivals and re-sorts
+    # the heaps in ONE double sort over [outbox | heap] rows keyed by
+    # (dst host, time, src/seq) — no gathers, the right trade on TPU
+    # where takes cost ~10 ms and multi-operand sorts ~3 ms; "window"
+    # is the flat-sort + per-host window + row-merge path (the right
+    # trade on one CPU core). "auto" picks by platform. Bit-identical
+    # traces either way.
+    merge_strategy: str = "auto"    # auto | global | window
     # max simulated time per device dispatch (ns; 0 = unbounded):
     # long runs split into several invocations of the one compiled
     # program with identical traces (window clamping stays on the
@@ -309,6 +317,8 @@ class ExperimentalOptions:
                       out.exchange, ("all_gather", "all_to_all"))
         _check_choice("experimental", "judge_placement",
                       out.judge_placement, ("auto", "flush", "step"))
+        _check_choice("experimental", "merge_strategy",
+                      out.merge_strategy, ("auto", "global", "window"))
         from shadow_tpu.host.tcp import CONGESTION_ALGORITHMS
         _check_choice("experimental", "tcp_congestion",
                       out.tcp_congestion,
@@ -317,7 +327,12 @@ class ExperimentalOptions:
                       out.hybrid_cpu_policy,
                       [p for p in SCHEDULER_POLICIES
                        if p not in ("tpu", "hybrid")])
-        for name, minimum in (("event_capacity", 1),
+        if out.model_bandwidth and out.judge_placement == "flush":
+            raise ValueError(
+                "experimental.judge_placement: flush cannot combine "
+                "with model_bandwidth (the fluid NIC's tx/rx state "
+                "is sequential per event; judgment stays in-step)")
+        for name, minimum in (("event_capacity", 2),
                               ("dispatch_segment", 0),
                               ("outbox_capacity", 1),
                               ("exchange_capacity", 0),

@@ -124,6 +124,18 @@ class EngineConfig:
     # judge's extra memory traffic is not). None = auto by platform.
     # Traces are bit-identical either way (tests pin both).
     judge_hoist: Optional[bool] = None
+    # flush merge strategy: True = ONE global double sort of
+    # [outbox rows | heap rows] keyed by (dst host, time, src/seq)
+    # lands every row at its [host, slot] heap position with zero
+    # gathers — on TPU a 500k-element take costs ~10 ms while a
+    # 6-operand 840k-row sort costs ~3 ms, so the window path's
+    # seg_take + take_along_axis recovery (5 + 3 takes per flush) IS
+    # the round cost there. False = the flat-sort + per-host window +
+    # row-merge path (fewer/narrower sorts; the right trade on one
+    # CPU core where sorts are the cost and takes are cheap).
+    # None = auto by platform. Traces are bit-identical either way
+    # (tests pin both).
+    merge_global: Optional[bool] = None
 
 
 class DeviceEngine:
@@ -364,6 +376,10 @@ class DeviceEngine:
         HOIST = (not MB) and (cfg.judge_hoist
                               if cfg.judge_hoist is not None
                               else platform == "tpu")
+        # gatherless flush merge (see EngineConfig.merge_global)
+        MERGE_GLOBAL = (cfg.merge_global
+                        if cfg.merge_global is not None
+                        else platform == "tpu")
         # statically lossless topologies (all reliability == 1) never
         # drop: packet_drop_mask is False for every row regardless of
         # the roll, so the threefry batch is skipped outright
@@ -1037,6 +1053,211 @@ class DeviceEngine:
                 is_send, pack2(surv.astype(jnp.int32), lo32(fv)), fv)
             return state, {**ob, "t": new_t, "m": new_m, "v": new_v}
 
+        # ---------------- gatherless flush (merge_global) --------------
+        # TPU takes with computed indices cost ~10 ms per 500k
+        # elements while multi-operand sorts of the same data cost
+        # ~3 ms (bitonic passes are bandwidth-bound; gathers
+        # serialize). So on TPU the flush is TWO stable sorts and
+        # zero gathers: sort [outbox | heap] rows by (host, t, key),
+        # rank rows within each host segment with segmented scans,
+        # then re-sort by target slot host*E+rank — every host
+        # contributes exactly E heap rows (consumed slots masked to
+        # INF), so ranks 0..E-1 exist for every host and the kept
+        # prefix reshapes straight into the [H, E] heaps. Rows
+        # ranked >= E are the merge overflow; their per-host count
+        # rides the second sort to slot [h, 0] on the rank-0 row.
+        # Arrival order within a host is (t, src<<32|seq) — a total
+        # order, so traces are bit-identical to the window path
+        # whenever neither path overflows (both fail loudly).
+        # (host, t) pack into one i64 sort key: host in the top bits,
+        # time below. Real times at or above T_CAP would alias the
+        # INF encoding — they are counted into `overflow` (loud run
+        # failure) rather than silently reordered; sims needing
+        # >2^T_BITS ns of horizon must pin merge_strategy: window.
+        H_BITS = max(1, int(math.ceil(math.log2(H_loc + 2))))
+        T_BITS = 63 - H_BITS
+        T_CAP = np.int64((1 << T_BITS) - 1)
+
+        def _henc(host, t):
+            return (host.astype(jnp.int64) << T_BITS) | \
+                jnp.minimum(t, T_CAP)
+
+        def _ob_rows(ft, fk, fm, fs, fv, lo, hi):
+            """Outbox-format flat rows -> merge-format
+            (hostt key, k, hm, hv, hw, poison); rows outside [lo, hi)
+            or not exchangeable (t >= DROP_T) mask to the sentinel
+            segment H_loc (sorts after every real host, lands past
+            the kept prefix)."""
+            dst = hi32(fm)
+            kindb = lo32(fm) & 0xFF        # strip the train count
+            m2 = pack2(kindb, hi32(fs))
+            v2 = pack2(lo32(fs), lo32(fv))
+            w2 = (fv >> 32) & U32
+            mine = (ft < DROP_T) & (dst >= lo) & (dst < hi)
+            host = jnp.where(mine, dst - lo,
+                             jnp.int32(H_loc)).astype(jnp.int32)
+            t = jnp.where(mine, ft, INF)
+            k = jnp.where(mine, fk, IMAX)
+            poison = ((t >= T_CAP) & (t < INF)).sum() \
+                .astype(jnp.int32)
+            return _henc(host, t), k, m2, v2, w2, poison
+
+        def _merge_rows(state, parts):
+            """The double-sort merge: `parts` are (hostt, k, m, v, w,
+            poison) flat row tuples (already in heap field format)."""
+            live = jnp.arange(E)[None, :] >= state["head"][:, None]
+            mt = jnp.where(live, state["ht"], INF)
+            mk = jnp.where(live, state["hk"], IMAX).reshape(-1)
+            hrow = jnp.broadcast_to(
+                jnp.arange(H_loc, dtype=jnp.int32)[:, None],
+                (H_loc, E))
+            poison = (((mt >= T_CAP) & (mt < INF)).sum()
+                      .astype(jnp.int32)
+                      + sum(p[5] for p in parts))
+            ghk = jnp.concatenate([_henc(hrow, mt).reshape(-1)]
+                                  + [p[0] for p in parts])
+            gk = jnp.concatenate([mk] + [p[1] for p in parts])
+            gm = jnp.concatenate([state["hm"].reshape(-1)]
+                                 + [p[2] for p in parts])
+            gv = jnp.concatenate([state["hv"].reshape(-1)]
+                                 + [p[3] for p in parts])
+            gw = jnp.concatenate([state["hw"].reshape(-1)]
+                                 + [p[4] for p in parts])
+            N = ghk.shape[0]
+
+            shk, sk_, sm_, sv_, sw_ = lax.sort(
+                (ghk, gk, gm, gv, gw), num_keys=2)
+            sh = (shk >> T_BITS).astype(jnp.int64)
+            idx = jnp.arange(N, dtype=jnp.int64)
+            is_new = jnp.concatenate(
+                [jnp.ones((1,), bool), sh[1:] != sh[:-1]])
+            seg0 = lax.associative_scan(
+                jnp.maximum, jnp.where(is_new, idx, 0))
+            rank = idx - seg0
+            kept = rank < E
+            is_real = (shk & T_CAP) < T_CAP
+            dropped_real = (~kept) & is_real
+
+            tgt = sh * E + rank
+            key2 = jnp.where(kept, tgt,
+                             INF + idx)
+            _, t2k, k2, m2, v2, w2 = lax.sort(
+                (key2, shk, sk_, sm_, sv_, sw_), num_keys=1)
+            KEEP = H_loc * E
+            enc = (t2k[:KEEP] & T_CAP).reshape(H_loc, E)
+            state["ht"] = jnp.where(enc == T_CAP, INF, enc)
+            state["hk"] = k2[:KEEP].reshape(H_loc, E)
+            state["hm"] = m2[:KEEP].reshape(H_loc, E)
+            state["hv"] = v2[:KEEP].reshape(H_loc, E)
+            state["hw"] = w2[:KEEP].reshape(H_loc, E)
+
+            # overflow: per-host attribution is a sort + searchsorted
+            # we only pay when something actually dropped (never in a
+            # healthy run); the poison count (times aliasing T_CAP)
+            # lands on host 0 — both fail the run loudly either way
+            n_drop_tot = dropped_real.sum()
+
+            def _attr(_):
+                dh = lax.sort(jnp.where(dropped_real, sh, IMAX))
+                hb = jnp.searchsorted(
+                    dh, jnp.arange(H_loc + 1, dtype=jnp.int64))
+                return (hb[1:] - hb[:-1]).astype(jnp.int32)
+
+            ov = lax.cond(
+                (n_drop_tot + poison) > 0, _attr,
+                lambda _: jnp.zeros(H_loc, jnp.int32), 0)
+            state["overflow"] = state["overflow"] + ov + \
+                jnp.zeros(H_loc, jnp.int32).at[0].add(poison)
+            state["head"] = jnp.zeros_like(state["head"])
+            return state
+
+        def _pack_remote(state, skey, perm, rows, my_shard,
+                         ship_keys):
+            """Pack genuinely remote rows into [n_shards, CAP] and
+            move them with one all_to_all; self-shard rows never
+            enter the pack (zero ICI, zero CAP). CAP overflow is
+            attributed to the SENDING host (it owns the sizing knob)
+            via a segment-rank scan + 1-key sort + searchsorted
+            histogram — scatter-free like everything else.
+            `ship_keys` additionally moves each row's skey (the
+            window merge re-sorts arrivals by it; the global merge
+            orders by (t, key) and skips the extra operand)."""
+            G = H_loc * CX
+            bound = (jnp.arange(n_shards + 1, dtype=jnp.int64)
+                     * H_loc * SPAN)
+            edges = jnp.searchsorted(skey, bound)
+            starts, nxt = edges[:-1], edges[1:]
+            counts = nxt - starts
+            remote = jnp.arange(n_shards) != my_shard
+            counts = jnp.where(remote, counts, 0)
+            idx = jnp.arange(G, dtype=jnp.int64)
+            shard_of = skey // (H_loc * SPAN)
+            is_new = jnp.concatenate(
+                [jnp.array([True]), shard_of[1:] != shard_of[:-1]])
+            seg0 = lax.associative_scan(
+                jnp.maximum, jnp.where(is_new, idx, 0))
+            lost_mask = (skey < IMAX) & ((idx - seg0) >= CAP) & \
+                (shard_of != my_shard.astype(jnp.int64))
+            src_loc = (skey % SPAN) // OB \
+                - my_shard.astype(jnp.int64) * H_loc
+            lk = lax.sort(jnp.where(lost_mask, src_loc, IMAX))
+            hb = jnp.searchsorted(
+                lk, jnp.arange(H_loc + 1, dtype=jnp.int64))
+            state["x_overflow"] = state["x_overflow"] + \
+                (hb[1:] - hb[:-1]).astype(jnp.int32)
+            win = _seg_take(perm, rows, starts, counts, CAP)
+            moved = {f: lax.all_to_all(
+                win[f], AXIS, split_axis=0, concat_axis=0)
+                .reshape(n_shards * CAP) for f in XF}
+            kmoved = None
+            if ship_keys:
+                kidx = jnp.clip(
+                    starts[:, None] + jnp.arange(CAP,
+                                                 dtype=jnp.int64),
+                    0, G - 1)
+                kwin = jnp.where(
+                    jnp.arange(CAP)[None, :] <
+                    jnp.minimum(counts, CAP)[:, None],
+                    jnp.take(skey, kidx.reshape(-1)).reshape(
+                        n_shards, CAP),
+                    IMAX)
+                kmoved = lax.all_to_all(
+                    kwin, AXIS, split_axis=0,
+                    concat_axis=0).reshape(n_shards * CAP)
+            return state, moved, kmoved
+
+        def _exchange_global(state, ob, gid, my_shard):
+            lo = my_shard.astype(jnp.int32) * H_loc
+            hi = lo + H_loc
+            flat = {f: ob[f].reshape(H_loc * OB) for f in XF}
+            if n_shards > 1 and cfg.exchange == "all_to_all":
+                # remote rows pack per (src shard, dst shard) for the
+                # all_to_all (x_overflow accounting shared with the
+                # window path); self-shard rows bypass the pack and
+                # feed the merge directly
+                state, skey, perm, rows = _flat_sorted(state, ob, gid)
+                state, moved, _ = _pack_remote(
+                    state, skey, perm, rows, my_shard,
+                    ship_keys=False)
+                parts = [
+                    _ob_rows(flat["t"], flat["k"], flat["m"],
+                             flat["s"], flat["v"], lo, hi),
+                    _ob_rows(moved["t"], moved["k"], moved["m"],
+                             moved["s"], moved["v"], lo, hi),
+                ]
+            elif n_shards > 1:
+                # all_gather fallback: replicate every shard's raw
+                # outbox rows; each shard keeps its own via the
+                # [lo, hi) mask inside _ob_rows
+                allf = {f: lax.all_gather(flat[f], AXIS)
+                        .reshape(n_shards * H_loc * OB) for f in XF}
+                parts = [_ob_rows(allf["t"], allf["k"], allf["m"],
+                                  allf["s"], allf["v"], lo, hi)]
+            else:
+                parts = [_ob_rows(flat["t"], flat["k"], flat["m"],
+                                  flat["s"], flat["v"], lo, hi)]
+            return _merge_rows(state, parts)
+
         def _exchange(state, ob, gid, my_shard, host_vertex, lat, rel,
                       win_end):
             if HOIST:
@@ -1044,6 +1265,8 @@ class DeviceEngine:
                                           lat, rel, win_end)
             if CP:
                 state = _count_paths(state, ob, host_vertex)
+            if MERGE_GLOBAL:
+                return _exchange_global(state, ob, gid, my_shard)
             state, skey, perm, rows = _flat_sorted(state, ob, gid)
             G = H_loc * CX
 
@@ -1055,55 +1278,13 @@ class DeviceEngine:
                 # CAP consumption) and reach the merge as a second
                 # incoming block below. Only genuinely remote rows
                 # pack into [n_shards, CAP] for the all_to_all.
-                bound = (jnp.arange(n_shards + 1, dtype=jnp.int64)
-                         * H_loc * SPAN)
-                edges = jnp.searchsorted(skey, bound)
-                starts, nxt = edges[:-1], edges[1:]
-                counts = nxt - starts
-
                 # my own range: straight per-host windows (IN each)
                 state, inc2 = _host_windows(state, skey, perm, rows,
                                             my_shard)
 
-                # remote rows: mask my own slot out of the pack
-                remote = jnp.arange(n_shards) != my_shard
-                counts = jnp.where(remote, counts, 0)
-                # overflow attributed to the SENDING host (it owns the
-                # sizing knob): per-shard ranks via segment scan, then
-                # a 1-key sort + searchsorted histogram of the lost
-                # rows' source hosts — scatter-free like everything
-                idx = jnp.arange(G, dtype=jnp.int64)
-                shard_of = skey // (H_loc * SPAN)
-                is_new = jnp.concatenate(
-                    [jnp.array([True]), shard_of[1:] != shard_of[:-1]])
-                seg0 = lax.associative_scan(
-                    jnp.maximum, jnp.where(is_new, idx, 0))
-                lost_mask = (skey < IMAX) & ((idx - seg0) >= CAP) & \
-                    (shard_of != my_shard.astype(jnp.int64))
-                src_loc = (skey % SPAN) // OB \
-                    - my_shard.astype(jnp.int64) * H_loc
-                lk = lax.sort(jnp.where(lost_mask, src_loc, IMAX))
-                hb = jnp.searchsorted(
-                    lk, jnp.arange(H_loc + 1, dtype=jnp.int64))
-                state["x_overflow"] = state["x_overflow"] + \
-                    (hb[1:] - hb[:-1]).astype(jnp.int32)
-                win = _seg_take(perm, rows, starts, counts, CAP)
-                kidx = jnp.clip(
-                    starts[:, None] + jnp.arange(CAP,
-                                                 dtype=jnp.int64),
-                    0, G - 1)
-                kwin = jnp.where(
-                    jnp.arange(CAP)[None, :] <
-                    jnp.minimum(counts, CAP)[:, None],
-                    jnp.take(skey, kidx.reshape(-1)).reshape(
-                        n_shards, CAP),
-                    IMAX)
-                moved = {f: lax.all_to_all(
-                    win[f], AXIS, split_axis=0, concat_axis=0)
-                    .reshape(n_shards * CAP) for f in XF}
-                kmoved = lax.all_to_all(
-                    kwin, AXIS, split_axis=0,
-                    concat_axis=0).reshape(n_shards * CAP)
+                state, moved, kmoved = _pack_remote(
+                    state, skey, perm, rows, my_shard,
+                    ship_keys=True)
                 G = n_shards * CAP
                 skey, perm = lax.sort(
                     (kmoved, jnp.arange(G, dtype=jnp.int64)),

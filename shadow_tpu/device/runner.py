@@ -196,6 +196,9 @@ class DeviceRunner:
                 judge_hoist={"auto": None, "flush": True,
                              "step": False}[
                     cfg.experimental.judge_placement],
+                merge_global={"auto": None, "global": True,
+                              "window": False}[
+                    cfg.experimental.merge_strategy],
             ),
             self.app,
             host_vertex=sim.netmodel.host_vertex.astype(np.int32),

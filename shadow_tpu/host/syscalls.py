@@ -1404,16 +1404,16 @@ class SyscallHandler:
         dispatch through the pread64/pwrite64 handlers keeps the
         per-type semantics (os-backed files, VirtualFileDesc, ESPIPE
         for pipes/sockets) in ONE place (ref file.c handlers)."""
-        if self._desc(_s32(a[0])) is None:
-            return self._no_desc(_s32(a[0]))
+        off = _s64(a[3])
+        if off < 0:                     # do_preadv validates pos
+            return -EINVAL              # before fdget: a bad fd with
+        if self._desc(_s32(a[0])) is None:   # pos -1 is EINVAL, not
+            return self._no_desc(_s32(a[0]))  # EBADF
         cnt = _s32(a[2])
         if cnt < 0 or cnt > 1024:       # IOV_MAX
             return -EINVAL
         if cnt == 0:                    # kernel: zero segs transfers 0
             return 0
-        off = _s64(a[3])
-        if off < 0:
-            return -EINVAL
         total = 0
         for base, ln in kmem.read_iovec(self.mem, a[1], cnt):
             if ln == 0:
@@ -1439,8 +1439,11 @@ class SyscallHandler:
     RWF_NOWAIT, RWF_APPEND = 8, 16
 
     def _rwf2(self, ctx, a, read: bool):
-        # the kernel resolves the fd before validating flags: a bad
-        # fd is EBADF even with unsupported RWF_* bits set
+        # pos validation precedes fd resolution (do_preadv), but the
+        # fd still resolves before the flag checks: pos < -1 on a bad
+        # fd is EINVAL, unsupported RWF_* bits on a bad fd are EBADF
+        if _s64(a[3]) < -1:
+            return -EINVAL
         d = self._desc(_s32(a[0]))
         if d is None:
             return self._no_desc(_s32(a[0]))

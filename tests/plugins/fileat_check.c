@@ -35,6 +35,10 @@ int main(void) {
   struct iovec iov[2] = {{buf, 3}, {buf + 8, 2}};
   check("preadv", preadv(fd, iov, 2, 6) == 5 &&
         !strncmp(buf, "wor", 3) && !strncmp(buf + 8, "ld", 2));
+  /* the kernel validates the offset before the zero-seg shortcut */
+  errno = 0;
+  check("preadv_badoff", preadv(fd, iov, 0, -1) == -1 &&
+        errno == EINVAL);
   check("pwrite", pwrite(fd, "WORLD", 5, 6) == 5);
   check("lseek", lseek(fd, 0, SEEK_SET) == 0);
   memset(buf, 0, sizeof buf);

@@ -209,6 +209,96 @@ def test_judge_placement_identical_traces_phold():
     assert outs["step"] == outs["flush"]
 
 
+def test_merge_strategy_identical_traces_phold():
+    """Gatherless global double-sort merge vs the flat-sort + window
+    merge: same arrival sets, same (time, src, seq) per-host order,
+    bit-identical traces — on lossy multi-lane phold over the
+    8-device mesh (exercises the all_to_all pack + self-shard bypass
+    feeding the global merge)."""
+    outs = {}
+    for strategy in ("window", "global"):
+        yaml = PHOLD_YAML.format(policy="tpu", seed=7, loss=0.1, q=8,
+                                 msgload=3)
+        yaml = yaml.replace(
+            "experimental:",
+            f"experimental:\n  merge_strategy: {strategy}")
+        c = Controller(load_config_str(yaml))
+        stats = c.run()
+        assert stats.ok, strategy
+        outs[strategy] = (stats.events_executed, stats.packets_sent,
+                          stats.packets_dropped,
+                          [h.trace_checksum for h in c.sim.hosts])
+    assert outs["window"] == outs["global"]
+
+
+def test_tpu_default_knobs_identical_traces():
+    """The combination production TPU actually runs — judgment
+    hoisted to flush AND the global double-sort merge together
+    (_judge_outbox rewrites ob t/m/v, then _ob_rows re-reads them) —
+    pinned against the CPU-default step+window combination."""
+    outs = {}
+    for extra in ("  judge_placement: step\n  merge_strategy: window",
+                  "  judge_placement: flush\n  merge_strategy: global"):
+        yaml = PHOLD_YAML.format(policy="tpu", seed=7, loss=0.1, q=8,
+                                 msgload=3)
+        yaml = yaml.replace("experimental:",
+                            "experimental:\n" + extra)
+        c = Controller(load_config_str(yaml))
+        stats = c.run()
+        assert stats.ok, extra
+        outs[extra] = (stats.events_executed, stats.packets_sent,
+                       stats.packets_dropped,
+                       [h.trace_checksum for h in c.sim.hosts])
+    a, b = outs.values()
+    assert a == b
+
+
+def test_merge_strategy_identical_traces_all_gather():
+    """The all_gather exchange fallback under the global merge:
+    every shard replicates raw outbox rows and keeps its own via the
+    destination mask; traces must match the window path."""
+    outs = {}
+    for strategy in ("window", "global"):
+        yaml = PHOLD_YAML.format(policy="tpu", seed=3, loss=0.05, q=8,
+                                 msgload=2)
+        yaml = yaml.replace(
+            "experimental:",
+            "experimental:\n  exchange: all_gather\n"
+            f"  merge_strategy: {strategy}")
+        c = Controller(load_config_str(yaml))
+        stats = c.run()
+        assert stats.ok, strategy
+        outs[strategy] = (stats.events_executed, stats.packets_sent,
+                          stats.packets_dropped,
+                          [h.trace_checksum for h in c.sim.hosts])
+    assert outs["window"] == outs["global"]
+
+
+def test_merge_global_overflow_detected():
+    """Hub skew under the global merge: 999 clients hammering one
+    server must fail LOUDLY at small event_capacity (rank-based
+    overflow, same contract as the window path's arrival-window
+    overflow) and, once the knob is raised, bit-match the window
+    path."""
+    yaml = HUB_YAML.format(exchange="all_to_all", ecap=64).replace(
+        "experimental:", "experimental:\n  merge_strategy: global")
+    c = Controller(load_config_str(yaml))
+    stats = c.run()
+    assert not stats.ok
+
+    out = {}
+    for strategy in ("window", "global"):
+        yaml = HUB_YAML.format(exchange="all_to_all",
+                               ecap=1024).replace(
+            "experimental:",
+            f"experimental:\n  merge_strategy: {strategy}")
+        c = Controller(load_config_str(yaml))
+        stats = c.run()
+        assert stats.ok, strategy
+        out[strategy] = [h.trace_checksum for h in c.sim.hosts]
+    assert out["window"] == out["global"]
+
+
 def test_device_deterministic_across_runs():
     _, h1 = _run("tpu", seed=9)
     _, h2 = _run("tpu", seed=9)

@@ -70,6 +70,28 @@ def test_tgen_device_matches_serial_oracle(loss, extra):
         assert sh.trace_checksum == dh.trace_checksum, sh.name
 
 
+def test_merge_strategy_identical_traces():
+    """Global double-sort merge vs window merge on the train-sending
+    tgen app with real loss (partial trains, retries) on the 8-device
+    mesh — the TPU-default flush path pinned against the CPU-tuned
+    one."""
+    outs = {}
+    for strategy in ("window", "global"):
+        yaml = TGEN_YAML.format(policy="tpu", seed=11, loss=0.15,
+                                clients=6, size="300KiB", count=2,
+                                stop="10s", extra="retry=150ms")
+        yaml = yaml.replace(
+            "experimental:",
+            f"experimental:\n  merge_strategy: {strategy}")
+        c = Controller(load_config_str(yaml))
+        stats = c.run()
+        assert stats.ok, strategy
+        outs[strategy] = (stats.events_executed, stats.packets_sent,
+                          stats.packets_dropped,
+                          [h.trace_checksum for h in c.sim.hosts])
+    assert outs["window"] == outs["global"]
+
+
 def test_judge_placement_identical_traces():
     """Flush-hoisted network judgment (one batched judge per phase)
     vs the legacy in-step judgment: same drop-roll keys, same delivery
