@@ -8,6 +8,9 @@ the moment a probe sees a real accelerator it runs, in order:
 
   1. python bench.py                    -> artifacts/BENCH_tpu.json
   2. scripts/profile_device.py 10k rung -> artifacts/PROFILE_tpu.json
+  3. scripts/tor_large_run.py 12        -> artifacts/TORLARGE_tpu.json
+     (the longest step: a full-state 56k-host execution; the watcher
+     holds the single-client relay for its duration)
 
 Usage: python scripts/tpu_watch.py [max_hours]
 """
@@ -76,6 +79,11 @@ def main() -> int:
                           "examples/tgen_10000.yaml", "2.5"],
                          f"{ART}/PROFILE_tpu.json",
                          f"{ART}/PROFILE_tpu.log")
+            log("profile done — running full-state tor_large")
+            run_and_save([sys.executable, "scripts/tor_large_run.py",
+                          "12"],
+                         f"{ART}/TORLARGE_tpu.json",
+                         f"{ART}/TORLARGE_tpu.log")
             return 0
         time.sleep(SLEEP_BETWEEN_S)
     log("gave up: TPU never recovered inside the window")

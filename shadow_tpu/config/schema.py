@@ -269,6 +269,13 @@ class ExperimentalOptions:
     # trade on one CPU core). "auto" picks by platform. Bit-identical
     # traces either way.
     merge_strategy: str = "auto"    # auto | global | window
+    # pop head reads on the device engine: "onehot" replaces the pop
+    # loop's take_along_axis head reads with one-hot masked
+    # reductions (no gathers — the same trade as merge_strategy:
+    # global, applied to the pop side); "gather" keeps
+    # take_along_axis (cheaper on one CPU core). "auto" picks by
+    # platform. Bit-identical traces either way.
+    pop_strategy: str = "auto"      # auto | onehot | gather
     # max simulated time per device dispatch (ns; 0 = unbounded):
     # long runs split into several invocations of the one compiled
     # program with identical traces (window clamping stays on the
@@ -319,6 +326,8 @@ class ExperimentalOptions:
                       out.judge_placement, ("auto", "flush", "step"))
         _check_choice("experimental", "merge_strategy",
                       out.merge_strategy, ("auto", "global", "window"))
+        _check_choice("experimental", "pop_strategy",
+                      out.pop_strategy, ("auto", "onehot", "gather"))
         from shadow_tpu.host.tcp import CONGESTION_ALGORITHMS
         _check_choice("experimental", "tcp_congestion",
                       out.tcp_congestion,

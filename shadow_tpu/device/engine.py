@@ -136,6 +136,16 @@ class EngineConfig:
     # None = auto by platform. Traces are bit-identical either way
     # (tests pin both).
     merge_global: Optional[bool] = None
+    # pop head reads: True = one-hot masked reductions (compare a
+    # column iota against head, select, reduce over E) — pure
+    # elementwise+reduce VPU work, no gather; the pop loop's
+    # take_along_axis head reads (5 operand takes + the loop-cond
+    # take per iteration) are the same ~ms-class TPU gathers the
+    # gatherless flush removed. False = take_along_axis (cheaper on
+    # one CPU core, where gathers are a pointer chase and the E-wide
+    # reduction is real work). None = auto by platform. Traces are
+    # bit-identical either way (tests pin both).
+    pop_onehot: Optional[bool] = None
 
 
 class DeviceEngine:
@@ -380,6 +390,10 @@ class DeviceEngine:
         MERGE_GLOBAL = (cfg.merge_global
                         if cfg.merge_global is not None
                         else platform == "tpu")
+        # gatherless pop head reads (see EngineConfig.pop_onehot)
+        POP_ONEHOT = (cfg.pop_onehot
+                      if cfg.pop_onehot is not None
+                      else platform == "tpu")
         # statically lossless topologies (all reliability == 1) never
         # drop: packet_drop_mask is False for every row regardless of
         # the roll, so the threefry batch is skipped outright
@@ -415,6 +429,11 @@ class DeviceEngine:
         hidx = jnp.arange(H_loc)
 
         def _take_head(arr, head, fill):
+            if POP_ONEHOT:
+                m = jnp.arange(E)[None, :] == head[:, None]
+                v = jnp.where(m, arr,
+                              jnp.zeros((), arr.dtype)).sum(axis=1)
+                return jnp.where(head < E, v, fill)
             v = jnp.take_along_axis(
                 arr, jnp.minimum(head, E - 1)[:, None], axis=1)[:, 0]
             return jnp.where(head < E, v, fill)
@@ -429,6 +448,13 @@ class DeviceEngine:
                 idxs = head[:, None] + offs
 
                 def _take_heads(arr, fill):
+                    if POP_ONEHOT:
+                        m = jnp.arange(E)[None, None, :] == \
+                            idxs[:, :, None]
+                        v = jnp.where(m, arr[:, None, :],
+                                      jnp.zeros((), arr.dtype)) \
+                            .sum(axis=-1)
+                        return jnp.where(idxs < E, v, fill)
                     v = jnp.take_along_axis(
                         arr, jnp.minimum(idxs, E - 1), axis=1)
                     return jnp.where(idxs < E, v, fill)

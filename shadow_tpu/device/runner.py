@@ -199,6 +199,9 @@ class DeviceRunner:
                 merge_global={"auto": None, "global": True,
                               "window": False}[
                     cfg.experimental.merge_strategy],
+                pop_onehot={"auto": None, "onehot": True,
+                            "gather": False}[
+                    cfg.experimental.pop_strategy],
             ),
             self.app,
             host_vertex=sim.netmodel.host_vertex.astype(np.int32),

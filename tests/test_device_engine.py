@@ -253,6 +253,26 @@ def test_tpu_default_knobs_identical_traces():
     assert a == b
 
 
+def test_pop_strategy_identical_traces_phold():
+    """One-hot masked-reduction head reads vs take_along_axis: the
+    pop loop must yield the same event order (and thus bit-identical
+    traces) on lossy multi-lane phold over the 8-device mesh."""
+    outs = {}
+    for strategy in ("gather", "onehot"):
+        yaml = PHOLD_YAML.format(policy="tpu", seed=7, loss=0.1, q=8,
+                                 msgload=3)
+        yaml = yaml.replace(
+            "experimental:",
+            f"experimental:\n  pop_strategy: {strategy}")
+        c = Controller(load_config_str(yaml))
+        stats = c.run()
+        assert stats.ok, strategy
+        outs[strategy] = (stats.events_executed, stats.packets_sent,
+                          stats.packets_dropped,
+                          [h.trace_checksum for h in c.sim.hosts])
+    assert outs["gather"] == outs["onehot"]
+
+
 def test_merge_strategy_identical_traces_all_gather():
     """The all_gather exchange fallback under the global merge:
     every shard replicates raw outbox rows and keeps its own via the

@@ -55,10 +55,12 @@ hosts:
 
 
 def _run(policy, bw="1 Mbit", seed=3, loss=0.0, msgload=3,
-         size=4096, stop="3s"):
-    c = Controller(load_config_str(YAML.format(
-        policy=policy, bw=bw, seed=seed, loss=loss, msgload=msgload,
-        size=size, stop=stop)))
+         size=4096, stop="3s", extra=""):
+    yaml = YAML.format(policy=policy, bw=bw, seed=seed, loss=loss,
+                       msgload=msgload, size=size, stop=stop)
+    if extra:
+        yaml = yaml.replace("experimental:", "experimental:\n" + extra)
+    c = Controller(load_config_str(yaml))
     stats = c.run()
     return stats, c.sim.hosts
 
@@ -98,6 +100,27 @@ def test_model_nic_codel_drops_standing_queue():
 def test_device_matches_serial_oracle_with_bandwidth(bw, loss):
     s_stats, s_hosts = _run("serial", bw=bw, loss=loss)
     d_stats, d_hosts = _run("tpu", bw=bw, loss=loss)
+    assert d_stats.ok
+    assert s_stats.events_executed == d_stats.events_executed
+    assert s_stats.packets_sent == d_stats.packets_sent
+    assert s_stats.packets_dropped == d_stats.packets_dropped
+    assert s_stats.packets_delivered == d_stats.packets_delivered
+    for sh, dh in zip(s_hosts, d_hosts):
+        assert sh.trace_checksum == dh.trace_checksum, sh.name
+
+
+def test_device_tpu_default_strategies_with_bandwidth():
+    """model_bandwidth under the strategies production TPU actually
+    auto-selects (merge_strategy: global, pop_strategy: onehot) vs
+    the serial oracle — the other MB oracle tests run on CPU where
+    both auto-resolve to the CPU-tuned paths, so without this pin the
+    on-chip MB combination would ship untested (READY-reinsert rows
+    through the global double-sort merge, fluid-NIC pops through the
+    one-hot head reads)."""
+    extra = "  merge_strategy: global\n  pop_strategy: onehot"
+    s_stats, s_hosts = _run("serial", bw="2 Mbit", loss=0.05)
+    d_stats, d_hosts = _run("tpu", bw="2 Mbit", loss=0.05,
+                            extra=extra)
     assert d_stats.ok
     assert s_stats.events_executed == d_stats.events_executed
     assert s_stats.packets_sent == d_stats.packets_sent

@@ -92,6 +92,27 @@ def test_merge_strategy_identical_traces():
     assert outs["window"] == outs["global"]
 
 
+def test_pop_strategy_identical_traces():
+    """One-hot head reads vs take_along_axis on the train-sending
+    tgen app — the burst-pop (P>1) _take_heads path included, since
+    tgen servers declare burst pops. Bit-identical traces required."""
+    outs = {}
+    for strategy in ("gather", "onehot"):
+        yaml = TGEN_YAML.format(policy="tpu", seed=11, loss=0.15,
+                                clients=6, size="300KiB", count=2,
+                                stop="10s", extra="retry=150ms")
+        yaml = yaml.replace(
+            "experimental:",
+            f"experimental:\n  pop_strategy: {strategy}")
+        c = Controller(load_config_str(yaml))
+        stats = c.run()
+        assert stats.ok, strategy
+        outs[strategy] = (stats.events_executed, stats.packets_sent,
+                          stats.packets_dropped,
+                          [h.trace_checksum for h in c.sim.hosts])
+    assert outs["gather"] == outs["onehot"]
+
+
 def test_judge_placement_identical_traces():
     """Flush-hoisted network judgment (one batched judge per phase)
     vs the legacy in-step judgment: same drop-roll keys, same delivery
