@@ -283,6 +283,16 @@ class ExperimentalOptions:
     # minutes, so bench full runs bound each dispatch to a few
     # wall-seconds of work.
     dispatch_segment: int = 0
+    # device-state checkpoint / resume (device/checkpoint.py; the
+    # reference has no checkpoint at all — SURVEY §5). checkpoint_save
+    # writes the full simulation state at checkpoint_save_time
+    # (0 = at stop_time) and pauses the run there; checkpoint_load
+    # resumes a saved state and runs on to stop_time. A paused+resumed
+    # pair bit-matches the uninterrupted run (window clamping stays on
+    # the global stop — the heartbeat-segmentation contract).
+    checkpoint_save: str = ""
+    checkpoint_save_time: int = 0
+    checkpoint_load: str = ""
     mesh_axis: str = "hosts"
     device_batch_rounds: int = 64   # rounds fused into one device while_loop
     # hybrid mode: which CPU policy drives host emulation while the
@@ -302,7 +312,8 @@ class ExperimentalOptions:
         for f in dataclasses.fields(cls):
             if f.name in d:
                 v = d[f.name]
-                if f.name in ("runahead", "dispatch_segment"):
+                if f.name in ("runahead", "dispatch_segment",
+                              "checkpoint_save_time"):
                     v = parse_time_ns(v)
                 elif f.name in ("interface_buffer", "socket_recv_buffer",
                                 "socket_send_buffer"):
@@ -336,6 +347,19 @@ class ExperimentalOptions:
                       out.hybrid_cpu_policy,
                       [p for p in SCHEDULER_POLICIES
                        if p not in ("tpu", "hybrid")])
+        if out.checkpoint_save_time and not out.checkpoint_save:
+            raise ValueError(
+                "experimental.checkpoint_save_time is set but "
+                "checkpoint_save (the output path) is not — the "
+                "pause time would be silently ignored")
+        if (out.checkpoint_save or out.checkpoint_load) and \
+                out.scheduler_policy != "tpu":
+            raise ValueError(
+                "experimental.checkpoint_save/load: device-state "
+                "checkpointing requires scheduler_policy: tpu (CPU "
+                "policies execute managed OS processes, whose state "
+                "is not checkpointable — the reference has the same "
+                "limitation, i.e. no checkpoint at all)")
         if out.model_bandwidth and out.judge_placement == "flush":
             raise ValueError(
                 "experimental.judge_placement: flush cannot combine "
@@ -343,6 +367,7 @@ class ExperimentalOptions:
                 "is sequential per event; judgment stays in-step)")
         for name, minimum in (("event_capacity", 2),
                               ("dispatch_segment", 0),
+                              ("checkpoint_save_time", 0),
                               ("outbox_capacity", 1),
                               ("exchange_capacity", 0),
                               ("exchange_in_capacity", 0),

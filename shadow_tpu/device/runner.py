@@ -242,10 +242,38 @@ class DeviceRunner:
     def run(self, stop: int) -> SimStats:
         import time as _time
 
-        state = self.engine.init_state(self.sim.starts)
+        xp = self.sim.cfg.experimental
+        if xp.checkpoint_load:
+            from shadow_tpu.device import checkpoint
+            state, t_start = checkpoint.load_state(
+                self.engine, self.sim.starts, xp.checkpoint_load)
+            if t_start >= stop:
+                raise ValueError(
+                    f"checkpoint_load: saved state pauses at "
+                    f"{t_start} ns, at/after stop_time {stop} ns — "
+                    f"nothing to resume")
+            log.info("resumed checkpoint %s at t=%d ns",
+                     xp.checkpoint_load, t_start)
+        else:
+            state = self.engine.init_state(self.sim.starts)
+            t_start = 0
+        # with checkpoint_save, the run PAUSES at checkpoint_save_time
+        # (0 = at stop_time) and writes the state there; window
+        # clamping stays on the global stop either way, so the
+        # paused+resumed pair bit-matches the uninterrupted run
+        pause = stop
+        if xp.checkpoint_save:
+            if xp.checkpoint_save_time:
+                pause = min(stop, xp.checkpoint_save_time)
+            if pause <= t_start:
+                raise ValueError(
+                    f"checkpoint_save_time {pause} ns is not after "
+                    f"the run's start time {t_start} ns")
         t0 = _time.perf_counter()
         hb = self.sim.cfg.general.heartbeat_interval
-        seg = self.sim.cfg.experimental.dispatch_segment
+        seg = xp.dispatch_segment
+        budget_hit = False
+        t_end = pause
         if hb or seg:
             # pause the (single compiled) device program at each
             # heartbeat boundary and/or dispatch-segment boundary;
@@ -253,10 +281,12 @@ class DeviceRunner:
             # equals an unsegmented run
             rounds = 0
             budget = self.engine.config.max_rounds
-            t = 0
-            next_hb = hb if hb else None
-            while t < stop:
-                nxt = stop
+            t = t_start
+            next_hb = None
+            if hb:
+                next_hb = (t // hb + 1) * hb if t else hb
+            while t < pause:
+                nxt = pause
                 if next_hb is not None:
                     nxt = min(nxt, next_hb)
                 if seg:
@@ -272,15 +302,39 @@ class DeviceRunner:
                     log.warning("max_rounds (%d) exhausted during "
                                 "heartbeat segmentation; stopping",
                                 budget)
+                    budget_hit = True
                     break
+                # a boundary that lands exactly on `pause` still emits
+                # (an uninterrupted run would); only the global end
+                # suppresses — resume restarts past the saved t, so
+                # the pair emits each boundary exactly once
                 if next_hb is not None and t >= next_hb and t < stop:
                     self._emit_heartbeats(t, state)
                     next_hb += hb
+            t_end = t
         else:
             # pass stop explicitly: a cached/reused engine may have
             # been built for a different stop_time (runtime scalar)
-            state, rounds = self.engine.run(state, stop=stop)
+            state, rounds = self.engine.run(state, stop=pause,
+                                            final_stop=stop)
             rounds = int(rounds)
+            budget_hit = rounds >= self.engine.config.max_rounds
+        if xp.checkpoint_save:
+            if budget_hit:
+                # the simulation stopped at an unknown sim-time short
+                # of `pause`; stamping `pause` would let a resume skip
+                # unexecuted work, so refuse loudly instead
+                log.error("max_rounds exhausted before the checkpoint "
+                          "boundary — NOT saving %s",
+                          xp.checkpoint_save)
+            else:
+                from shadow_tpu.device import checkpoint
+                checkpoint.save_state(self.engine, state,
+                                      xp.checkpoint_save, t_end)
+                log.info("checkpoint saved at t=%d ns -> %s (run %s)",
+                         t_end, xp.checkpoint_save,
+                         "complete" if t_end >= stop else
+                         "paused early; resume with checkpoint_load")
         # fetch ONLY the stats the controller needs — the [H,E] event
         # heaps are ~20 MB at the 10k rung (250 MB at tor_large) and
         # dominate wall time over a tunneled TPU if pulled back
@@ -309,7 +363,7 @@ class DeviceRunner:
                  n_exec_total / wall if wall > 0 else 0.0)
 
         stats = SimStats()
-        stats.end_time = stop
+        stats.end_time = t_end
         stats.rounds = int(rounds)
         stats.events_executed = n_exec_total
         stats.packets_sent = int(final["n_sent"][:H].sum())

@@ -1,0 +1,136 @@
+"""Device-state checkpoint / resume (device/checkpoint.py).
+
+The reference has no checkpoint facility (SURVEY §5) — simulations
+run start-to-finish. The device engine's state is an explicit array
+pytree, so pause/save/resume is supported and must be bit-identical
+to the uninterrupted run: window clamping stays on the global stop
+(the same contract as heartbeat segmentation)."""
+
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller
+
+YAML = """
+general:
+  stop_time: 3s
+  seed: 11
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ directed 0
+        node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.1 ]
+        edge [ source 0 target 1 latency "20 ms" packet_loss 0.1 ]
+        edge [ source 1 target 1 latency "10 ms" packet_loss 0.1 ]
+      ]
+experimental:
+  scheduler_policy: tpu
+  event_capacity: 192
+  outbox_capacity: 256
+{extra}
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+    - path: model:tgen_server
+      start_time: 10ms
+  client:
+    quantity: 6
+    network_node_id: 1
+    processes:
+    - path: model:tgen_client
+      args: server=server size=200KiB count=3 pause=150ms retry=250ms
+      start_time: 100ms
+"""
+
+
+def _run(extra=""):
+    c = Controller(load_config_str(YAML.format(extra=extra)))
+    stats = c.run()
+    return stats, c
+
+
+def _sig(stats, c):
+    return (stats.events_executed, stats.packets_sent,
+            stats.packets_dropped, stats.packets_delivered,
+            [(h.name, h.trace_checksum) for h in c.sim.hosts])
+
+
+def test_pause_save_resume_bitmatches_uninterrupted(tmp_path):
+    ck = str(tmp_path / "state.npz")
+    full_stats, full_c = _run()
+    assert full_stats.ok
+
+    part_stats, _ = _run(
+        f"  checkpoint_save: {ck}\n"
+        f"  checkpoint_save_time: 1500ms")
+    assert part_stats.ok
+    # the pause point is mid-run: strictly less work than the full
+    # run, and the reported end time is the pause, not the config stop
+    assert part_stats.events_executed < full_stats.events_executed
+    assert part_stats.end_time == 1_500_000_000
+
+    res_stats, res_c = _run(f"  checkpoint_load: {ck}")
+    assert res_stats.ok
+    assert _sig(res_stats, res_c) == _sig(full_stats, full_c)
+
+
+def test_resume_with_heartbeat_segmentation(tmp_path):
+    """Resume under hb/dispatch segmentation still bit-matches (the
+    segmented loop starts at the saved t, heartbeat boundaries align
+    past it)."""
+    ck = str(tmp_path / "state.npz")
+    full_stats, full_c = _run()
+    _run(f"  checkpoint_save: {ck}\n"
+         f"  checkpoint_save_time: 1200ms")
+    res_stats, res_c = _run(
+        f"  checkpoint_load: {ck}\n"
+        f"  dispatch_segment: 700ms")
+    assert res_stats.ok
+    assert _sig(res_stats, res_c) == _sig(full_stats, full_c)
+
+
+def test_fingerprint_mismatch_rejected(tmp_path):
+    ck = str(tmp_path / "state.npz")
+    _run(f"  checkpoint_save: {ck}\n"
+         f"  checkpoint_save_time: 1500ms")
+    bad = YAML.replace("seed: 11", "seed: 12")
+    with pytest.raises(ValueError, match="does not match"):
+        Controller(load_config_str(bad.format(
+            extra=f"  checkpoint_load: {ck}"))).run()
+
+
+def test_topology_edit_rejected(tmp_path):
+    """A checkpoint resumed against an edited graph would replay the
+    remaining events on different latencies/losses — the topology is
+    part of the fingerprint, so the load must refuse."""
+    ck = str(tmp_path / "state.npz")
+    _run(f"  checkpoint_save: {ck}\n"
+         f"  checkpoint_save_time: 1500ms")
+    bad = YAML.replace('latency "20 ms"', 'latency "25 ms"')
+    with pytest.raises(ValueError, match="does not match"):
+        Controller(load_config_str(bad.format(
+            extra=f"  checkpoint_load: {ck}"))).run()
+
+
+def test_save_time_without_path_rejected():
+    with pytest.raises(ValueError, match="checkpoint_save_time"):
+        load_config_str(YAML.format(
+            extra="  checkpoint_save_time: 1s"))
+
+
+def test_checkpoint_requires_device_policy():
+    with pytest.raises(ValueError, match="scheduler_policy: tpu"):
+        load_config_str(YAML.format(
+            extra="  checkpoint_save: /tmp/x.npz").replace(
+            "scheduler_policy: tpu", "scheduler_policy: serial"))
+
+
+def test_resume_at_or_past_stop_rejected(tmp_path):
+    ck = str(tmp_path / "state.npz")
+    _run(f"  checkpoint_save: {ck}")     # pauses at stop_time
+    with pytest.raises(ValueError, match="nothing to resume"):
+        _run(f"  checkpoint_load: {ck}")
