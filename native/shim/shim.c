@@ -878,6 +878,12 @@ static const int kTrapSyscalls[] = {
 #ifdef SYS_clone3
     SYS_clone3,       /* refused with ENOSYS: glibc falls back to clone */
 #endif
+    /* mknod(at) must emulate regardless of privilege: running the
+     * simulator as root would otherwise let a plugin create REAL
+     * device nodes natively where an unprivileged run gets EPERM —
+     * a privilege-dependent divergence. Neither is issued in the
+     * post-execve loader window, so unconditional trapping is safe. */
+    SYS_mknod,        SYS_mknodat,
 };
 
 static const int kFdGatedSyscalls[] = {
@@ -898,6 +904,10 @@ static const int kFdGatedSyscalls[] = {
 #ifdef SYS_preadv2
     SYS_preadv2,   SYS_pwritev2,
 #endif
+    /* advisory I/O: native fds keep full-speed kernel advice (the
+     * kernel contract is "may be ignored", so native behavior equals
+     * the emulated deterministic success); VFD-backed fds funnel. */
+    SYS_fadvise64, SYS_readahead, SYS_sync_file_range, SYS_syncfs,
     /* dirfd(arg0)-relative path family (ref fileat.c): */
     SYS_unlinkat,  SYS_mkdirat,    SYS_readlinkat, SYS_faccessat,
 #ifdef SYS_faccessat2

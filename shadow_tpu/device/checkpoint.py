@@ -47,7 +47,7 @@ def _fingerprint(engine) -> dict:
     # scalars are the configuration surface).
     h = hashlib.sha256()
     for arr in (engine.host_vertex, engine.latency,
-                engine.reliability):
+                engine.reliability, engine.bw_up, engine.bw_down):
         a = np.ascontiguousarray(np.asarray(arr))
         h.update(str(a.shape).encode())
         h.update(a.tobytes())
@@ -60,6 +60,7 @@ def _fingerprint(engine) -> dict:
         "event_capacity": int(cfg.event_capacity),
         "outbox_capacity": int(cfg.outbox_capacity),
         "seed": int(cfg.seed),
+        "model_bandwidth": bool(cfg.model_bandwidth),
         "app": type(engine.app).__name__,
         "world": h.hexdigest(),
     }

@@ -269,6 +269,15 @@ class DeviceRunner:
                 raise ValueError(
                     f"checkpoint_save_time {pause} ns is not after "
                     f"the run's start time {t_start} ns")
+            # fail on an unwritable path NOW, in milliseconds — not
+            # after a multi-hour run when the state would be lost
+            try:
+                with open(xp.checkpoint_save, "ab"):
+                    pass
+            except OSError as e:
+                raise ValueError(
+                    f"checkpoint_save path {xp.checkpoint_save!r} "
+                    f"is not writable: {e}") from e
         t0 = _time.perf_counter()
         hb = self.sim.cfg.general.heartbeat_interval
         seg = xp.dispatch_segment
@@ -284,7 +293,7 @@ class DeviceRunner:
             t = t_start
             next_hb = None
             if hb:
-                next_hb = (t // hb + 1) * hb if t else hb
+                next_hb = (t // hb + 1) * hb
             while t < pause:
                 nxt = pause
                 if next_hb is not None:

@@ -105,6 +105,8 @@ NR = dict(
     prlimit64=302, prctl=157, set_robust_list=273,
     get_robust_list=274, getrlimit=97, setrlimit=160, fstatfs=138,
     preadv=295, pwritev=296, preadv2=327, pwritev2=328,
+    mknod=133, mknodat=259, readahead=187, fadvise64=221,
+    sync_file_range=277, syncfs=306,
 )
 NR_NAME = {v: k for k, v in NR.items()}
 
@@ -2036,6 +2038,86 @@ class SyscallHandler:
             return 0
         except OSError as e:
             return -e.errno
+
+    # advisory I/O (ref file.c: advice steers caching, never contents
+    # — the kernel contract is "may be ignored", so after fd/argument
+    # validation these are deterministic successes; sync_file_range
+    # additionally flushes like fdatasync so durability still holds)
+    def sys_fadvise64(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        if _s32(a[3]) not in (0, 1, 2, 3, 4, 5):   # POSIX_FADV_*
+            return -EINVAL
+        return 0
+
+    def sys_readahead(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        if _s64(a[1]) < 0:
+            return -EINVAL
+        return 0
+
+    def sys_sync_file_range(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        if _s64(a[1]) < 0 or _s64(a[2]) < 0 or int(a[3]) & ~0x7:
+            return -EINVAL
+        try:
+            os.fdatasync(d.osfd)
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    def sys_syncfs(self, ctx, a):
+        d = self._host_file(_s32(a[0]))
+        if not isinstance(d, HostFileDesc):
+            return d
+        try:
+            os.fsync(d.osfd)
+            return 0
+        except OSError as e:
+            return -e.errno
+
+    # mknod(at): regular files, FIFOs, and unix-socket nodes
+    # materialize in the confined data dir (the kernel allows all
+    # three unprivileged); char/block device nodes answer EPERM as
+    # the kernel does for unprivileged callers — emulated regardless
+    # of the simulator's own privilege, so a root-run simulation
+    # cannot create real device nodes a user-run one would refuse
+    def _mknod(self, dirfd, ptr, mode: int, dev: int):
+        fmt = mode & 0o170000
+        perm = mode & 0o7777
+        if fmt in (0, 0o100000):               # S_IFREG (0 = default)
+            def op(p):
+                fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                             perm)
+                os.close(fd)
+            return self._path_op(dirfd, ptr, op)
+        if fmt == 0o010000:                    # S_IFIFO
+            return self._path_op(dirfd, ptr,
+                                 lambda p: os.mkfifo(p, perm))
+        if fmt == 0o140000:                    # S_IFSOCK
+
+            def op(p):
+                import socket as _socket
+                s = _socket.socket(_socket.AF_UNIX,
+                                   _socket.SOCK_STREAM)
+                try:
+                    s.bind(p)
+                finally:
+                    s.close()
+                os.chmod(p, perm)
+            return self._path_op(dirfd, ptr, op)
+        return -EPERM
+
+    def sys_mknodat(self, ctx, a):
+        return self._mknod(_s32(a[0]), a[1], int(a[2]), int(a[3]))
+
+    def sys_mknod(self, ctx, a):
+        return self._mknod(self.AT_FDCWD, a[0], int(a[1]), int(a[2]))
 
     def sys_fchmod(self, ctx, a):
         d = self._host_file(_s32(a[0]))

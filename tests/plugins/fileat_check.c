@@ -12,6 +12,7 @@
 #include <string.h>
 #include <sys/file.h>
 #include <sys/stat.h>
+#include <sys/sysmacros.h>
 #include <sys/uio.h>
 #include <unistd.h>
 
@@ -129,6 +130,32 @@ int main(void) {
   }
   check("dino_matches_stat",
         stat("sub/a.txt", &st) == 0 && d_ino == (long)st.st_ino);
+
+  /* -- mknod(at): FIFOs and regular files land confined; device
+   * nodes answer EPERM like the kernel does unprivileged -- */
+  check("mknod_fifo", mknod("f.fifo", S_IFIFO | 0644, 0) == 0);
+  check("fifo_stat",
+        stat("f.fifo", &st) == 0 && S_ISFIFO(st.st_mode));
+  check("mknod_reg", mknod("plain.txt", S_IFREG | 0644, 0) == 0);
+  check("mknod_sock", mknod("s.sock", S_IFSOCK | 0600, 0) == 0);
+  check("sock_stat",
+        stat("s.sock", &st) == 0 && S_ISSOCK(st.st_mode));
+  check("mknod_dev",
+        mknod("dev0", S_IFCHR | 0644, makedev(1, 3)) == -1 &&
+        errno == EPERM);
+
+  /* -- advisory I/O: deterministic successes after validation -- */
+  int af = open("plain.txt", O_RDWR);
+  check("adv_open", af >= 0);
+  check("adv_write", write(af, "x", 1) == 1);
+  check("fadvise",
+        posix_fadvise(af, 0, 0, POSIX_FADV_SEQUENTIAL) == 0);
+  check("fadvise_bad", posix_fadvise(af, 0, 0, 99) == EINVAL);
+  check("readahead", readahead(af, 0, 4096) == 0);
+  check("sync_range",
+        sync_file_range(af, 0, 0, SYNC_FILE_RANGE_WRITE) == 0);
+  check("syncfs", syncfs(af) == 0);
+  close(af);
   printf("done\n");
   return 0;
 }

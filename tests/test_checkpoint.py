@@ -116,6 +116,25 @@ def test_topology_edit_rejected(tmp_path):
             extra=f"  checkpoint_load: {ck}"))).run()
 
 
+def test_bandwidth_edit_rejected(tmp_path):
+    """Per-host bandwidths steer packet timing (model NIC) — they are
+    fingerprinted too, so an edited-bandwidth resume refuses."""
+    ck = str(tmp_path / "state.npz")
+    _run(f"  checkpoint_save: {ck}\n"
+         f"  checkpoint_save_time: 1500ms")
+    bad = YAML.replace('id 1 bandwidth_down "1 Gbit"',
+                       'id 1 bandwidth_down "500 Mbit"')
+    with pytest.raises(ValueError, match="does not match"):
+        Controller(load_config_str(bad.format(
+            extra=f"  checkpoint_load: {ck}"))).run()
+
+
+def test_unwritable_save_path_fails_fast(tmp_path):
+    with pytest.raises(ValueError, match="not writable"):
+        _run("  checkpoint_save: "
+             f"{tmp_path}/no-such-dir/state.npz")
+
+
 def test_save_time_without_path_rejected():
     with pytest.raises(ValueError, match="checkpoint_save_time"):
         load_config_str(YAML.format(
