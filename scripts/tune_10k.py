@@ -1,0 +1,77 @@
+"""On-chip knob sweep for the 10k north-star rung.
+
+Runs the fused 2.5 sim-s tgen_10000 slice across the perf knobs that
+cannot be chosen off-chip (TPU gather/sort/VPU cost ratios differ from
+CPU by >10x): pop_strategy x burst_pops (and optionally
+merge_strategy), printing wall seconds + derived ms/round per combo
+and ONE final JSON line with the best combo. Every run must report
+identical delivery counts — a combo that diverges is flagged loudly
+and disqualified (the knobs are all trace-invariant by contract).
+
+Usage: python scripts/tune_10k.py [stop_s] [config]
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+POPS = ("onehot", "gather")
+BURSTS = (8, 16, 32)
+
+
+def main() -> int:
+    stop_s = float(sys.argv[1]) if len(sys.argv) > 1 else 2.5
+    config = sys.argv[2] if len(sys.argv) > 2 else \
+        "examples/tgen_10000.yaml"
+
+    from shadow_tpu._jax import jax
+    from shadow_tpu import simtime
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+
+    platform = jax.devices()[0].platform
+    results = []
+    ref_counts = None
+    for pop, bp in itertools.product(POPS, BURSTS):
+        cfg = load_config(config)
+        cfg.general.stop_time = simtime.from_seconds(stop_s)
+        cfg.experimental.pop_strategy = pop
+        cfg.experimental.burst_pops = bp
+        c = Controller(cfg)
+        t0 = time.perf_counter()
+        stats = c.run()
+        wall = time.perf_counter() - t0
+        counts = (stats.events_executed, stats.packets_sent,
+                  stats.packets_delivered, stats.packets_dropped)
+        ok = bool(stats.ok)
+        if ref_counts is None:
+            ref_counts = counts
+        match = counts == ref_counts
+        row = {"pop": pop, "burst": bp, "wall_s": round(wall, 2),
+               "rounds": stats.rounds,
+               "ms_per_round": round(1e3 * wall / max(1, stats.rounds),
+                                     2),
+               "ok": ok, "counts_match": match}
+        results.append(row)
+        print(f"  pop={pop:7s} burst={bp:2d}: {wall:6.2f}s "
+              f"{row['ms_per_round']:7.2f} ms/round "
+              f"{'' if match and ok else ' <== DIVERGED/FAILED'}",
+              file=sys.stderr, flush=True)
+
+    good = [r for r in results if r["ok"] and r["counts_match"]]
+    best = min(good, key=lambda r: r["wall_s"]) if good else None
+    print(json.dumps({"workload": config, "platform": platform,
+                      "slice_sim_s": stop_s, "results": results,
+                      "best": best}))
+    return 0 if good else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -276,6 +276,13 @@ class ExperimentalOptions:
     # take_along_axis (cheaper on one CPU core). "auto" picks by
     # platform. Bit-identical traces either way.
     pop_strategy: str = "auto"      # auto | onehot | gather
+    # burst-pop lane width override (0 = the app's own declaration):
+    # burst apps (tgen servers, tor relays) pop up to this many
+    # consecutive in-window packet events per iteration, one send
+    # lane each. Traces are width-invariant; the knob trades
+    # per-iteration vector width (nearly free on TPU) against
+    # iteration count (the serial cost). 1 disables bursting.
+    burst_pops: int = 0
     # max simulated time per device dispatch (ns; 0 = unbounded):
     # long runs split into several invocations of the one compiled
     # program with identical traces (window clamping stays on the
@@ -372,12 +379,23 @@ class ExperimentalOptions:
                               ("exchange_capacity", 0),
                               ("exchange_in_capacity", 0),
                               ("outbox_compact", 0),
+                              ("burst_pops", 0),
                               ("device_batch_rounds", 1),
                               ("hybrid_judge_min_batch", 0),
                               ("preload_spin_max", 0)):
             if getattr(out, name) < minimum:
                 raise ValueError(
                     f"experimental.{name} must be >= {minimum}")
+        if out.burst_pops > 32:
+            raise ValueError(
+                "experimental.burst_pops must be <= 32 (the per-lane "
+                "checksum fold unrolls P-wide in the compiled step)")
+        if out.burst_pops > 1 and out.model_bandwidth:
+            raise ValueError(
+                "experimental.burst_pops > 1 cannot combine with "
+                "model_bandwidth (the fluid NIC's tx/rx state is "
+                "sequential per event — the engine would silently "
+                "degrade the requested width to 1)")
         return out
 
 

@@ -53,6 +53,12 @@ def _fingerprint(engine) -> dict:
         h.update(a.tobytes())
     app_params = {k: v for k, v in sorted(vars(engine.app).items())
                   if isinstance(v, (bool, int, float, str))}
+    # burst_pops is a trace-invariant lane-width knob (pinned by
+    # test_burst_width_identical_traces) that the runner writes onto
+    # the app when experimental.burst_pops overrides it — retuning
+    # width across a save/resume pair is exactly its use case, so it
+    # must not poison the fingerprint
+    app_params.pop("burst_pops", None)
     h.update(json.dumps(app_params, sort_keys=True).encode())
     return {
         "n_hosts": int(cfg.n_hosts),

@@ -166,6 +166,21 @@ class DeviceRunner:
         # event per phase, paying one collective exchange per event.
         # Give bursty apps 8 bursts of room unless the config asks for
         # more.
+        bp = cfg.experimental.burst_pops
+        if bp:
+            # width override for on-chip tuning: lowering to 1 is
+            # always safe (disables bursting); raising needs an app
+            # that implements the burst contract (handle_burst +
+            # burst_mask). Traces are P-invariant — per-host pop
+            # order is (t, src, seq) regardless of lane width —
+            # pinned by test_burst_width_identical_traces.
+            if bp > 1 and getattr(self.app, "burst_pops", 1) <= 1:
+                raise ValueError(
+                    "experimental.burst_pops > 1 requires an app "
+                    "with burst support (stateless-responder "
+                    "contract); this app pops one event per "
+                    "iteration")
+            self.app.burst_pops = bp
         burst = max(1, getattr(self.app, "burst_pops", 1))
         per_iter = self.app.max_sends * burst + self.app.max_timers
         # floor the outbox at 8 iterations per phase — 4 when bursts
@@ -270,7 +285,11 @@ class DeviceRunner:
                     f"checkpoint_save_time {pause} ns is not after "
                     f"the run's start time {t_start} ns")
             # fail on an unwritable path NOW, in milliseconds — not
-            # after a multi-hour run when the state would be lost
+            # after a multi-hour run when the state would be lost.
+            # The probe must not leave a zero-byte decoy behind if
+            # the run later dies before saving
+            import os as _os
+            existed = _os.path.lexists(xp.checkpoint_save)
             try:
                 with open(xp.checkpoint_save, "ab"):
                     pass
@@ -278,6 +297,8 @@ class DeviceRunner:
                 raise ValueError(
                     f"checkpoint_save path {xp.checkpoint_save!r} "
                     f"is not writable: {e}") from e
+            if not existed:
+                _os.unlink(xp.checkpoint_save)
         t0 = _time.perf_counter()
         hb = self.sim.cfg.general.heartbeat_interval
         seg = xp.dispatch_segment

@@ -2072,11 +2072,18 @@ class SyscallHandler:
             return -e.errno
 
     def sys_syncfs(self, ctx, a):
+        # syncfs flushes the whole filesystem holding the fd; the
+        # emulated "filesystem" is the host data dir, so every open
+        # os-backed descriptor of this process flushes (a superset of
+        # the single fd; a single fsync would silently weaken the
+        # durability contract)
         d = self._host_file(_s32(a[0]))
         if not isinstance(d, HostFileDesc):
             return d
         try:
-            os.fsync(d.osfd)
+            for desc in list(self.table._slots.values()):
+                if isinstance(desc, HostFileDesc) and not desc.closed:
+                    os.fsync(desc.osfd)
             return 0
         except OSError as e:
             return -e.errno
@@ -2100,18 +2107,15 @@ class SyscallHandler:
             return self._path_op(dirfd, ptr,
                                  lambda p: os.mkfifo(p, perm))
         if fmt == 0o140000:                    # S_IFSOCK
-
-            def op(p):
-                import socket as _socket
-                s = _socket.socket(_socket.AF_UNIX,
-                                   _socket.SOCK_STREAM)
-                try:
-                    s.bind(p)
-                finally:
-                    s.close()
-                os.chmod(p, perm)
-            return self._path_op(dirfd, ptr, op)
-        return -EPERM
+            # os.mknod of a socket node needs no privilege and keeps
+            # kernel errnos (EEXIST on collision; no AF_UNIX 108-byte
+            # sun_path cap that a bind()-based emulation would hit on
+            # deeply nested data dirs)
+            return self._path_op(dirfd, ptr,
+                                 lambda p: os.mknod(p, fmt | perm))
+        if fmt in (0o020000, 0o060000):        # S_IFCHR / S_IFBLK
+            return -EPERM
+        return -EINVAL                         # S_IFDIR / garbage
 
     def sys_mknodat(self, ctx, a):
         return self._mknod(_s32(a[0]), a[1], int(a[2]), int(a[3]))

@@ -143,6 +143,10 @@ int main(void) {
   check("mknod_dev",
         mknod("dev0", S_IFCHR | 0644, makedev(1, 3)) == -1 &&
         errno == EPERM);
+  check("mknod_sock_exists",
+        mknod("s.sock", S_IFSOCK | 0600, 0) == -1 && errno == EEXIST);
+  check("mknod_dir_einval",
+        mknod("dx", S_IFDIR | 0755, 0) == -1 && errno == EINVAL);
 
   /* -- advisory I/O: deterministic successes after validation -- */
   int af = open("plain.txt", O_RDWR);

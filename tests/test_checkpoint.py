@@ -116,6 +116,21 @@ def test_topology_edit_rejected(tmp_path):
             extra=f"  checkpoint_load: {ck}"))).run()
 
 
+def test_resume_at_different_burst_width(tmp_path):
+    """burst_pops is a trace-invariant perf knob — retuning it across
+    a save/resume pair (the on-chip tuning workflow) must neither be
+    rejected by the fingerprint nor change the trace."""
+    ck = str(tmp_path / "state.npz")
+    full_stats, full_c = _run()
+    _run(f"  checkpoint_save: {ck}\n"
+         f"  checkpoint_save_time: 1500ms\n"
+         f"  burst_pops: 4")
+    res_stats, res_c = _run(f"  checkpoint_load: {ck}\n"
+                            f"  burst_pops: 8")
+    assert res_stats.ok
+    assert _sig(res_stats, res_c) == _sig(full_stats, full_c)
+
+
 def test_bandwidth_edit_rejected(tmp_path):
     """Per-host bandwidths steer packet timing (model NIC) — they are
     fingerprinted too, so an edited-bandwidth resume refuses."""
