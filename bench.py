@@ -119,6 +119,30 @@ def init_backend():
         raise RuntimeError(f"no jax backend: {e}") from last
 
 
+TUNE_PATH = os.path.join("artifacts", "TUNE_tpu.json")
+_tuned: dict = {}
+
+
+def load_tuned_knobs() -> dict:
+    """Best (pop_strategy, burst_pops) combo measured ON CHIP by
+    scripts/tune_10k.py, if a committed sweep artifact exists. The
+    gather/sort/VPU cost ratios differ >10x between platforms, so the
+    sweep is the authority on TPU; CPU keeps the auto defaults.
+    Invalid/missing artifacts mean no overrides (auto)."""
+    try:
+        with open(TUNE_PATH) as f:
+            t = json.load(f)
+        best = t.get("best") or {}
+        if t.get("platform") == "tpu" and best.get("counts_match"):
+            return {"pop_strategy": str(best["pop"]),
+                    "burst_pops": int(best["burst"])}
+    except Exception as e:              # noqa: BLE001
+        # a malformed artifact must never abort the bench — auto
+        # knobs are always a safe fallback
+        log(f"ignoring unreadable {TUNE_PATH}: {e}")
+    return {}
+
+
 def load(config_path: str, policy: str, stop_s: float):
     from shadow_tpu import simtime
     from shadow_tpu.config import load_config
@@ -126,6 +150,9 @@ def load(config_path: str, policy: str, stop_s: float):
     cfg = load_config(config_path)
     cfg.experimental.scheduler_policy = policy
     cfg.general.stop_time = simtime.from_seconds(stop_s)
+    if policy == "tpu" and _tuned:
+        cfg.experimental.pop_strategy = _tuned["pop_strategy"]
+        cfg.experimental.burst_pops = _tuned["burst_pops"]
     return cfg
 
 
@@ -345,6 +372,11 @@ def main() -> int:
         devs, fell_back = init_backend()
         n_chips = len({d.id for d in devs})
         result["platform"] = devs[0].platform
+        if not fell_back:
+            _tuned.update(load_tuned_knobs())
+            if _tuned:
+                log(f"applying on-chip tuned knobs: {_tuned}")
+                result["tuned_knobs"] = dict(_tuned)
         rungs, headline, full_stop = RUNGS, HEADLINE, FULL_STOP_S
         if fell_back:
             result["error"] = ("tpu backend unavailable; numbers are "

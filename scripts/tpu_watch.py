@@ -6,9 +6,11 @@ probe subprocess gets a generous natural window and a SIGTERM + grace
 shutdown (never a bare SIGKILL on a possibly-mid-claim client) — and
 the moment a probe sees a real accelerator it runs, in order:
 
-  1. python bench.py                    -> artifacts/BENCH_tpu.json
-  2. scripts/profile_device.py 10k rung -> artifacts/PROFILE_tpu.json
-  3. scripts/tor_large_run.py 12        -> artifacts/TORLARGE_tpu.json
+  1. scripts/tune_10k.py 2.5            -> artifacts/TUNE_tpu.json
+     (pop_strategy x burst_pops sweep; bench.py reads the best combo)
+  2. python bench.py                    -> artifacts/BENCH_tpu.json
+  3. scripts/profile_device.py 10k rung -> artifacts/PROFILE_tpu.json
+  4. scripts/tor_large_run.py 12        -> artifacts/TORLARGE_tpu.json
      (the longest step: a full-state 56k-host execution; the watcher
      holds the single-client relay for its duration)
 
@@ -70,7 +72,12 @@ def main() -> int:
     deadline = time.monotonic() + max_hours * 3600
     while time.monotonic() < deadline:
         if probe_once():
-            log("TPU is back — running bench")
+            log("TPU is back — running the 10k knob sweep")
+            run_and_save([sys.executable, "scripts/tune_10k.py",
+                          "2.5"],
+                         f"{ART}/TUNE_tpu.json",
+                         f"{ART}/TUNE_tpu.log")
+            log("sweep done — running bench (tuned knobs apply)")
             run_and_save([sys.executable, "bench.py"],
                          f"{ART}/BENCH_tpu.json",
                          f"{ART}/BENCH_tpu.log")

@@ -2081,12 +2081,21 @@ class SyscallHandler:
         if not isinstance(d, HostFileDesc):
             return d
         try:
-            for desc in list(self.table._slots.values()):
-                if isinstance(desc, HostFileDesc) and not desc.closed:
-                    os.fsync(desc.osfd)
-            return 0
+            os.fsync(d.osfd)        # the argument fd's failure reports
         except OSError as e:
             return -e.errno
+        for desc in list(self.table._slots.values()):
+            if desc is d or not isinstance(desc, HostFileDesc) \
+                    or desc.closed:
+                continue
+            try:
+                os.fsync(desc.osfd)
+            except OSError:
+                # best-effort for the rest: an unsyncable sibling
+                # (O_PATH passthrough and the like) must not fail the
+                # whole-filesystem flush the way it would not natively
+                continue
+        return 0
 
     # mknod(at): regular files, FIFOs, and unix-socket nodes
     # materialize in the confined data dir (the kernel allows all
