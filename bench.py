@@ -124,18 +124,29 @@ _tuned: dict = {}
 
 
 def load_tuned_knobs() -> dict:
-    """Best (pop_strategy, burst_pops) combo measured ON CHIP by
-    scripts/tune_10k.py, if a committed sweep artifact exists. The
-    gather/sort/VPU cost ratios differ >10x between platforms, so the
-    sweep is the authority on TPU; CPU keeps the auto defaults.
-    Invalid/missing artifacts mean no overrides (auto)."""
+    """Best (pop_strategy, burst_pops, outbox_compact) combo measured
+    ON CHIP by scripts/tune_10k.py, if a committed sweep artifact
+    exists. The gather/sort/VPU cost ratios differ >10x between
+    platforms, so the sweep is the authority on TPU; CPU keeps the
+    auto defaults. Invalid/missing artifacts mean no overrides
+    (auto). outbox_compact is capacity-sensitive — it applies only to
+    the swept workload, and run_device_tuned retries without it if
+    the full run overflows the slice-validated width."""
     try:
         with open(TUNE_PATH) as f:
             t = json.load(f)
         best = t.get("best") or {}
         if t.get("platform") == "tpu" and best.get("counts_match"):
-            return {"pop_strategy": str(best["pop"]),
-                    "burst_pops": int(best["burst"])}
+            knobs = {"pop_strategy": str(best["pop"]),
+                     "burst_pops": int(best["burst"])}
+            if best.get("compact") is not None:
+                # capacity-sensitive: only valid for the exact
+                # workload it was swept on (other rungs have other
+                # per-phase fan-ins and could overflow loudly)
+                knobs["outbox_compact"] = int(best["compact"])
+                knobs["workload"] = os.path.normpath(
+                    t.get("workload", ""))
+            return knobs
     except Exception as e:              # noqa: BLE001
         # a malformed artifact must never abort the bench — auto
         # knobs are always a safe fallback
@@ -153,6 +164,13 @@ def load(config_path: str, policy: str, stop_s: float):
     if policy == "tpu" and _tuned:
         cfg.experimental.pop_strategy = _tuned["pop_strategy"]
         cfg.experimental.burst_pops = _tuned["burst_pops"]
+        if "outbox_compact" in _tuned:
+            if _tuned.get("workload") == os.path.normpath(config_path):
+                cfg.experimental.outbox_compact = \
+                    _tuned["outbox_compact"]
+            else:
+                log(f"tuned outbox_compact not applied to "
+                    f"{config_path} (swept on {_tuned.get('workload')})")
     return cfg
 
 
@@ -191,6 +209,29 @@ def run_device(config_path: str, stop_s: float,
             f"device run of {config_path} (stop={stop_s}s) overflowed "
             "— the capacity plan is wrong; see log for the knob")
     return wall, stats.packets_sent, stop_s
+
+
+def run_device_tuned(config_path: str, stop_s: float,
+                     engine_cache: dict,
+                     segment_s: float = 0.0) -> tuple[float, int, float]:
+    """run_device, but a loud overflow while the tuned outbox_compact
+    is applied retries once WITHOUT it: the sweep validates compact on
+    a bounded slice, and a steady-state window of the full run can
+    legitimately exceed the compacted width — that must cost the knob,
+    never the benchmark."""
+    try:
+        return run_device(config_path, stop_s, engine_cache,
+                          segment_s)
+    except RuntimeError as e:
+        if "overflow" in str(e) and \
+                _tuned.pop("outbox_compact", None) is not None:
+            _tuned.pop("workload", None)
+            log(f"tuned outbox_compact overflowed on {config_path}; "
+                "retrying without it")
+            engine_cache.pop(config_path, None)
+            return run_device(config_path, stop_s, engine_cache,
+                              segment_s)
+        raise
 
 
 def run_cpu_thread(config_path: str, stop_s: float
@@ -407,7 +448,8 @@ def main() -> int:
                     log(f"{name}: skipped ({ladder[name]['skipped']})")
                     continue
             log(f"{name}: device slice ({slice_s}s sim)")
-            d_wall, d_pkts, _ = run_device(path, slice_s, engine_cache)
+            d_wall, d_pkts, _ = run_device_tuned(path, slice_s,
+                                                 engine_cache)
             log(f"  device: {d_pkts} pkts in {d_wall:.2f}s "
                 f"({d_pkts / d_wall:,.0f}/s)")
             log(f"{name}: cpu thread slice ({slice_s}s sim)")
@@ -438,7 +480,7 @@ def main() -> int:
         log(f"{headline}: device full run ({full_stop}s sim, "
             "2.5s-sim dispatch segments)")
         headline_path = dict((n, p) for n, p, _ in rungs)[headline]
-        f_wall, f_pkts, f_sim = run_device(
+        f_wall, f_pkts, f_sim = run_device_tuned(
             headline_path, full_stop, engine_cache, segment_s=2.5)
         sim_per_wall = f_sim / f_wall
         log(f"  full: {f_pkts} pkts in {f_wall:.2f}s "
@@ -465,6 +507,11 @@ def main() -> int:
         result["error"] = str(e)
         log(f"FAILED: {e}")
         rc = 1
+    if "tuned_knobs" in result:
+        # the overflow fallback may have dropped outbox_compact
+        # mid-run — the artifact must report what actually applied
+        result["tuned_knobs"] = {k: v for k, v in _tuned.items()
+                                 if k != "workload"}
     print(json.dumps(result), flush=True)
     return rc
 

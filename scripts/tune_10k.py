@@ -2,11 +2,14 @@
 
 Runs the fused 2.5 sim-s tgen_10000 slice across the perf knobs that
 cannot be chosen off-chip (TPU gather/sort/VPU cost ratios differ from
-CPU by >10x): pop_strategy x burst_pops (and optionally
-merge_strategy), printing wall seconds + derived ms/round per combo
-and ONE final JSON line with the best combo. Every run must report
-identical delivery counts — a combo that diverges is flagged loudly
-and disqualified (the knobs are all trace-invariant by contract).
+CPU by >10x): pop_strategy x burst_pops x outbox_compact, printing
+wall seconds + derived ms/round per combo and ONE final JSON line
+with the best combo. pop/burst are trace-invariant by contract; a
+combo that diverges anyway is flagged loudly and disqualified.
+outbox_compact is CAPACITY-sensitive: too small fails loudly
+(x_overflow) and is disqualified here, and because the sweep slice
+may not cover steady state, bench.py re-guards it (workload match +
+retry-without on overflow).
 
 Usage: python scripts/tune_10k.py [stop_s] [config]
 """
@@ -23,7 +26,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 POPS = ("onehot", "gather")
-BURSTS = (8, 16, 32)
+BURSTS = (8, 16)
+# outbox compaction shrinks the global merge's outbox block (the 10k
+# outbox is ~99% empty: ~2.8k real events/round over H*OB = 400k
+# rows) at the price of one per-host lane sort; too small fails
+# LOUDLY (x_overflow) and the sweep just disqualifies that combo
+COMPACTS = (0, 16)
 
 
 def main() -> int:
@@ -39,27 +47,33 @@ def main() -> int:
     platform = jax.devices()[0].platform
     results = []
     all_counts = []
-    for pop, bp in itertools.product(POPS, BURSTS):
+    for pop, bp, cx in itertools.product(POPS, BURSTS, COMPACTS):
         cfg = load_config(config)
         cfg.general.stop_time = simtime.from_seconds(stop_s)
         cfg.experimental.pop_strategy = pop
         cfg.experimental.burst_pops = bp
+        cfg.experimental.outbox_compact = cx
         c = Controller(cfg)
         t0 = time.perf_counter()
-        stats = c.run()
+        try:
+            stats = c.run()
+            ok = bool(stats.ok)
+            counts = (stats.events_executed, stats.packets_sent,
+                      stats.packets_delivered, stats.packets_dropped)
+            rounds = stats.rounds
+        except Exception as e:          # noqa: BLE001
+            print(f"  pop={pop} burst={bp} compact={cx}: "
+                  f"RAISED {e}", file=sys.stderr, flush=True)
+            ok, counts, rounds = False, None, 0
         wall = time.perf_counter() - t0
-        counts = (stats.events_executed, stats.packets_sent,
-                  stats.packets_delivered, stats.packets_dropped)
-        ok = bool(stats.ok)
-        row = {"pop": pop, "burst": bp, "wall_s": round(wall, 2),
-               "rounds": stats.rounds,
-               "ms_per_round": round(1e3 * wall / max(1, stats.rounds),
-                                     2),
+        row = {"pop": pop, "burst": bp, "compact": cx,
+               "wall_s": round(wall, 2), "rounds": rounds,
+               "ms_per_round": round(1e3 * wall / max(1, rounds), 2),
                "ok": ok}
         results.append(row)
         all_counts.append(counts)
-        print(f"  pop={pop:7s} burst={bp:2d}: {wall:6.2f}s "
-              f"{row['ms_per_round']:7.2f} ms/round "
+        print(f"  pop={pop:7s} burst={bp:2d} compact={cx:2d}: "
+              f"{wall:6.2f}s {row['ms_per_round']:7.2f} ms/round "
               f"{'' if ok else ' <== FAILED'}",
               file=sys.stderr, flush=True)
 
@@ -73,7 +87,8 @@ def main() -> int:
         r["counts_match"] = bool(r["ok"] and c == ref)
         if r["ok"] and not r["counts_match"]:
             print(f"  DIVERGED: pop={r['pop']} burst={r['burst']} "
-                  f"{c} != {ref}", file=sys.stderr, flush=True)
+                  f"compact={r['compact']} {c} != {ref}",
+                  file=sys.stderr, flush=True)
     good = [r for r in results if r["counts_match"]]
     best = min(good, key=lambda r: r["wall_s"]) if good else None
     print(json.dumps({"workload": config, "platform": platform,
