@@ -139,7 +139,7 @@ def load_tuned_knobs() -> dict:
         if t.get("platform") == "tpu" and best.get("counts_match"):
             knobs = {"pop_strategy": str(best["pop"]),
                      "burst_pops": int(best["burst"])}
-            if best.get("compact") is not None:
+            if best.get("compact"):    # 0 = off, not a knob to carry
                 # capacity-sensitive: only valid for the exact
                 # workload it was swept on (other rungs have other
                 # per-phase fan-ins and could overflow loudly)
@@ -223,8 +223,10 @@ def run_device_tuned(config_path: str, stop_s: float,
         return run_device(config_path, stop_s, engine_cache,
                           segment_s)
     except RuntimeError as e:
-        if "overflow" in str(e) and \
-                _tuned.pop("outbox_compact", None) is not None:
+        applied = "outbox_compact" in _tuned and \
+            _tuned.get("workload") == os.path.normpath(config_path)
+        if "overflow" in str(e) and applied:
+            _tuned.pop("outbox_compact", None)
             _tuned.pop("workload", None)
             log(f"tuned outbox_compact overflowed on {config_path}; "
                 "retrying without it")

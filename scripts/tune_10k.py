@@ -27,17 +27,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 POPS = ("onehot", "gather")
 BURSTS = (8, 16)
-# outbox compaction shrinks the global merge's outbox block (the 10k
-# outbox is ~99% empty: ~2.8k real events/round over H*OB = 400k
-# rows) at the price of one per-host lane sort; too small fails
-# LOUDLY (x_overflow) and the sweep just disqualifies that combo
-COMPACTS = (0, 16)
+# outbox compaction shrinks the global merge's outbox block at the
+# price of one per-host lane sort; too small fails LOUDLY
+# (x_overflow) and the sweep just disqualifies that combo. The width
+# is uniform and thus bounded by the BUSIEST host — on the hub-shaped
+# 10k config a burst server legitimately fills its whole 40-row
+# outbox (measured: compact=16 overflows 3k+ rows in the first
+# traffic window), so the axis defaults OFF here; pass extra compact
+# widths as trailing args for flatter workloads.
+COMPACTS = (0,)
 
 
 def main() -> int:
     stop_s = float(sys.argv[1]) if len(sys.argv) > 1 else 2.5
     config = sys.argv[2] if len(sys.argv) > 2 else \
         "examples/tgen_10000.yaml"
+    compacts = tuple(int(a) for a in sys.argv[3:]) or COMPACTS
 
     from shadow_tpu._jax import jax
     from shadow_tpu import simtime
@@ -47,7 +52,7 @@ def main() -> int:
     platform = jax.devices()[0].platform
     results = []
     all_counts = []
-    for pop, bp, cx in itertools.product(POPS, BURSTS, COMPACTS):
+    for pop, bp, cx in itertools.product(POPS, BURSTS, compacts):
         cfg = load_config(config)
         cfg.general.stop_time = simtime.from_seconds(stop_s)
         cfg.experimental.pop_strategy = pop

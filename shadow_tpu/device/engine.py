@@ -1252,34 +1252,62 @@ class DeviceEngine:
                     concat_axis=0).reshape(n_shards * CAP)
             return state, moved, kmoved
 
+        def _compact_flat(state, ob):
+            """Gatherless outbox compaction for the GLOBAL merge
+            (outbox_compact; the window path has its own in
+            _flat_sorted): one 5-operand lane sort brings each
+            host's exchangeable rows (t < DROP_T — they sort before
+            judged-drop DROP_T markers and empty INF slots) to the
+            front, then a STATIC slice keeps the first CX columns —
+            zero gathers. Real rows beyond CX count loudly into
+            x_overflow against the sending host. Shrinks the merge's
+            double sort from H*(OB+E) to H*(CX+E) rows."""
+            if CX >= OB:
+                return state, \
+                    {f: ob[f].reshape(H_loc * OB) for f in XF}
+            st, sk, sm, ss, sv = lax.sort(
+                (ob["t"], ob["k"], ob["m"], ob["s"], ob["v"]),
+                dimension=1, num_keys=1)
+            state["x_overflow"] = state["x_overflow"] + \
+                (st[:, CX:] < DROP_T).sum(-1).astype(jnp.int32)
+            comp = {"t": st, "k": sk, "m": sm, "s": ss, "v": sv}
+            return state, {f: comp[f][:, :CX].reshape(H_loc * CX)
+                           for f in XF}
+
         def _exchange_global(state, ob, gid, my_shard):
             lo = my_shard.astype(jnp.int32) * H_loc
             hi = lo + H_loc
-            flat = {f: ob[f].reshape(H_loc * OB) for f in XF}
             if n_shards > 1 and cfg.exchange == "all_to_all":
                 # remote rows pack per (src shard, dst shard) for the
                 # all_to_all (x_overflow accounting shared with the
                 # window path); self-shard rows bypass the pack and
-                # feed the merge directly
+                # feed the merge directly. _flat_sorted already
+                # compacts its returned rows to CX (and counts the
+                # loss once) — reuse them for the self-shard part
+                # instead of re-compacting ob
                 state, skey, perm, rows = _flat_sorted(state, ob, gid)
                 state, moved, _ = _pack_remote(
                     state, skey, perm, rows, my_shard,
                     ship_keys=False)
                 parts = [
-                    _ob_rows(flat["t"], flat["k"], flat["m"],
-                             flat["s"], flat["v"], lo, hi),
+                    _ob_rows(rows["t"], rows["k"], rows["m"],
+                             rows["s"], rows["v"], lo, hi),
                     _ob_rows(moved["t"], moved["k"], moved["m"],
                              moved["s"], moved["v"], lo, hi),
                 ]
             elif n_shards > 1:
-                # all_gather fallback: replicate every shard's raw
-                # outbox rows; each shard keeps its own via the
-                # [lo, hi) mask inside _ob_rows
+                # all_gather fallback: replicate every shard's
+                # (compacted) outbox rows — compaction also cuts the
+                # replicated ICI volume OB -> CX; each shard keeps
+                # its own via the [lo, hi) mask inside _ob_rows
+                state, flat = _compact_flat(state, ob)
+                W = flat["t"].shape[0]
                 allf = {f: lax.all_gather(flat[f], AXIS)
-                        .reshape(n_shards * H_loc * OB) for f in XF}
+                        .reshape(n_shards * W) for f in XF}
                 parts = [_ob_rows(allf["t"], allf["k"], allf["m"],
                                   allf["s"], allf["v"], lo, hi)]
             else:
+                state, flat = _compact_flat(state, ob)
                 parts = [_ob_rows(flat["t"], flat["k"], flat["m"],
                                   flat["s"], flat["v"], lo, hi)]
             return _merge_rows(state, parts)

@@ -294,6 +294,44 @@ def test_merge_strategy_identical_traces_all_gather():
     assert outs["window"] == outs["global"]
 
 
+def test_outbox_compact_global_identical_traces():
+    """Gatherless compaction on the GLOBAL merge path (lane sort +
+    static slice): with a width that fits the real per-host fan-out,
+    traces must bit-match the uncompacted global merge — on the
+    8-device mesh over both exchanges (all_to_all self-shard rows and
+    the all_gather replication, whose ICI volume compaction cuts)."""
+    for exchange in ("all_to_all", "all_gather"):
+        outs = {}
+        for cx in (0, 12):
+            yaml = PHOLD_YAML.format(policy="tpu", seed=7, loss=0.1,
+                                     q=8, msgload=3)
+            yaml = yaml.replace(
+                "experimental:",
+                f"experimental:\n  exchange: {exchange}\n"
+                f"  merge_strategy: global\n  outbox_compact: {cx}")
+            c = Controller(load_config_str(yaml))
+            stats = c.run()
+            assert stats.ok, (exchange, cx)
+            outs[cx] = (stats.events_executed, stats.packets_sent,
+                        stats.packets_dropped,
+                        [h.trace_checksum for h in c.sim.hosts])
+        assert outs[0] == outs[12], exchange
+
+
+def test_outbox_compact_global_overflow_detected():
+    """A compaction width smaller than a host's real per-phase
+    fan-out must fail LOUDLY (x_overflow), never silently drop."""
+    yaml = PHOLD_YAML.format(policy="tpu", seed=7, loss=0.1, q=8,
+                             msgload=3)
+    yaml = yaml.replace(
+        "experimental:",
+        "experimental:\n  merge_strategy: global\n"
+        "  outbox_compact: 1")
+    c = Controller(load_config_str(yaml))
+    stats = c.run()
+    assert not stats.ok
+
+
 def test_merge_global_overflow_detected():
     """Hub skew under the global merge: 999 clients hammering one
     server must fail LOUDLY at small event_capacity (rank-based
