@@ -50,10 +50,12 @@ hosts:
 
 
 def _run(policy, seed=1, loss=0.0, relays=8, clients=16, cells=48,
-         stop="20s", retry=""):
+         stop="20s", retry="", extra=""):
     yaml = TOR_YAML.format(policy=policy, seed=seed, loss=loss,
                            relays=relays, clients=clients, cells=cells,
                            stop=stop, retry=retry)
+    if extra:
+        yaml = yaml.replace("experimental:", "experimental:\n" + extra)
     c = Controller(load_config_str(yaml))
     stats = c.run()
     return stats, c.sim.hosts
@@ -81,11 +83,23 @@ def test_tor_clients_complete_downloads_cpu():
     assert stats.ok
 
 
-@pytest.mark.parametrize("loss,retry", [(0.0, ""), (0.05, " retry=2s")],
-                         ids=["lossless", "lossy_retry"])
-def test_tor_device_matches_serial_oracle(loss, retry):
+# the strategy stack production TPU auto-selects (judge flush +
+# global double-sort merge + one-hot pop): the on-chip tor_large run
+# executes exactly this combination on the TOR app — onion trains
+# with per-hop survivor masks and relay burst pops — so it is pinned
+# against the serial oracle here, not just under the CPU-auto paths
+TPU_STACK = ("  judge_placement: flush\n  merge_strategy: global\n"
+             "  pop_strategy: onehot")
+
+
+@pytest.mark.parametrize("loss,retry,extra",
+                         [(0.0, "", ""), (0.05, " retry=2s", ""),
+                          (0.05, " retry=2s", TPU_STACK)],
+                         ids=["lossless", "lossy_retry",
+                              "lossy_tpu_default_stack"])
+def test_tor_device_matches_serial_oracle(loss, retry, extra):
     s_stats, s_hosts = _run("serial", loss=loss, retry=retry)
-    d_stats, d_hosts = _run("tpu", loss=loss, retry=retry)
+    d_stats, d_hosts = _run("tpu", loss=loss, retry=retry, extra=extra)
     assert d_stats.ok
     assert s_stats.events_executed == d_stats.events_executed
     assert s_stats.packets_sent == d_stats.packets_sent
