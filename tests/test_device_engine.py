@@ -237,8 +237,10 @@ def test_tpu_default_knobs_identical_traces():
     (_judge_outbox rewrites ob t/m/v, then _ob_rows re-reads them) —
     pinned against the CPU-default step+window combination."""
     outs = {}
-    for extra in ("  judge_placement: step\n  merge_strategy: window",
-                  "  judge_placement: flush\n  merge_strategy: global"):
+    for extra in ("  judge_placement: step\n  merge_strategy: window\n"
+                  "  pop_strategy: gather",
+                  "  judge_placement: flush\n  merge_strategy: global\n"
+                  "  pop_strategy: onehot"):
         yaml = PHOLD_YAML.format(policy="tpu", seed=7, loss=0.1, q=8,
                                  msgload=3)
         yaml = yaml.replace("experimental:",
