@@ -21,4 +21,19 @@ jax.config.update("jax_enable_x64", True)
 if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
+# persistent compilation cache: device-program compiles run ~50 s on
+# the tunneled TPU, and the tuning sweep + bench + profiler compile
+# the same few programs across separate processes — the disk cache
+# turns every repeat into a hit. Opt-out via SHADOW_TPU_NO_CACHE.
+if not os.environ.get("SHADOW_TPU_NO_CACHE"):
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("SHADOW_TPU_CACHE_DIR",
+                           os.path.expanduser("~/.cache/shadow_tpu_xla")))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          2.0)
+    except Exception:                       # noqa: BLE001
+        pass        # older jax without the knobs: compile as before
+
 __all__ = ["jax", "jnp"]
