@@ -1,0 +1,33 @@
+/* C++ runtime under syscall interposition (ref src/test/cpp parity):
+ * libstdc++ static init, exceptions, std::string/iostream,
+ * std::thread (pthread_create -> clone, trapped), and
+ * std::chrono::steady_clock + sleep_for riding the VIRTUAL clock. */
+#include <chrono>
+#include <cstdio>
+#include <stdexcept>
+#include <string>
+#include <thread>
+
+int main() {
+  std::string s = "cpp";
+  try {
+    throw std::runtime_error("boom");
+  } catch (const std::exception &) {
+    s += "-eh";
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  long got = 0;
+  std::thread th([&] {
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    got = 42;
+  });
+  th.join();
+  auto el_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                   std::chrono::steady_clock::now() - t0)
+                   .count();
+  std::printf("str %s\n", s.c_str());
+  std::printf("thread %ld\n", got);
+  std::printf("sleep_visible %d\n", el_ms >= 20 ? 1 : 0);
+  std::printf("done\n");
+  return 0;
+}

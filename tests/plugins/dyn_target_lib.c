@@ -1,0 +1,13 @@
+/* dlopen target (built as a .so by the test fixture): proves symbols
+ * resolve at runtime and that code in a dlopened library sees the
+ * SAME virtual clock as the main image — seccomp interposition is
+ * process-wide and the preload overrides bind into the .so's PLT. */
+#include <time.h>
+
+long dyn_add(long a, long b) { return a + b; }
+
+long dyn_now_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000000000L + ts.tv_nsec;
+}

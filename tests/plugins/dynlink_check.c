@@ -1,0 +1,36 @@
+/* Runtime dynamic linking under interposition (ref src/test/dynlink
+ * parity): dlopen a shared object, resolve symbols, and verify the
+ * dlopened code shares the main image's virtual timeline. */
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <stdio.h>
+#include <time.h>
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    printf("no lib\n");
+    return 1;
+  }
+  void *h = dlopen(argv[1], RTLD_NOW);
+  printf("dlopen %d\n", h != NULL);
+  if (!h) {
+    printf("%s\n", dlerror());
+    return 1;
+  }
+  long (*add)(long, long) = (long (*)(long, long))dlsym(h, "dyn_add");
+  long (*now)(void) = (long (*)(void))dlsym(h, "dyn_now_ns");
+  printf("dlsym %d\n", add != NULL && now != NULL);
+  printf("add %ld\n", add(40, 2));
+
+  long a = now(); /* read via the dlopened library */
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts); /* read via the main image */
+  long b = ts.tv_sec * 1000000000L + ts.tv_nsec;
+  struct timespec d = {0, 5 * 1000 * 1000};
+  nanosleep(&d, 0);
+  long c = now();
+  printf("monotonic %d\n", b >= a);
+  printf("sleep_visible %d\n", c >= b + 5 * 1000 * 1000);
+  printf("done\n");
+  return 0;
+}
