@@ -276,6 +276,11 @@ class ExperimentalOptions:
     # take_along_axis (cheaper on one CPU core). "auto" picks by
     # platform. Bit-identical traces either way.
     pop_strategy: str = "auto"      # auto | onehot | gather
+    # topology-table lookups in the hoisted judge: "onehot" unrolls
+    # the [V,V] lat/rel lookups into masked sums (no gather; V*V <=
+    # 128 only), "gather" keeps indexed lookups. "auto" = gather
+    # until the on-chip micro decides. Bit-identical either way.
+    table_strategy: str = "auto"    # auto | onehot | gather
     # burst-pop lane width override (0 = the app's own declaration):
     # burst apps (tgen servers, tor relays) pop up to this many
     # consecutive in-window packet events per iteration, one send
@@ -346,6 +351,8 @@ class ExperimentalOptions:
                       out.merge_strategy, ("auto", "global", "window"))
         _check_choice("experimental", "pop_strategy",
                       out.pop_strategy, ("auto", "onehot", "gather"))
+        _check_choice("experimental", "table_strategy",
+                      out.table_strategy, ("auto", "onehot", "gather"))
         from shadow_tpu.host.tcp import CONGESTION_ALGORITHMS
         _check_choice("experimental", "tcp_congestion",
                       out.tcp_congestion,

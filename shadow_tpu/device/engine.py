@@ -146,6 +146,13 @@ class EngineConfig:
     # reduction is real work). None = auto by platform. Traces are
     # bit-identical either way (tests pin both).
     pop_onehot: Optional[bool] = None
+    # topology-table lookups (lat[srcv,dstv] / rel[srcv,dstv] in the
+    # hoisted judge): True = one-hot masked sums over the V*V table
+    # (unrolled; only legal for V*V <= 128) — no gather; False =
+    # indexed gather. None = False everywhere until the on-chip
+    # micro (scripts/tpu_micro4.py) decides. Selection is exact
+    # (single nonzero term), so traces are bit-identical either way.
+    table_onehot: Optional[bool] = None
 
 
 class DeviceEngine:
@@ -394,6 +401,10 @@ class DeviceEngine:
         POP_ONEHOT = (cfg.pop_onehot
                       if cfg.pop_onehot is not None
                       else platform == "tpu")
+        # one-hot topology-table lookups (see EngineConfig.table_onehot)
+        TAB_ONEHOT = bool(cfg.table_onehot) and V * V <= 128
+        if cfg.table_onehot and not TAB_ONEHOT:
+            log.info("table_onehot disabled: V*V = %d > 128", V * V)
         # statically lossless topologies (all reliability == 1) never
         # drop: packet_drop_mask is False for every row regardless of
         # the roll, so the threefry batch is skipped outright
@@ -1007,8 +1018,24 @@ class DeviceEngine:
             dst = hi32(fm)
             srcv = host_vertex[gid][:, None]
             dstv = host_vertex[jnp.clip(dst, 0, H_pad - 1)]
-            latv = lat[srcv, dstv].astype(jnp.int64)
-            relv = rel[srcv, dstv]
+            if TAB_ONEHOT:
+                # gatherless table lookup: unrolled one-hot masked
+                # sums over the tiny [V,V] table (exact — a single
+                # nonzero term per row); the indexed gather costs
+                # ~ms-class on TPU for [H,OB] outputs
+                pairv = srcv * jnp.int32(V) + dstv           # [H,OB]
+                latf, relf = lat.reshape(-1), rel.reshape(-1)
+                latv = jnp.zeros(pairv.shape, jnp.int64)
+                relv = jnp.zeros(pairv.shape, rel.dtype)
+                for j in range(V * V):
+                    m = pairv == j
+                    latv = latv + jnp.where(
+                        m, latf[j].astype(jnp.int64), jnp.int64(0))
+                    relv = relv + jnp.where(
+                        m, relf[j], jnp.zeros((), rel.dtype))
+            else:
+                latv = lat[srcv, dstv].astype(jnp.int64)
+                relv = rel[srcv, dstv]
 
             # per-row packet-seq base: state["packet_seq"] is already
             # the END of the phase; outbox columns sit in consumption

@@ -38,6 +38,13 @@ from shadow_tpu.utils.slog import get_logger
 log = get_logger("device")
 
 
+def _tristate(value: str, true_word: str):
+    """Strategy-knob mapping shared by every auto/<on>/<off> choice:
+    'auto' -> None (engine picks by platform), `true_word` -> True,
+    anything else (the schema-validated off word) -> False."""
+    return None if value == "auto" else value == true_word
+
+
 class NoDeviceTwin(ValueError):
     """The config's apps have no fully-vectorized device twin; the tpu
     policy falls back to hybrid execution (CPU host emulation + device
@@ -208,15 +215,14 @@ class DeviceRunner:
                 outbox_compact=cfg.experimental.outbox_compact,
                 model_bandwidth=cfg.experimental.model_bandwidth,
                 count_paths=cfg.experimental.count_paths,
-                judge_hoist={"auto": None, "flush": True,
-                             "step": False}[
-                    cfg.experimental.judge_placement],
-                merge_global={"auto": None, "global": True,
-                              "window": False}[
-                    cfg.experimental.merge_strategy],
-                pop_onehot={"auto": None, "onehot": True,
-                            "gather": False}[
-                    cfg.experimental.pop_strategy],
+                judge_hoist=_tristate(
+                    cfg.experimental.judge_placement, "flush"),
+                merge_global=_tristate(
+                    cfg.experimental.merge_strategy, "global"),
+                pop_onehot=_tristate(
+                    cfg.experimental.pop_strategy, "onehot"),
+                table_onehot=_tristate(
+                    cfg.experimental.table_strategy, "onehot"),
             ),
             self.app,
             host_vertex=sim.netmodel.host_vertex.astype(np.int32),

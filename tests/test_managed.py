@@ -381,6 +381,8 @@ def test_cpp_runtime(plugins, tmp_path, method):
     """C++ runtime under both backends (ref src/test/cpp): libstdc++
     static init, exceptions, std::string, std::thread (clone), and
     std::chrono steady_clock + sleep_for on the VIRTUAL clock."""
+    if "cpp_check" not in plugins:
+        pytest.skip("no g++ on this machine")
     data = str(tmp_path / "shadow.data")
     cfg = base_cfg(data).replace(
         "hosts:\n",
