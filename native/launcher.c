@@ -16,6 +16,7 @@
 #include <string.h>
 #include <sys/personality.h>
 #include <sys/prctl.h>
+#include <sys/resource.h>
 #include <unistd.h>
 
 #ifndef PR_SET_TSC
@@ -40,6 +41,11 @@ int main(int argc, char **argv) {
   personality(ADDR_NO_RANDOMIZE);
   if (tsc)
     prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
+  /* native fds must stay below the virtual-fd floor (600) so the
+   * fd-range classification can never be wrong; libc callers see
+   * VIRTUAL rlimits via the emulated getrlimit/prlimit64 */
+  struct rlimit nof = {600, 600};
+  setrlimit(RLIMIT_NOFILE, &nof);
   raise(SIGSTOP); /* tracer seizes here */
   execv(argv[argi], argv + argi);
   perror("execv");

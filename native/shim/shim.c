@@ -65,10 +65,16 @@
 
 /* ---- constants shared with the Python side ------------------------- */
 
-#define SHADOWTPU_VFD_BASE 0x0FD00000u /* virtual descriptor fd floor */
-#define SHADOWTPU_VFD_END 0x0FE00000u  /* exclusive ceiling: values above
-                                        * (e.g. AT_FDCWD as u32) are not
-                                        * virtual fds and stay native */
+/* Virtual fds live in [600, 1024): BELOW FD_SETSIZE so select()'s
+ * fd_set can express them (glibc's FD_SET writes bit fd into a
+ * 1024-bit array — a giant vfd number would smash memory in APP code
+ * before any syscall is made), and above every native fd the plugin
+ * can hold (the spawn path caps RLIMIT_NOFILE at 600, so the kernel
+ * never hands out a native fd >= 600 and the fd-range gate stays
+ * airtight). Values outside the window (e.g. AT_FDCWD as u32) are
+ * not virtual fds and stay native. */
+#define SHADOWTPU_VFD_BASE 600u  /* virtual descriptor fd floor */
+#define SHADOWTPU_VFD_END 1024u  /* exclusive ceiling (FD_SETSIZE) */
 
 enum {
   IPC_NONE = 0,

@@ -32,7 +32,11 @@ from typing import Optional
 from shadow_tpu.host.sockets import UdpSocket
 from shadow_tpu.host.tcp import DEFAULT_SEND_BUFFER, TcpSocket, TcpState
 
-VFD_BASE = 0x0FD00000           # keep in sync with native/shim/shim.c
+VFD_BASE = 600                  # keep in sync with native/shim/shim.c
+VFD_END = 1024                  # exclusive; < FD_SETSIZE so select()'s
+                                # fd_set can express every virtual fd
+                                # (native fds are capped below 600 via
+                                # RLIMIT_NOFILE at spawn)
 
 R = 1                           # readable
 W = 2                           # writable
@@ -523,6 +527,12 @@ class EventfdDesc(Descriptor):
         return st
 
 
+class TableFull(Exception):
+    """The per-process virtual fd window [VFD_BASE, VFD_END) is
+    exhausted — the dispatcher answers EMFILE, exactly as the kernel
+    does at RLIMIT_NOFILE."""
+
+
 class DescriptorTable:
     """Per-process fd table (descriptor_table.rs): virtual fds are
     handed out from VFD_BASE upward; lowest-free-slot reuse matches
@@ -532,22 +542,32 @@ class DescriptorTable:
         self.manager = manager
         self.owner = owner          # owning ManagedProcess (lock purge)
         self._slots: dict[int, Descriptor] = {}
-        self._next = 0
         # close-on-exec is a PER-FD flag (kernel fd table), not a
         # property of the open file description: dup'd fds never
         # inherit it, fork'd tables copy it, execve closes these
         self.cloexec: set[int] = set()
 
+    def has_room(self, n: int = 1) -> bool:
+        """Can `n` more fds be allocated? Handlers whose failure
+        path is not side-effect-free (openat's real os.open, accept's
+        queue pop, pipe's twin alloc) check this FIRST so EMFILE
+        never leaks state."""
+        return len(self._slots) + n <= VFD_END - VFD_BASE
+
     def alloc(self, desc: Descriptor, min_fd: int = 0) -> int:
-        idx = max(self._next, min_fd)
+        # lowest free slot, exactly like kernel fd allocation — the
+        # [600, 1024) window is only 424 slots, so freed slots MUST
+        # be reused (a monotonic cursor would exhaust the table after
+        # 424 cumulative allocations regardless of live count)
+        idx = min_fd
         while VFD_BASE + idx in self._slots:
             idx += 1
         fd = VFD_BASE + idx
+        if fd >= VFD_END:
+            raise TableFull()
         self._slots[fd] = desc
         if desc.fd < 0:
             desc.fd = fd
-        if min_fd == 0:
-            self._next = idx + 1
         return fd
 
     def get(self, fd: int) -> Optional[Descriptor]:
@@ -558,8 +578,9 @@ class DescriptorTable:
 
     def dup(self, fd: int, min_fd: int = 0) -> int:
         d = self._slots[fd]
-        d.refs += 1
-        return self.alloc(d, min_fd)
+        newfd = self.alloc(d, min_fd)   # may raise TableFull: no ref
+        d.refs += 1                     # leak on the failure path
+        return newfd
 
     def replace(self, fd: int, new_desc: Descriptor) -> None:
         """Swap the object behind fd (socket() desc -> listener desc)."""
@@ -610,7 +631,6 @@ class DescriptorTable:
         a close in either process only drops that table's reference)."""
         t = DescriptorTable(self.manager)
         t._slots = dict(self._slots)
-        t._next = self._next
         t.cloexec = set(self.cloexec)   # fd flags copy across fork
         for d in t._slots.values():
             d.refs += 1

@@ -26,6 +26,7 @@ channel's plugin-exited flag so a blocked recv returns immediately.
 from __future__ import annotations
 
 import os
+import resource
 import shlex
 import struct
 import threading
@@ -33,7 +34,8 @@ from typing import Optional
 
 from shadow_tpu import native
 from shadow_tpu.core.event import Event, KIND_TASK
-from shadow_tpu.host.descriptors import Condition, DescriptorTable
+from shadow_tpu.host.descriptors import (Condition, DescriptorTable,
+                                         VFD_BASE)
 from shadow_tpu.host.memory import ProcessMemory
 from shadow_tpu.host.syscalls import (
     NATIVE,
@@ -362,9 +364,30 @@ class ManagedProcess:
         # filters then kill the shim's own raw syscalls.)
         _disable_aslr_inheritable()
         argv = [self.path] + self.args
+
+        def _cap_native_fds():
+            # native fds must stay below the virtual-fd floor
+            # (descriptors.VFD_BASE) so the seccomp fd-range gate can
+            # never misclassify; libc callers see VIRTUAL rlimits via
+            # the emulated getrlimit/prlimit64. Runs post-fork in the
+            # child (costs the posix_spawn fast path — acceptable,
+            # and the ptrace backend's launcher.c does the same).
+            # `resource` is imported at module top: a first-time
+            # import here, post-fork in a threaded parent, could
+            # deadlock on the import lock. Clamped to the ambient
+            # hard limit and best-effort, matching launcher.c.
+            try:
+                hard = resource.getrlimit(resource.RLIMIT_NOFILE)[1]
+                lim = VFD_BASE if hard == resource.RLIM_INFINITY \
+                    else min(VFD_BASE, hard)
+                resource.setrlimit(resource.RLIMIT_NOFILE, (lim, lim))
+            except (ValueError, OSError):
+                pass
+
         self.proc = subprocess.Popen(
             argv, env=env, cwd=host_dir, stdout=stdout_f,
-            stderr=stderr_f, stdin=subprocess.DEVNULL)
+            stderr=stderr_f, stdin=subprocess.DEVNULL,
+            preexec_fn=_cap_native_fds)
         stdout_f.close()
         stderr_f.close()
         self.mem = ProcessMemory(self.proc.pid)

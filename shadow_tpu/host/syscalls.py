@@ -39,11 +39,13 @@ from shadow_tpu.host.descriptors import (
     HostFileDesc,
     PipeDesc,
     R,
+    TableFull,
     TcpDesc,
     TcpListenDesc,
     TimerfdDesc,
     UdpDesc,
     VFD_BASE,
+    VFD_END,
     VirtualFileDesc,
     W,
 )
@@ -116,7 +118,7 @@ ECHILD = 10
 ENOTTY, ESPIPE, EPIPE, ENOSYS, ENOTSOCK, EDESTADDRREQ = 25, 29, 32, 38, 88, 89
 EMSGSIZE, ENOPROTOOPT, EPROTONOSUPPORT, EOPNOTSUPP, EAFNOSUPPORT = \
     90, 92, 93, 95, 97
-E2BIG, EACCES = 7, 13
+E2BIG, EACCES, EMFILE = 7, 13, 24
 EEXIST, EXDEV, ENODEV, ENOTDIR, EISDIR, ENOTEMPTY = 17, 18, 19, 20, 21, 39
 ENAMETOOLONG, ELOOP, ERANGE, ENODATA = 36, 40, 34, 61
 EADDRINUSE, ENETUNREACH, ECONNRESET, EISCONN, ENOTCONN = 98, 101, 104, 106, 107
@@ -256,7 +258,12 @@ class SyscallHandler:
         fn = getattr(self, "sys_" + name, None)
         if fn is None:
             return -ENOSYS
-        return fn(ctx, args)
+        try:
+            return fn(ctx, args)
+        except TableFull:
+            # virtual fd window [600, 1024) exhausted: EMFILE, the
+            # same answer the kernel gives at RLIMIT_NOFILE
+            return -EMFILE
 
     # ==================================================================
     # time (host/syscall/time.c)
@@ -1039,6 +1046,9 @@ class SyscallHandler:
             if self._nonblock(desc):
                 return -EAGAIN
             raise Blocked([desc])
+        if not self.table.has_room():
+            return -EMFILE      # BEFORE the dequeue: the connection
+                                # must stay queued, as the kernel does
         child = desc.accept_queue.popleft()
         child.nonblock = bool(flags & SOCK_NONBLOCK)
         cfd = self.table.alloc(child)
@@ -1695,6 +1705,9 @@ class SyscallHandler:
         rp = os.path.realpath(abspath)
         if os.path.exists(rp) and not self._confined(rp):
             return -EACCES
+        if not self.table.has_room():
+            return -EMFILE      # BEFORE os.open: a TableFull after
+                                # it would leak the simulator-side fd
         try:
             osfd = os.open(abspath,
                            (flags & ~self.O_CLOEXEC_FLAG)
@@ -2915,6 +2928,12 @@ class SyscallHandler:
             return self._no_desc(oldfd)
         if newfd < VFD_BASE:
             return -EINVAL          # cannot shadow native kernel fds
+        if newfd >= VFD_END:
+            # outside the shim's fd-range gate: later I/O on it would
+            # go raw to the kernel under preload (EBADF) while ptrace
+            # would emulate it — refuse like the kernel does past the
+            # fd limit
+            return -EBADF
         if newfd == oldfd:
             return newfd
         if self.table.get(newfd) is not None:
@@ -2932,6 +2951,8 @@ class SyscallHandler:
         return self._pipe(ctx, a[0], _s32(a[1]))
 
     def _pipe(self, ctx, fds_ptr: int, flags: int):
+        if not self.table.has_room(2):
+            return -EMFILE          # both slots or neither
         r, w = PipeDesc.make_pair()
         r.nonblock = w.nonblock = bool(flags & O_NONBLOCK)
         rfd = self.table.alloc(r)
@@ -3208,33 +3229,99 @@ class SyscallHandler:
         return self._select(ctx, a, timeval=False)
 
     def _select(self, ctx, a, timeval: bool):
+        """Real select over the virtual fd window: since the
+        [600, 1024) redesign every virtual fd fits in an fd_set, so
+        select works on simulated sockets/pipes/timerfds exactly like
+        poll (same descriptor status bits; native fds — regular
+        files/ttys — are always ready; exceptfds map to ERR). The
+        kernel contract: the return value counts BITS across all
+        three sets, the sets are rewritten in place, and (for the
+        timeval flavor) the remaining time is written back."""
         nfds = _s32(a[0])
-        # virtual fds sit far above FD_SETSIZE, so select() can only
-        # ever name native fds here. The portable select-as-sleep idiom
-        # (no fds) is emulated; anything else is unsupported
-        # (poll/epoll are the supported readiness APIs).
-        def fdset_empty(ptr):
-            if not ptr or nfds <= 0:
-                return True
-            nbytes = (nfds + 7) // 8
-            return not any(self.mem.read(ptr, nbytes))
+        if nfds < 0 or nfds > 1024:
+            return -EINVAL
+        nbytes = (nfds + 7) // 8
+        sets = [bytearray(self.mem.read(p, nbytes))
+                if p and nbytes else bytearray(nbytes)
+                for p in (a[1], a[2], a[3])]
+        rset, wset, eset = sets
+        out = [bytearray(nbytes) for _ in range(3)]
+        n_bits = 0
+        virt_descs = []
+        for fd in range(nfds):
+            byte, bit = fd >> 3, 1 << (fd & 7)
+            want_r = rset[byte] & bit
+            want_w = wset[byte] & bit
+            want_e = eset[byte] & bit
+            if not (want_r or want_w or want_e):
+                continue
+            if fd < VFD_BASE:
+                # native fd (regular file / tty): always ready — the
+                # same policy as _poll; never exceptional
+                if want_r:
+                    out[0][byte] |= bit
+                    n_bits += 1
+                if want_w:
+                    out[1][byte] |= bit
+                    n_bits += 1
+                continue
+            d = self._desc(fd)
+            if d is None:
+                return -EBADF       # kernel checks fds up front
+            virt_descs.append(d)
+            stt = d.status()
+            if want_r and (stt & R):
+                out[0][byte] |= bit
+                n_bits += 1
+            if want_w and (stt & W):
+                out[1][byte] |= bit
+                n_bits += 1
+            if want_e and (stt & ERR):
+                out[2][byte] |= bit
+                n_bits += 1
 
-        if fdset_empty(a[1]) and fdset_empty(a[2]) and fdset_empty(a[3]):
-            st = self.state
-            if "deadline" not in st:
-                if not a[4]:
-                    return -EINVAL      # would block forever
+        def write_back(which):
+            for ptr, ob in zip((a[1], a[2], a[3]), which):
+                if ptr and nbytes:
+                    self.mem.write(ptr, bytes(ob))
+
+        st = self.state
+        if n_bits:
+            write_back(out)
+            if timeval and a[4] and st.get("deadline") is not None:
+                # ready after blocking partway through the timeout:
+                # Linux select() rewrites the timeval to the
+                # remainder (the documented loop-on-same-timeval
+                # idiom depends on it)
+                rem = max(0, st["deadline"] - ctx.now)
+                self.mem.write(a[4], struct.pack(
+                    "<qq", rem // 1_000_000_000,
+                    (rem % 1_000_000_000) // 1000))
+            return n_bits
+        if "deadline" not in st:
+            if not a[4]:
+                st["deadline"] = None       # block on the fds alone
+            else:
                 if timeval:
                     sec, usec = struct.unpack(
                         "<qq", self.mem.read(a[4], 16))
+                    if sec < 0 or usec < 0:
+                        return -EINVAL
                     ns = sec * 1_000_000_000 + usec * 1000
                 else:
                     ns = kmem.unpack_timespec(self.mem.read(a[4], 16))
-                st["deadline"] = ctx.now + max(0, ns)
-            if ctx.now >= st["deadline"]:
-                return 0
-            raise Blocked(deadline=st["deadline"])
-        return -EINVAL
+                    if ns < 0:
+                        return -EINVAL
+                st["deadline"] = ctx.now + ns
+        if st["deadline"] is not None and ctx.now >= st["deadline"]:
+            write_back(out)                 # all-zero sets
+            if timeval and a[4]:
+                # Linux select() updates the timeval to the remainder
+                self.mem.write(a[4], struct.pack("<qq", 0, 0))
+            return 0
+        if not virt_descs and st["deadline"] is None:
+            return -EINVAL                  # would block forever
+        raise Blocked(virt_descs, deadline=st["deadline"])
 
     # ==================================================================
     # msghdr-based I/O (uio.c / socket.c)

@@ -1,0 +1,117 @@
+/* Readiness-API family under interposition (ref src/test/{epoll,
+ * poll, eventfd, timerfd, pipe} suites): pipe2 + poll, eventfd
+ * semantics, timerfd through epoll with EXACT virtual-time
+ * advancement, and a select() timeout that consumes exactly its
+ * simulated duration. Prints "label value" lines; the harness
+ * asserts exact output (clocks are virtual, so output is a pure
+ * function of the config). */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/select.h>
+#include <sys/timerfd.h>
+#include <time.h>
+#include <unistd.h>
+
+static long now_ms(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000L + ts.tv_nsec / 1000000L;
+}
+
+static void check(const char *label, int ok) {
+  printf("%s %d\n", label, ok);
+}
+
+int main(void) {
+  setvbuf(stdout, NULL, _IONBF, 0);
+  /* -- pipe2 + poll readiness -- */
+  int pfd[2];
+  check("pipe2", pipe2(pfd, O_NONBLOCK) == 0);
+  struct pollfd pp = {.fd = pfd[0], .events = POLLIN};
+  check("poll_empty", poll(&pp, 1, 0) == 0);
+  check("pipe_write", write(pfd[1], "xy", 2) == 2);
+  pp.revents = 0;
+  check("poll_ready", poll(&pp, 1, 0) == 1 && (pp.revents & POLLIN));
+  char buf[8] = {0};
+  check("pipe_read", read(pfd[0], buf, 8) == 2 && !strcmp(buf, "xy"));
+  check("pipe_drained", read(pfd[0], buf, 8) == -1 && errno == EAGAIN);
+
+  /* -- eventfd counter semantics -- */
+  int efd = eventfd(0, EFD_NONBLOCK);
+  check("eventfd", efd >= 0);
+  unsigned long v = 3;
+  check("efd_write", write(efd, &v, 8) == 8);
+  v = 2;
+  check("efd_write2", write(efd, &v, 8) == 8);
+  v = 0;
+  check("efd_read", read(efd, &v, 8) == 8 && v == 5);  /* sums */
+  check("efd_empty", read(efd, &v, 8) == -1 && errno == EAGAIN);
+
+  /* -- timerfd through epoll: exact virtual-time fire -- */
+  int tfd = timerfd_create(CLOCK_MONOTONIC, 0);
+  check("timerfd", tfd >= 0);
+  int ep = epoll_create1(0);
+  check("epoll_create", ep >= 0);
+  struct epoll_event ev = {.events = EPOLLIN, .data.fd = tfd};
+  check("epoll_ctl", epoll_ctl(ep, EPOLL_CTL_ADD, tfd, &ev) == 0);
+  struct itimerspec its = {.it_value = {0, 30 * 1000 * 1000}};
+  long t0 = now_ms();
+  check("tfd_arm", timerfd_settime(tfd, 0, &its, NULL) == 0);
+  struct epoll_event got;
+  int n = epoll_wait(ep, &got, 1, 1000);
+  long waited = now_ms() - t0;
+  check("epoll_fire", n == 1 && got.data.fd == tfd);
+  unsigned long exp = 0;
+  check("tfd_count", read(tfd, &exp, 8) == 8 && exp == 1);
+  printf("tfd_wait_ms %ld\n", waited);   /* exactly 30 (virtual) */
+
+  /* -- select() pure timeout consumes exactly its duration -- */
+  fd_set rf;
+  FD_ZERO(&rf);
+  FD_SET(pfd[0], &rf);
+  struct timeval tv = {0, 20 * 1000};
+  t0 = now_ms();
+  int sn = select(pfd[0] + 1, &rf, NULL, NULL, &tv);
+  long slept = now_ms() - t0;
+  check("select_timeout", sn == 0);
+  printf("select_ms %ld\n", slept);      /* exactly 20 (virtual) */
+
+  /* -- select readiness on a virtual fd (possible at all because
+   * virtual fds live below FD_SETSIZE) -- */
+  check("pipe_rewrite", write(pfd[1], "z", 1) == 1);
+  FD_ZERO(&rf);
+  FD_SET(pfd[0], &rf);
+  fd_set wf;
+  FD_ZERO(&wf);
+  FD_SET(pfd[1], &wf);
+  tv.tv_sec = 1;
+  tv.tv_usec = 0;
+  sn = select((pfd[0] > pfd[1] ? pfd[0] : pfd[1]) + 1, &rf, &wf,
+              NULL, &tv);
+  check("select_ready",
+        sn == 2 && FD_ISSET(pfd[0], &rf) && FD_ISSET(pfd[1], &wf));
+  check("pipe_rez", read(pfd[0], buf, 8) == 1);
+
+  /* -- epoll sees the eventfd too -- */
+  ev.events = EPOLLIN;
+  ev.data.fd = efd;
+  check("epoll_ctl2", epoll_ctl(ep, EPOLL_CTL_ADD, efd, &ev) == 0);
+  v = 7;
+  check("efd_rewrite", write(efd, &v, 8) == 8);
+  n = epoll_wait(ep, &got, 1, 0);
+  check("epoll_efd", n == 1 && got.data.fd == efd);
+
+  close(ep);
+  close(tfd);
+  close(efd);
+  close(pfd[0]);
+  close(pfd[1]);
+  printf("done\n");
+  return 0;
+}

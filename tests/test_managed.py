@@ -377,6 +377,38 @@ def static_plugin(tmp_path_factory):
 
 
 @pytest.mark.parametrize("method", ["preload", "ptrace"])
+def test_readiness_family(plugins, tmp_path, method):
+    """The readiness-API family (ref src/test/{epoll,poll,eventfd,
+    timerfd,pipe} suites) on both backends: pipe2+poll, eventfd
+    counter semantics, timerfd firing through epoll after EXACTLY its
+    virtual duration, and a select() timeout consuming exactly its
+    simulated 20 ms."""
+    data = str(tmp_path / "shadow.data")
+    cfg = base_cfg(data).replace(
+        "hosts:\n",
+        f"experimental:\n  interpose_method: {method}\nhosts:\n") + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['readiness_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    assert stats.ok
+    out = read_stdout(data, "alice", "readiness_check")
+    assert "done" in out, out
+    for line in out.splitlines():
+        parts = line.split()
+        if len(parts) == 2 and parts[1] in ("0", "1") \
+                and not parts[0].endswith("_ms"):
+            assert parts[1] == "1", f"{line!r} failed:\n{out}"
+    # virtual time is exact: the 30 ms timer and 20 ms select
+    # timeout consume precisely their simulated durations
+    assert "tfd_wait_ms 30" in out, out
+    assert "select_ms 20" in out, out
+
+
+@pytest.mark.parametrize("method", ["preload", "ptrace"])
 def test_cpp_runtime(plugins, tmp_path, method):
     """C++ runtime under both backends (ref src/test/cpp): libstdc++
     static init, exceptions, std::string, std::thread (clone), and
