@@ -319,6 +319,68 @@ class PipeDesc(Descriptor):
             return W | ERR              # EPIPE
         return W if len(self.buf) < self.CAPACITY else 0
 
+    def close(self, ctx) -> None:
+        super().close(ctx)
+        if self.peer is not None:
+            self.peer.notify(ctx)   # blocked reader -> EOF,
+                                    # blocked writer -> EPIPE
+
+
+class UnixPairDesc(Descriptor):
+    """One end of socketpair(AF_UNIX) — the reference emulates these
+    via its unix-socket layer (ref syscall dispatch `socketpair`);
+    here each end is a bidirectional in-memory channel with pipe
+    capacity semantics per direction. SOCK_STREAM ends coalesce
+    bytes; SOCK_DGRAM ends preserve message boundaries."""
+
+    CAPACITY = 65536
+
+    def __init__(self, dgram: bool):
+        super().__init__()
+        self.dgram = dgram
+        self.rbuf = bytearray()             # stream inbox
+        self.rmsgs: deque = deque()         # dgram inbox
+        self.rbytes = 0                     # dgram inbox fill
+        self.peer: Optional["UnixPairDesc"] = None
+        self.rd_shut = False
+        self.wr_shut = False
+
+    @staticmethod
+    def make_pair(dgram: bool) -> tuple["UnixPairDesc",
+                                        "UnixPairDesc"]:
+        a, b = UnixPairDesc(dgram), UnixPairDesc(dgram)
+        a.peer, b.peer = b, a
+        return a, b
+
+    def _inbox_full(self) -> bool:
+        if self.dgram:
+            return self.rbytes >= self.CAPACITY
+        return len(self.rbuf) >= self.CAPACITY
+
+    def _readable(self) -> bool:
+        return bool(self.rmsgs) if self.dgram else bool(self.rbuf)
+
+    def status(self) -> int:
+        st = 0
+        peer_gone = (self.peer is None or self.peer.closed
+                     or self.peer.wr_shut)
+        if self._readable() or peer_gone or self.rd_shut:
+            st |= R                         # data or EOF readable
+        if self.peer is None or self.peer.closed:
+            st |= ERR | W                   # EPIPE on write
+        elif self.wr_shut or not self.peer._inbox_full():
+            # SEND_SHUTDOWN keeps EPOLLOUT (Linux unix_poll): writes
+            # complete immediately — with EPIPE — so a poll-then-
+            # write loop must not park
+            st |= W
+        return st
+
+    def close(self, ctx) -> None:
+        super().close(ctx)
+        if self.peer is not None:
+            self.peer.notify(ctx)   # blocked reader -> EOF,
+                                    # blocked writer -> EPIPE
+
 
 class EpollDesc(Descriptor):
     """epoll instance (descriptor/epoll.c): level-triggered readiness

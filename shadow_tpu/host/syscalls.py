@@ -44,6 +44,7 @@ from shadow_tpu.host.descriptors import (
     TcpListenDesc,
     TimerfdDesc,
     UdpDesc,
+    UnixPairDesc,
     VFD_BASE,
     VFD_END,
     VirtualFileDesc,
@@ -1121,6 +1122,10 @@ class SyscallHandler:
         desc = self._desc(fd)
         if desc is None:
             return self._no_desc(fd)
+        if isinstance(desc, UnixPairDesc):
+            if a[4]:
+                return -EISCONN     # the pair is permanently connected
+            return self._upair_write(ctx, desc, buf, n, flags)
         if isinstance(desc, UdpDesc):
             if n > UDP_MAX_PAYLOAD:
                 return -EMSGSIZE
@@ -1163,6 +1168,15 @@ class SyscallHandler:
         desc = self._desc(fd)
         if desc is None:
             return self._no_desc(fd)
+        if isinstance(desc, UnixPairDesc):
+            if a[4] and not a[5]:
+                return -EFAULT      # src_addr without addrlen
+            r = self._upair_read(ctx, desc, buf, n, flags)
+            if r >= 0 and a[4]:
+                # success only (kernel leaves addrlen untouched on
+                # error): the peer is unnamed -> length 0
+                self.mem.write(a[5], struct.pack("<I", 0))
+            return r
         if isinstance(desc, UdpDesc):
             desc.ensure_bound(self.p.host.net)
             if not desc.queue:
@@ -1215,6 +1229,17 @@ class SyscallHandler:
             if how in (SHUT_RD, SHUT_RDWR):
                 desc.eof = True
                 desc.notify(ctx)
+            return 0
+        if isinstance(desc, UnixPairDesc):
+            if how in (SHUT_RD, SHUT_RDWR):
+                desc.rd_shut = True
+            if how in (SHUT_WR, SHUT_RDWR):
+                desc.wr_shut = True
+            if desc.peer is not None:
+                # both directions matter: SHUT_WR gives a blocked
+                # reader EOF; SHUT_RD gives a blocked writer EPIPE
+                desc.peer.notify(ctx)
+            desc.notify(ctx)
             return 0
         if isinstance(desc, (UdpDesc, TcpListenDesc)):
             return 0
@@ -1303,7 +1328,85 @@ class SyscallHandler:
         return 0            # accept and ignore (SO_REUSEADDR, NODELAY…)
 
     def sys_socketpair(self, ctx, a):
-        return -EAFNOSUPPORT        # AF_UNIX: roadmap
+        """socketpair(AF_UNIX, SOCK_STREAM|SOCK_DGRAM) as an
+        in-memory bidirectional channel pair (ref dispatch
+        `socketpair`; unix-socket layer). Network families answer
+        EOPNOTSUPP — simulated inter-host traffic uses real
+        sockets."""
+        dom, typ, proto, sv_ptr = (_s32(a[0]), _s32(a[1]),
+                                   _s32(a[2]), a[3])
+        if dom != 1:                        # AF_UNIX only
+            return -EAFNOSUPPORT
+        base = typ & 0xFF
+        if base not in (SOCK_STREAM, SOCK_DGRAM):
+            return -EOPNOTSUPP
+        if proto not in (0,):
+            return -EPROTONOSUPPORT
+        if not sv_ptr:
+            return -EFAULT
+        if not self.table.has_room(2):
+            return -EMFILE                  # both ends or neither
+        d1, d2 = UnixPairDesc.make_pair(dgram=base == SOCK_DGRAM)
+        d1.nonblock = d2.nonblock = bool(typ & SOCK_NONBLOCK)
+        fd1, fd2 = self.table.alloc(d1), self.table.alloc(d2)
+        if typ & SOCK_CLOEXEC:
+            self.table.cloexec.update((fd1, fd2))
+        self.mem.write(sv_ptr, struct.pack("<ii", fd1, fd2))
+        return 0
+
+    def _upair_read(self, ctx, d, buf: int, n: int,
+                    flags: int = 0):
+        if d.rd_shut and not d._readable():
+            return 0
+        if not d._readable():
+            if d.peer is None or d.peer.closed or d.peer.wr_shut:
+                return 0                    # EOF
+            if self._nonblock(d, flags):
+                return -EAGAIN
+            raise Blocked([d])
+        peek = bool(flags & MSG_PEEK)
+        if d.dgram:
+            msg = d.rmsgs[0]
+            data = msg[:n]                  # excess truncates (dgram)
+            if not peek:
+                d.rmsgs.popleft()
+                d.rbytes -= len(msg)
+        else:
+            data = bytes(d.rbuf[:n])
+            if not peek:
+                del d.rbuf[:n]
+        self.mem.write(buf, data)
+        if not peek and d.peer is not None:
+            d.peer.notify(ctx)              # writer may proceed
+        return len(data)
+
+    def _upair_write(self, ctx, d, buf: int, n: int,
+                     flags: int = 0):
+        if d.wr_shut or d.peer is None or d.peer.closed \
+                or d.peer.rd_shut:
+            return -EPIPE           # plain errno, like _pipe_write
+        peer = d.peer
+        if d.dgram:
+            if n > UnixPairDesc.CAPACITY:
+                return -EMSGSIZE
+            if peer.rbytes + n > UnixPairDesc.CAPACITY:
+                if self._nonblock(d, flags):
+                    return -EAGAIN
+                raise Blocked([d])
+            msg = bytes(self.mem.read(buf, n))
+            peer.rmsgs.append(msg)
+            peer.rbytes += n
+            peer.notify(ctx)
+            return n
+        space = UnixPairDesc.CAPACITY - len(peer.rbuf)
+        if space <= 0:
+            if self._nonblock(d, flags):
+                return -EAGAIN
+            raise Blocked([d])
+        take = min(n, space)
+        peer.rbuf += self.mem.read(buf, take)
+        peer.notify(ctx)
+        return take
 
     # ==================================================================
     # generic fd I/O (unistd.c / uio.c)
@@ -1317,6 +1420,8 @@ class SyscallHandler:
             return self._tcp_read(ctx, desc, buf, n, 0)
         if isinstance(desc, UdpDesc):
             return self.sys_recvfrom(ctx, (a[0], a[1], a[2], 0, 0, 0))
+        if isinstance(desc, UnixPairDesc):
+            return self._upair_read(ctx, desc, buf, n)
         if isinstance(desc, PipeDesc):
             return self._pipe_read(ctx, desc, buf, n)
         if isinstance(desc, EventfdDesc):
@@ -1351,6 +1456,8 @@ class SyscallHandler:
             return self._tcp_write(ctx, desc, buf, n, 0)
         if isinstance(desc, UdpDesc):
             return self.sys_sendto(ctx, (a[0], a[1], a[2], 0, 0, 0))
+        if isinstance(desc, UnixPairDesc):
+            return self._upair_write(ctx, desc, buf, n)
         if isinstance(desc, PipeDesc):
             return self._pipe_write(ctx, desc, buf, n)
         if isinstance(desc, EventfdDesc):

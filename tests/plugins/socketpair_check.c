@@ -1,0 +1,98 @@
+/* socketpair(AF_UNIX) under interposition (ref dispatch `socketpair`
+ * parity): the classic privilege-separation pattern — a STREAM pair
+ * shared across fork() with bidirectional messages and EOF on peer
+ * close, plus DGRAM message boundaries and shutdown semantics in one
+ * process. Prints "label value" lines; clocks are virtual so output
+ * is exact. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <time.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static void check(const char *label, int ok) {
+  printf("%s %d\n", label, ok);
+}
+
+int main(void) {
+  setvbuf(stdout, NULL, _IONBF, 0);
+  signal(SIGPIPE, SIG_IGN); /* EPIPE as errno, both worlds */
+
+  /* -- DGRAM pair keeps message boundaries -- */
+  int dg[2];
+  check("dgram_pair",
+        socketpair(AF_UNIX, SOCK_DGRAM, 0, dg) == 0);
+  check("dg_send1", send(dg[0], "one", 3, 0) == 3);
+  check("dg_send2", send(dg[0], "twotwo", 6, 0) == 6);
+  char buf[64] = {0};
+  check("dg_recv1", recv(dg[1], buf, 64, 0) == 3 &&
+        !memcmp(buf, "one", 3));
+  check("dg_recv2", recv(dg[1], buf, 64, 0) == 6 &&
+        !memcmp(buf, "twotwo", 6));
+  close(dg[0]);
+  close(dg[1]);
+
+  /* -- MSG_PEEK leaves the data in place -- */
+  int pk[2];
+  check("peek_pair",
+        socketpair(AF_UNIX, SOCK_STREAM, 0, pk) == 0);
+  check("peek_send", send(pk[0], "abc", 3, 0) == 3);
+  check("peek", recv(pk[1], buf, 64, MSG_PEEK) == 3 &&
+        !memcmp(buf, "abc", 3));
+  check("peek_consume", recv(pk[1], buf, 64, 0) == 3);
+  close(pk[0]);
+  close(pk[1]);
+
+  /* -- STREAM pair across fork: request/reply, then EOF -- */
+  int sv[2];
+  check("stream_pair",
+        socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  pid_t pid = fork();
+  if (pid == 0) {
+    /* child: serve one request, reply after 50 ms (forces the
+     * parent's read to PARK and be woken), linger 50 ms more before
+     * exiting (forces the parent's EOF read to park on the close
+     * path too) */
+    close(sv[0]);
+    char req[64] = {0};
+    ssize_t r = read(sv[1], req, 64);
+    struct timespec d = {0, 50 * 1000 * 1000};
+    nanosleep(&d, 0);
+    if (r > 0 && !strcmp(req, "ping")) {
+      write(sv[1], "pong", 5);
+    }
+    nanosleep(&d, 0);
+    close(sv[1]);
+    _exit(0);
+  }
+  check("fork", pid > 0);
+  close(sv[1]);
+  check("req", write(sv[0], "ping", 5) == 5);
+  memset(buf, 0, sizeof buf);
+  check("reply", read(sv[0], buf, 64) == 5 && !strcmp(buf, "pong"));
+  /* child closed its end: next read sees EOF */
+  check("eof", read(sv[0], buf, 64) == 0);
+  int st = -1;
+  check("wait", waitpid(pid, &st, 0) == pid && WIFEXITED(st) &&
+        WEXITSTATUS(st) == 0);
+
+  /* -- shutdown(SHUT_WR) gives the peer EOF; writes then EPIPE -- */
+  int sh[2];
+  check("shut_pair",
+        socketpair(AF_UNIX, SOCK_STREAM, 0, sh) == 0);
+  check("shut_wr", shutdown(sh[0], SHUT_WR) == 0);
+  check("shut_eof", read(sh[1], buf, 64) == 0);
+  check("shut_epipe",
+        write(sh[0], "x", 1) == -1 && errno == EPIPE);
+  check("shut_other_way", write(sh[1], "y", 1) == 1);
+  check("shut_still_reads", read(sh[0], buf, 1) == 1 &&
+        buf[0] == 'y');
+  close(sh[0]);
+  close(sh[1]);
+  printf("done\n");
+  return 0;
+}

@@ -409,6 +409,33 @@ def test_readiness_family(plugins, tmp_path, method):
 
 
 @pytest.mark.parametrize("method", ["preload", "ptrace"])
+def test_socketpair_family(plugins, tmp_path, method):
+    """socketpair(AF_UNIX) on both backends (ref dispatch parity):
+    DGRAM message boundaries, a STREAM pair shared across fork with
+    request/reply + EOF on child exit, and shutdown(SHUT_WR)
+    half-close semantics (peer EOF, writer EPIPE, reverse direction
+    stays open)."""
+    data = str(tmp_path / "shadow.data")
+    cfg = base_cfg(data).replace(
+        "hosts:\n",
+        f"experimental:\n  interpose_method: {method}\nhosts:\n") + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['socketpair_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    assert stats.ok
+    out = read_stdout(data, "alice", "socketpair_check")
+    assert "done" in out, out
+    for line in out.splitlines():
+        parts = line.split()
+        if len(parts) == 2 and parts[1] in ("0", "1"):
+            assert parts[1] == "1", f"{line!r} failed:\n{out}"
+
+
+@pytest.mark.parametrize("method", ["preload", "ptrace"])
 def test_cpp_runtime(plugins, tmp_path, method):
     """C++ runtime under both backends (ref src/test/cpp): libstdc++
     static init, exceptions, std::string, std::thread (clone), and
