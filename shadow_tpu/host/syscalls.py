@@ -132,6 +132,18 @@ SOCK_NONBLOCK, SOCK_CLOEXEC = 0x800, 0x80000
 SOL_SOCKET, SOL_TCP = 1, 6
 SO_ERROR, SO_TYPE, SO_SNDBUF, SO_RCVBUF, SO_ACCEPTCONN = 4, 3, 7, 8, 30
 MSG_DONTWAIT, MSG_PEEK = 0x40, 0x02
+
+_LIBC = None
+
+
+def _libc():
+    # cached ctypes handle for the few operations the os module
+    # cannot express (fallocate modes, renameat2 exchange)
+    global _LIBC
+    if _LIBC is None:
+        import ctypes
+        _LIBC = ctypes.CDLL(None, use_errno=True)
+    return _LIBC
 SHUT_RD, SHUT_WR, SHUT_RDWR = 0, 1, 2
 O_NONBLOCK, O_RDWR = 0x800, 0x2
 F_DUPFD, F_GETFD, F_SETFD, F_GETFL, F_SETFL, F_DUPFD_CLOEXEC = \
@@ -1258,9 +1270,25 @@ class SyscallHandler:
                     else desc.bound_port or 0)
         elif isinstance(desc, TcpListenDesc):
             port = desc.sock.local_port
+        elif isinstance(desc, UnixPairDesc):
+            return self._write_unnamed_unix(a[1], a[2])
         else:
             return -ENOTSOCK
         self._write_sockaddr(a[1], a[2], self._self_ip_be(), port)
+        return 0
+
+    def _write_unnamed_unix(self, addr_ptr: int, len_ptr: int):
+        """socketpair ends are unnamed: sockaddr_un with only
+        sun_family, length 2 (Linux unix_getname)."""
+        if not len_ptr:
+            return -EFAULT
+        if addr_ptr:
+            alen = struct.unpack("<I",
+                                 self.mem.read(len_ptr, 4))[0]
+            self.mem.write(addr_ptr,
+                           struct.pack("<H", 1)[:max(0,
+                                                     min(2, alen))])
+        self.mem.write(len_ptr, struct.pack("<I", 2))
         return 0
 
     def sys_getpeername(self, ctx, a):
@@ -1273,6 +1301,8 @@ class SyscallHandler:
             peer = desc.sock.peer
         elif isinstance(desc, UdpDesc):
             peer = desc.default_peer
+        elif isinstance(desc, UnixPairDesc):
+            return self._write_unnamed_unix(a[1], a[2])
         if peer is None:
             return -ENOTCONN
         self._write_sockaddr(a[1], a[2], self._host_ip_be(peer[0]),
@@ -1292,8 +1322,9 @@ class SyscallHandler:
                     val = desc.connect_err
                     desc.connect_err = None
             elif opt == SO_TYPE:
-                val = SOCK_DGRAM if isinstance(desc, UdpDesc) \
-                    else SOCK_STREAM
+                dgramish = isinstance(desc, UdpDesc) or (
+                    isinstance(desc, UnixPairDesc) and desc.dgram)
+                val = SOCK_DGRAM if dgramish else SOCK_STREAM
             elif opt == SO_SNDBUF:
                 sock = getattr(desc, "sock", None)
                 net = self.p.host.net
@@ -1380,6 +1411,24 @@ class SyscallHandler:
             d.peer.notify(ctx)              # writer may proceed
         return len(data)
 
+    def _upair_send_dgram(self, ctx, d, data: bytes, flags: int):
+        """One atomic datagram (bytes already gathered)."""
+        if d.wr_shut or d.peer is None or d.peer.closed \
+                or d.peer.rd_shut:
+            return -EPIPE
+        peer = d.peer
+        n = len(data)
+        if n > UnixPairDesc.CAPACITY:
+            return -EMSGSIZE
+        if peer.rbytes + n > UnixPairDesc.CAPACITY:
+            if self._nonblock(d, flags):
+                return -EAGAIN
+            raise Blocked([d])
+        peer.rmsgs.append(data)
+        peer.rbytes += n
+        peer.notify(ctx)
+        return n
+
     def _upair_write(self, ctx, d, buf: int, n: int,
                      flags: int = 0):
         if d.wr_shut or d.peer is None or d.peer.closed \
@@ -1387,26 +1436,31 @@ class SyscallHandler:
             return -EPIPE           # plain errno, like _pipe_write
         peer = d.peer
         if d.dgram:
-            if n > UnixPairDesc.CAPACITY:
-                return -EMSGSIZE
-            if peer.rbytes + n > UnixPairDesc.CAPACITY:
+            return self._upair_send_dgram(
+                ctx, d, bytes(self.mem.read(buf, n)), flags)
+        # STREAM: Linux unix_stream_sendmsg BLOCKS until the whole
+        # buffer is queued (short returns only for nonblocking);
+        # progress across Blocked restarts rides the parked-syscall
+        # state so replays never duplicate bytes
+        st = self.state
+        done = st.get("upair_done", 0)
+        while done < n:
+            if d.wr_shut or peer.closed or peer.rd_shut:
+                st.pop("upair_done", None)
+                return done if done else -EPIPE
+            space = UnixPairDesc.CAPACITY - len(peer.rbuf)
+            if space <= 0:
                 if self._nonblock(d, flags):
-                    return -EAGAIN
+                    st.pop("upair_done", None)
+                    return done if done else -EAGAIN
+                st["upair_done"] = done
                 raise Blocked([d])
-            msg = bytes(self.mem.read(buf, n))
-            peer.rmsgs.append(msg)
-            peer.rbytes += n
+            take = min(n - done, space)
+            peer.rbuf += self.mem.read(buf + done, take)
             peer.notify(ctx)
-            return n
-        space = UnixPairDesc.CAPACITY - len(peer.rbuf)
-        if space <= 0:
-            if self._nonblock(d, flags):
-                return -EAGAIN
-            raise Blocked([d])
-        take = min(n, space)
-        peer.rbuf += self.mem.read(buf, take)
-        peer.notify(ctx)
-        return take
+            done += take
+        st.pop("upair_done", None)
+        return done
 
     # ==================================================================
     # generic fd I/O (unistd.c / uio.c)
@@ -2152,7 +2206,17 @@ class SyscallHandler:
         if off < 0 or ln <= 0:
             return -EINVAL
         if mode != 0:
-            return -EOPNOTSUPP      # punch-hole/zero-range: not yet
+            # punch-hole/zero-range/collapse via the real fallocate(2)
+            # on the confined fd — the kernel validates the mode
+            # combination and answers EOPNOTSUPP for filesystems that
+            # lack it, which is exactly the faithful behavior
+            import ctypes
+            libc = _libc()
+            libc.fallocate.argtypes = (ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_long, ctypes.c_long)
+            if libc.fallocate(d.osfd, mode, off, ln) != 0:
+                return -ctypes.get_errno()
+            return 0
         try:
             os.posix_fallocate(d.osfd, off, ln)
             return 0
@@ -2338,8 +2402,8 @@ class SyscallHandler:
         RENAME_NOREPLACE, RENAME_EXCHANGE = 1, 2
         if flags & ~(RENAME_NOREPLACE | RENAME_EXCHANGE):
             return -EINVAL
-        if flags & RENAME_EXCHANGE:
-            return -EINVAL          # atomic exchange: not emulated
+        if (flags & RENAME_EXCHANGE) and (flags & RENAME_NOREPLACE):
+            return -EINVAL          # kernel: mutually exclusive
         for ptr in (old_ptr, new_ptr):
             if not ptr:
                 return -EFAULT
@@ -2363,6 +2427,25 @@ class SyscallHandler:
         if flags & RENAME_NOREPLACE and os.path.lexists(rn):
             return -EEXIST
         try:
+            if flags & RENAME_EXCHANGE:
+                # true atomic exchange through glibc's renameat2
+                # wrapper on the two CONFINED paths (os.rename cannot
+                # express it; the wrapper is arch-portable where a
+                # raw syscall number is not). Both targets must
+                # exist, as the kernel demands.
+                import ctypes
+                libc = _libc()
+                try:
+                    fn = libc.renameat2
+                except AttributeError:
+                    return -EINVAL      # pre-2.28 glibc
+                fn.argtypes = (ctypes.c_int, ctypes.c_char_p,
+                               ctypes.c_int, ctypes.c_char_p,
+                               ctypes.c_uint)
+                if fn(-100, ro.encode(), -100, rn.encode(),
+                      RENAME_EXCHANGE) != 0:     # AT_FDCWD anchors
+                    return -ctypes.get_errno()
+                return 0
             os.rename(ro, rn)
             return 0
         except OSError as e:
@@ -3008,6 +3091,10 @@ class SyscallHandler:
                 n = len(desc.queue[0][0])
             elif isinstance(desc, PipeDesc):
                 n = len(desc.buf)
+            elif isinstance(desc, UnixPairDesc):
+                # SIOCINQ on unix dgram = size of the next datagram
+                n = (len(desc.rmsgs[0]) if desc.dgram and desc.rmsgs
+                     else 0) if desc.dgram else len(desc.rbuf)
             self.mem.write(argp, struct.pack("<i", n))
             return 0
         return -ENOTTY
@@ -3456,6 +3543,28 @@ class SyscallHandler:
             desc.sock.sendto(ctx.now, dst[0], dst[1], len(data),
                              payload=data)
             return len(data)
+        if isinstance(desc, UnixPairDesc):
+            if name:
+                return -EISCONN
+            if desc.dgram:
+                # one datagram from the gathered iovecs (atomic)
+                data = b"".join(bytes(self.mem.read(b, ln))
+                                for b, ln in iov if ln)
+                return self._upair_send_dgram(ctx, desc, data, flags)
+            total = 0
+            for base, ln in iov:
+                if ln == 0:
+                    continue
+                try:
+                    r = self._upair_write(ctx, desc, base, ln, flags)
+                except Blocked:
+                    if total == 0:
+                        raise
+                    break
+                if isinstance(r, int) and r < 0:
+                    return r if total == 0 else total
+                total += r
+            return total
         if isinstance(desc, TcpDesc):
             # like _iov_loop: only the first iov may block — a Blocked
             # after partial progress would replay sent bytes on restart
@@ -3492,6 +3601,12 @@ class SyscallHandler:
                       msg_ptr + 8 if name else 0))
         if isinstance(desc, TcpDesc):
             return self._tcp_read(ctx, desc, base, ln, flags)
+        if isinstance(desc, UnixPairDesc):
+            r = self._upair_read(ctx, desc, base, ln, flags)
+            if isinstance(r, int) and r >= 0 and name:
+                # unnamed peer: msg_namelen (msghdr + 8) becomes 0
+                self.mem.write(msg_ptr + 8, struct.pack("<I", 0))
+            return r
         return -ENOTSOCK
 
     def sys_sendmmsg(self, ctx, a):

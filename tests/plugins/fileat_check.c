@@ -8,6 +8,7 @@
 #include <dirent.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <linux/falloc.h>
 #include <stdio.h>
 #include <string.h>
 #include <sys/file.h>
@@ -131,6 +132,21 @@ int main(void) {
   check("dino_matches_stat",
         stat("sub/a.txt", &st) == 0 && d_ino == (long)st.st_ino);
 
+  /* -- renameat2 RENAME_EXCHANGE: true atomic swap -- */
+  FILE *xa = fopen("xa.txt", "w");
+  FILE *xb = fopen("xb.txt", "w");
+  check("exch_setup", xa && xb);
+  if (xa) { fputs("AAA", xa); fclose(xa); }
+  if (xb) { fputs("B", xb); fclose(xb); }
+  check("exch", renameat2(AT_FDCWD, "xa.txt", AT_FDCWD, "xb.txt",
+                          RENAME_EXCHANGE) == 0);
+  check("exch_sizes",
+        stat("xa.txt", &st) == 0 && st.st_size == 1 &&
+        stat("xb.txt", &st) == 0 && st.st_size == 3);
+  check("exch_missing",
+        renameat2(AT_FDCWD, "xa.txt", AT_FDCWD, "nosuch.txt",
+                  RENAME_EXCHANGE) == -1 && errno == ENOENT);
+
   /* -- mknod(at): FIFOs and regular files land confined; device
    * nodes answer EPERM like the kernel does unprivileged -- */
   check("mknod_fifo", mknod("f.fifo", S_IFIFO | 0644, 0) == 0);
@@ -156,6 +172,12 @@ int main(void) {
         posix_fadvise(af, 0, 0, POSIX_FADV_SEQUENTIAL) == 0);
   check("fadvise_bad", posix_fadvise(af, 0, 0, 99) == EINVAL);
   check("readahead", readahead(af, 0, 4096) == 0);
+  check("falloc", posix_fallocate(af, 0, 8192) == 0);
+  /* punch a hole: size stays (KEEP_SIZE) but the range zeroes */
+  check("punch", fallocate(af, FALLOC_FL_PUNCH_HOLE |
+                           FALLOC_FL_KEEP_SIZE, 0, 4096) == 0);
+  struct stat pst;
+  check("punch_size", fstat(af, &pst) == 0 && pst.st_size == 8192);
   check("sync_range",
         sync_file_range(af, 0, 0, SYNC_FILE_RANGE_WRITE) == 0);
   check("syncfs", syncfs(af) == 0);

@@ -10,7 +10,10 @@
 #include <stdio.h>
 #include <string.h>
 #include <time.h>
+#include <sys/ioctl.h>
 #include <sys/socket.h>
+#include <sys/un.h>
+#include <sys/uio.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
@@ -35,6 +38,45 @@ int main(void) {
         !memcmp(buf, "twotwo", 6));
   close(dg[0]);
   close(dg[1]);
+
+  /* -- SO_TYPE reflects the pair flavor; FIONREAD counts -- */
+  int dg2[2];
+  check("dg2_pair", socketpair(AF_UNIX, SOCK_DGRAM, 0, dg2) == 0);
+  int sotype = 0;
+  socklen_t slen = sizeof sotype;
+  check("so_type_dgram",
+        getsockopt(dg2[0], SOL_SOCKET, SO_TYPE, &sotype, &slen) == 0
+        && sotype == SOCK_DGRAM);
+  check("dg2_send", send(dg2[0], "abcd", 4, 0) == 4);
+  int avail = -1;
+  check("fionread", ioctl(dg2[1], FIONREAD, &avail) == 0 &&
+        avail == 4);
+  struct sockaddr_un su;
+  socklen_t sulen = sizeof su;
+  check("getsockname_unnamed",
+        getsockname(dg2[0], (struct sockaddr *)&su, &sulen) == 0 &&
+        sulen == 2 && su.sun_family == AF_UNIX);
+  close(dg2[0]);
+  close(dg2[1]);
+
+  /* -- sendmsg/recvmsg gather/scatter on a stream pair -- */
+  int sm[2];
+  check("sm_pair", socketpair(AF_UNIX, SOCK_STREAM, 0, sm) == 0);
+  struct iovec siov[2] = {{"hel", 3}, {"lo!", 3}};
+  struct msghdr mh;
+  memset(&mh, 0, sizeof mh);
+  mh.msg_iov = siov;
+  mh.msg_iovlen = 2;
+  check("sendmsg", sendmsg(sm[0], &mh, 0) == 6);
+  char r1[4] = {0}, r2[4] = {0};
+  struct iovec riov[2] = {{r1, 3}, {r2, 3}};
+  memset(&mh, 0, sizeof mh);
+  mh.msg_iov = riov;
+  mh.msg_iovlen = 2;
+  check("recvmsg", recvmsg(sm[1], &mh, 0) >= 3 &&
+        !memcmp(r1, "hel", 3));
+  close(sm[0]);
+  close(sm[1]);
 
   /* -- MSG_PEEK leaves the data in place -- */
   int pk[2];
@@ -93,6 +135,35 @@ int main(void) {
         buf[0] == 'y');
   close(sh[0]);
   close(sh[1]);
+
+  /* -- a blocking stream write LARGER than the 64 KiB buffer must
+   * complete fully (Linux unix_stream_sendmsg blocks until queued;
+   * a short return would silently lose the tail) -- */
+  int bw[2];
+  check("bw_pair", socketpair(AF_UNIX, SOCK_STREAM, 0, bw) == 0);
+  pid_t dr = fork();
+  if (dr == 0) {
+    close(bw[0]);
+    char sink[8192];
+    long total = 0;
+    struct timespec nap = {0, 2 * 1000 * 1000};
+    while (total < 100000) {
+      nanosleep(&nap, 0);             /* slow drain forces blocking */
+      ssize_t r = read(bw[1], sink, sizeof sink);
+      if (r <= 0) break;
+      total += r;
+    }
+    _exit(total == 100000 ? 0 : 1);
+  }
+  close(bw[1]);
+  static char big[100000];
+  memset(big, 'Q', sizeof big);
+  check("big_write_full", write(bw[0], big, sizeof big) ==
+        (ssize_t)sizeof big);
+  close(bw[0]);
+  int bst = -1;
+  check("drain_ok", waitpid(dr, &bst, 0) == dr && WIFEXITED(bst) &&
+        WEXITSTATUS(bst) == 0);
   printf("done\n");
   return 0;
 }
