@@ -1,0 +1,48 @@
+/* Virtual fd window semantics: [600, 1024) = 424 slots. Exhaustion
+ * answers EMFILE exactly at capacity (no state leaked by the failed
+ * call), closing recycles slots, and allocation is kernel-style
+ * lowest-free within the window. */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <stdio.h>
+#include <unistd.h>
+
+int main(void) {
+  setvbuf(stdout, NULL, _IONBF, 0);
+  int fds[1024];
+  int n = 0;
+  int saw_emfile = 0;
+  while (n < 1000) {
+    int p[2];
+    if (pipe(p) < 0) {
+      saw_emfile = (errno == EMFILE);
+      break;
+    }
+    fds[n++] = p[0];
+    fds[n++] = p[1];
+  }
+  printf("emfile %d\n", saw_emfile);
+  printf("capacity %d\n", n);           /* exactly 424 */
+  /* the first fd is the window floor, allocated lowest-first */
+  printf("floor %d\n", n > 0 ? fds[0] : -1);
+
+  /* recycle: close two in the MIDDLE, reopen — lowest-free reuses
+   * exactly those slots */
+  int a = fds[10], b = fds[11];
+  close(a);
+  close(b);
+  int p2[2];
+  printf("reopen %d\n", pipe(p2) == 0);
+  printf("lowest_free %d\n",
+         (p2[0] == (a < b ? a : b)) && (p2[1] == (a < b ? b : a)));
+
+  /* full close -> full capacity again */
+  for (int i = 0; i < n; i++)
+    if (fds[i] != a && fds[i] != b) close(fds[i]);
+  close(p2[0]);
+  close(p2[1]);
+  int p3[2];
+  printf("drain_reopen %d\n", pipe(p3) == 0 && p3[0] == 600);
+  printf("done\n");
+  return 0;
+}

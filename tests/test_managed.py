@@ -409,6 +409,34 @@ def test_readiness_family(plugins, tmp_path, method):
 
 
 @pytest.mark.parametrize("method", ["preload", "ptrace"])
+def test_fd_window_emfile_and_recycling(plugins, tmp_path, method):
+    """The [600, 1024) virtual fd window: EMFILE exactly at the
+    424-slot capacity, kernel-style lowest-free allocation, freed
+    slots recycle (the monotonic-cursor bug this pins would have
+    exhausted the window after 424 cumulative opens forever)."""
+    data = str(tmp_path / "shadow.data")
+    cfg = base_cfg(data).replace(
+        "hosts:\n",
+        f"experimental:\n  interpose_method: {method}\nhosts:\n") + f"""
+  alice:
+    network_node_id: 0
+    processes:
+    - path: {plugins['fdlimit_check']}
+      start_time: 1s
+"""
+    stats, _ = run_sim(cfg, tmp_path)
+    assert stats.ok
+    out = read_stdout(data, "alice", "fdlimit_check")
+    assert "emfile 1" in out, out
+    assert "capacity 424" in out, out
+    assert "floor 600" in out, out
+    assert "reopen 1" in out, out
+    assert "lowest_free 1" in out, out
+    assert "drain_reopen 1" in out, out
+    assert "done" in out, out
+
+
+@pytest.mark.parametrize("method", ["preload", "ptrace"])
 def test_socketpair_family(plugins, tmp_path, method):
     """socketpair(AF_UNIX) on both backends (ref dispatch parity):
     DGRAM message boundaries, a STREAM pair shared across fork with
