@@ -465,6 +465,18 @@ def test_real_cpython_tcp_pair(tmp_path, method):
     assert "client done" in cli_out, cli_out
 
 
+def _wget_block() -> str:
+    """An extra wget process entry when wget exists (it drives its
+    socket with select(), exercising that path with a production
+    binary)."""
+    w = shutil.which("wget")
+    if w is None:
+        return ""
+    return (f"\n    - {{path: {w}, "
+            f"args: -q -O got.html http://www:8080/,\n"
+            f"       start_time: 4s}}")
+
+
 @pytest.mark.parametrize("method", ["preload", "ptrace"])
 def test_real_curl_fetches_real_http_server(tmp_path, method):
     """The reference README's marquee claim, reproduced: real curl
@@ -490,7 +502,7 @@ def test_real_curl_fetches_real_http_server(tmp_path, method):
     network_node_id: 1
     processes:
     - {{path: {curl}, args: -s -o fetched.html http://www:8080/,
-       start_time: 3s}}
+       start_time: 3s}}{_wget_block()}
 """
     stats, _ = run_sim(cfg, tmp_path)
     assert stats.ok
@@ -499,6 +511,10 @@ def test_real_curl_fetches_real_http_server(tmp_path, method):
         os.path.join(data, "hosts", "fetcher"))
     body = open(out).read()
     assert "Directory listing" in body or "<html" in body.lower()
+    wgot = os.path.join(data, "hosts", "fetcher", "got.html")
+    if _wget_block():
+        assert os.path.exists(wgot)
+        assert open(wgot).read() == body   # same listing, both tools
 
 
 @pytest.mark.parametrize("method", ["preload", "ptrace"])
