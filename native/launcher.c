@@ -30,24 +30,42 @@
 int main(int argc, char **argv) {
   int argi = 1;
   int tsc = 1;
-  if (argi < argc && strcmp(argv[argi], "--no-tsc") == 0) {
-    tsc = 0;
-    argi++;
+  int run_mode = 0;
+  for (; argi < argc; argi++) {
+    if (strcmp(argv[argi], "--no-tsc") == 0) {
+      tsc = 0;
+    } else if (strcmp(argv[argi], "--run") == 0) {
+      /* preload-backend mode (built -static so LD_PRELOAD is inert
+       * in the stub itself): apply the pre-exec settings, no
+       * SIGSTOP (nothing seizes us) and no TSC trap (the preload
+       * shim manages PR_SET_TSC in its own constructor). The win
+       * over a preexec_fn: no Python ever runs in the forked child
+       * of the JAX-threaded simulator (CPython's documented
+       * post-fork hazard) and _posixsubprocess may use vfork. */
+      run_mode = 1;
+    } else {
+      break;
+    }
   }
   if (argi >= argc) {
-    fprintf(stderr, "usage: launcher [--no-tsc] <prog> [args...]\n");
+    fprintf(stderr,
+            "usage: launcher [--no-tsc] [--run] <prog> [args...]\n");
     return 2;
   }
   personality(ADDR_NO_RANDOMIZE);
-  if (tsc)
+  if (tsc && !run_mode)
     prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
   /* native fds must stay below the virtual-fd floor (600) so the
    * fd-range classification can never be wrong; libc callers see
    * VIRTUAL rlimits via the emulated getrlimit/prlimit64 */
   struct rlimit nof = {600, 600};
   setrlimit(RLIMIT_NOFILE, &nof);
-  raise(SIGSTOP); /* tracer seizes here */
-  execv(argv[argi], argv + argi);
+  if (!run_mode)
+    raise(SIGSTOP); /* tracer seizes here */
+  if (run_mode)
+    execvp(argv[argi], argv + argi); /* PATH semantics like Popen */
+  else
+    execv(argv[argi], argv + argi);
   perror("execv");
   return 127;
 }

@@ -28,6 +28,7 @@ from __future__ import annotations
 import os
 import resource
 import shlex
+import shutil
 import struct
 import threading
 from typing import Optional
@@ -363,31 +364,55 @@ class ManagedProcess:
         # whose instruction-pointer escape dies at execve, and stacked
         # filters then kill the shim's own raw syscalls.)
         _disable_aslr_inheritable()
-        argv = [self.path] + self.args
+        # native fds must stay below the virtual-fd floor
+        # (descriptors.VFD_BASE) so the seccomp fd-range gate can
+        # never misclassify; libc callers see VIRTUAL rlimits via the
+        # emulated getrlimit/prlimit64. Preferred: the STATIC launcher
+        # stub in --run mode (rlimit + exec; LD_PRELOAD is inert in a
+        # static stub) — no Python ever runs in the forked child of
+        # this JAX-threaded process (CPython's documented post-fork
+        # hazard) and _posixsubprocess may use vfork. Fallback on
+        # machines without static libc: a preexec_fn.
+        stub = native.launcher_static_path()
+        preexec = None
+        if stub is not None:
+            # spawn-error parity with the direct-Popen path: Popen
+            # raises FileNotFoundError for a missing executable; the
+            # stub would only perror+exit 127 in the child, so check
+            # resolvability up front (the stub execvp's bare names
+            # against PATH, others against cwd=host_dir)
+            p = self.path
+            if os.sep not in p:
+                if not shutil.which(
+                        p, path=env.get("PATH",
+                                        os.environ.get("PATH", ""))):
+                    raise FileNotFoundError(2, "No such file or "
+                                            "directory", p)
+            elif not os.path.exists(
+                    p if os.path.isabs(p)
+                    else os.path.join(host_dir, p)):
+                raise FileNotFoundError(2, "No such file or "
+                                        "directory", p)
+            argv = [stub, "--run", self.path] + self.args
+        else:
+            argv = [self.path] + self.args
 
-        def _cap_native_fds():
-            # native fds must stay below the virtual-fd floor
-            # (descriptors.VFD_BASE) so the seccomp fd-range gate can
-            # never misclassify; libc callers see VIRTUAL rlimits via
-            # the emulated getrlimit/prlimit64. Runs post-fork in the
-            # child (costs the posix_spawn fast path — acceptable,
-            # and the ptrace backend's launcher.c does the same).
-            # `resource` is imported at module top: a first-time
-            # import here, post-fork in a threaded parent, could
-            # deadlock on the import lock. Clamped to the ambient
-            # hard limit and best-effort, matching launcher.c.
-            try:
-                hard = resource.getrlimit(resource.RLIMIT_NOFILE)[1]
-                lim = VFD_BASE if hard == resource.RLIM_INFINITY \
-                    else min(VFD_BASE, hard)
-                resource.setrlimit(resource.RLIMIT_NOFILE, (lim, lim))
-            except (ValueError, OSError):
-                pass
+            def preexec():
+                try:
+                    hard = resource.getrlimit(
+                        resource.RLIMIT_NOFILE)[1]
+                    lim = VFD_BASE \
+                        if hard == resource.RLIM_INFINITY \
+                        else min(VFD_BASE, hard)
+                    resource.setrlimit(resource.RLIMIT_NOFILE,
+                                       (lim, lim))
+                except (ValueError, OSError):
+                    pass
 
         self.proc = subprocess.Popen(
             argv, env=env, cwd=host_dir, stdout=stdout_f,
             stderr=stderr_f, stdin=subprocess.DEVNULL,
-            preexec_fn=_cap_native_fds)
+            preexec_fn=preexec)
         stdout_f.close()
         stderr_f.close()
         self.mem = ProcessMemory(self.proc.pid)

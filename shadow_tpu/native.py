@@ -230,3 +230,28 @@ def launcher_path(build_if_missing: bool = True) -> str:
                         "build/shadowtpu_launcher"],
                        check=True, capture_output=True)
     return _LAUNCHER_PATH
+
+
+_LAUNCHER_STATIC_PATH = os.path.join(_NATIVE_DIR, "build",
+                                     "shadowtpu_launcher_static")
+_LAUNCHER_STATIC_RESULT = [False, None]     # [attempted, path|None]
+
+
+def launcher_static_path(build_if_missing: bool = True):
+    """Path to the STATIC launcher stub (preload backend's --run
+    mode: rlimit cap + ASLR off + exec, with LD_PRELOAD inert in the
+    stub itself), or None when no static libc exists on this machine
+    (callers fall back to a preexec_fn). The build attempt is
+    memoized — a machine without static libc must not pay a failing
+    make per process spawn."""
+    if os.path.exists(_LAUNCHER_STATIC_PATH):
+        return _LAUNCHER_STATIC_PATH
+    if not build_if_missing or _LAUNCHER_STATIC_RESULT[0]:
+        return _LAUNCHER_STATIC_RESULT[1]
+    _LAUNCHER_STATIC_RESULT[0] = True
+    r = subprocess.run(["make", "-C", _NATIVE_DIR,
+                        "build/shadowtpu_launcher_static"],
+                       capture_output=True)
+    if r.returncode == 0 and os.path.exists(_LAUNCHER_STATIC_PATH):
+        _LAUNCHER_STATIC_RESULT[1] = _LAUNCHER_STATIC_PATH
+    return _LAUNCHER_STATIC_RESULT[1]
