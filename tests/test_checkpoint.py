@@ -78,6 +78,38 @@ def test_pause_save_resume_bitmatches_uninterrupted(tmp_path):
     assert _sig(res_stats, res_c) == _sig(full_stats, full_c)
 
 
+def test_tor_pause_resume_bitmatches(tmp_path):
+    """Checkpoint/resume on the TOR app family (onion trains,
+    relay burst pops, different app-state shape than tgen): a
+    mid-bootstrap pause + resume of the small-Tor example must
+    bit-match the uninterrupted run."""
+    import os
+    from shadow_tpu import simtime
+    from shadow_tpu.config import load_config
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "tor_small.yaml")
+    ck = str(tmp_path / "tor.npz")
+
+    def run(extra=None):
+        cfg = load_config(path)
+        cfg.general.stop_time = simtime.from_seconds(12.0)
+        if extra:
+            for k, v in extra.items():
+                setattr(cfg.experimental, k, v)
+        c = Controller(cfg)
+        stats = c.run()
+        return stats, c
+
+    full_stats, full_c = run()
+    assert full_stats.ok
+    run({"checkpoint_save": ck,
+         "checkpoint_save_time": simtime.from_seconds(7.0)})
+    res_stats, res_c = run({"checkpoint_load": ck})
+    assert res_stats.ok
+    assert _sig(res_stats, res_c) == _sig(full_stats, full_c)
+
+
 def test_resume_with_heartbeat_segmentation(tmp_path):
     """Resume under hb/dispatch segmentation still bit-matches (the
     segmented loop starts at the saved t, heartbeat boundaries align
