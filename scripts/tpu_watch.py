@@ -86,7 +86,11 @@ def main() -> int:
                           "examples/tgen_10000.yaml", "2.5"],
                          f"{ART}/PROFILE_tpu.json",
                          f"{ART}/PROFILE_tpu.log")
-            log("profile done — running full-state tor_large")
+            log("profile done — running micro4 (gather attribution)")
+            run_and_save([sys.executable, "scripts/tpu_micro4.py"],
+                         f"{ART}/MICRO4_tpu.json",
+                         f"{ART}/MICRO4_tpu.log")
+            log("micro4 done — running full-state tor_large")
             run_and_save([sys.executable, "scripts/tor_large_run.py",
                           "12"],
                          f"{ART}/TORLARGE_tpu.json",
