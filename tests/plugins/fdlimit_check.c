@@ -4,6 +4,7 @@
  * lowest-free within the window. */
 #define _GNU_SOURCE
 #include <errno.h>
+#include <sys/resource.h>
 #include <stdio.h>
 #include <unistd.h>
 
@@ -43,6 +44,17 @@ int main(void) {
   close(p2[1]);
   int p3[2];
   printf("drain_reopen %d\n", pipe(p3) == 0 && p3[0] == 600);
+
+  /* libc callers see VIRTUAL rlimits (default 1024/1M) even though
+   * the spawn path capped the NATIVE limit at 600 */
+  struct rlimit rl;
+  printf("rlimit_virtual_default %d\n",
+         getrlimit(RLIMIT_NOFILE, &rl) == 0 && rl.rlim_cur == 1024);
+  struct rlimit nl = {512, 2048};
+  printf("setrlimit %d\n", setrlimit(RLIMIT_NOFILE, &nl) == 0);
+  printf("rlimit_roundtrip %d\n",
+         getrlimit(RLIMIT_NOFILE, &rl) == 0 && rl.rlim_cur == 512 &&
+         rl.rlim_max == 2048);
   printf("done\n");
   return 0;
 }

@@ -542,6 +542,9 @@ def test_fd_window_emfile_and_recycling(plugins, tmp_path, method):
     assert "reopen 1" in out, out
     assert "lowest_free 1" in out, out
     assert "drain_reopen 1" in out, out
+    assert "rlimit_virtual_default 1" in out, out
+    assert "setrlimit 1" in out, out
+    assert "rlimit_roundtrip 1" in out, out
     assert "done" in out, out
 
 
