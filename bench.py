@@ -121,6 +121,9 @@ def init_backend():
 
 TUNE_PATH = os.path.join("artifacts", "TUNE_tpu.json")
 _tuned: dict = {}
+# config path -> (artifacts/OCC_*.json path, occupancy record) from
+# the most recent device run of that config (see run_device)
+_occ_records: dict = {}
 
 
 def load_tuned_knobs() -> dict:
@@ -161,6 +164,27 @@ def load(config_path: str, policy: str, stop_s: float):
     cfg = load_config(config_path)
     cfg.experimental.scheduler_policy = policy
     cfg.general.stop_time = simtime.from_seconds(stop_s)
+    if policy == "tpu" and os.environ.get("BENCH_CAPACITY_PLAN"):
+        # opt-in: size every capacity from a measured warm-up slice
+        # (device/capacity.py) instead of the configs' static knobs.
+        # Traces stay bit-identical unless something overflows, and
+        # an overflow re-plans and retries instead of failing. The
+        # warm-up must reach real traffic — tgen clients start at 2s
+        # sim, so the default stop/8 would measure boot only and eat
+        # a re-plan cycle per rung
+        plan = os.environ["BENCH_CAPACITY_PLAN"]
+        if plan not in ("static", "auto") and \
+                not plan.endswith(".json"):
+            # the schema's own check runs at load_config time; this
+            # assignment is post-validation, so re-check here or a
+            # typo dies minutes later as a raw FileNotFoundError
+            raise SystemExit(
+                f"BENCH_CAPACITY_PLAN={plan!r} is neither 'static', "
+                "'auto', nor a path to a saved OCC_*.json record")
+        cfg.experimental.capacity_plan = plan
+        if cfg.experimental.capacity_plan == "auto":
+            cfg.experimental.capacity_warmup = min(
+                cfg.general.stop_time, simtime.from_seconds(3.0))
     if policy == "tpu" and _tuned:
         cfg.experimental.pop_strategy = _tuned["pop_strategy"]
         cfg.experimental.burst_pops = _tuned["burst_pops"]
@@ -192,15 +216,30 @@ def run_device(config_path: str, stop_s: float,
         cfg.experimental.dispatch_segment = \
             simtime.from_seconds(segment_s)
     c = Controller(cfg)
-    if config_path in engine_cache:
+    # under a capacity plan the runner rebuilds the engine from
+    # measured occupancy, so a cached statically-sized engine would
+    # just be thrown away — plan ahead of the timed window instead
+    planned = cfg.experimental.capacity_plan != "static"
+    if not planned and config_path in engine_cache:
         c.runner.engine = engine_cache[config_path]
-    else:
+    elif not planned:
         t0 = time.perf_counter()
         # compile + a minimal-length run (boot only) to warm the cache
         st = c.runner.engine.init_state(c.sim.starts)
         c.runner.engine.run(st, stop=simtime.from_seconds(0.001))
         log(f"  compile+warm {time.perf_counter() - t0:.1f}s")
         engine_cache[config_path] = c.runner.engine
+    else:
+        # plan + compile OUTSIDE the timed window, for parity with
+        # the static path's warm cache: the warm-up slice, the static
+        # engine's compile, and the planned engine's compile must not
+        # land in `wall` (the cpu baseline pays none of them). run()
+        # sees the runner already planned and skips re-planning.
+        t0 = time.perf_counter()
+        c.runner._plan_capacities(cfg.general.stop_time)
+        st = c.runner.engine.init_state(c.sim.starts)
+        c.runner.engine.run(st, stop=simtime.from_seconds(0.001))
+        log(f"  plan+compile+warm {time.perf_counter() - t0:.1f}s")
     t0 = time.perf_counter()
     stats = c.run()
     wall = time.perf_counter() - t0
@@ -208,6 +247,13 @@ def run_device(config_path: str, stop_s: float,
         raise RuntimeError(
             f"device run of {config_path} (stop={stop_s}s) overflowed "
             "— the capacity plan is wrong; see log for the knob")
+    if stats.occupancy is not None:
+        # measured high-water marks + the capacities that held them;
+        # the headline run's record is written to artifacts/ in main()
+        # so scripts/tune_10k.py can prune its sweep grid from it
+        from shadow_tpu.device import capacity
+        _occ_records[config_path] = (
+            capacity.record_path(c.runner.engine), stats.occupancy)
     return wall, stats.packets_sent, stop_s
 
 
@@ -428,9 +474,12 @@ def main() -> int:
             # VERDICT r4 weak-1: a fallback artifact must still carry
             # the big rungs (clearly labeled platform: cpu) — run the
             # 1k rung always, the 10k rung if the wall budget allows
-            # (guarded below), and shorten the full run
+            # (guarded below), and shorten the full run. Slices must
+            # clear the clients' 2s start_time by enough to route real
+            # traffic: the old 2.0s tgen_1000 slice ended exactly at
+            # client start and benched 0 packets (BENCH_r05)
             rungs = [("tgen_100", "examples/tgen_100.yaml", 5.0),
-                     ("tgen_1000", "examples/tgen_1000.yaml", 2.0),
+                     ("tgen_1000", "examples/tgen_1000.yaml", 3.0),
                      ("tgen_10000", "examples/tgen_10000.yaml", 2.5)]
             headline, full_stop = "tgen_1000", 10.0
         engine_cache: dict = {}
@@ -465,6 +514,18 @@ def main() -> int:
                 raise RuntimeError(
                     f"{name}: device routed {d_pkts} packets but cpu "
                     f"routed {c_pkts} on the same config/seed")
+            if d_pkts == 0 or c_pkts == 0:
+                # a zero-packet rung makes the throughput ratio 0/0
+                # (BENCH_r05's "float division by zero"): the tgen
+                # clients start at 2s sim, so any slice that stops at
+                # or before that measures boot, not routing — fail
+                # with the config's arithmetic, never a bare ZeroDiv
+                raise RuntimeError(
+                    f"{name}: 0 packets routed on the {slice_s}s sim "
+                    f"slice (device={d_pkts}, cpu={c_pkts}) — tgen "
+                    "clients start at 2s sim, so the slice must stop "
+                    "well past their start_time to carry traffic; "
+                    "lengthen the slice or fix the config")
             ratio = (d_pkts / d_wall) / (c_pkts / c_wall)
             ladder[name] = {
                 "slice_sim_s": slice_s,
@@ -495,6 +556,19 @@ def main() -> int:
         result["sim_s_per_wall_s"] = round(sim_per_wall, 3)
         result["n_chips"] = n_chips
         result["ladder"] = ladder
+
+        if headline_path in _occ_records:
+            # the full run's measured occupancy high-water marks —
+            # scripts/tune_10k.py prunes its sweep grid from this
+            # record, and capacity_plan: <path> replays it
+            from shadow_tpu.device import capacity
+            occ_path, occ = _occ_records[headline_path]
+            try:
+                capacity.save_record(occ, occ_path)
+                result["occupancy_record"] = occ_path
+                log(f"occupancy record -> {occ_path}")
+            except OSError as e:
+                log(f"could not write occupancy record: {e}")
 
         if not os.environ.get("BENCH_SMOKE"):
             log(f"hybrid sweep: pairs in {HYBRID_SWEEP} (adaptive "

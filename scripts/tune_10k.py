@@ -11,6 +11,13 @@ outbox_compact is CAPACITY-sensitive: too small fails loudly
 may not cover steady state, bench.py re-guards it (workload match +
 retry-without on overflow).
 
+When a measured occupancy record (artifacts/OCC_*.json, written by
+bench.py or any capacity_plan run — see device/capacity.py) exists
+for a workload with this host count, compact widths below the
+measured busiest-host outbox fill are PRUNED from the grid up front:
+they can only overflow loudly, so sweeping them burns chip time to
+learn what the record already says.
+
 Usage: python scripts/tune_10k.py [stop_s] [config]
 """
 
@@ -38,6 +45,73 @@ BURSTS = (8, 16)
 COMPACTS = (0,)
 
 
+def prune_compacts(compacts: tuple, config: str, stop_ns: int) -> tuple:
+    """Drop compact widths a measured occupancy record proves too
+    small: the busiest host's outbox fill is a hard floor (a smaller
+    compaction width x_overflows loudly and the combo is disqualified
+    anyway — sweeping it just burns chip time). Records match on the
+    device app class, host count, AND the workload fingerprint (app
+    scalars + per-host parameter arrays) — a 10k-host phold record
+    must never size a 10k-host tgen sweep, nor a heavy-traffic tgen
+    record a light-traffic variant; among matches the longest
+    measured window wins. A record covering a PREFIX of the sweep
+    slice (stop_time <= `stop_ns`) proves the width overflows in the
+    sweep itself; a longer record (e.g. bench.py's full-run headline)
+    proves it overflows at the real rung even if the shorter slice
+    survives it — either way the width is not worth chip time.
+    Outbox fill per phase is a property of the event windows, which
+    are pop/burst-invariant (the knobs this sweep varies), so the
+    floor transfers across combos. No record means no pruning."""
+    import glob
+
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import build
+    from shadow_tpu.device import capacity
+    from shadow_tpu.device.runner import NoDeviceTwin, device_twin
+
+    if all(c == 0 for c in compacts):
+        return compacts                 # nothing prunable on the axis
+    try:
+        sim = build(load_config(config))
+        twin = device_twin(sim)
+    except NoDeviceTwin:
+        return compacts                 # sweep will fail loudly anyway
+    app = type(twin).__name__
+    app_fp = capacity.app_fingerprint(twin)
+    n_hosts = len(sim.hosts)
+    occ_dir = os.environ.get("SHADOW_TPU_OCC_DIR", "artifacts")
+    best = None
+    for path in sorted(glob.glob(os.path.join(occ_dir, "OCC_*.json"))):
+        try:
+            rec = capacity.load_record(path)
+        except (OSError, ValueError):
+            continue
+        rec_stop = rec["workload"].get("stop_time", 0)
+        if rec["workload"].get("n_hosts") == n_hosts and \
+                rec["workload"].get("app") == app and \
+                rec["workload"].get("app_fp") == app_fp \
+                and rec_stop > 0 \
+                and (best is None or rec_stop > best[2]):
+            best = (path, rec, rec_stop)
+    if best is None:
+        return compacts
+    path, rec, rec_stop = best
+    floor = max(rec["measured"]["outbox_rows_max"],
+                rec.get("final_measured", {}).get("outbox_rows_max", 0))
+    keep = tuple(c for c in compacts if c == 0 or c >= floor)
+    dropped = [c for c in compacts if c not in keep]
+    if dropped:
+        why = "they can only x_overflow in this sweep" \
+            if rec_stop <= stop_ns else \
+            (f"they x_overflow by {rec_stop / 1e9:g} sim-s even if "
+             "this shorter slice survives them")
+        print(f"  occupancy record {path}: busiest host fills {floor} "
+              f"outbox rows — pruning compact widths {dropped} from "
+              f"the sweep ({why})",
+              file=sys.stderr, flush=True)
+    return keep or (0,)
+
+
 def main() -> int:
     stop_s = float(sys.argv[1]) if len(sys.argv) > 1 else 2.5
     config = sys.argv[2] if len(sys.argv) > 2 else \
@@ -48,6 +122,9 @@ def main() -> int:
     from shadow_tpu import simtime
     from shadow_tpu.config import load_config
     from shadow_tpu.core.controller import Controller
+
+    compacts = prune_compacts(compacts, config,
+                              simtime.from_seconds(stop_s))
 
     platform = jax.devices()[0].platform
     results = []

@@ -36,4 +36,16 @@ if not os.environ.get("SHADOW_TPU_NO_CACHE"):
     except Exception:                       # noqa: BLE001
         pass        # older jax without the knobs: compile as before
 
-__all__ = ["jax", "jnp"]
+# shard_map moved from jax.experimental to the jax namespace (with
+# check_rep renamed check_vma) across jax releases; export one callable
+# with the NEW calling convention so engine code is version-agnostic
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+__all__ = ["jax", "jnp", "shard_map"]

@@ -8,6 +8,7 @@ on the raw dict before schema conversion.
 
 from __future__ import annotations
 
+import os
 from typing import Iterable, Optional
 
 import yaml
@@ -42,4 +43,16 @@ def load_config_str(text: str,
 def load_config(path: str,
                 overrides: Optional[Iterable[str]] = None) -> ConfigOptions:
     with open(path) as f:
-        return load_config_str(f.read(), overrides)
+        cfg = load_config_str(f.read(), overrides)
+    plan = cfg.experimental.capacity_plan
+    if plan not in ("static", "auto") and not os.path.isabs(plan):
+        # a path-valued capacity_plan (a saved OCC_*.json occupancy
+        # record) resolves relative to the config file; a value that
+        # came in as a CLI override was typed against the launching
+        # cwd, so when only the cwd candidate exists, use it
+        cand = os.path.normpath(
+            os.path.join(os.path.dirname(os.path.abspath(path)), plan))
+        if not os.path.exists(cand) and os.path.exists(plan):
+            cand = os.path.abspath(plan)
+        cfg.experimental.capacity_plan = cand
+    return cfg

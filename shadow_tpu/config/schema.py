@@ -256,6 +256,20 @@ class ExperimentalOptions:
     # H*outbox to H*this). 0 = off; too small fails loudly
     # (x_overflow). Size to the busiest host's sends+timers per phase.
     outbox_compact: int = 0
+    # occupancy-driven capacity planning (device/capacity.py):
+    # "static" keeps the hand-tuned knobs above; "auto" measures a
+    # short warm-up slice and sizes every capacity from its occupancy
+    # high-water marks; any other value is a path to a previously
+    # written artifacts/OCC_*.json record. Non-static runs also
+    # re-plan with doubled headroom and retry from the last
+    # known-good state on a loud capacity overflow instead of
+    # failing the run. Traces are bit-identical across capacity
+    # choices whenever nothing overflows (tests pin it).
+    capacity_plan: str = "static"
+    # warm-up slice length for capacity_plan: auto (sim time;
+    # 0 = stop_time / 8). It must reach real traffic — a slice that
+    # ends before the first client start_time measures only boot.
+    capacity_warmup: int = 0
     # network-judgment placement on the device engine: "auto" judges
     # the phase's outbox at flush on TPU (fewer ops in the pop loop)
     # and in-step on CPU; "flush"/"step" pin it. Bit-identical traces
@@ -325,7 +339,8 @@ class ExperimentalOptions:
             if f.name in d:
                 v = d[f.name]
                 if f.name in ("runahead", "dispatch_segment",
-                              "checkpoint_save_time"):
+                              "checkpoint_save_time",
+                              "capacity_warmup"):
                     v = parse_time_ns(v)
                 elif f.name in ("interface_buffer", "socket_recv_buffer",
                                 "socket_send_buffer"):
@@ -366,6 +381,32 @@ class ExperimentalOptions:
                 "experimental.checkpoint_save_time is set but "
                 "checkpoint_save (the output path) is not — the "
                 "pause time would be silently ignored")
+        if out.capacity_plan != "static" and \
+                out.scheduler_policy != "tpu":
+            raise ValueError(
+                "experimental.capacity_plan: occupancy-driven "
+                "capacity planning sizes the DEVICE engine's buffers "
+                "and requires scheduler_policy: tpu (CPU policies "
+                "have no static capacities to plan)")
+        if out.capacity_warmup < 0:
+            raise ValueError(
+                "experimental.capacity_warmup must be >= 0")
+        if out.capacity_plan not in ("static", "auto") and \
+                not out.capacity_plan.endswith(".json"):
+            # record paths always end in .json (capacity.record_path
+            # writes OCC_*.json) — anything else is a typo'd mode
+            # that would otherwise surface minutes later as a raw
+            # FileNotFoundError deep inside the run
+            raise ValueError(
+                f"experimental.capacity_plan: {out.capacity_plan!r} "
+                "is neither 'static', 'auto', nor a path to a saved "
+                "OCC_*.json occupancy record")
+        if out.capacity_warmup and out.capacity_plan != "auto":
+            raise ValueError(
+                "experimental.capacity_warmup is set but "
+                f"capacity_plan is {out.capacity_plan!r} — the "
+                "warm-up slice only runs under capacity_plan: auto, "
+                "so the knob would be silently ignored")
         if (out.checkpoint_save or out.checkpoint_load) and \
                 out.scheduler_policy != "tpu":
             raise ValueError(

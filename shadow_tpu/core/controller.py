@@ -212,6 +212,15 @@ class Controller:
                 return
             except NoDeviceTwin as e:
                 log.info("tpu policy -> hybrid: %s", e)
+                if cfg.experimental.capacity_plan != "static":
+                    # the schema rejects capacity_plan on CPU policies
+                    # for exactly this silent-ignore hazard; the
+                    # fallback must not hide it either
+                    log.warning(
+                        "capacity_plan: %s ignored — the hybrid "
+                        "fallback's CPU host emulation has no static "
+                        "capacities to plan",
+                        cfg.experimental.capacity_plan)
                 policy_name = "hybrid"
         if policy_name == "hybrid":
             # CPU host emulation + batched device network judgment
@@ -258,7 +267,17 @@ class Controller:
         cfg = self.cfg
         stop = cfg.general.stop_time
         if self.runner is not None:
-            return self.runner.run(stop)
+            stats = self.runner.run(stop)
+            occ = stats.occupancy
+            if occ is not None and "planned" in occ:
+                # one-line audit of the adaptive plan: what it chose
+                # vs the static knobs, and whether it held first try
+                log.info(
+                    "capacity plan (%s): %s  [static %s, %d replan%s]",
+                    cfg.experimental.capacity_plan, occ["planned"],
+                    occ["static"], stats.replans,
+                    "" if stats.replans == 1 else "s")
+            return stats
 
         m = self.manager
         m.boot_hosts(self.sim.starts)

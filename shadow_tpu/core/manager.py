@@ -68,6 +68,12 @@ class SimStats:
     packets_delivered: int = 0
     packets_dropped: int = 0
     rounds: int = 0
+    # device-engine occupancy telemetry (device/capacity.py record:
+    # measured high-water marks + the capacities that held them);
+    # None on CPU policies
+    occupancy: Optional[dict] = None
+    # capacity re-plan/retry cycles the run needed (0 = the plan held)
+    replans: int = 0
 
     def merge(self, other: "SimStats") -> None:
         self.events_executed += other.events_executed

@@ -1,0 +1,288 @@
+"""Occupancy-driven capacity planning for the device engine.
+
+Every hot-path cost in the device engine scales with a statically
+provisioned capacity: heap merges are E + IN rows wide, the flush's
+flat sort covers H*OB (or H*CX) rows, and the all_to_all exchange
+ships [n_shards, CAP] buffers auto-sized with 4x headroom "for skewed
+traffic" (engine.py) — so on sparse or bursty workloads most of the
+sort width and ICI bandwidth moves padding. The engine now accumulates
+per-segment occupancy HIGH-WATER MARKS in its state (state["occ_*"],
+reductions only, no extra sorts); this module turns those measurements
+into tight capacities and back:
+
+* ``measure(engine, state)``  — occupancy record (a JSON-able dict)
+  from a run's final state: measured maxima + the effective
+  capacities that held them.
+* ``plan(record, ...)``       — EngineConfig capacity overrides sized
+  to the measurements with headroom.
+* ``widen(knobs, dims, eff)`` — double the offending dimension(s)
+  after a loud overflow (the runner's re-plan/retry loop).
+* ``grow_heaps(host_state, new_e)`` / ``transfer(engine, starts,
+  host_state)`` — carry a saved state into a re-planned engine whose
+  event_capacity grew.
+
+Safety argument: a plan that undershoots (the warm-up slice missed
+steady state) trips the engine's LOUD overflow counters; the runner
+re-plans with doubled headroom on the offending dimension and re-runs
+the segment from the last known-good state instead of failing the
+run. Traces are bit-identical across capacity choices whenever
+nothing overflows (the engine's determinism contract, pinned by
+tests), so planning is purely a performance lever.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import numpy as np
+
+FORMAT = 1
+# planned = ceil(measured * HEADROOM) + SLACK: the warm-up slice is a
+# lower bound on steady-state occupancy, and the retry loop makes an
+# undershoot cost one re-run, never the run
+HEADROOM = 1.5
+SLACK = 2
+# re-plan attempts before the run is allowed to fail loudly (each
+# attempt doubles the offending dimension, so 6 covers a 64x miss)
+MAX_REPLANS = 6
+
+# overflow counter -> the capacity dimensions it implicates. The
+# merge/arrival `overflow` counter cannot distinguish a short heap
+# from a short arrival window, so both grow together; `x_overflow`
+# covers both the shard-pair CAP and the compaction width.
+OVERFLOW_DIMS = {
+    "overflow": ("event_capacity", "exchange_in_capacity"),
+    "x_overflow": ("exchange_capacity", "outbox_compact"),
+}
+
+
+def app_scalars(app) -> dict:
+    """The app's scalar config surface (bool/int/float/str instance
+    attrs — device apps keep per-host state in the engine state dict,
+    so scalars are the configuration surface). burst_pops is a
+    trace-invariant lane-width knob and is excluded, so retuning
+    width neither splits occupancy records nor poisons checkpoint
+    fingerprints. Shared by app_fingerprint and the checkpoint
+    fingerprint — an app knob that must join or leave the identity
+    changes in exactly one place."""
+    out = {k: v for k, v in sorted(vars(app).items())
+           if isinstance(v, (bool, int, float, str))}
+    out.pop("burst_pops", None)
+    return out
+
+
+def app_fingerprint(app) -> str:
+    """Workload-variant fingerprint of a device app: its scalar
+    config surface plus its per-host parameter arrays (tgen counts/
+    pauses, tor relay ids, ...). Two same-class, same-host-count
+    apps with different traffic shapes have different occupancy —
+    they must not share a record."""
+    import hashlib
+
+    h = hashlib.sha256(
+        json.dumps(app_scalars(app), sort_keys=True).encode())
+    for k, v in sorted(vars(app).items()):
+        if isinstance(v, np.ndarray):
+            h.update(k.encode())
+            h.update(str(v.shape).encode())
+            h.update(np.ascontiguousarray(v).tobytes())
+    return h.hexdigest()[:12]
+
+
+def measure(engine, state, source: str = "run") -> dict:
+    """Build an occupancy record from a (finished) run's state. The
+    occ_* entries are a handful of small per-shard arrays — fetching
+    them costs microseconds, never the [H, E] heaps."""
+    from shadow_tpu._jax import jax
+
+    H = engine.config.n_hosts
+    occ = {k: np.asarray(jax.device_get(state[k]))
+           for k in ("occ_heap", "occ_ob", "occ_in", "occ_x",
+                     "occ_trips", "occ_phases", "overflow",
+                     "x_overflow")}
+    eff = dict(engine.effective)
+    measured = {
+        "heap_rows_max": int(occ["occ_heap"][:H].max(initial=0)),
+        "outbox_rows_max": int(occ["occ_ob"][:H].max(initial=0)),
+        "arrivals_per_flush_max": int(occ["occ_in"][:H].max(initial=0)),
+        "exchange_rows_max": int(occ["occ_x"].max(initial=0)),
+        "pop_trips_max": int(occ["occ_trips"].max(initial=0)),
+        "phases": int(occ["occ_phases"].max(initial=0)),
+        "overflow": int(occ["overflow"][:H].sum()),
+        "x_overflow": int(occ["x_overflow"][:H].sum()),
+    }
+    return {
+        "format": FORMAT,
+        "source": source,
+        "workload": {
+            "app": type(engine.app).__name__,
+            "app_fp": app_fingerprint(engine.app),
+            "n_hosts": H,
+            "seed": int(engine.config.seed),
+            "stop_time": int(engine.config.stop_time),
+        },
+        "measured": measured,
+        "effective": eff,
+    }
+
+
+def plan(record: dict, per_iter: int, floor_iters: int = 4,
+         n_shards: int = 1, headroom: float = HEADROOM) -> dict:
+    """Measured occupancies -> EngineConfig capacity overrides.
+
+    per_iter is the outbox row cost of one pop iteration (K_eff + T
+    [+ READY]); outbox_capacity is planned in iterations so the
+    engine's B = outbox // per_iter arithmetic lands exactly.
+
+    Saved records carry both the warm-up slice maxima (`measured`)
+    and, once the runner finishes, the full run's (`final_measured`)
+    — plan from the elementwise max so a capacity_plan: <path> replay
+    sizes for steady state, not just the warm-up prefix."""
+    m = dict(record["measured"])
+    for k, v in record.get("final_measured", {}).items():
+        if k in m:
+            m[k] = max(m[k], v)
+
+    def pad(x: int) -> int:
+        return int(math.ceil(x * headroom)) + SLACK
+
+    event_capacity = max(2, pad(m["heap_rows_max"]))
+    exchange_in = max(1, pad(m["arrivals_per_flush_max"]))
+    # too few iterations per phase costs one collective exchange per
+    # few events; too many only pads the (compactable) outbox
+    iters = max(floor_iters, pad(m["pop_trips_max"]))
+    outbox_capacity = iters * max(1, per_iter)
+    # compaction wins only when the busiest host's real fan-out is
+    # well under the outbox width (the lane sort must buy sort rows)
+    cx = pad(m["outbox_rows_max"])
+    outbox_compact = cx if cx < (3 * outbox_capacity) // 4 else 0
+    # per shard-pair exchange rows: only meaningful multi-shard; 0
+    # keeps the engine's own auto-sizing when nothing was measured
+    if n_shards > 1 and m["exchange_rows_max"] > 0:
+        exchange_capacity = max(8, pad(m["exchange_rows_max"]))
+    else:
+        exchange_capacity = 0
+    return {
+        "event_capacity": event_capacity,
+        "outbox_capacity": outbox_capacity,
+        "exchange_capacity": exchange_capacity,
+        "exchange_in_capacity": exchange_in,
+        "outbox_compact": outbox_compact,
+    }
+
+
+def widen(knobs: dict, dims: tuple, effective: dict) -> dict:
+    """Double the offending capacity dimension(s) after a loud
+    overflow. `knobs` are the current EngineConfig overrides (may hold
+    zeros meaning auto); `effective` supplies the auto-sized values so
+    doubling always starts from what actually ran."""
+    out = dict(knobs)
+    for dim in dims:
+        if dim == "event_capacity":
+            out[dim] = 2 * max(out.get(dim) or 0, effective["E"])
+        elif dim == "exchange_in_capacity":
+            out[dim] = 2 * max(out.get(dim) or 0, effective["IN"])
+        elif dim == "exchange_capacity":
+            if effective["CAP"] > 0:
+                out[dim] = 2 * max(out.get(dim) or 0, effective["CAP"])
+        elif dim == "outbox_compact":
+            # a compaction width that lost rows first doubles, then
+            # turns off once it stops paying for itself
+            cx, ob = effective["CX"], effective["OB"]
+            if cx < ob:
+                ncx = 2 * cx
+                out[dim] = ncx if ncx < ob else 0
+    return out
+
+
+def overflow_dims(state) -> tuple:
+    """Which capacity dimensions the state's loud counters implicate
+    (empty tuple = clean). Costs two tiny device_gets."""
+    from shadow_tpu._jax import jax
+
+    dims = ()
+    for counter, d in OVERFLOW_DIMS.items():
+        if int(np.asarray(jax.device_get(state[counter])).sum()):
+            dims += d
+    return dims
+
+
+def grow_heaps(host_state: dict, new_e: int) -> dict:
+    """Pad the five [H, E] heap arrays of a host-side state snapshot
+    to a larger event_capacity (rows are sorted; empty slots sort
+    last, so tail padding preserves the heap invariant)."""
+    INF = np.int64(1) << np.int64(62)
+    IMAX = np.int64(np.iinfo(np.int64).max)
+    out = dict(host_state)
+    h, e = host_state["ht"].shape
+    if new_e < e:
+        raise ValueError(f"cannot shrink event_capacity {e} -> {new_e} "
+                         "on a live state")
+    if new_e == e:
+        return out
+    fills = {"ht": INF, "hk": IMAX, "hm": 0, "hv": 0, "hw": 0}
+    for k, fill in fills.items():
+        pad = np.full((h, new_e - e), fill, dtype=np.int64)
+        out[k] = np.concatenate([np.asarray(host_state[k]), pad], 1)
+    return out
+
+
+def transfer(engine, starts, host_state: dict) -> dict:
+    """Place a host-side state snapshot onto a (re-planned) engine:
+    pads the heaps to the engine's event_capacity and device_puts
+    every leaf with the sharding of a freshly built template state."""
+    from shadow_tpu._jax import jax
+
+    host_state = grow_heaps(host_state, engine.config.event_capacity)
+    template = engine.init_state(starts)
+    if set(template) != set(host_state):
+        raise ValueError(
+            "state keys changed across re-plan: "
+            f"{sorted(set(template) ^ set(host_state))}")
+    out = {}
+    for k, tmpl in template.items():
+        arr = np.asarray(host_state[k])
+        if arr.shape != tmpl.shape or arr.dtype != np.dtype(tmpl.dtype):
+            raise ValueError(
+                f"state leaf {k} is {arr.shape}/{arr.dtype}, the "
+                f"re-planned engine expects {tmpl.shape}/{tmpl.dtype}")
+        out[k] = jax.device_put(arr, tmpl.sharding)
+    return out
+
+
+def record_path(engine, directory: str = "") -> str:
+    """Canonical OCC record path for a workload: app class + host
+    count + workload fingerprint (deterministic, so tune_10k.py and
+    repeat runs find it; the fingerprint keeps two traffic-shape
+    variants of the same app from clobbering each other's record).
+    SHADOW_TPU_OCC_DIR overrides the directory (tests point it at a
+    tmpdir so runs never litter the repo's artifacts/)."""
+    directory = directory or os.environ.get("SHADOW_TPU_OCC_DIR",
+                                            "artifacts")
+    return os.path.join(
+        directory,
+        f"OCC_{type(engine.app).__name__}_{engine.config.n_hosts}"
+        f"_{app_fingerprint(engine.app)}.json")
+
+
+def save_record(record: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def load_record(path: str) -> dict:
+    with open(path) as f:
+        record = json.load(f)
+    if record.get("format") != FORMAT:
+        raise ValueError(
+            f"occupancy record {path}: format {record.get('format')} "
+            f"(this build reads format {FORMAT})")
+    for key in ("measured", "workload"):
+        if key not in record:
+            raise ValueError(f"occupancy record {path}: missing {key!r}")
+    return record

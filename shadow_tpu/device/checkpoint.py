@@ -18,11 +18,17 @@ the same mechanism heartbeat/dispatch segmentation already relies on
 stop_time` on both sides of a checkpoint.
 
 Format: one .npz with a JSON `__meta__` entry (format version, pause
-sim-time, engine fingerprint, key-path list) and one array entry per
-pytree leaf. The fingerprint pins everything that determines state
-layout and trace determinism: host count, padded width, capacities,
-seed, the app class and its scalar parameters, and a hash of the
-topology arrays (attachment, latency, reliability).
+sim-time, the run's global stop (`final_stop`), engine fingerprint,
+key-path list) and one array entry per pytree leaf. The fingerprint
+pins everything that determines state layout and trace determinism:
+host count, padded width, capacities, seed, the app class and its
+scalar parameters, and a hash of the topology arrays (attachment,
+latency, reliability). `final_stop` is checked separately from the
+fingerprint: the saved prefix's windows were clamped on it, so
+resuming toward a different stop would not bit-match an
+uninterrupted run at that stop — the load rejects the mismatch.
+The capacity planner's re-plan-and-resume path relies on this stamp
+to re-run a segment against the same global stop.
 """
 
 from __future__ import annotations
@@ -51,15 +57,13 @@ def _fingerprint(engine) -> dict:
         a = np.ascontiguousarray(np.asarray(arr))
         h.update(str(a.shape).encode())
         h.update(a.tobytes())
-    app_params = {k: v for k, v in sorted(vars(engine.app).items())
-                  if isinstance(v, (bool, int, float, str))}
-    # burst_pops is a trace-invariant lane-width knob (pinned by
-    # test_burst_width_identical_traces) that the runner writes onto
-    # the app when experimental.burst_pops overrides it — retuning
-    # width across a save/resume pair is exactly its use case, so it
-    # must not poison the fingerprint
-    app_params.pop("burst_pops", None)
-    h.update(json.dumps(app_params, sort_keys=True).encode())
+    # scalar surface shared with the occupancy-record fingerprint
+    # (capacity.app_scalars): burst_pops stays out there too —
+    # retuning width across a save/resume pair is exactly its use
+    # case (pinned by test_resume_at_different_burst_width)
+    from shadow_tpu.device.capacity import app_scalars
+    h.update(json.dumps(app_scalars(engine.app),
+                        sort_keys=True).encode())
     return {
         "n_hosts": int(cfg.n_hosts),
         "h_pad": int(engine.H_pad),
@@ -78,9 +82,12 @@ def _flatten(state):
     return [(keystr(kp), leaf) for kp, leaf in leaves], treedef
 
 
-def save_state(engine, state, path: str, sim_time: int) -> None:
+def save_state(engine, state, path: str, sim_time: int,
+               final_stop: int = 0) -> None:
     """Write `state` (a live, possibly sharded device pytree) plus
-    the pause `sim_time` and the engine fingerprint to `path`."""
+    the pause `sim_time`, the run's global stop (`final_stop` — the
+    window-clamping bound the saved prefix was computed against), and
+    the engine fingerprint to `path`."""
     from shadow_tpu._jax import jax
 
     host_state = jax.device_get(state)
@@ -88,7 +95,19 @@ def save_state(engine, state, path: str, sim_time: int) -> None:
     meta = {
         "format": FORMAT,
         "sim_time": int(sim_time),
+        "final_stop": int(final_stop),
         "fingerprint": _fingerprint(engine),
+        # ALL capacity knobs of the saving engine, not just the
+        # layout-determining two in the fingerprint: a resume under
+        # capacity_plan adopts these, so a plan/widen that grew
+        # exchange_in/exchange/outbox_compact is not silently
+        # reverted to the config statics (which would just replay
+        # the overflow + re-plan cycle past the resume point)
+        "capacities": {
+            k: int(getattr(engine.config, k))
+            for k in ("event_capacity", "outbox_capacity",
+                      "exchange_capacity", "exchange_in_capacity",
+                      "outbox_compact")},
         "keys": [k for k, _ in named],
     }
     arrays = {f"leaf_{i}": np.asarray(v)
@@ -97,11 +116,34 @@ def save_state(engine, state, path: str, sim_time: int) -> None:
         np.savez_compressed(f, __meta__=json.dumps(meta), **arrays)
 
 
-def load_state(engine, starts, path: str):
+def peek_meta(path: str) -> dict:
+    """Read ONLY the npz meta (no array payloads): the runner uses
+    it to rebuild a capacity-planned engine with the SAVED capacities
+    before loading, so a checkpoint written under capacity_plan: auto
+    stays loadable even though the planner's sizes differ from the
+    config's static knobs — and to pre-validate resume parameters in
+    milliseconds, before the planner spends minutes compiling."""
+    with np.load(path, allow_pickle=False) as z:
+        return json.loads(str(z["__meta__"]))
+
+
+def peek_fingerprint(path: str) -> dict:
+    return peek_meta(path)["fingerprint"]
+
+
+def load_state(engine, starts, path: str, final_stop: int = 0):
     """Load a checkpoint into a fresh engine: builds a template state
     via `init_state(starts)` (for tree structure + shardings),
-    validates the fingerprint and every leaf's shape/dtype, and
-    device_puts each saved leaf with the template leaf's sharding.
+    validates the fingerprint, the run's global stop, and every
+    leaf's shape/dtype, and device_puts each saved leaf with the
+    template leaf's sharding.
+
+    `final_stop` is this run's global stop; a checkpoint saved
+    against a different one is rejected (the saved prefix's windows
+    were clamped on the stop it was computed for, so the resumed
+    trace would not bit-match an uninterrupted run). Pass 0 to skip
+    the check (records saved before the stamp existed load as
+    before).
 
     Returns (state, sim_time)."""
     from shadow_tpu._jax import jax
@@ -115,6 +157,16 @@ def load_state(engine, starts, path: str):
         saved = {k: z[f"leaf_{i}"]
                  for i, k in enumerate(meta["keys"])}
 
+    saved_stop = int(meta.get("final_stop", 0))
+    if final_stop and saved_stop and saved_stop != final_stop:
+        raise ValueError(
+            f"checkpoint {path} was saved for a run with stop_time "
+            f"{saved_stop} ns; this run stops at {final_stop} ns — "
+            "the saved prefix's event windows were clamped on the "
+            "original stop, so resuming toward a different one would "
+            "not bit-match an uninterrupted run (re-run from scratch "
+            "or restore the original stop_time)")
+
     fp, want = meta["fingerprint"], _fingerprint(engine)
     if fp != want:
         diffs = {k: (fp.get(k), want[k]) for k in want
@@ -125,12 +177,24 @@ def load_state(engine, starts, path: str):
 
     template = engine.init_state(starts)
     named, treedef = _flatten(template)
-    if [k for k, _ in named] != meta["keys"]:
+    want_keys = [k for k, _ in named]
+    saved_keys = meta["keys"]
+    # the occ_* telemetry leaves postdate FORMAT 1 checkpoints: a
+    # record saved without them still loads, with the template's
+    # zeroed counters (high-water marks then cover the resumed
+    # segment only — the trace itself is unaffected)
+    missing = [k for k in want_keys if k not in saved_keys]
+    telemetry_only = missing and all("'occ_" in k for k in missing) \
+        and saved_keys == [k for k in want_keys if k not in missing]
+    if want_keys != saved_keys and not telemetry_only:
         raise ValueError(
             f"checkpoint {path}: state layout changed "
             f"(saved keys != this engine's state keys)")
     leaves = []
     for key, tmpl in named:
+        if key not in saved:
+            leaves.append(tmpl)
+            continue
         arr = saved[key]
         if arr.shape != tmpl.shape or arr.dtype != np.dtype(tmpl.dtype):
             raise ValueError(

@@ -41,7 +41,7 @@ from typing import Optional
 
 import numpy as np
 
-from shadow_tpu._jax import jax, jnp
+from shadow_tpu._jax import jax, jnp, shard_map
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -64,6 +64,9 @@ from shadow_tpu.utils.checksum import (
     CHK_SRC,
     MASK63,
 )
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("device")
 
 INF = np.int64(1) << np.int64(62)
 # reserved outbox time marker: a drop-rolled send carried only for the
@@ -299,6 +302,22 @@ class DeviceEngine:
             "overflow": zeros_i32.copy(),
             "x_overflow": zeros_i32.copy(),
             "chk": np.zeros(H, dtype=np.int64),
+            # occupancy telemetry (device/capacity.py consumes these):
+            # per-segment high-water marks accumulated with reductions
+            # only — never sorts — so they ride every run for free.
+            #   occ_heap  [H]  max live heap rows per host (post-merge)
+            #   occ_ob    [H]  max exchangeable outbox rows per phase
+            #   occ_in    [H]  max arrivals accepted per flush
+            "occ_heap": zeros_i32.copy(),
+            "occ_ob": zeros_i32.copy(),
+            "occ_in": zeros_i32.copy(),
+            #   occ_x     [S,S] max rows per (src shard, dst shard)
+            #   occ_trips [S]  max pop-loop iterations per phase
+            #   occ_phases[S]  total flushes executed
+            "occ_x": np.zeros((self.n_shards, self.n_shards),
+                              dtype=np.int32),
+            "occ_trips": np.zeros(self.n_shards, dtype=np.int32),
+            "occ_phases": np.zeros(self.n_shards, dtype=np.int32),
         }
         if self.config.count_paths:
             V = self.n_vertices
@@ -907,6 +926,12 @@ class DeviceEngine:
         # sort + 5-operand merge dominating round cost (~85%).
         CX = min(cfg.outbox_compact or OB, OB)
 
+        # effective (post-auto-sizing) capacities, for the occupancy
+        # record and the planner's re-plan arithmetic
+        self.effective = {"E": E, "B": B, "OB": OB, "IN": IN,
+                          "CAP": int(CAP), "CX": CX, "M_out": M_out,
+                          "n_shards": n_shards}
+
         def _flat_sorted(state, ob, gid):
             slot = jnp.arange(OB, dtype=jnp.int64)[None, :]
             okey2 = gid.astype(jnp.int64)[:, None] * OB + slot
@@ -992,7 +1017,8 @@ class DeviceEngine:
         def _host_windows(state, skey, perm, rows, my_shard):
             """Per-host contiguous arrival segments -> [H_loc, IN]
             windows + overflow accounting (shared by the self-shard
-            bypass and the post-exchange arrival step)."""
+            bypass and the post-exchange arrival step). Also returns
+            the per-host arrival counts (occupancy telemetry)."""
             base = my_shard.astype(jnp.int64) * H_loc
             hb = (base + jnp.arange(H_loc + 1, dtype=jnp.int64)) \
                 * SPAN
@@ -1000,7 +1026,8 @@ class DeviceEngine:
             starts, counts = edges[:-1], edges[1:] - edges[:-1]
             state["overflow"] = state["overflow"] + \
                 jnp.maximum(0, counts - IN).astype(jnp.int32)
-            return state, _seg_take(perm, rows, starts, counts, IN)
+            return state, _seg_take(perm, rows, starts, counts, IN), \
+                counts.astype(jnp.int32)
 
         def _judge_outbox(state, ob, gid, host_vertex, lat, rel,
                           win_end):
@@ -1180,6 +1207,14 @@ class DeviceEngine:
 
             shk, sk_, sm_, sv_, sw_ = lax.sort(
                 (ghk, gk, gm, gv, gw), num_keys=2)
+            # occupancy: arrivals per host this flush — each host's
+            # sorted segment holds exactly E heap rows plus arrivals
+            # (masked heap slots encode t=T_CAP, staying in-segment)
+            hb2 = jnp.arange(H_loc + 1, dtype=jnp.int64) << T_BITS
+            seg_n = jnp.searchsorted(shk, hb2)
+            state["occ_in"] = jnp.maximum(
+                state["occ_in"],
+                (seg_n[1:] - seg_n[:-1] - E).astype(jnp.int32))
             sh = (shk >> T_BITS).astype(jnp.int64)
             idx = jnp.arange(N, dtype=jnp.int64)
             is_new = jnp.concatenate(
@@ -1222,6 +1257,9 @@ class DeviceEngine:
             state["overflow"] = state["overflow"] + ov + \
                 jnp.zeros(H_loc, jnp.int32).at[0].add(poison)
             state["head"] = jnp.zeros_like(state["head"])
+            state["occ_heap"] = jnp.maximum(
+                state["occ_heap"],
+                (state["ht"] < INF).sum(-1).astype(jnp.int32))
             return state
 
         def _pack_remote(state, skey, perm, rows, my_shard,
@@ -1243,6 +1281,10 @@ class DeviceEngine:
             counts = nxt - starts
             remote = jnp.arange(n_shards) != my_shard
             counts = jnp.where(remote, counts, 0)
+            # occupancy: rows this shard ships to each dst shard —
+            # what exchange_capacity (CAP) must hold per pair
+            state["occ_x"] = jnp.maximum(
+                state["occ_x"], counts.astype(jnp.int32)[None, :])
             idx = jnp.arange(G, dtype=jnp.int64)
             shard_of = skey // (H_loc * SPAN)
             is_new = jnp.concatenate(
@@ -1346,12 +1388,19 @@ class DeviceEngine:
                                           lat, rel, win_end)
             if CP:
                 state = _count_paths(state, ob, host_vertex)
+            # occupancy: exchangeable outbox rows per host this phase
+            # (post-judge, the population outbox_compact must hold)
+            state["occ_ob"] = jnp.maximum(
+                state["occ_ob"],
+                (ob["t"] < DROP_T).sum(-1).astype(jnp.int32))
+            state["occ_phases"] = state["occ_phases"] + jnp.int32(1)
             if MERGE_GLOBAL:
                 return _exchange_global(state, ob, gid, my_shard)
             state, skey, perm, rows = _flat_sorted(state, ob, gid)
             G = H_loc * CX
 
             inc2 = None
+            arr2 = jnp.zeros(H_loc, jnp.int32)
             if n_shards > 1 and cfg.exchange == "all_to_all":
                 # SELF-SHARD rows (timers, model-NIC READY reinserts,
                 # local sends — often half the outbox) never need to
@@ -1360,8 +1409,8 @@ class DeviceEngine:
                 # incoming block below. Only genuinely remote rows
                 # pack into [n_shards, CAP] for the all_to_all.
                 # my own range: straight per-host windows (IN each)
-                state, inc2 = _host_windows(state, skey, perm, rows,
-                                            my_shard)
+                state, inc2, arr2 = _host_windows(state, skey, perm,
+                                                  rows, my_shard)
 
                 state, moved, kmoved = _pack_remote(
                     state, skey, perm, rows, my_shard,
@@ -1386,8 +1435,13 @@ class DeviceEngine:
                 G = n_shards * G
 
             # my hosts' contiguous arrival segments -> [H_loc, IN]
-            state, inc = _host_windows(state, skey, perm, rows,
-                                       my_shard)
+            state, inc, arr = _host_windows(state, skey, perm, rows,
+                                            my_shard)
+            # occupancy: the self-shard bypass and the post-exchange
+            # arrivals are windowed to IN separately, so the
+            # capacity-relevant mark is the per-block max, not the sum
+            state["occ_in"] = jnp.maximum(state["occ_in"],
+                                          jnp.maximum(arr, arr2))
 
             # merge: one lexicographic row sort of [live heap | inc
             # (| self-shard inc)] by (time, src<<32|seq) — keys +
@@ -1429,6 +1483,11 @@ class DeviceEngine:
             state["hv"] = jnp.take_along_axis(cv, sie, axis=1)
             state["hw"] = jnp.take_along_axis(cw, sie, axis=1)
             state["head"] = jnp.zeros_like(state["head"])
+            # occupancy: live heap rows after the merge — the rows
+            # event_capacity must hold
+            state["occ_heap"] = jnp.maximum(
+                state["occ_heap"],
+                (state["ht"] < INF).sum(-1).astype(jnp.int32))
             return state
 
         # ---------------- one round (window) ---------------------------
@@ -1456,7 +1515,9 @@ class DeviceEngine:
                     lambda c: _step(c, win_end, gid, host_vertex,
                                     lat, rel),
                     (state, ob, jnp.int32(0), dirty))
-                state2, ob, _, _ = carry
+                state2, ob, blk, _ = carry
+                state2["occ_trips"] = jnp.maximum(
+                    state2["occ_trips"], jnp.reshape(blk, (1,)))
                 # skip the whole exchange when nothing was sent and no
                 # slots were consumed (idle windows). The predicate is
                 # COLLECTIVE: the flush contains all_to_all, so every
@@ -1554,6 +1615,8 @@ class DeviceEngine:
                 lambda c: _step(c, win_end, gid, host_vertex, lat,
                                 rel),
                 (state, ob, jnp.int32(0), dirty))
+            state["occ_trips"] = jnp.maximum(
+                state["occ_trips"], jnp.reshape(blk, (1,)))
             return state, ob, jnp.reshape(blk, (1,))
 
         def _flush_shard(state, ob, host_vertex, lat, rel, win_end):
@@ -1565,31 +1628,33 @@ class DeviceEngine:
         spec_keys = ("ht", "hk", "hm", "hv", "hw", "head",
                      "event_seq", "packet_seq", "app_seq", "app",
                      "n_exec", "n_sent", "n_drop", "n_deliv",
-                     "overflow", "x_overflow", "chk") + \
+                     "overflow", "x_overflow", "chk",
+                     "occ_heap", "occ_ob", "occ_in", "occ_x",
+                     "occ_trips", "occ_phases") + \
             (NIC_KEYS if MB else ()) + \
             (("path_cnt",) if CP else ())
         specs = {k: self._shard_spec for k in spec_keys}
         ob_specs = {f: self._shard_spec for f in XF}
         repl = self._repl_spec
-        self._run = jax.jit(jax.shard_map(
+        self._run = jax.jit(shard_map(
             _run_shard, mesh=self.mesh,
             in_specs=(specs, repl, repl, repl, repl, repl),
             out_specs=(specs, repl),
             check_vma=False,
         ))
-        self._round_step = jax.jit(jax.shard_map(
+        self._round_step = jax.jit(shard_map(
             _one_round, mesh=self.mesh,
             in_specs=(specs, repl, repl, repl, repl),
             out_specs=(specs, repl),
             check_vma=False,
         ))
-        self._pop_phase = jax.jit(jax.shard_map(
+        self._pop_phase = jax.jit(shard_map(
             _pop_shard, mesh=self.mesh,
             in_specs=(specs, ob_specs, repl, repl, repl, repl),
             out_specs=(specs, ob_specs, self._shard_spec),
             check_vma=False,
         ))
-        self._flush_phase = jax.jit(jax.shard_map(
+        self._flush_phase = jax.jit(shard_map(
             _flush_shard, mesh=self.mesh,
             in_specs=(specs, ob_specs, repl, repl, repl, repl),
             out_specs=specs,

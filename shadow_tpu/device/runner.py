@@ -188,53 +188,184 @@ class DeviceRunner:
                     "contract); this app pops one event per "
                     "iteration")
             self.app.burst_pops = bp
-        burst = max(1, getattr(self.app, "burst_pops", 1))
-        per_iter = self.app.max_sends * burst + self.app.max_timers
+        self._burst = max(1, getattr(self.app, "burst_pops", 1))
+        self._mesh = mesh
+        # capacity overrides on top of the config's static knobs:
+        # filled by the occupancy planner (capacity_plan: auto|path)
+        # and widened by the overflow re-plan/retry loop
+        self._capacity_overrides: dict = {}
+        self.engine = self._build_engine()
+        self.final_state: Optional[dict] = None
+        self.occ_record: Optional[dict] = None
+        self.replans = 0
+        # set once _plan_capacities has sized the engine: run() skips
+        # re-planning, so a caller may plan ahead of its timed window
+        # (bench.py) and a re-used runner keeps its plan
+        self._planned = False
+
+    def _build_engine(self) -> DeviceEngine:
+        """Construct the engine from the config's static knobs plus
+        any planner/retry capacity overrides (re-invoked by the
+        re-plan loop; a capacity change recompiles the program)."""
+        sim = self.sim
+        cfg = sim.cfg
+        xp = cfg.experimental
+        per_iter = self.app.max_sends * self._burst + \
+            self.app.max_timers
         # floor the outbox at 8 iterations per phase — 4 when bursts
         # drain backlogs P events at a time
-        outbox = max(cfg.experimental.outbox_capacity,
-                     (4 if burst > 1 else 8) * per_iter)
-        if outbox != cfg.experimental.outbox_capacity:
+        outbox = max(xp.outbox_capacity,
+                     (4 if self._burst > 1 else 8) * per_iter)
+        if outbox != xp.outbox_capacity and \
+                "outbox_capacity" not in self._capacity_overrides:
             log.info("outbox_capacity raised %d -> %d (8 iterations "
                      "of %d lanes)",
-                     cfg.experimental.outbox_capacity, outbox,
-                     per_iter)
-        self.engine = DeviceEngine(
+                     xp.outbox_capacity, outbox, per_iter)
+        knobs = {
+            "event_capacity": xp.event_capacity,
+            "outbox_capacity": outbox,
+            "exchange_capacity": xp.exchange_capacity,
+            "exchange_in_capacity": xp.exchange_in_capacity,
+            "outbox_compact": xp.outbox_compact,
+        }
+        knobs.update(self._capacity_overrides)
+        return DeviceEngine(
             EngineConfig(
                 n_hosts=len(sim.hosts),
-                event_capacity=cfg.experimental.event_capacity,
-                outbox_capacity=outbox,
                 lookahead=max(1, sim.lookahead),
                 stop_time=cfg.general.stop_time,
                 bootstrap_end=cfg.general.bootstrap_end_time,
                 seed=cfg.general.seed,
-                exchange=cfg.experimental.exchange,
-                exchange_capacity=cfg.experimental.exchange_capacity,
-                exchange_in_capacity=cfg.experimental
-                .exchange_in_capacity,
-                outbox_compact=cfg.experimental.outbox_compact,
-                model_bandwidth=cfg.experimental.model_bandwidth,
-                count_paths=cfg.experimental.count_paths,
-                judge_hoist=_tristate(
-                    cfg.experimental.judge_placement, "flush"),
-                merge_global=_tristate(
-                    cfg.experimental.merge_strategy, "global"),
-                pop_onehot=_tristate(
-                    cfg.experimental.pop_strategy, "onehot"),
-                table_onehot=_tristate(
-                    cfg.experimental.table_strategy, "onehot"),
+                exchange=xp.exchange,
+                model_bandwidth=xp.model_bandwidth,
+                count_paths=xp.count_paths,
+                judge_hoist=_tristate(xp.judge_placement, "flush"),
+                merge_global=_tristate(xp.merge_strategy, "global"),
+                pop_onehot=_tristate(xp.pop_strategy, "onehot"),
+                table_onehot=_tristate(xp.table_strategy, "onehot"),
+                **knobs,
             ),
             self.app,
             host_vertex=sim.netmodel.host_vertex.astype(np.int32),
             latency_ns=sim.topology.latency_ns,
             reliability=sim.topology.reliability,
-            mesh=mesh,
+            mesh=self._mesh,
             bw_up_bits=np.array([h.bw_up_bits for h in sim.hosts],
                                 dtype=np.int64),
             bw_down_bits=np.array([h.bw_down_bits for h in sim.hosts],
                                   dtype=np.int64),
         )
-        self.final_state: Optional[dict] = None
+
+    def _plan_capacities(self, stop: int) -> None:
+        """capacity_plan: auto|<path> — size the engine's capacities
+        from measured occupancy instead of the hand-tuned knobs.
+        `auto` runs a short warm-up slice on the statically-sized
+        engine (window clamping on the global stop, so the windows
+        match the real run's prefix); a path consumes a previously
+        written OCC record. Either way the planned engine's traces
+        bit-match the static engine's whenever nothing overflows, and
+        the overflow retry loop (see _advance) covers the undershoot
+        case loudly."""
+        from shadow_tpu.device import capacity
+
+        xp = self.sim.cfg.experimental
+        mode = xp.capacity_plan
+        if xp.checkpoint_load:
+            # the checkpoint fingerprint pins the saved engine's
+            # capacities — a checkpoint written under a plan carries
+            # the PLANNER's sizes, not the config's static knobs, so
+            # re-planning (or building the static engine) would only
+            # produce a loud fingerprint mismatch. Adopt the saved
+            # capacities instead; an overflow past the resume point
+            # still re-plans through the normal retry loop.
+            from shadow_tpu.device import checkpoint
+            meta = checkpoint.peek_meta(xp.checkpoint_load)
+            caps = meta.get("capacities")
+            if caps is None:
+                # pre-"capacities" checkpoints: only the two
+                # layout-determining knobs ride the fingerprint
+                caps = {k: meta["fingerprint"][k]
+                        for k in ("event_capacity", "outbox_capacity")}
+            self._capacity_overrides = {
+                k: int(v) for k, v in caps.items()}
+            self.engine = self._build_engine()
+            self._planned = True
+            log.warning("capacity_plan: %s skipped — checkpoint_load "
+                        "resumes with the saved engine's capacities "
+                        "%s", mode, self._capacity_overrides)
+            return
+        # the record's audit baseline: what the config's static knobs
+        # build, captured BEFORE any warm-up widen-retry rebuilds the
+        # engine (else an overflowed warm-up reports the doubled
+        # values as "static")
+        static_knobs = {
+            k: getattr(self.engine.config, k)
+            for k in ("event_capacity", "outbox_capacity",
+                      "exchange_capacity", "exchange_in_capacity",
+                      "outbox_compact")}
+        if mode == "auto":
+            warm = xp.capacity_warmup or max(1, stop // 8)
+            warm = min(warm, stop)
+            # honor dispatch_segment here too: the warm-up is a real
+            # device dispatch, and the segment bound exists because
+            # tunneled-TPU relays kill executions that run too long —
+            # an un-segmented warm-up would break on exactly the
+            # platform the planner targets. Overflow is checked at
+            # each boundary, so a bad static sizing re-plans without
+            # finishing the slice first.
+            seg = xp.dispatch_segment
+            state = self.engine.init_state(self.sim.starts)
+            for attempt in range(capacity.MAX_REPLANS + 1):
+                t = 0
+                dims = ()
+                while t < warm:
+                    nxt = min(warm, t + seg) if seg else warm
+                    state, _ = self.engine.run(state, stop=nxt,
+                                               final_stop=stop)
+                    t = nxt
+                    dims = capacity.overflow_dims(state)
+                    if dims:
+                        break
+                if not dims:
+                    break
+                if attempt == capacity.MAX_REPLANS:
+                    raise RuntimeError(
+                        f"capacity warm-up still overflows after "
+                        f"{capacity.MAX_REPLANS} doublings on {dims}")
+                self._capacity_overrides = capacity.widen(
+                    self._capacity_overrides, dims,
+                    self.engine.effective)
+                log.warning("capacity warm-up overflowed on %s; "
+                            "retrying with %s", dims,
+                            self._capacity_overrides)
+                self.engine = self._build_engine()
+                state = self.engine.init_state(self.sim.starts)
+            record = capacity.measure(self.engine, state,
+                                      source=f"warmup:{warm}ns")
+        else:
+            record = capacity.load_record(mode)
+            want = {"app": type(self.app).__name__,
+                    "app_fp": capacity.app_fingerprint(self.app),
+                    "n_hosts": len(self.sim.hosts)}
+            got = {k: record["workload"].get(k) for k in want}
+            if got != want:
+                raise ValueError(
+                    f"occupancy record {mode} was measured on {got}; "
+                    f"this simulation is {want} — re-measure with "
+                    "capacity_plan: auto")
+        planned = capacity.plan(
+            record,
+            per_iter=self.engine.effective["M_out"],
+            floor_iters=4 if self._burst > 1 else 8,
+            n_shards=self.engine.n_shards)
+        record["planned"] = planned
+        record["static"] = static_knobs
+        self.occ_record = record
+        self._capacity_overrides = dict(planned)
+        self.engine = self._build_engine()
+        self._planned = True
+        log.info("capacity plan (%s): %s  [measured %s]", mode,
+                 planned, record["measured"])
 
     def _emit_heartbeats(self, now: int, state) -> None:
         """Per-host [shadow-heartbeat] CSV lines from device counters
@@ -260,14 +391,141 @@ class DeviceRunner:
             h.packets_dropped = int(n_drop[i])
             h.tracker.heartbeat(now, h)
 
+    def _advance(self, state, t_start: int, pause: int, stop: int):
+        """Advance [t_start, pause) in segments (heartbeat and/or
+        dispatch-segment boundaries; a single segment when neither is
+        configured), checking the loud overflow counters at each
+        boundary. Under a capacity plan (capacity_plan != static) an
+        overflow re-plans with doubled headroom on the offending
+        dimension and re-runs from the last known-good state instead
+        of failing the run; static runs keep the old loud-failure
+        contract. Returns (state, rounds, t_end, budget_hit,
+        overflowed)."""
+        from shadow_tpu.device import capacity
+
+        xp = self.sim.cfg.experimental
+        hb = self.sim.cfg.general.heartbeat_interval
+        seg = xp.dispatch_segment
+        retry_ok = xp.capacity_plan != "static"
+        budget = self.engine.config.max_rounds
+        # last known-good snapshot: device refs are immutable, so
+        # holding the pytree costs nothing to take — but it pins the
+        # previous segment's buffers (a second full state, tens of MB
+        # at the 10k rung), so static runs, which can never retry,
+        # don't keep one
+        good_state, good_t = (state if retry_ok else None), t_start
+        rounds = 0
+        budget_hit = False
+        overflowed = False
+        t = t_start
+        next_hb = (t // hb + 1) * hb if hb else None
+        while t < pause:
+            nxt = pause
+            if next_hb is not None:
+                nxt = min(nxt, next_hb)
+            if seg:
+                nxt = min(nxt, t + seg)
+            state, seg_rounds = self.engine.run(state, stop=nxt,
+                                                final_stop=stop)
+            dims = capacity.overflow_dims(state)
+            if dims:
+                if not retry_ok or \
+                        self.replans >= capacity.MAX_REPLANS:
+                    rounds += int(seg_rounds)
+                    t = nxt
+                    overflowed = True
+                    break           # loud failure (stats.ok = False)
+                self.replans += 1
+                self._capacity_overrides = capacity.widen(
+                    self._capacity_overrides, dims,
+                    self.engine.effective)
+                log.warning(
+                    "capacity overflow on %s in (%d, %d] ns; "
+                    "re-plan #%d with %s, re-running from t=%d ns",
+                    dims, good_t, nxt, self.replans,
+                    self._capacity_overrides, good_t)
+                self.engine = self._build_engine()
+                state = capacity.transfer(
+                    self.engine, self.sim.starts,
+                    jax.device_get(good_state))
+                good_state = state
+                t = good_t
+                next_hb = (t // hb + 1) * hb if hb else None
+                continue
+            rounds += int(seg_rounds)
+            t = nxt
+            if rounds >= budget:
+                if t < pause:
+                    # enforced cumulatively (per-invocation caps would
+                    # reset each segment); don't emit a heartbeat for
+                    # an interval the budget cut short
+                    log.warning("max_rounds (%d) exhausted during "
+                                "segmentation; stopping", budget)
+                budget_hit = True
+                break
+            if next_hb is not None and t >= next_hb and t < stop:
+                self._emit_heartbeats(t, state)
+                next_hb += hb
+            if retry_ok:
+                good_state, good_t = state, t
+        return state, rounds, t, budget_hit, overflowed
+
     def run(self, stop: int) -> SimStats:
         import time as _time
 
+        from shadow_tpu.device import capacity
+
         xp = self.sim.cfg.experimental
+        self.replans = 0
+        if xp.capacity_plan == "static":
+            # a re-used runner must not merge this run's measurements
+            # into a stale record from an earlier run (the merge
+            # branch below is the with-a-plan-active path, and it
+            # WRITES artifacts/OCC_*.json)
+            self.occ_record = None
+        if xp.checkpoint_save:
+            # fail on an unwritable path NOW, in milliseconds — before
+            # the capacity warm-up spends minutes compiling, and not
+            # after a multi-hour run when the state would be lost.
+            # The probe must not leave a zero-byte decoy behind if
+            # the run later dies before saving
+            import os as _os
+            existed = _os.path.lexists(xp.checkpoint_save)
+            try:
+                with open(xp.checkpoint_save, "ab"):
+                    pass
+            except OSError as e:
+                raise ValueError(
+                    f"checkpoint_save path {xp.checkpoint_save!r} "
+                    f"is not writable: {e}") from e
+            if not existed:
+                _os.unlink(xp.checkpoint_save)
+        if xp.checkpoint_load:
+            # pre-validate the resume parameters from the npz meta
+            # alone, for the same reason as the writability probe:
+            # fail in milliseconds, not after the capacity warm-up
+            # spends minutes compiling
+            from shadow_tpu.device import checkpoint
+            t_peek = int(checkpoint.peek_meta(
+                xp.checkpoint_load)["sim_time"])
+            if t_peek >= stop:
+                raise ValueError(
+                    f"checkpoint_load: saved state pauses at "
+                    f"{t_peek} ns, at/after stop_time {stop} ns — "
+                    f"nothing to resume")
+            if xp.checkpoint_save and xp.checkpoint_save_time and \
+                    min(stop, xp.checkpoint_save_time) <= t_peek:
+                raise ValueError(
+                    f"checkpoint_save_time "
+                    f"{min(stop, xp.checkpoint_save_time)} ns is not "
+                    f"after the run's start time {t_peek} ns")
+        if xp.capacity_plan != "static" and not self._planned:
+            self._plan_capacities(stop)
         if xp.checkpoint_load:
             from shadow_tpu.device import checkpoint
             state, t_start = checkpoint.load_state(
-                self.engine, self.sim.starts, xp.checkpoint_load)
+                self.engine, self.sim.starts, xp.checkpoint_load,
+                final_stop=stop)
             if t_start >= stop:
                 raise ValueError(
                     f"checkpoint_load: saved state pauses at "
@@ -290,83 +548,32 @@ class DeviceRunner:
                 raise ValueError(
                     f"checkpoint_save_time {pause} ns is not after "
                     f"the run's start time {t_start} ns")
-            # fail on an unwritable path NOW, in milliseconds — not
-            # after a multi-hour run when the state would be lost.
-            # The probe must not leave a zero-byte decoy behind if
-            # the run later dies before saving
-            import os as _os
-            existed = _os.path.lexists(xp.checkpoint_save)
-            try:
-                with open(xp.checkpoint_save, "ab"):
-                    pass
-            except OSError as e:
-                raise ValueError(
-                    f"checkpoint_save path {xp.checkpoint_save!r} "
-                    f"is not writable: {e}") from e
-            if not existed:
-                _os.unlink(xp.checkpoint_save)
         t0 = _time.perf_counter()
-        hb = self.sim.cfg.general.heartbeat_interval
-        seg = xp.dispatch_segment
-        budget_hit = False
-        t_end = pause
-        if hb or seg:
-            # pause the (single compiled) device program at each
-            # heartbeat boundary and/or dispatch-segment boundary;
-            # window clamping stays on the global stop so the trace
-            # equals an unsegmented run
-            rounds = 0
-            budget = self.engine.config.max_rounds
-            t = t_start
-            next_hb = None
-            if hb:
-                next_hb = (t // hb + 1) * hb
-            while t < pause:
-                nxt = pause
-                if next_hb is not None:
-                    nxt = min(nxt, next_hb)
-                if seg:
-                    nxt = min(nxt, t + seg)
-                state, seg_rounds = self.engine.run(
-                    state, stop=nxt, final_stop=stop)
-                rounds += int(seg_rounds)
-                t = nxt
-                if rounds >= budget:
-                    # the per-invocation cap would otherwise reset per
-                    # segment; enforce it cumulatively and don't emit
-                    # a heartbeat for an interval the budget cut short
-                    log.warning("max_rounds (%d) exhausted during "
-                                "heartbeat segmentation; stopping",
-                                budget)
-                    budget_hit = True
-                    break
-                # a boundary that lands exactly on `pause` still emits
-                # (an uninterrupted run would); only the global end
-                # suppresses — resume restarts past the saved t, so
-                # the pair emits each boundary exactly once
-                if next_hb is not None and t >= next_hb and t < stop:
-                    self._emit_heartbeats(t, state)
-                    next_hb += hb
-            t_end = t
-        else:
-            # pass stop explicitly: a cached/reused engine may have
-            # been built for a different stop_time (runtime scalar)
-            state, rounds = self.engine.run(state, stop=pause,
-                                            final_stop=stop)
-            rounds = int(rounds)
-            budget_hit = rounds >= self.engine.config.max_rounds
+        # segmented advance + the overflow re-plan/retry loop; a
+        # boundary that lands exactly on `pause` still emits its
+        # heartbeat (an uninterrupted run would); only the global end
+        # suppresses — resume restarts past the saved t, so the pair
+        # emits each boundary exactly once
+        state, rounds, t_end, budget_hit, overflowed = self._advance(
+            state, t_start, pause, stop)
         if xp.checkpoint_save:
-            if budget_hit:
-                # the simulation stopped at an unknown sim-time short
-                # of `pause`; stamping `pause` would let a resume skip
-                # unexecuted work, so refuse loudly instead
-                log.error("max_rounds exhausted before the checkpoint "
-                          "boundary — NOT saving %s",
+            if budget_hit or overflowed:
+                # budget: the simulation stopped at an unknown
+                # sim-time short of `pause`, so stamping `pause`
+                # would let a resume skip unexecuted work. overflow:
+                # the state has already dropped events, so a resumed
+                # trace would silently replay the loss. Refuse both
+                # loudly instead of leaving a valid-looking decoy.
+                log.error("%s before the checkpoint boundary — NOT "
+                          "saving %s",
+                          "max_rounds exhausted" if budget_hit
+                          else "capacity overflow (events lost)",
                           xp.checkpoint_save)
             else:
                 from shadow_tpu.device import checkpoint
                 checkpoint.save_state(self.engine, state,
-                                      xp.checkpoint_save, t_end)
+                                      xp.checkpoint_save, t_end,
+                                      final_stop=stop)
                 log.info("checkpoint saved at t=%d ns -> %s (run %s)",
                          t_end, xp.checkpoint_save,
                          "complete" if t_end >= stop else
@@ -398,9 +605,32 @@ class DeviceRunner:
                  wall, rounds / wall if wall > 0 else 0.0,
                  n_exec_total / wall if wall > 0 else 0.0)
 
+        # occupancy record: measured high-water marks from the FULL
+        # run alongside the capacities that held them; with a plan
+        # active, merged into the planner's record and written to
+        # artifacts/OCC_*.json for reuse (capacity_plan: <path>,
+        # scripts/tune_10k.py sweep pruning)
+        occ = capacity.measure(self.engine, state, source="run")
+        if self.occ_record is not None:
+            self.occ_record["final_measured"] = occ["measured"]
+            self.occ_record["effective"] = occ["effective"]
+            self.occ_record["replans"] = self.replans
+            self.occ_record["applied"] = dict(self._capacity_overrides)
+            path = capacity.record_path(self.engine)
+            try:
+                capacity.save_record(self.occ_record, path)
+                log.info("occupancy record -> %s", path)
+            except OSError as e:
+                log.warning("could not write occupancy record %s: %s",
+                            path, e)
+        else:
+            self.occ_record = occ
+
         stats = SimStats()
         stats.end_time = t_end
         stats.rounds = int(rounds)
+        stats.occupancy = self.occ_record
+        stats.replans = self.replans
         stats.events_executed = n_exec_total
         stats.packets_sent = int(final["n_sent"][:H].sum())
         stats.packets_dropped = int(final["n_drop"][:H].sum())
@@ -409,15 +639,17 @@ class DeviceRunner:
         if overflow:
             stats.ok = False
             log.error("device engine overflow: %d events lost — raise "
-                      "experimental.event_capacity/outbox_capacity",
-                      overflow)
+                      "experimental.event_capacity/outbox_capacity, "
+                      "or set capacity_plan: auto to size and retry "
+                      "automatically", overflow)
         x_overflow = int(final["x_overflow"][:H].sum())
         if x_overflow:
             stats.ok = False
             log.error("exchange overflow: %d rows exceeded the per-"
                       "shard-pair capacity — raise experimental."
                       "exchange_capacity (or use exchange: all_gather "
-                      "for hub-concentrated traffic)", x_overflow)
+                      "for hub-concentrated traffic, or "
+                      "capacity_plan: auto)", x_overflow)
 
         # reflect per-host results back onto the Host objects
         for h in self.sim.hosts:

@@ -1553,6 +1553,12 @@ class SyscallHandler:
             except Blocked:
                 if total == 0:
                     raise
+                # a unix-pair write parks its committed byte count
+                # (upair_done) before blocking; those bytes already
+                # reached the peer and must ride the short return —
+                # only _upair_write sets the key, and a completed
+                # call pops it, so 0 for every other fd type
+                total += self.state.pop("upair_done", 0)
                 break
             if r is NATIVE or (isinstance(r, int) and r < 0):
                 return r if total == 0 else total
@@ -3560,10 +3566,18 @@ class SyscallHandler:
                 except Blocked:
                     if total == 0:
                         raise
+                    # the interrupted segment parked its committed
+                    # byte count (upair_done); those bytes are already
+                    # in the peer's buffer, so they MUST ride the
+                    # short return — dropping them makes the app
+                    # resend bytes the peer received (duplicates)
+                    total += self.state.pop("upair_done", 0)
                     break
                 if isinstance(r, int) and r < 0:
                     return r if total == 0 else total
                 total += r
+                if r < ln:
+                    break
             return total
         if isinstance(desc, TcpDesc):
             # like _iov_loop: only the first iov may block — a Blocked

@@ -1,0 +1,41 @@
+"""BENCH_SMOKE=1 bench.py as a slow-marked test: bench regressions
+(like the r5 zero-division on a zero-packet rung) must fail here
+before a relay window is spent discovering them. CPU platform, tiny
+ladder — this validates the bench MECHANICS (ladder, ratio guards,
+JSON contract, occupancy record), not the numbers."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_smoke_emits_valid_json(tmp_path):
+    env = dict(os.environ,
+               BENCH_SMOKE="1",
+               JAX_PLATFORMS="cpu",
+               SHADOW_TPU_OCC_DIR=str(tmp_path))
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        cwd=ROOT, env=env, capture_output=True, text=True,
+        timeout=900)
+    # the contract: exactly one JSON line on stdout, always
+    lines = [ln for ln in p.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, p.stdout + p.stderr
+    result = json.loads(lines[0])
+    assert result["metric"] == "packets_routed_per_sec_per_chip"
+    assert p.returncode == 0, (result, p.stderr[-2000:])
+    assert "error" not in result, result
+    assert result["value"] > 0
+    assert result["ladder"]["tgen_100"]["speedup"] > 0
+    # the run's measured occupancy landed for tune_10k.py to reuse
+    occ_path = result["occupancy_record"]
+    with open(occ_path) as f:
+        occ = json.load(f)
+    assert occ["measured"]["outbox_rows_max"] > 0
+    assert occ["workload"]["n_hosts"] == 100

@@ -77,6 +77,14 @@ def test_pause_save_resume_bitmatches_uninterrupted(tmp_path):
     assert res_stats.ok
     assert _sig(res_stats, res_c) == _sig(full_stats, full_c)
 
+    # the meta carries ALL capacity knobs (a planned resume adopts
+    # them — not just the two layout-determining fingerprint ones)
+    from shadow_tpu.device import checkpoint
+    caps = checkpoint.peek_meta(ck)["capacities"]
+    assert set(caps) == {"event_capacity", "outbox_capacity",
+                         "exchange_capacity", "exchange_in_capacity",
+                         "outbox_compact"}
+
 
 def test_tor_pause_resume_bitmatches(tmp_path):
     """Checkpoint/resume on the TOR app family (onion trains,
@@ -200,3 +208,89 @@ def test_resume_at_or_past_stop_rejected(tmp_path):
     _run(f"  checkpoint_save: {ck}")     # pauses at stop_time
     with pytest.raises(ValueError, match="nothing to resume"):
         _run(f"  checkpoint_load: {ck}")
+
+
+def test_resume_toward_different_stop_rejected(tmp_path):
+    """The saved prefix's windows were clamped on the run's global
+    stop (final_stop, stamped in the npz meta) — resuming toward a
+    different stop would not bit-match an uninterrupted run at that
+    stop, so the load must refuse the mismatch."""
+    ck = str(tmp_path / "state.npz")
+    _run(f"  checkpoint_save: {ck}\n"
+         f"  checkpoint_save_time: 1500ms")
+    bad = YAML.replace("stop_time: 3s", "stop_time: 4s")
+    with pytest.raises(ValueError, match="stop"):
+        Controller(load_config_str(bad.format(
+            extra=f"  checkpoint_load: {ck}"))).run()
+
+
+def test_pre_telemetry_checkpoint_loads(tmp_path):
+    """Checkpoints saved before the occ_* telemetry leaves existed
+    lack them in the npz key list; the load fills the missing
+    counters from the freshly-initialized template (zeros) instead of
+    rejecting, and the resumed trace still bit-matches."""
+    import json
+
+    import numpy as np
+
+    ck = str(tmp_path / "state.npz")
+    full_stats, full_c = _run()
+    _run(f"  checkpoint_save: {ck}\n"
+         f"  checkpoint_save_time: 1500ms")
+
+    with np.load(ck, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        saved = {k: z[f"leaf_{i}"]
+                 for i, k in enumerate(meta["keys"])}
+    meta["keys"] = [k for k in meta["keys"] if "'occ_" not in k]
+    arrays = {f"leaf_{i}": saved[k]
+              for i, k in enumerate(meta["keys"])}
+    with open(ck, "wb") as f:
+        np.savez_compressed(f, __meta__=json.dumps(meta), **arrays)
+
+    res_stats, res_c = _run(f"  checkpoint_load: {ck}")
+    assert res_stats.ok
+    assert _sig(res_stats, res_c) == _sig(full_stats, full_c)
+
+    # a non-telemetry leaf going missing must still refuse loudly
+    meta2 = dict(meta, keys=[k for k in meta["keys"]
+                             if "'overflow'" not in k])
+    arrays2 = {f"leaf_{i}": saved[k]
+               for i, k in enumerate(meta2["keys"])}
+    with open(ck, "wb") as f:
+        np.savez_compressed(f, __meta__=json.dumps(meta2), **arrays2)
+    with pytest.raises(ValueError, match="state layout changed"):
+        _run(f"  checkpoint_load: {ck}")
+
+
+@pytest.mark.slow
+def test_resume_adopts_saved_capacities_under_plan(tmp_path,
+                                                   monkeypatch):
+    """capacity_plan under checkpoint_load skips planning and adopts
+    the SAVED engine's capacities (peeked from the npz fingerprint):
+    a checkpoint written by a planner-sized engine must stay loadable
+    even though the planned capacities differ from the config's
+    static knobs — and the resumed pair must still bit-match the
+    uninterrupted run."""
+    monkeypatch.setenv("SHADOW_TPU_OCC_DIR", str(tmp_path))
+    ck = str(tmp_path / "state.npz")
+    full_stats, full_c = _run()
+
+    # save under an active plan: the saved fingerprint carries the
+    # planner's capacities, not event_capacity: 192 from the YAML
+    plan = ("  capacity_plan: auto\n"
+            "  capacity_warmup: 2500ms\n")
+    save_stats, _ = _run(plan +
+                         f"  checkpoint_save: {ck}\n"
+                         f"  checkpoint_save_time: 1500ms")
+    assert save_stats.ok
+
+    res_stats, res_c = _run(plan + f"  checkpoint_load: {ck}")
+    assert res_stats.ok
+    assert _sig(res_stats, res_c) == _sig(full_stats, full_c)
+
+    # and a static-config resume of that planned save works too
+    res2_stats, res2_c = _run(f"  checkpoint_load: {ck}\n"
+                              f"  capacity_plan: auto")
+    assert res2_stats.ok
+    assert _sig(res2_stats, res2_c) == _sig(full_stats, full_c)

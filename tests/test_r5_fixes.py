@@ -1,0 +1,122 @@
+"""Regression tests for ADVICE r5 (high): unix-pair vector writes.
+
+`_upair_write` commits bytes to the peer's buffer as space appears and
+parks its progress (`upair_done`) before raising Blocked. The
+sendmsg/writev iov loops catch that Blocked after earlier iovs made
+progress and return a short count — which used to EXCLUDE the bytes
+the interrupted segment had already committed, so the application
+would resend bytes the peer had already received (duplicates on the
+stream). The loops now fold the parked progress into the short return.
+
+Driven at the syscall-handler layer with a fake process/memory: the
+managed-process e2e harness needs real clone/ptrace support this test
+must not depend on.
+"""
+
+import struct
+
+import pytest
+
+from shadow_tpu.host.descriptors import UnixPairDesc
+from shadow_tpu.host.syscalls import Blocked, SyscallHandler
+
+CAP = UnixPairDesc.CAPACITY
+
+
+class FlatMem:
+    """ProcessMemory stand-in: one flat bytearray address space."""
+
+    def __init__(self, size: int = 1 << 20):
+        self.buf = bytearray(size)
+
+    def read(self, addr: int, n: int) -> bytes:
+        return bytes(self.buf[addr:addr + n])
+
+    def write(self, addr: int, data: bytes) -> None:
+        self.buf[addr:addr + len(data)] = data
+
+
+class FakeProcess:
+    def __init__(self):
+        self.mem = FlatMem()
+        self.syscall_state = {}
+        self._fds = {}
+        self.table = self
+
+    def get(self, fd):                   # descriptor-table duck type
+        return self._fds.get(fd)
+
+
+class Ctx:
+    now = 0
+
+
+FD = 1000           # >= VFD_BASE so _no_desc never hands it native
+DATA = 0x1000       # payload bytes live here in FlatMem
+IOV = 0x8000        # struct iovec[2]
+MSG = 0x9000        # struct msghdr
+
+
+def _setup(space_left: int, nonblock: bool = False):
+    """A handler whose fd FD is one end of a stream pair with exactly
+    `space_left` bytes of room in the peer's inbox, and a 140-byte
+    pattern split into two iovs [60, 80] staged in memory."""
+    p = FakeProcess()
+    h = SyscallHandler(p)
+    a, b = UnixPairDesc.make_pair(dgram=False)
+    a.nonblock = nonblock
+    p._fds[FD] = a
+    b.rbuf += bytes(CAP - space_left)    # prefill: zeros, drained first
+    pattern = bytes((i * 131 + 7) & 0xFF for i in range(140))
+    p.mem.write(DATA, pattern)
+    p.mem.write(IOV, struct.pack("<QQQQ", DATA, 60, DATA + 60, 80))
+    # msghdr: name/namelen 0, iov -> IOV, iovlen 2, rest 0
+    p.mem.write(MSG, struct.pack("<QQQQQQQ", 0, 0, IOV, 2, 0, 0, 0))
+    return h, a, b, pattern
+
+
+def _stream_tail(b, prefill: int) -> bytes:
+    return bytes(b.rbuf[prefill:])
+
+
+@pytest.mark.parametrize("call", ["sendmsg", "writev"])
+def test_upair_vector_write_counts_committed_bytes(call):
+    # space for 100 bytes: iov[0] (60) fits whole, iov[1] commits 40
+    # and then blocks — the short return must say 100, matching what
+    # the peer actually received
+    h, a, b, pattern = _setup(space_left=100)
+    if call == "sendmsg":
+        r = h.sys_sendmsg(Ctx(), (FD, MSG, 0))
+    else:
+        r = h.sys_writev(Ctx(), (FD, IOV, 2))
+    assert r == 100
+    assert len(b.rbuf) == CAP
+    assert _stream_tail(b, CAP - 100) == pattern[:100]
+    # the syscall replied: no parked progress may leak into the next
+    assert h.state == {}
+
+
+def test_upair_first_iov_block_still_parks_and_resumes():
+    # space for 40: iov[0] commits 40 of its 60 bytes then blocks with
+    # nothing yet counted — the syscall must park (restart semantics)
+    # and the replay must resume, not repeat, the committed bytes
+    h, a, b, pattern = _setup(space_left=40)
+    with pytest.raises(Blocked):
+        h.sys_sendmsg(Ctx(), (FD, MSG, 0))
+    assert h.state.get("upair_done") == 40
+    got = _stream_tail(b, CAP - 40)
+    del b.rbuf[:]                        # the peer drains everything
+    r = h.sys_sendmsg(Ctx(), (FD, MSG, 0))   # parked syscall replays
+    assert r == 140
+    got += bytes(b.rbuf)
+    assert got == pattern                # no duplicate, no hole
+    assert h.state == {}
+
+
+def test_upair_nonblocking_vector_write_unchanged():
+    # nonblocking path already folded progress (returns done) — pin it
+    h, a, b, pattern = _setup(space_left=100, nonblock=True)
+    r = h.sys_sendmsg(Ctx(), (FD, MSG, 0))
+    assert r == 100
+    assert _stream_tail(b, CAP - 100) == pattern[:100]
+    assert h.state == {}
