@@ -57,9 +57,20 @@ int main(int argc, char **argv) {
     prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
   /* native fds must stay below the virtual-fd floor (600) so the
    * fd-range classification can never be wrong; libc callers see
-   * VIRTUAL rlimits via the emulated getrlimit/prlimit64 */
+   * VIRTUAL rlimits via the emulated getrlimit/prlimit64. A hard
+   * limit already below 600 is fine as-is (fds stay below the
+   * window); a FAILED setrlimit is not — a native fd landing in
+   * [600,1024) would be classified as virtual, so fail loudly
+   * instead of silently running uncapped. */
   struct rlimit nof = {600, 600};
-  setrlimit(RLIMIT_NOFILE, &nof);
+  struct rlimit cur;
+  if (getrlimit(RLIMIT_NOFILE, &cur) == 0 && cur.rlim_max < 600)
+    nof.rlim_cur = nof.rlim_max = cur.rlim_max;
+  if (setrlimit(RLIMIT_NOFILE, &nof) != 0) {
+    perror("launcher: setrlimit(RLIMIT_NOFILE) failed - native fds "
+           "could reach the virtual-fd window [600,1024)");
+    return 126;
+  }
   if (!run_mode)
     raise(SIGSTOP); /* tracer seizes here */
   if (run_mode)

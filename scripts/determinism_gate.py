@@ -13,6 +13,12 @@ reference enforces in CI).
 
 Usage: python scripts/determinism_gate.py [config.yaml] [--policy P]
 Defaults to examples/minimal.yaml with the serial policy.
+
+`--policy` also takes a comma list ("serial,thread,tpu"): the gate
+then runs the config once per policy and additionally requires every
+policy's per-host signature to be bit-identical to the first's — the
+cross-policy determinism matrix (the fault-injection CI rung pins
+serial/thread/tpu on examples/tgen_faults.yaml this way).
 """
 
 from __future__ import annotations
@@ -71,11 +77,13 @@ def main() -> int:
     ap.add_argument("--policy", default="serial")
     args = ap.parse_args()
 
+    policies = [p.strip() for p in args.policy.split(",") if p.strip()]
+
     with tempfile.TemporaryDirectory() as tmp:
         d1 = os.path.join(tmp, "run1", "shadow.data")
         d2 = os.path.join(tmp, "run2", "shadow.data")
-        sig1, stats1 = run_once(args.config, args.policy, d1)
-        sig2, stats2 = run_once(args.config, args.policy, d2)
+        sig1, stats1 = run_once(args.config, policies[0], d1)
+        sig2, stats2 = run_once(args.config, policies[0], d2)
 
         rc = 0
         if sig1 != sig2:
@@ -90,11 +98,35 @@ def main() -> int:
             print("DETERMINISM FAILURE: host files differ")
             for d in diffs[:20]:
                 print(f"  {d}")
+
+        # cross-policy matrix: every additional policy must reproduce
+        # the first policy's per-host signature bit for bit
+        for policy in policies[1:]:
+            dp = os.path.join(tmp, f"run_{policy}", "shadow.data")
+            sigp, _ = run_once(args.config, policy, dp)
+            if sigp != sig1:
+                rc = 1
+                print(f"DETERMINISM FAILURE: policy {policy} diverges "
+                      f"from {policies[0]}")
+                for a, b in zip(sig1, sigp):
+                    if a != b:
+                        print(f"  {a[0]}: {a[1:]} != {b[1:]}")
+            diffs = compare_trees(d1, dp)
+            if diffs:
+                rc = 1
+                print(f"DETERMINISM FAILURE: host files differ "
+                      f"({policies[0]} vs {policy})")
+                for d in diffs[:20]:
+                    print(f"  {d}")
+
         if rc == 0:
-            print(f"determinism OK: {args.config} policy={args.policy} "
+            across = f"across 2 runs of {policies[0]}"
+            if len(policies) > 1:
+                across += f" and policies {','.join(policies[1:])}"
+            print(f"determinism OK: {args.config} "
                   f"({stats1.events_executed} events, "
                   f"{stats1.packets_sent} packets, bit-identical "
-                  "signatures and host files across 2 runs)")
+                  f"signatures and host files {across})")
         return rc
 
 

@@ -171,22 +171,91 @@ class GeneralOptions:
         )
 
 
+def _fault_from_dict(i: int, d: dict):
+    """One `network.faults` entry -> a validated FaultEvent
+    (shadow_tpu/faults.py). Structural validation happens here at
+    config load; topology-dependent checks (the edge exists, down/up
+    pairing, host names) happen at build time when the graph and host
+    list exist."""
+    from shadow_tpu.faults import (
+        FAULT_KINDS,
+        FaultEvent,
+        HOST_KINDS,
+        LINK_KINDS,
+    )
+
+    section = f"network.faults[{i}]"
+    if not isinstance(d, dict):
+        raise ValueError(f"{section} must be a mapping")
+    _check_keys(section, d, {"kind", "time", "source", "target",
+                             "duration", "latency_multiplier",
+                             "extra_packet_loss", "host"})
+    kind = d.get("kind")
+    if kind not in FAULT_KINDS:
+        raise ValueError(
+            f"{section}.kind={kind!r} is not one of {list(FAULT_KINDS)}")
+    if "time" not in d:
+        raise ValueError(f"{section}: missing required key 'time'")
+    if kind in LINK_KINDS:
+        if d.get("source") is None or d.get("target") is None:
+            raise ValueError(
+                f"{section}: {kind} needs 'source' and 'target' "
+                "topology vertex ids")
+        if d.get("host") is not None:
+            raise ValueError(
+                f"{section}: 'host' is only valid for "
+                f"{list(HOST_KINDS)}")
+    else:
+        if not d.get("host"):
+            raise ValueError(
+                f"{section}: {kind} needs 'host' (a configured host "
+                "name, group-expanded like client0)")
+        for bad in ("source", "target", "duration",
+                    "latency_multiplier", "extra_packet_loss"):
+            if d.get(bad) is not None:
+                raise ValueError(
+                    f"{section}: {bad!r} is only valid for link "
+                    "faults")
+    if kind != "degrade":
+        for bad in ("duration", "latency_multiplier",
+                    "extra_packet_loss"):
+            if d.get(bad) is not None:
+                raise ValueError(
+                    f"{section}: {bad!r} is only valid for degrade")
+    return FaultEvent(
+        kind=kind,
+        time=parse_time_ns(d["time"]),
+        source=int(d["source"]) if d.get("source") is not None else -1,
+        target=int(d["target"]) if d.get("target") is not None else -1,
+        duration=(parse_time_ns(d["duration"])
+                  if d.get("duration") is not None else 0),
+        latency_multiplier=float(d.get("latency_multiplier", 1.0)),
+        extra_packet_loss=float(d.get("extra_packet_loss", 0.0)),
+        host=str(d.get("host", "")),
+    )
+
+
 @dataclass
 class NetworkOptions:
     """`network` section (configuration.rs:199-213).
 
     graph.type is "gml" (with `file.path` or `inline`) or the builtin
-    "1_gbit_switch" (configuration.rs:732-760).
+    "1_gbit_switch" (configuration.rs:732-760). `faults` is the
+    deterministic fault-injection schedule (shadow_tpu/faults.py):
+    timed link_down/link_up/degrade edge events compiled into an
+    epoch table at load, plus manager-side host_crash/host_restart.
     """
 
     graph_type: str = "1_gbit_switch"
     graph_file: Optional[str] = None
     graph_inline: Optional[str] = None
     use_shortest_path: bool = True
+    faults: list = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict) -> "NetworkOptions":
-        _check_keys("network", d, {"graph", "use_shortest_path"})
+        _check_keys("network", d, {"graph", "use_shortest_path",
+                                   "faults"})
         graph = d.get("graph", {}) or {}
         _check_keys("network.graph", graph, {"type", "file", "inline"})
         gtype = graph.get("type", "1_gbit_switch")
@@ -195,11 +264,17 @@ class NetworkOptions:
             gfile = graph["file"].get("path")
         elif isinstance(graph.get("file"), str):
             gfile = graph["file"]
+        raw_faults = d.get("faults") or []
+        if not isinstance(raw_faults, list):
+            raise ValueError("network.faults must be a list of fault "
+                             "events")
         return cls(
             graph_type=gtype,
             graph_file=gfile,
             graph_inline=graph.get("inline"),
             use_shortest_path=bool(d.get("use_shortest_path", True)),
+            faults=[_fault_from_dict(i, f)
+                    for i, f in enumerate(raw_faults)],
         )
 
 
@@ -329,6 +404,16 @@ class ExperimentalOptions:
     # ~1-2 ms over a tunneled TPU; a CPU judgment costs ~10 us/pkt,
     # so small batches never pay for the trip). 0 = always device.
     hybrid_judge_min_batch: int = 192
+    # wall-clock round watchdog (core/manager.py RoundWatchdog),
+    # seconds; 0 = off. If a scheduling round makes no progress for
+    # this long, dump per-host/per-process state (current blocked
+    # syscall, quarantine counts) and abort with a diagnostic instead
+    # of hanging forever. CPU policies only (the device engine's
+    # rounds are bounded by max_rounds). Size the interval ABOVE any
+    # legitimate in-round pause — in particular hybrid mode's first
+    # device flush includes its XLA compile (tens of seconds on a
+    # tunneled TPU), during which no event executes.
+    round_watchdog: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -430,6 +515,7 @@ class ExperimentalOptions:
                               ("burst_pops", 0),
                               ("device_batch_rounds", 1),
                               ("hybrid_judge_min_batch", 0),
+                              ("round_watchdog", 0),
                               ("preload_spin_max", 0)):
             if getattr(out, name) < minimum:
                 raise ValueError(

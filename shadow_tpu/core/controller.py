@@ -58,13 +58,24 @@ class BuiltSimulation:
     dns: object = None
     groups: dict = None                  # group name -> [host ids]
     runtime: object = None               # ManagedRuntime if real procs
+    # fault injection (shadow_tpu/faults.py): the compiled link-fault
+    # epoch table (None without link faults) and the validated
+    # [(time, host_id, kind)] host crash/restart schedule
+    fault_table: object = None
+    host_faults: list = None
 
 
 def build(cfg: ConfigOptions) -> BuiltSimulation:
+    from shadow_tpu import faults as faultmod
     from shadow_tpu.host.cpu import Cpu
     from shadow_tpu.routing.dns import Dns
 
     topology = load_topology(cfg)
+    # link faults compile into the epoch table HERE, at load time,
+    # exactly like the base all-pairs matrices; host faults resolve
+    # against the built host list further down
+    link_events, host_events = faultmod.split_events(cfg.network.faults)
+    fault_table = faultmod.compile_link_faults(topology, link_events)
     root_rng = SeededRandom(cfg.general.seed)
     attacher = Attacher(topology, root_rng.child("attach"))
     dns = Dns()
@@ -103,6 +114,7 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
             for proc in group.processes:
                 for _ in range(proc.quantity):
                     app = None
+                    factory = None   # respawn closure (host_restart)
                     if is_model_path(proc.path):
                         # packet/timer events dispatch to the host's
                         # single model app; real processes are driven
@@ -116,6 +128,9 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
                                 "processes)")
                         app = make_app(proc.path, proc.args,
                                        host_id, n_total)
+                        factory = (lambda p=proc.path, a=proc.args,
+                                   hid=host_id, n=n_total:
+                                   make_app(p, a, hid, n))
                     else:
                         # real executable under syscall interposition
                         import shutil
@@ -158,12 +173,29 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
                             app = PtraceProcess(
                                 runtime, path, proc.args,
                                 proc.environment)
+                            factory = (lambda cls=PtraceProcess,
+                                       rt=runtime, p=path,
+                                       a=proc.args,
+                                       e=proc.environment:
+                                       cls(rt, p, a, e))
                         else:
                             app = ManagedProcess(
                                 runtime, path, proc.args,
                                 proc.environment)
+                            factory = (lambda cls=ManagedProcess,
+                                       rt=runtime, p=path,
+                                       a=proc.args,
+                                       e=proc.environment:
+                                       cls(rt, p, a, e))
                     proc_idx = len(host.apps)
                     host.apps.append(app)
+                    if host.respawn is None:
+                        host.respawn = []
+                    host.respawn.append(
+                        (factory, proc.start_time,
+                         proc.stop_time if proc.stop_time is not None
+                         else -1,
+                         is_model_path(proc.path)))
                     # the model app (at most one) is ALWAYS the
                     # packet/timer dispatch target, regardless of its
                     # position in the process list; otherwise the
@@ -181,10 +213,16 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
         host_vertex=np.array([h.vertex for h in hosts], dtype=np.int64),
         seed=cfg.general.seed,
         bootstrap_end=cfg.general.bootstrap_end_time,
+        faults=fault_table,
     )
+    host_faults = faultmod.resolve_host_faults(
+        host_events, {h.name: h.host_id for h in hosts})
+    # the lookahead window must be a static floor over every fault
+    # epoch (netmodel.min_latency_ns is fault-aware) — all backends
+    # consume this one value, so window sequences stay identical
     lookahead = (cfg.experimental.runahead
                  if cfg.experimental.runahead is not None
-                 else topology.min_latency_ns)
+                 else netmodel.min_latency_ns)
     if runtime is not None:
         # managed processes resolve names against this file
         # (dns.c's /etc/hosts-style emission)
@@ -194,7 +232,8 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
     return BuiltSimulation(cfg=cfg, topology=topology, hosts=hosts,
                            netmodel=netmodel, starts=starts,
                            lookahead=lookahead, dns=dns, runtime=runtime,
-                           groups=groups)
+                           groups=groups, fault_table=fault_table,
+                           host_faults=host_faults)
 
 
 class Controller:
@@ -231,7 +270,8 @@ class Controller:
                 self.sim.netmodel.host_vertex,
                 cfg.general.seed,
                 bootstrap_end=cfg.general.bootstrap_end_time,
-                min_batch=cfg.experimental.hybrid_judge_min_batch)
+                min_batch=cfg.experimental.hybrid_judge_min_batch,
+                fault_table=self.sim.fault_table)
             policy_name = cfg.experimental.hybrid_cpu_policy
         from shadow_tpu.core.manager import NetOptions
         self.manager = Manager(
@@ -281,6 +321,8 @@ class Controller:
 
         m = self.manager
         m.boot_hosts(self.sim.starts)
+        if self.sim.host_faults:
+            m.schedule_host_faults(self.sim.host_faults)
         if cfg.general.heartbeat_interval:
             m.schedule_heartbeats(cfg.general.heartbeat_interval, stop)
         lookahead = max(1, self.sim.lookahead)
@@ -288,22 +330,48 @@ class Controller:
                  len(self.sim.hosts), simtime.format_time(stop),
                  simtime.format_time(lookahead))
 
-        next_time = m.policy.next_event_time()
-        while next_time < stop:
-            window_end = min(next_time + lookahead, stop)
-            next_time = m.run_window(next_time, window_end)
+        watchdog = None
+        if cfg.experimental.round_watchdog:
+            from shadow_tpu.core.manager import RoundWatchdog
+            watchdog = RoundWatchdog(
+                m, cfg.experimental.round_watchdog)
+            watchdog.start()
+        try:
+            next_time = m.policy.next_event_time()
+            while next_time < stop:
+                window_end = min(next_time + lookahead, stop)
+                next_time = m.run_window(next_time, window_end)
 
-        if self.sim.runtime is not None:
-            # kill surviving managed processes (forked children die
-            # with their parents), release the arena
-            ctx = m._ctx
-            ctx.now = stop
-            for h in m.hosts:
-                for app in (h.apps or [h.app]):
-                    if app is not None and hasattr(app, "on_sim_end"):
-                        ctx.host = h
-                        app.on_sim_end(ctx)
-            self.sim.runtime.close()
+            if self.sim.runtime is not None:
+                # kill surviving managed processes (forked children
+                # die with their parents), release the arena. Inside
+                # the watchdog's try: its SIGINT may land just after
+                # the loop exits (progress resumed between the sample
+                # and the signal), and that window must surface the
+                # same diagnostic, not a bare ^C traceback mid-
+                # teardown
+                ctx = m._ctx
+                ctx.now = stop
+                for h in m.hosts:
+                    for app in (h.apps or [h.app]):
+                        if app is not None and \
+                                hasattr(app, "on_sim_end"):
+                            ctx.host = h
+                            app.on_sim_end(ctx)
+                self.sim.runtime.close()
+        except KeyboardInterrupt:
+            if watchdog is None or not watchdog.fired:
+                raise
+            # the watchdog aborted a stalled round: surface a
+            # diagnostic error, not a bare ^C traceback
+            raise RuntimeError(
+                "simulation aborted by the round watchdog (no "
+                "scheduling progress for "
+                f"{cfg.experimental.round_watchdog}s wall — see the "
+                "per-host state dump in the log)") from None
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
         m.finalize()
         m.stats.end_time = stop
         if m.net_judge is not None:

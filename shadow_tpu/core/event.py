@@ -36,6 +36,12 @@ KIND_TCP_TIMER = 7        # TCP timer (data: (conn_id, generation))
 # serialization delivery time — on both engines (host/model_nic.py,
 # device/engine.py)
 KIND_PACKET_READY = 8
+# fault injection (shadow_tpu/faults.py, manager-side): kill a host's
+# processes and quarantine its pending events / respawn the configured
+# processes with a fresh network stack. CPU policies only — under the
+# tpu policy host-fault configs fall back to hybrid.
+KIND_HOST_CRASH = 9
+KIND_HOST_RESTART = 10
 
 
 class EventKey(NamedTuple):

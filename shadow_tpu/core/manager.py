@@ -21,6 +21,8 @@ from shadow_tpu import simtime
 from shadow_tpu.core.event import (
     Event,
     KIND_BOOT,
+    KIND_HOST_CRASH,
+    KIND_HOST_RESTART,
     KIND_NIC_WAKE,
     KIND_PACKET,
     KIND_PACKET_READY,
@@ -136,8 +138,12 @@ class Manager:
         self.rng_key = nprng.seed_key(self.seed)
         self._name_to_id = {h.name: h.host_id for h in self.hosts}
         # out-of-band TCP payload streams for managed processes,
-        # keyed (src_host, src_port, dst_host, dst_port)
+        # keyed (src_host, src_port, dst_host, dst_port); the lock
+        # covers create-vs-teardown races under threaded policies
+        # (host-crash teardown runs on the crashed host's worker
+        # while peers may be resolving channels concurrently)
         self._streams: dict[tuple, object] = {}
+        self._streams_lock = threading.Lock()
         self._barrier = simtime.SIMTIME_INVALID
         self._trace_lock = threading.Lock()
         self._worker_stats: list[SimStats] = []
@@ -146,6 +152,8 @@ class Manager:
         self._pending: list[tuple] = []
         self._pending_lock = threading.Lock()
         self._last_hb_flush = simtime.SIMTIME_INVALID
+        self._hb_interval = 0        # set by schedule_heartbeats
+        self._hb_stop = 0
         self._ctx = SimContext(self, self.stats)
         no = self.net_opts
         for h in self.hosts:
@@ -171,11 +179,12 @@ class Manager:
 
     def stream_channel(self, key: tuple):
         """Byte channel for one TCP direction (host/descriptors.py)."""
-        ch = self._streams.get(key)
-        if ch is None:
-            from shadow_tpu.host.descriptors import StreamChannel
-            ch = self._streams[key] = StreamChannel()
-        return ch
+        with self._streams_lock:
+            ch = self._streams.get(key)
+            if ch is None:
+                from shadow_tpu.host.descriptors import StreamChannel
+                ch = self._streams[key] = StreamChannel()
+            return ch
 
     def push_event(self, ev: Event) -> None:
         self.policy.push(ev, self._barrier)
@@ -185,6 +194,141 @@ class Manager:
         stats = SimStats()
         self._worker_stats.append(stats)
         return SimContext(self, stats), stats
+
+    def schedule_host_faults(self, host_faults: list[tuple]) -> None:
+        """host_faults: [(time, host_id, kind)] from
+        faults.resolve_host_faults — crash/restart events enter the
+        queue before the first round, consuming event seqs exactly
+        like boot/stop events (identically under every CPU policy, so
+        traces stay policy-invariant)."""
+        for t, host_id, kind in host_faults:
+            h = self.hosts[host_id]
+            self.push_event(Event(
+                time=t, dst_host=host_id, src_host=host_id,
+                seq=h.next_event_seq(),
+                kind=(KIND_HOST_CRASH if kind == "host_crash"
+                      else KIND_HOST_RESTART)))
+
+    def _host_crash(self, ctx, host) -> None:
+        """KIND_HOST_CRASH: the machine dies mid-run. Managed (real)
+        processes are killed for real; model apps simply stop
+        executing (their objects are replaced at restart). Pending
+        events for the host are quarantined as they surface
+        (execute_event), and the shared TCP payload channels the host
+        participated in are dropped so surviving peers observe resets/
+        timeouts through their own retry logic instead of reading a
+        ghost's stream."""
+        log.info("host %s crashed (fault injection)", host.name)
+        for app in host.apps:
+            if hasattr(app, "on_sim_end"):
+                # ManagedProcess/PtraceProcess: kill the OS process
+                app.on_sim_end(ctx)
+        host.crashed = True
+        # under threaded policies a peer draining in the same window
+        # may interleave with this teardown by wall clock; the lock
+        # makes the dict operations safe, and per-connection readers
+        # tolerate a vanished channel as a reset (managed-TCP fault
+        # scenarios wanting strict cross-run byte-level determinism
+        # should run a serial policy, like threaded heartbeat
+        # attribution already does)
+        with self._streams_lock:
+            for key in [k for k in self._streams
+                        if k[0] == host.host_id
+                        or k[2] == host.host_id]:
+                del self._streams[key]
+        # the pcap writer deliberately survives the crash: the capture
+        # up to the outage is exactly the artifact a fault-injection
+        # user inspects, and the restart re-attaches it (a fresh
+        # HostNetStack would truncate the file)
+
+    def _host_restart(self, ctx, host) -> None:
+        """KIND_HOST_RESTART: respawn the configured processes from
+        the factories captured at build time, on a FRESH network
+        stack/CPU model — a rebooted machine keeps nothing but its
+        disk (the per-host data dir). Boot events are pushed at the
+        restart time (self-destined, so no causality bump) and the
+        processes' original stop_times still apply when still in the
+        future."""
+        from shadow_tpu.core.event import KIND_TASK
+        from shadow_tpu.host.cpu import Cpu
+        from shadow_tpu.host.netstack import HostNetStack
+
+        log.info("host %s restarting (fault injection; %d events "
+                 "quarantined while down)", host.name,
+                 host.events_quarantined)
+        host.crashed = False
+        old_pcap = host.net.pcap if host.net is not None else None
+        no = self.net_opts
+        pcap_dir, host.pcap_directory = host.pcap_directory, None
+        try:
+            host.net = HostNetStack(
+                host, self, qdisc=no.qdisc,
+                router_queue=no.router_queue,
+                router_static_capacity=no.router_static_capacity,
+                bootstrap_end=no.bootstrap_end,
+                tcp_congestion=no.tcp_congestion,
+                tcp_recv_buffer=no.tcp_recv_buffer,
+                tcp_send_buffer=no.tcp_send_buffer,
+                tcp_recv_autotune=no.tcp_recv_autotune,
+                tcp_send_autotune=no.tcp_send_autotune)
+        finally:
+            host.pcap_directory = pcap_dir
+        # re-attach the surviving capture (see _host_crash): the
+        # constructor would have truncated the pre-crash file
+        host.net.pcap = old_pcap
+        if host.cpu is not None:
+            host.cpu = Cpu()
+        if host.model_nic is not None:
+            host.model_nic = type(host.model_nic)(host.bw_up_bits,
+                                                  host.bw_down_bits)
+        # the heartbeat chain is self-rescheduling, so a tick that
+        # surfaced during the outage was quarantined and the chain is
+        # dead — re-seed it at the next interval boundary (the outage
+        # shows as a gap, then ticks resume). ONLY dead chains: a
+        # short outage whose next tick never surfaced while down
+        # still has its live chain queued, and a second seed would
+        # double every subsequent tick.
+        if self._hb_interval and getattr(host, "_hb_dead", False):
+            host._hb_dead = False
+            nxt = (ctx.now // self._hb_interval + 1) * \
+                self._hb_interval
+            if nxt < self._hb_stop:
+                self.push_event(Event(
+                    time=nxt, dst_host=host.host_id,
+                    src_host=host.host_id,
+                    seq=host.next_event_seq(), kind=KIND_TASK,
+                    task=self._make_hb_task(host)))
+        if not host.respawn:
+            log.warning("host %s restarted with no respawn factories "
+                        "(nothing boots)", host.name)
+            return
+        host.apps = []
+        host.app = None
+        for proc_idx, (factory, start_time, stop_time, is_model) in \
+                enumerate(host.respawn):
+            if stop_time is not None and 0 <= stop_time <= ctx.now:
+                # the process's configured life ended while the host
+                # was down — it stays dead (a None placeholder keeps
+                # later processes' BOOT/STOP indices aligned)
+                host.apps.append(None)
+                continue
+            app = factory()
+            host.apps.append(app)
+            # mirror build()'s primary-app rule: the model app (at
+            # most one) is always the packet/timer dispatch target
+            if is_model or host.app is None:
+                host.app = app
+            # boot NOW only if the original start has passed; a
+            # future start_time still has its original KIND_BOOT
+            # event queued (it was never quarantined), and the
+            # original KIND_STOP likewise fires on this new app —
+            # pushing duplicates here would double-boot/-stop
+            if start_time <= ctx.now:
+                self.push_event(Event(
+                    time=ctx.now, dst_host=host.host_id,
+                    src_host=host.host_id,
+                    seq=host.next_event_seq(),
+                    kind=KIND_BOOT, data=(proc_idx,)))
 
     def boot_hosts(self, start_times: list[tuple]) -> None:
         """start_times: (host_id, start_time, stop_time|-1[, proc_idx])
@@ -329,43 +473,83 @@ class Manager:
                 h.net.pcap.close()
         return self.stats
 
+    def _make_hb_task(self, host):
+        """One host's self-rescheduling heartbeat task (shared by the
+        initial seeding and the host_restart re-seed)."""
+        from shadow_tpu.core.event import KIND_TASK
+
+        interval, stop = self._hb_interval, self._hb_stop
+
+        def task(ctx, ev):
+            # hybrid: settle this round's pending drop verdicts so
+            # the CSV counters match the pure-CPU oracle's interval
+            # attribution (drop rolls are pure functions of
+            # (seed, src, pkt_seq) — flushing mid-round is safe).
+            # Serial policies only: under threaded policies a flush
+            # from a worker would race other workers' counter
+            # updates, and threaded heartbeat attribution is
+            # unordered in pure-CPU mode anyway. One flush per
+            # heartbeat tick, not per host.
+            if (self.net_judge is not None
+                    and not hasattr(self.policy, "run_parallel")
+                    and self._last_hb_flush != ev.time):
+                self._last_hb_flush = ev.time
+                self.flush_judgments()
+            host.tracker.heartbeat(ev.time, host)
+            nxt = ev.time + interval
+            if nxt < stop:
+                self.push_event(Event(
+                    time=nxt, dst_host=host.host_id,
+                    src_host=host.host_id,
+                    seq=host.next_event_seq(), kind=KIND_TASK,
+                    task=task))
+        # lets the quarantine path recognize a dead heartbeat chain
+        # (the restart re-seed must not duplicate a chain whose next
+        # tick survived the outage)
+        task._hb_chain = True
+        return task
+
     def schedule_heartbeats(self, interval: int, stop: int) -> None:
         """Per-host heartbeat chain (tracker_heartbeat, tracker.c:565)."""
         from shadow_tpu.core.event import KIND_TASK
         from shadow_tpu.host.tracker import Tracker
 
-        def make_task(host):
-            def task(ctx, ev):
-                # hybrid: settle this round's pending drop verdicts so
-                # the CSV counters match the pure-CPU oracle's interval
-                # attribution (drop rolls are pure functions of
-                # (seed, src, pkt_seq) — flushing mid-round is safe).
-                # Serial policies only: under threaded policies a flush
-                # from a worker would race other workers' counter
-                # updates, and threaded heartbeat attribution is
-                # unordered in pure-CPU mode anyway. One flush per
-                # heartbeat tick, not per host.
-                if (self.net_judge is not None
-                        and not hasattr(self.policy, "run_parallel")
-                        and self._last_hb_flush != ev.time):
-                    self._last_hb_flush = ev.time
-                    self.flush_judgments()
-                host.tracker.heartbeat(ev.time, host)
-                nxt = ev.time + interval
-                if nxt < stop:
-                    self.push_event(Event(
-                        time=nxt, dst_host=host.host_id,
-                        src_host=host.host_id,
-                        seq=host.next_event_seq(), kind=KIND_TASK,
-                        task=task))
-            return task
-
+        self._hb_interval, self._hb_stop = interval, stop
         for h in self.hosts:
             h.tracker = Tracker(h.name, interval)
             self.push_event(Event(time=interval, dst_host=h.host_id,
                                   src_host=h.host_id,
                                   seq=h.next_event_seq(),
-                                  kind=KIND_TASK, task=make_task(h)))
+                                  kind=KIND_TASK,
+                                  task=self._make_hb_task(h)))
+
+    def dump_state(self) -> str:
+        """Per-host / per-process diagnostic snapshot — what the round
+        watchdog prints when a round stalls: executed/quarantined
+        event counts, crash state, app types, and for managed (real)
+        processes each thread's parked (blocked) syscall."""
+        lines = []
+        for h in self.hosts:
+            apps = ",".join(type(a).__name__ for a in h.apps) or "-"
+            lines.append(
+                f"  host {h.name} (id {h.host_id}): "
+                f"events={h.events_executed} "
+                f"quarantined={h.events_quarantined} "
+                f"crashed={h.crashed} apps=[{apps}]")
+            for app in h.apps:
+                threads = getattr(app, "threads", None)
+                if not isinstance(threads, dict):
+                    continue
+                for vtid, th in threads.items():
+                    parked = getattr(th, "parked", None)
+                    if parked is None:
+                        continue
+                    from shadow_tpu.host.syscalls import NR_NAME
+                    nr = parked[0] if parked else -1
+                    lines.append(
+                        f"    vtid {vtid}: blocked in syscall "
+                        f"{NR_NAME.get(nr, nr)}")
+        return "\n".join(lines)
 
     @staticmethod
     def _proc_of(host, ev: Event):
@@ -382,6 +566,23 @@ class Manager:
         """event_execute analogue (core/work/event.c:64): set the clock
         and host context, apply the CPU-delay model, dispatch by kind."""
         host = self.hosts[ev.dst_host]
+        if host.crashed and ev.kind != KIND_HOST_RESTART:
+            # quarantine: a crashed host executes nothing — events
+            # surfacing for it while down are counted (packet kinds
+            # also count as drops: the network lost them at the dead
+            # NIC) and discarded. Per-host event order makes this
+            # deterministic under every policy: the crash event at an
+            # earlier (time, src, seq) key always runs first.
+            host.events_quarantined += 1
+            if ev.kind in (KIND_PACKET, KIND_PACKET_READY,
+                           KIND_ROUTER_ARRIVAL):
+                host.packets_dropped += ev.npkts
+            if ev.task is not None and \
+                    getattr(ev.task, "_hb_chain", False):
+                # the self-rescheduling heartbeat tick died here —
+                # _host_restart re-seeds exactly the dead chains
+                host._hb_dead = True
+            return
         if host.cpu is not None:
             host.cpu.update_time(ev.time)
             if host.cpu.is_blocked(ev.time):
@@ -461,5 +662,89 @@ class Manager:
                 target = self._proc_of(host, ev)
                 if target is not None:
                     target.on_stop(ctx)
+            elif ev.kind == KIND_HOST_CRASH:
+                self._host_crash(ctx, host)
+            elif ev.kind == KIND_HOST_RESTART:
+                self._host_restart(ctx, host)
         finally:
             clear_context()
+
+
+class RoundWatchdog:
+    """Wall-clock stall detector for the scheduling round loop
+    (experimental.round_watchdog, seconds; 0 = off).
+
+    A wedged host-side call — a blocking open the emulation missed, a
+    managed process spinning off-channel — used to hang the whole
+    simulator forever with zero diagnostics. The watchdog samples a
+    cheap progress signal (rounds + per-host executed-event counters)
+    from a daemon thread; when NOTHING moves for `interval` wall
+    seconds it dumps per-host/per-process state (Manager.dump_state:
+    current blocked syscall, quarantine counts) and aborts the run
+    with a diagnostic instead of hanging.
+
+    `on_stall(dump)` is injectable for tests; the default logs the
+    dump, marks stats not-ok, and interrupts the main thread."""
+
+    def __init__(self, manager: Manager, interval_s: float,
+                 on_stall=None):
+        if interval_s <= 0:
+            raise ValueError("round_watchdog interval must be > 0")
+        self._m = manager
+        self.interval = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.on_stall = on_stall or self._default_stall
+        self.fired = False
+
+    def _progress(self) -> tuple:
+        m = self._m
+        return (m.stats.rounds,
+                sum(h.events_executed for h in m.hosts),
+                sum(h.events_quarantined for h in m.hosts))
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="round-watchdog", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        import time as _time
+
+        poll = max(0.05, min(self.interval / 4.0, 1.0))
+        last = self._progress()
+        last_t = _time.monotonic()
+        while not self._stop.wait(poll):
+            cur = self._progress()
+            if cur != last:
+                last, last_t = cur, _time.monotonic()
+                continue
+            if _time.monotonic() - last_t >= self.interval:
+                self.fired = True
+                self.on_stall(self._m.dump_state())
+                return
+
+    def _default_stall(self, dump: str) -> None:
+        import signal
+
+        log.error(
+            "round watchdog: no scheduling progress for %.0fs wall — "
+            "aborting with per-host state:\n%s", self.interval, dump)
+        self._m.stats.ok = False
+        # a REAL signal to the main thread: pthread_kill delivers
+        # SIGINT so a main thread wedged inside a blocking C call
+        # (the exact class this watchdog exists for) takes EINTR and
+        # raises KeyboardInterrupt; interrupt_main() would only set a
+        # flag checked between bytecodes, which such a thread never
+        # reaches
+        try:
+            signal.pthread_kill(threading.main_thread().ident,
+                                signal.SIGINT)
+        except (ValueError, ProcessLookupError, RuntimeError, OSError):
+            import _thread
+            _thread.interrupt_main()

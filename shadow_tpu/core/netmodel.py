@@ -43,6 +43,11 @@ class NetworkModel:
     host_vertex: np.ndarray        # [H] vertex index per host
     seed: int
     bootstrap_end: int = 0
+    # compiled link-fault schedule (shadow_tpu/faults.py FaultTable);
+    # None = the static base matrices. The lookup is keyed by the
+    # packet's SEND time — the same key every device backend uses —
+    # so traces stay bit-identical across engines under faults.
+    faults: object = None
     # per-path packet counters (topology_incrementPathPacketCounter
     # analogue), aggregated per (src_vertex, dst_vertex); judged from
     # multiple worker threads under threaded policies
@@ -51,7 +56,21 @@ class NetworkModel:
 
     @property
     def min_latency_ns(self) -> int:
+        if self.faults is not None:
+            # conservative across every fault epoch (a degrade only
+            # raises latency, but the lookahead must be a static floor)
+            return min(self.topology.min_latency_ns,
+                       self.faults.min_latency_ns)
         return self.topology.min_latency_ns
+
+    def _path(self, now: int, sv: int, dv: int) -> tuple[int, float]:
+        """(latency_ns, reliability) of the path sv->dv at send time
+        `now` — the single lookup both judge paths share, epoch-aware
+        under a fault schedule."""
+        if self.faults is not None:
+            return self.faults.lookup(now, sv, dv)
+        return (int(self.topology.latency_ns[sv, dv]),
+                float(self.topology.reliability[sv, dv]))
 
     def record_paths(self, counts: dict) -> None:
         """Merge a batch of per-(src_vertex, dst_vertex) packet counts
@@ -77,8 +96,7 @@ class NetworkModel:
             f"judge_train count={count} exceeds the 64-bit mask"
         sv = int(self.host_vertex[src_host])
         dv = int(self.host_vertex[dst_host])
-        latency = int(self.topology.latency_ns[sv, dv])
-        reliability = float(self.topology.reliability[sv, dv])
+        latency, reliability = self._path(now, sv, dv)
 
         surv = (1 << count) - 1
         if reliability < 1.0 and now >= self.bootstrap_end:
@@ -98,8 +116,7 @@ class NetworkModel:
               pkt_seq: int) -> PacketVerdict:
         sv = int(self.host_vertex[src_host])
         dv = int(self.host_vertex[dst_host])
-        latency = int(self.topology.latency_ns[sv, dv])
-        reliability = float(self.topology.reliability[sv, dv])
+        latency, reliability = self._path(now, sv, dv)
 
         delivered = True
         if reliability < 1.0 and now >= self.bootstrap_end:

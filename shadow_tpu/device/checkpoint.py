@@ -51,9 +51,17 @@ def _fingerprint(engine) -> dict:
     # its scalar instance attributes (msgload, sizes, counts, ... —
     # device apps keep per-host state in the engine state dict, so
     # scalars are the configuration surface).
+    # with a fault schedule, epoch_times joins the world hash: the
+    # stacked latency/reliability matrices already cover the
+    # schedule's *values*, but two schedules can share matrices with
+    # different boundary times — resuming across an edited schedule
+    # must fail. Fault-free engines hash exactly the pre-fault-layer
+    # surface, so existing fault-free checkpoints keep loading.
+    faulted = len(engine.epoch_times) > 1
     h = hashlib.sha256()
     for arr in (engine.host_vertex, engine.latency,
-                engine.reliability, engine.bw_up, engine.bw_down):
+                engine.reliability, engine.bw_up, engine.bw_down) + \
+            ((engine.epoch_times,) if faulted else ()):
         a = np.ascontiguousarray(np.asarray(arr))
         h.update(str(a.shape).encode())
         h.update(a.tobytes())
@@ -64,7 +72,7 @@ def _fingerprint(engine) -> dict:
     from shadow_tpu.device.capacity import app_scalars
     h.update(json.dumps(app_scalars(engine.app),
                         sort_keys=True).encode())
-    return {
+    fp = {
         "n_hosts": int(cfg.n_hosts),
         "h_pad": int(engine.H_pad),
         "event_capacity": int(cfg.event_capacity),
@@ -74,6 +82,13 @@ def _fingerprint(engine) -> dict:
         "app": type(engine.app).__name__,
         "world": h.hexdigest(),
     }
+    if faulted:
+        # readable fault-schedule stamp alongside the world hash: a
+        # mismatch names the schedule, not just "world changed".
+        # Only present under a schedule — fault-free fingerprints
+        # stay key-compatible with pre-fault-layer checkpoints.
+        fp["fault_epochs"] = int(len(engine.epoch_times))
+    return fp
 
 
 def _flatten(state):

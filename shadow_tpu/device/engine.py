@@ -166,7 +166,8 @@ class DeviceEngine:
                  reliability: np.ndarray,
                  mesh: Optional[Mesh] = None,
                  bw_up_bits: Optional[np.ndarray] = None,
-                 bw_down_bits: Optional[np.ndarray] = None):
+                 bw_down_bits: Optional[np.ndarray] = None,
+                 epoch_times: Optional[np.ndarray] = None):
         self.config = config
         self.app = app
         # d2 survivor bitmasks are one uint32 word: a larger train
@@ -183,13 +184,31 @@ class DeviceEngine:
         self.H_pad = int(math.ceil(H / self.n_shards) * self.n_shards)
         self.H_loc = self.H_pad // self.n_shards
 
+        # topology matrices are stored STACKED per fault epoch
+        # [T,V,V] (shadow_tpu/faults.py epoch table) when a fault
+        # schedule exists; the fault-free single epoch keeps the
+        # plain [V,V] matrices so the compiled program (and its
+        # gathers) is byte-identical to the pre-fault engine
+        latency_ns = np.asarray(latency_ns)
+        reliability = np.asarray(reliability)
+        n_epochs = latency_ns.shape[0] if latency_ns.ndim == 3 else 1
+        if epoch_times is None:
+            epoch_times = np.zeros(n_epochs, dtype=np.int64)
+        self.epoch_times = np.asarray(epoch_times, dtype=np.int64)
+        if len(self.epoch_times) != n_epochs:
+            raise ValueError(
+                f"epoch_times has {len(self.epoch_times)} entries but "
+                f"the latency table has {n_epochs} epochs")
+        if latency_ns.ndim == 3 and n_epochs == 1:
+            latency_ns = latency_ns[0]
+            reliability = reliability[0]
         if (latency_ns > np.iinfo(np.int32).max).any():
             raise ValueError("path latencies above ~2.1 s don't fit the "
                              "i32 device latency matrix")
         self.host_vertex = np.zeros(self.H_pad, dtype=np.int32)
         self.host_vertex[:H] = host_vertex
         self.latency = latency_ns.astype(np.int32)
-        self.n_vertices = int(latency_ns.shape[0])
+        self.n_vertices = int(latency_ns.shape[-1])
         if config.count_paths and self.n_vertices ** 2 > 65536:
             raise ValueError(
                 "count_paths needs V*V <= 65536 (histogram boundaries "
@@ -420,10 +439,37 @@ class DeviceEngine:
         POP_ONEHOT = (cfg.pop_onehot
                       if cfg.pop_onehot is not None
                       else platform == "tpu")
+        # fault epochs: the [T] epoch start times bake into the
+        # program as a constant (they are part of the compiled
+        # schedule exactly like the capacities); each lookup selects
+        # its epoch by SEND time with a comparison count — the
+        # vectorized twin of the CPU model's binary search
+        # (faults.FaultTable.epoch_of). T == 1 (no faults) keeps the
+        # [V,V] matrices and the original 2-operand gather, so the
+        # fault-free program is byte-identical to before.
+        T_EP = len(self.epoch_times)
+        ep_t = jnp.asarray(self.epoch_times)
+
+        def _ep_of(t):
+            return (t[..., None] >= ep_t).sum(-1).astype(jnp.int32) - 1
+
+        def _tbl(tab, t, sv, dv):
+            """Topology-table gather at send time t; tab is [V,V]
+            (single epoch) or [T,V,V] (fault schedule)."""
+            if T_EP == 1:
+                return tab[sv, dv]
+            return tab[_ep_of(t), sv, dv]
+
         # one-hot topology-table lookups (see EngineConfig.table_onehot)
-        TAB_ONEHOT = bool(cfg.table_onehot) and V * V <= 128
+        TAB_ONEHOT = bool(cfg.table_onehot) and V * V <= 128 \
+            and T_EP == 1
         if cfg.table_onehot and not TAB_ONEHOT:
-            log.info("table_onehot disabled: V*V = %d > 128", V * V)
+            if T_EP > 1:
+                log.info("table_onehot disabled: fault epoch table "
+                         "(T=%d) uses the indexed gather", T_EP)
+            else:
+                log.info("table_onehot disabled: V*V = %d > 128",
+                         V * V)
         # statically lossless topologies (all reliability == 1) never
         # drop: packet_drop_mask is False for every row regardless of
         # the roll, so the threefry batch is skipped outright
@@ -670,8 +716,11 @@ class DeviceEngine:
                         + vrank
                 srcv = host_vertex[gid][:, None]
                 dstv = host_vertex[jnp.clip(dst, 0, H_pad - 1)]
-                latv = lat[srcv, dstv].astype(jnp.int64)         # [H,K]
-                relv = rel[srcv, dstv]
+                # epoch keyed on the SEND time (lane_t), matching the
+                # CPU model's judge(now=send time) under faults
+                latv = _tbl(lat, lane_t, srcv,
+                            dstv).astype(jnp.int64)              # [H,K]
+                relv = _tbl(rel, lane_t, srcv, dstv)
             if not HOIST and C > 1:
                 # packet TRAINS: one drop roll per packet, keyed by the
                 # exact (src, pkt_seq0+j) sequence individual sends
@@ -898,10 +947,11 @@ class DeviceEngine:
                 # send still stalls the host one phase, which only
                 # moves the phase boundary, never the per-host pop
                 # order (the trace is bit-identical either way)
-                selflat = lat[host_vertex[gid],
-                              host_vertex[gid]].astype(jnp.int64)
+                hvg = host_vertex[gid][:, None]                  # [H,1]
+                selflat = _tbl(lat, depart, hvg,
+                               hvg).astype(jnp.int64)
                 self_in = send_valid & (dst == gid[:, None]) & \
-                    (depart + selflat[:, None] < win_end)
+                    (depart + selflat < win_end)
                 tim_in = timer_valid & (timer_t < win_end)
                 dirty = dirty | (runnable &
                                  (self_in.any(-1) | tim_in.any(-1)))
@@ -1061,8 +1111,14 @@ class DeviceEngine:
                     relv = relv + jnp.where(
                         m, relf[j], jnp.zeros((), rel.dtype))
             else:
-                latv = lat[srcv, dstv].astype(jnp.int64)
-                relv = rel[srcv, dstv]
+                # epoch keyed on the row's depart time `ft` — equal to
+                # the send time in the hoisted (no-fluid-NIC) path, so
+                # the drop-roll reliability and the latency come from
+                # the same epoch the CPU twin reads. Empty rows
+                # (ft == INF) gather the last epoch harmlessly — they
+                # are masked by is_send everywhere downstream.
+                latv = _tbl(lat, ft, srcv, dstv).astype(jnp.int64)
+                relv = _tbl(rel, ft, srcv, dstv)
 
             # per-row packet-seq base: state["packet_seq"] is already
             # the END of the phase; outbox columns sit in consumption

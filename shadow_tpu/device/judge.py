@@ -43,12 +43,27 @@ class DeviceJudge:
     """Holds the topology matrices on device and a jitted batch-judge."""
 
     def __init__(self, topology, host_vertex: np.ndarray, seed: int,
-                 bootstrap_end: int = 0, min_batch: int = 192):
+                 bootstrap_end: int = 0, min_batch: int = 192,
+                 fault_table=None):
         if (topology.latency_ns > np.iinfo(np.int64).max // 2).any():
             raise ValueError("latency overflow")
+        # fault epochs ride as stacked [T,V,V] matrices + the [T]
+        # epoch start times; the fault-free case keeps the plain
+        # [V,V] matrices and the original program — identical XLA to
+        # before the fault layer
+        if fault_table is not None:
+            ep_times = np.asarray(fault_table.times, dtype=np.int64)
+            lat = np.asarray(fault_table.latency_ns, dtype=np.int64)
+            rel = np.asarray(fault_table.reliability, dtype=np.float32)
+        else:
+            ep_times = np.zeros(1, dtype=np.int64)
+            lat = topology.latency_ns.astype(np.int64)
+            rel = topology.reliability.astype(np.float32)
+        n_epochs = len(ep_times)
+        ep_times_t = jnp.asarray(ep_times)
         self._hv = jnp.asarray(host_vertex.astype(np.int32))
-        self._lat = jnp.asarray(topology.latency_ns.astype(np.int64))
-        self._rel = jnp.asarray(topology.reliability.astype(np.float32))
+        self._lat = jnp.asarray(lat)
+        self._rel = jnp.asarray(rel)
         self._seed_pair = prng.seed_key(seed)
         boot_end = np.int64(bootstrap_end)
         seed_pair = self._seed_pair
@@ -56,9 +71,18 @@ class DeviceJudge:
         def _judge(now, src, dst, pseq, hv, lat, rel):
             sv = hv[src]
             dv = hv[dst]
+            if n_epochs == 1:
+                latv, relv = lat[sv, dv], rel[sv, dv]
+            else:
+                # active epoch at SEND time: count of epoch starts <=
+                # now, minus one — the vectorized twin of the CPU
+                # model's binary search (faults.FaultTable.epoch_of)
+                ep = (now[:, None] >= ep_times_t[None, :]) \
+                    .sum(-1).astype(jnp.int32) - 1
+                latv, relv = lat[ep, sv, dv], rel[ep, sv, dv]
             dropped = packet_drop_mask(seed_pair, boot_end, now, src,
-                                       pseq, rel[sv, dv])
-            return ~dropped, now + lat[sv, dv]
+                                       pseq, relv)
+            return ~dropped, now + latv
 
         self._judge = jax.jit(_judge)
         # adaptive crossover: rounds smaller than this are judged on

@@ -156,6 +156,15 @@ def device_twin(sim) -> DeviceApp:
 
 class DeviceRunner:
     def __init__(self, sim, trace: Optional[list] = None, mesh=None):
+        if getattr(sim, "host_faults", None):
+            # host crash/restart are manager-side events (processes
+            # are killed and respawned) — the device engine has no
+            # manager loop, so these configs run hybrid: CPU host
+            # emulation with the batched device network judge, which
+            # carries the same fault epoch table
+            raise NoDeviceTwin(
+                "host_crash/host_restart faults are manager-side "
+                "events; running hybrid")
         self.app = device_twin(sim)     # raises NoDeviceTwin -> hybrid
         if trace is not None:
             raise ValueError(
@@ -229,6 +238,18 @@ class DeviceRunner:
             "outbox_compact": xp.outbox_compact,
         }
         knobs.update(self._capacity_overrides)
+        # link-fault epoch table (shadow_tpu/faults.py): the engine
+        # carries the stacked [T,V,V] matrices and selects the active
+        # epoch inside the jitted program; without faults it gets the
+        # single base epoch and compiles identically to before
+        ft = getattr(sim, "fault_table", None)
+        if ft is not None:
+            latency_ns, reliability = ft.latency_ns, ft.reliability
+            epoch_times = ft.times
+        else:
+            latency_ns = sim.topology.latency_ns
+            reliability = sim.topology.reliability
+            epoch_times = None
         return DeviceEngine(
             EngineConfig(
                 n_hosts=len(sim.hosts),
@@ -247,8 +268,9 @@ class DeviceRunner:
             ),
             self.app,
             host_vertex=sim.netmodel.host_vertex.astype(np.int32),
-            latency_ns=sim.topology.latency_ns,
-            reliability=sim.topology.reliability,
+            latency_ns=latency_ns,
+            reliability=reliability,
+            epoch_times=epoch_times,
             mesh=self._mesh,
             bw_up_bits=np.array([h.bw_up_bits for h in sim.hosts],
                                 dtype=np.int64),

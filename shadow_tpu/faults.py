@@ -1,0 +1,313 @@
+"""Deterministic fault injection: the epoch-table fault layer.
+
+The network model is otherwise failure-free except for the *static*
+all-pairs reliability matrix (topology/graph.py, core/netmodel.py).
+This module adds scheduled, deterministic faults:
+
+* ``link_down`` / ``link_up`` — a topology edge goes away / comes back
+  at a fixed sim time. With shortest paths enabled traffic re-routes
+  over the surviving edges; pairs left unreachable get reliability 0
+  (every packet between them drops) while keeping the healthy base
+  latency so lookahead windows and the i32 device matrices never
+  change shape.
+* ``degrade`` — for a window ``[time, time+duration)`` an edge's
+  latency is multiplied and/or extra packet loss is composed in
+  (rel' = rel * (1 - extra_packet_loss)).
+* ``host_crash`` / ``host_restart`` — manager-side events
+  (core/manager.py): the host's processes are killed, its pending
+  events quarantined, and at restart the configured processes respawn
+  with a fresh network stack.
+
+The **epoch table** is the whole trick: link faults change the network
+only at a finite set of times, so the schedule compiles — at load
+time, exactly like the base all-pairs matrices — into ``[T]`` epoch
+start times plus stacked ``[T, V, V]`` latency/reliability overrides.
+Every backend then agrees by construction:
+
+* the CPU twin (core/netmodel.py) picks the epoch by binary search on
+  the packet's send time;
+* the hybrid judge (device/judge.py) and the device engine
+  (device/engine.py) carry the stacked arrays on device and select
+  the active epoch with a searchsorted-style comparison inside the
+  jitted program, so per-packet lookups stay batched gathers.
+
+Drop rolls keep their (seed, src, pkt_seq) keys — the fault layer only
+changes the *reliability the roll is compared against* — so traces are
+bit-identical across serial / thread / hybrid / tpu whenever they were
+before. During the bootstrap phase packets are never dropped (the
+reference's bootstrap rule), so a fault window that overlaps
+``general.bootstrap_end_time`` delays losses until bootstrap ends;
+latency changes apply immediately.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from shadow_tpu.topology.graph import (
+    Topology,
+    compute_path_matrices,
+    dense_adjacency,
+)
+
+LINK_KINDS = ("link_down", "link_up", "degrade")
+HOST_KINDS = ("host_crash", "host_restart")
+FAULT_KINDS = LINK_KINDS + HOST_KINDS
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One validated ``network.faults`` entry (config/schema.py)."""
+
+    kind: str
+    time: int                      # sim ns (degrade: window start)
+    source: int = -1               # topology GML vertex ids (link kinds)
+    target: int = -1
+    duration: int = 0              # degrade window length, ns
+    latency_multiplier: float = 1.0
+    extra_packet_loss: float = 0.0
+    host: str = ""                 # host kinds: configured host name
+
+
+@dataclass
+class FaultTable:
+    """The compiled link-fault schedule: epoch start times plus one
+    [V,V] latency/reliability override pair per epoch. ``times[0]`` is
+    always 0 (the healthy base matrices), so every send time maps to
+    exactly one epoch."""
+
+    times: np.ndarray              # [T] int64, ascending, times[0]==0
+    latency_ns: np.ndarray         # [T,V,V] int64
+    reliability: np.ndarray        # [T,V,V] float32
+    events: list = field(default_factory=list)
+
+    @property
+    def n_epochs(self) -> int:
+        return len(self.times)
+
+    @property
+    def min_latency_ns(self) -> int:
+        """Conservative lookahead floor across every epoch — a degrade
+        can only keep or raise the window, never shrink it under a
+        backend's feet (all backends consume the same value)."""
+        return int(self.latency_ns.min())
+
+    def epoch_of(self, now: int) -> int:
+        """Active epoch at send time `now`: the largest i with
+        times[i] <= now (binary search; the device engines compute the
+        identical index with a vectorized comparison count)."""
+        return int(np.searchsorted(self.times, now, side="right") - 1)
+
+    def lookup(self, now: int, src_vertex: int,
+               dst_vertex: int) -> tuple[int, float]:
+        e = self.epoch_of(now)
+        return (int(self.latency_ns[e, src_vertex, dst_vertex]),
+                float(self.reliability[e, src_vertex, dst_vertex]))
+
+    def fingerprint(self) -> str:
+        """Stable digest of the compiled schedule, for tools and logs.
+        (Checkpoint resume-safety does not go through this method:
+        device/checkpoint.py folds the engine's epoch_times and the
+        stacked matrices into its world hash directly, so a saved
+        state already refuses an edited fault schedule.)"""
+        h = hashlib.sha256()
+        for a in (self.times, self.latency_ns, self.reliability):
+            a = np.ascontiguousarray(a)
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+        return h.hexdigest()[:12]
+
+
+def split_events(events) -> tuple[list, list]:
+    """(link_events, host_events), each in schedule order."""
+    link = [e for e in events or () if e.kind in LINK_KINDS]
+    host = [e for e in events or () if e.kind in HOST_KINDS]
+    return link, host
+
+
+def _edge_indices(top: Topology, ev: FaultEvent) -> list[int]:
+    """Indices of every (parallel) edge between the event's endpoints.
+    GML ids resolve through the topology; a fault on a nonexistent
+    edge is a config error, caught at load time."""
+    try:
+        s = top.vertex_index_for_id(ev.source)
+        d = top.vertex_index_for_id(ev.target)
+    except Exception:
+        raise ValueError(
+            f"network.faults: {ev.kind} at {ev.time} ns references "
+            f"unknown vertex id(s) {ev.source}->{ev.target}")
+    hit = [k for k in range(len(top.edge_src))
+           if (top.edge_src[k] == s and top.edge_dst[k] == d)
+           or (not top.directed
+               and top.edge_src[k] == d and top.edge_dst[k] == s)]
+    if not hit:
+        raise ValueError(
+            f"network.faults: {ev.kind} at {ev.time} ns names edge "
+            f"{ev.source}->{ev.target}, but the graph has no such "
+            "edge")
+    return hit
+
+
+def compile_link_faults(top: Topology,
+                        events: list) -> Optional[FaultTable]:
+    """Compile the link-fault schedule into a FaultTable (None when no
+    link events are configured — the fault-free fast paths stay
+    byte-identical to before). Validates pairing (link_up must undo an
+    earlier link_down; no double-down), then rebuilds the all-pairs
+    matrices per epoch from the modified edge set using the same
+    dense_adjacency + compute_path_matrices pipeline as the base
+    topology."""
+    if not events:
+        return None
+
+    for ev in events:
+        if ev.time < 0:
+            raise ValueError(
+                f"network.faults: {ev.kind} has negative time")
+        if ev.kind == "degrade":
+            if ev.duration <= 0:
+                raise ValueError(
+                    f"network.faults: degrade at {ev.time} ns needs "
+                    "duration > 0")
+            if ev.latency_multiplier <= 0:
+                raise ValueError(
+                    f"network.faults: degrade at {ev.time} ns needs "
+                    "latency_multiplier > 0")
+            if not (0.0 <= ev.extra_packet_loss <= 1.0):
+                raise ValueError(
+                    f"network.faults: degrade at {ev.time} ns "
+                    "extra_packet_loss must be in [0,1]")
+            if ev.latency_multiplier == 1.0 and \
+                    ev.extra_packet_loss == 0.0:
+                raise ValueError(
+                    f"network.faults: degrade at {ev.time} ns changes "
+                    "nothing (latency_multiplier 1 and "
+                    "extra_packet_loss 0)")
+
+    # resolve endpoints once; pair-key = frozenset-ish sorted vertex
+    # tuple for undirected graphs so down/up pairing matches an event
+    # written in either direction
+    def pair_key(ev):
+        ids = _edge_indices(top, ev)
+        s = top.vertex_index_for_id(ev.source)
+        d = top.vertex_index_for_id(ev.target)
+        key = (s, d) if top.directed else tuple(sorted((s, d)))
+        return key, ids
+
+    # sweep in (time, config order) to validate down/up pairing
+    down_at: dict = {}
+    ordered = sorted(range(len(events)), key=lambda i: (events[i].time, i))
+    keyed = [pair_key(e) for e in events]
+    for i in ordered:
+        ev = events[i]
+        key, _ = keyed[i]
+        if ev.kind == "link_down":
+            if key in down_at:
+                raise ValueError(
+                    f"network.faults: link_down at {ev.time} ns on "
+                    f"edge {ev.source}->{ev.target}, but the link is "
+                    f"already down (since {down_at[key]} ns)")
+            down_at[key] = ev.time
+        elif ev.kind == "link_up":
+            if key not in down_at:
+                raise ValueError(
+                    f"network.faults: link_up at {ev.time} ns on edge "
+                    f"{ev.source}->{ev.target} without a preceding "
+                    "link_down")
+            if down_at[key] == ev.time:
+                raise ValueError(
+                    f"network.faults: link_down and link_up on edge "
+                    f"{ev.source}->{ev.target} at the same instant "
+                    f"({ev.time} ns) is ambiguous")
+            del down_at[key]
+
+    # epoch boundaries: 0 plus every instant the edge state changes
+    bounds = {0}
+    for ev in events:
+        bounds.add(ev.time)
+        if ev.kind == "degrade":
+            bounds.add(ev.time + ev.duration)
+    times = np.array(sorted(bounds), dtype=np.int64)
+
+    V = top.n_vertices
+    base_lat, base_rel = top.latency_ns, top.reliability
+    lat_epochs, rel_epochs = [], []
+    for t in times:
+        # edge state active at time t
+        down_edges: set[int] = set()
+        for i in ordered:
+            ev = events[i]
+            if ev.time > t:
+                break
+            _, eids = keyed[i]
+            if ev.kind == "link_down":
+                down_edges.update(eids)
+            elif ev.kind == "link_up":
+                down_edges.difference_update(eids)
+        degrades = [(events[i], keyed[i][1]) for i in ordered
+                    if events[i].kind == "degrade"
+                    and events[i].time <= t
+                    < events[i].time + events[i].duration]
+        if not down_edges and not degrades:
+            lat_epochs.append(base_lat)
+            rel_epochs.append(base_rel)
+            continue
+        elat = top.edge_latency_ns.copy()
+        erel = top.edge_reliability.astype(np.float64)
+        alive = np.ones(len(elat), dtype=bool)
+        for k in down_edges:
+            alive[k] = False
+        for ev, eids in degrades:
+            for k in eids:
+                elat[k] = max(1, int(round(
+                    int(elat[k]) * ev.latency_multiplier)))
+                erel[k] = erel[k] * (1.0 - ev.extra_packet_loss)
+        direct_lat, direct_rel = dense_adjacency(
+            V, top.directed, top.edge_src, top.edge_dst, elat,
+            erel.astype(np.float32), edge_alive=alive)
+        lat, rel = compute_path_matrices(
+            direct_lat, direct_rel, top.use_shortest_path,
+            unreachable_lat=base_lat)
+        lat_epochs.append(lat)
+        rel_epochs.append(rel)
+
+    return FaultTable(times=times,
+                      latency_ns=np.stack(lat_epochs).astype(np.int64),
+                      reliability=np.stack(rel_epochs)
+                      .astype(np.float32),
+                      events=list(events))
+
+
+def resolve_host_faults(events: list,
+                        name_to_id: dict) -> list[tuple[int, int, str]]:
+    """Validate host_crash/host_restart events against the built host
+    list: names must resolve (group-expanded names like ``client0``),
+    and each host's schedule must alternate crash -> restart. Returns
+    [(time, host_id, kind)] sorted by time."""
+    out: list[tuple[int, int, str]] = []
+    state: dict[int, str] = {}
+    for ev in sorted(events, key=lambda e: e.time):
+        if ev.time < 0:
+            raise ValueError(
+                f"network.faults: {ev.kind} has negative time")
+        hid = name_to_id.get(ev.host)
+        if hid is None:
+            raise ValueError(
+                f"network.faults: {ev.kind} at {ev.time} ns names "
+                f"unknown host {ev.host!r}")
+        prev = state.get(hid, "up")
+        if ev.kind == "host_crash" and prev == "down":
+            raise ValueError(
+                f"network.faults: host_crash at {ev.time} ns, but "
+                f"{ev.host!r} is already crashed")
+        if ev.kind == "host_restart" and prev == "up":
+            raise ValueError(
+                f"network.faults: host_restart at {ev.time} ns "
+                f"without a preceding host_crash of {ev.host!r}")
+        state[hid] = "down" if ev.kind == "host_crash" else "up"
+        out.append((ev.time, hid, ev.kind))
+    return out

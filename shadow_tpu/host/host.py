@@ -42,6 +42,15 @@ class Host:
     _packet_seq: int = 0
     _app_seq: int = 0
 
+    # fault injection (core/manager.py KIND_HOST_CRASH/RESTART): a
+    # crashed host executes nothing — its pending events are
+    # quarantined (counted, packet kinds also count as drops) until
+    # the restart respawns the configured processes via `respawn`
+    # [(factory, start_time, stop_time, is_model)] captured at build
+    crashed: bool = False
+    events_quarantined: int = 0
+    respawn: Optional[list] = None
+
     # per-host stats (Tracker-lite; grows into host/tracker.py)
     events_executed: int = 0
     packets_sent: int = 0

@@ -398,16 +398,18 @@ class ManagedProcess:
             argv = [self.path] + self.args
 
             def preexec():
-                try:
-                    hard = resource.getrlimit(
-                        resource.RLIMIT_NOFILE)[1]
-                    lim = VFD_BASE \
-                        if hard == resource.RLIM_INFINITY \
-                        else min(VFD_BASE, hard)
-                    resource.setrlimit(resource.RLIMIT_NOFILE,
-                                       (lim, lim))
-                except (ValueError, OSError):
-                    pass
+                # a failed cap must fail the spawn LOUDLY (subprocess
+                # re-raises preexec exceptions in the parent): a
+                # native fd landing in the virtual window [600,1024)
+                # would be misclassified as one of ours, and the
+                # divergence surfaces far from this cause
+                hard = resource.getrlimit(
+                    resource.RLIMIT_NOFILE)[1]
+                lim = VFD_BASE \
+                    if hard == resource.RLIM_INFINITY \
+                    else min(VFD_BASE, hard)
+                resource.setrlimit(resource.RLIMIT_NOFILE,
+                                   (lim, lim))
 
         self.proc = subprocess.Popen(
             argv, env=env, cwd=host_dir, stdout=stdout_f,

@@ -115,6 +115,7 @@ NR_NAME = {v: k for k, v in NR.items()}
 
 # errno
 EPERM, ENOENT, EINTR, EBADF, EAGAIN, EFAULT, EINVAL = 1, 2, 4, 9, 11, 14, 22
+ENXIO = 6
 ECHILD = 10
 ENOTTY, ESPIPE, EPIPE, ENOSYS, ENOTSOCK, EDESTADDRREQ = 25, 29, 32, 38, 88, 89
 EMSGSIZE, ENOPROTOOPT, EPROTONOSUPPORT, EOPNOTSUPP, EAFNOSUPPORT = \
@@ -1495,6 +1496,14 @@ class SyscallHandler:
             try:
                 data = os.read(desc.osfd, min(n, 1 << 20))
             except OSError as e:
+                # FIFOs open host-side with O_NONBLOCK (the blocking
+                # open emulation, _open_fifo); a blocking app fd must
+                # park on the readiness poll, not see EAGAIN
+                if e.errno == EAGAIN and \
+                        getattr(desc, "is_fifo", False) and \
+                        not desc.nonblock:
+                    raise Blocked(deadline=ctx.now
+                                  + self._FIFO_POLL_NS)
                 return -e.errno
             if data:
                 self.mem.write(buf, data)
@@ -1528,6 +1537,12 @@ class SyscallHandler:
             try:
                 return os.write(desc.osfd, data)
             except OSError as e:
+                # full FIFO + blocking app fd: park (see sys_read)
+                if e.errno == EAGAIN and \
+                        getattr(desc, "is_fifo", False) and \
+                        not desc.nonblock:
+                    raise Blocked(deadline=ctx.now
+                                  + self._FIFO_POLL_NS)
                 return -e.errno
         return -EINVAL
 
@@ -1864,9 +1879,108 @@ class SyscallHandler:
         r = self._resolve_at(dirfd, path)
         if r is NATIVE or isinstance(r, int):
             return r
-        return self._open_host_file(r, flags, mode)
+        return self._open_host_file(ctx, r, flags, mode)
 
-    def _open_host_file(self, abspath: str, flags: int, mode: int):
+    # -- FIFO open emulation -------------------------------------------
+    # A blocking open() of a FIFO waits for the PEER end (reader for
+    # O_WRONLY, writer for O_RDONLY). The old passthrough os.open
+    # wedged the whole simulator thread in a host-side blocking open
+    # (ADVICE r5 medium): the writer process could never be scheduled
+    # to unblock it — a whole-simulation deadlock. FIFOs now open
+    # host-side with O_NONBLOCK always, and blocking-open semantics
+    # are emulated with the Blocked/readiness machinery like the
+    # socket paths: a per-host registry tracks open ends and parked
+    # openers, and blocked opens poll on a short sim-time deadline
+    # (the flock pattern) until the peer end exists.
+    _FIFO_POLL_NS = 1_000_000       # 1 ms sim-time re-check
+
+    def _fifo_registry(self) -> dict:
+        t = getattr(self.p.host, "_fifo_registry", None)
+        if t is None:
+            t = self.p.host._fifo_registry = {}
+        return t
+
+    def _open_fifo(self, ctx, abspath: str, rp: str, flags: int,
+                   mode: int):
+        reg = self._fifo_registry().setdefault(
+            rp, {"open": {}, "pending": {}})
+        # prune closed descriptors and dead parked openers lazily.
+        # Pending entries carry the sim time of their LAST poll and
+        # expire after two poll periods: an abandoned open (process
+        # interrupted mid-park, path unlinked so the retry never
+        # reaches this function again) must not leave a phantom peer
+        # that admits later openers into wrong semantics — a live
+        # parked opener refreshes its entry every poll.
+        for d in [d for d in reg["open"] if d.closed]:
+            del reg["open"][d]
+        stale = ctx.now - 2 * self._FIFO_POLL_NS
+        for tok in [t for t, (proc, _, treg) in reg["pending"].items()
+                    if not getattr(proc, "alive", True)
+                    or treg < stale]:
+            del reg["pending"][tok]
+        readers = any(m in ("r", "rw") for m in reg["open"].values())
+        pend_w = any(m == "w"
+                     for _, m, _t in reg["pending"].values())
+        nonblock = bool(flags & O_NONBLOCK)
+        acc = flags & 3                       # O_ACCMODE
+        st = self.state
+
+        def _park(want):
+            tok = st.get("fifo_tok")
+            if tok is None:
+                tok = st["fifo_tok"] = object()
+            reg["pending"][tok] = (self.p, want, ctx.now)
+            raise Blocked(deadline=ctx.now + self._FIFO_POLL_NS)
+
+        def _unpark():
+            tok = st.pop("fifo_tok", None)
+            if tok is not None:
+                reg["pending"].pop(tok, None)
+
+        if acc == 0:                          # O_RDONLY
+            want = "r"
+            # the kernel blocks a read-only open until a WRITER end
+            # exists — other readers are irrelevant (fifo(7)). A
+            # pending blocked writer counts: admitting the reader
+            # first gives the real FIFO a reader fd, so the writer's
+            # next poll can host-open successfully (both ends of the
+            # classic simultaneous blocking open complete)
+            ok = nonblock or pend_w or \
+                any(m in ("w", "rw") for m in reg["open"].values())
+        elif acc == 1:                        # O_WRONLY
+            want = "w"
+            if nonblock and not readers:
+                _unpark()
+                return -ENXIO                 # kernel semantics
+            ok = readers
+        else:                                 # O_RDWR never blocks
+            want = "rw"
+            ok = True
+        if not ok:
+            _park(want)
+        try:
+            osfd = os.open(abspath,
+                           (flags & ~self.O_CLOEXEC_FLAG)
+                           | os.O_CLOEXEC | O_NONBLOCK, mode)
+        except OSError as e:
+            if e.errno == ENXIO and not nonblock:  # raced a closing
+                _park(want)                        # reader — wait on
+            _unpark()
+            return -e.errno
+        _unpark()
+        d = HostFileDesc(osfd, abspath, flags, mode)
+        d.realpath = rp
+        d.is_fifo = True
+        # the APP's view of the flags: nonblock only if it asked
+        d.nonblock = nonblock
+        reg["open"][d] = want
+        fd = self.table.alloc(d)
+        if flags & self.O_CLOEXEC_FLAG:
+            self.table.cloexec.add(fd)
+        return fd
+
+    def _open_host_file(self, ctx, abspath: str, flags: int,
+                        mode: int):
         # a symlink chain may point OUTSIDE the data dir: realpath the
         # full target (if it exists) before opening through it
         rp = os.path.realpath(abspath)
@@ -1875,6 +1989,14 @@ class SyscallHandler:
         if not self.table.has_room():
             return -EMFILE      # BEFORE os.open: a TableFull after
                                 # it would leak the simulator-side fd
+        try:
+            if os.path.exists(rp):
+                import stat as _stat
+                if _stat.S_ISFIFO(os.stat(rp).st_mode):
+                    return self._open_fifo(ctx, abspath, rp, flags,
+                                           mode)
+        except OSError:
+            pass                # races fall through to the real open
         try:
             osfd = os.open(abspath,
                            (flags & ~self.O_CLOEXEC_FLAG)

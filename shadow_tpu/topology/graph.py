@@ -60,6 +60,182 @@ ONE_GBIT_SWITCH_GML = """graph [
 _MIN_PATH_LATENCY_NS = simtime.SIMTIME_ONE_MILLISECOND  # 0-latency clamp
 
 
+def dense_adjacency(n_vertices: int, directed: bool,
+                    edge_src: np.ndarray, edge_dst: np.ndarray,
+                    edge_latency_ns: np.ndarray,
+                    edge_reliability: np.ndarray,
+                    edge_alive: Optional[np.ndarray] = None
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    """Dense [V,V] direct-edge latency (ns; 0 = no edge) and
+    reliability matrices, keeping the cheapest parallel edge.
+    `edge_alive` (bool [E], default all-True) masks edges out — the
+    fault layer (shadow_tpu/faults.py) removes downed links through
+    it, so an epoch's adjacency is built by the SAME code path as the
+    base topology's."""
+    V = n_vertices
+    lat = np.zeros((V, V), dtype=np.int64)
+    rel = np.zeros((V, V), dtype=np.float32)
+
+    def _store(s, d, l, r):
+        if lat[s, d] == 0 or l < lat[s, d]:
+            lat[s, d] = l
+            rel[s, d] = r
+
+    for k, (s, d, l, r) in enumerate(zip(edge_src, edge_dst,
+                                         edge_latency_ns,
+                                         edge_reliability)):
+        if edge_alive is not None and not edge_alive[k]:
+            continue
+        _store(s, d, l, r)
+        if not directed:
+            _store(d, s, l, r)
+    return lat, rel
+
+
+def compute_path_matrices(direct_lat: np.ndarray, direct_rel: np.ndarray,
+                          use_shortest_path: bool,
+                          unreachable_lat: Optional[np.ndarray] = None
+                          ) -> tuple[np.ndarray, np.ndarray]:
+    """All-pairs (latency, reliability) path matrices from a dense
+    direct-edge adjacency — the core of Topology._compute_paths,
+    reusable per fault epoch with a modified edge set.
+
+    `unreachable_lat`: None = a disconnected pair raises GmlError (the
+    base-topology contract, topology.c:659-716); otherwise a [V,V]
+    latency matrix whose entries stand in for unreachable pairs (the
+    fault layer passes the healthy base matrix) with reliability 0 —
+    the pair is undeliverable (every drop roll fails) but the latency
+    stays finite so lookahead windows and the i32 device matrices are
+    unaffected."""
+    V = direct_lat.shape[0]
+
+    if not use_shortest_path:
+        path_lat = direct_lat.copy()
+        path_rel = direct_rel.copy()
+        # fault epochs only (unreachable_lat set): a zero off-diagonal
+        # entry means a downed link on this complete graph — mark it
+        # unreachable instead of letting the zero-latency clamp below
+        # resurrect it as a 1 ms lossless path. The base topology
+        # (unreachable_lat None) keeps the legacy clamp semantics
+        # byte for byte (completeness is enforced upstream anyway).
+        if unreachable_lat is not None:
+            miss = (path_lat <= 0) & ~np.eye(V, dtype=bool)
+            if miss.any():
+                path_rel = np.where(miss, 0.0, path_rel)
+                path_lat = np.where(miss, unreachable_lat, path_lat)
+    else:
+        path_lat, path_rel = _all_pairs_shortest(direct_lat, direct_rel,
+                                                 unreachable_lat)
+
+    # Self paths (topology.c:1431-1576): self-loop edge as-is,
+    # otherwise cheapest incident edge doubled.
+    for v in range(V):
+        options: list[tuple[int, float]] = []
+        if direct_lat[v, v] > 0:
+            options.append((int(direct_lat[v, v]),
+                            float(direct_rel[v, v])))
+        out = [(int(2 * direct_lat[v, u]), float(direct_rel[v, u] ** 2))
+               for u in range(V) if u != v and direct_lat[v, u] > 0]
+        options.extend(out)
+        if options:
+            path_lat[v, v], path_rel[v, v] = min(options)
+        else:
+            path_lat[v, v], path_rel[v, v] = 0, 1.0
+
+    # Clamp only *zero*-latency paths to 1 ms like the reference
+    # (topology.c:1788) — sub-millisecond edges are legitimate.
+    zero = path_lat <= 0
+    if zero.any():
+        path_rel = np.where(zero, 1.0, path_rel)
+        path_lat = np.where(zero, _MIN_PATH_LATENCY_NS, path_lat)
+
+    return path_lat.astype(np.int64), path_rel.astype(np.float32)
+
+
+def _all_pairs_shortest(direct_lat: np.ndarray, direct_rel: np.ndarray,
+                        unreachable_lat: Optional[np.ndarray]
+                        ) -> tuple[np.ndarray, np.ndarray]:
+    """All-pairs Dijkstra by latency; reliability is accumulated
+    along the chosen (latency-)shortest path via the predecessor
+    tree, replacing the reference's lazy per-source
+    igraph_get_shortest_paths_dijkstra (topology.c:1682-1701)."""
+    V = direct_lat.shape[0]
+    try:
+        from scipy.sparse import csr_matrix
+        from scipy.sparse.csgraph import dijkstra
+    except ImportError:
+        return _all_pairs_minplus(direct_lat, direct_rel,
+                                  unreachable_lat)
+
+    # Exclude self-loops from transit paths (the reference's Dijkstra
+    # operates on the simple graph; self paths are computed separately).
+    w = direct_lat.astype(np.float64)
+    np.fill_diagonal(w, 0.0)
+    graph = csr_matrix(w)
+    dist, pred = dijkstra(graph, directed=True, return_predecessors=True)
+    unreachable = np.isinf(dist)
+    if unreachable.any() and unreachable_lat is None:
+        raise GmlError("graph is not connected (no path between some "
+                       "vertex pair)")
+
+    # Walk the predecessor tree breadth-first from each source:
+    # rel[s,d] = rel[s,pred[d]] * edge_rel[pred[d],d]. Hop levels are
+    # found by fixpoint (hops[s,d] = hops[s,pred]+1), <= diameter
+    # iterations of O(V^2) vectorized work.
+    hops = np.full((V, V), -1, dtype=np.int64)
+    np.fill_diagonal(hops, 0)
+    for _ in range(V):
+        pending = (pred >= 0) & (hops < 0)
+        if not pending.any():
+            break
+        s_idx, d_idx = np.nonzero(pending)
+        parent_hops = hops[s_idx, pred[s_idx, d_idx]]
+        ready = parent_hops >= 0
+        if not ready.any():
+            break
+        hops[s_idx[ready], d_idx[ready]] = parent_hops[ready] + 1
+
+    rel = np.zeros((V, V), dtype=np.float64)
+    np.fill_diagonal(rel, 1.0)
+    for h in range(1, int(hops.max()) + 1):
+        s_idx, d_idx = np.nonzero(hops == h)
+        pr = pred[s_idx, d_idx]
+        rel[s_idx, d_idx] = rel[s_idx, pr] * direct_rel[pr, d_idx]
+
+    lat = np.rint(np.where(unreachable, 0.0, dist)).astype(np.int64)
+    if unreachable.any():
+        lat = np.where(unreachable, unreachable_lat, lat)
+        rel = np.where(unreachable, 0.0, rel)
+    return lat, rel.astype(np.float32)
+
+
+def _all_pairs_minplus(direct_lat: np.ndarray, direct_rel: np.ndarray,
+                       unreachable_lat: Optional[np.ndarray]
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """Dense Floyd-Warshall carrying reliability, scipy-free."""
+    V = direct_lat.shape[0]
+    # float64 avoids int64 INF+INF overflow; ns latencies are far
+    # below 2**53 so the arithmetic stays exact.
+    lat = np.where(direct_lat > 0, direct_lat.astype(np.float64), np.inf)
+    np.fill_diagonal(lat, 0.0)
+    rel = np.where(direct_lat > 0, direct_rel.astype(np.float64), 0.0)
+    np.fill_diagonal(rel, 1.0)
+    for k in range(V):
+        via = lat[:, k, None] + lat[None, k, :]
+        better = via < lat
+        lat = np.where(better, via, lat)
+        rel = np.where(better, rel[:, k, None] * rel[None, k, :], rel)
+    unreachable = np.isinf(lat)
+    if unreachable.any():
+        if unreachable_lat is None:
+            raise GmlError("graph is not connected (no path between "
+                           "some vertex pair)")
+        lat = np.where(unreachable, unreachable_lat.astype(np.float64),
+                       lat)
+        rel = np.where(unreachable, 0.0, rel)
+    return np.rint(lat).astype(np.int64), rel.astype(np.float32)
+
+
 def _parse_edge_latency_ns(value) -> int:
     """Edge latency: unit string ("50 ms") per the reference's
     _topology_findEdgeAttributeStringTimeMs; bare numbers are taken as
@@ -195,23 +371,10 @@ class Topology:
 
     # ------------------------------------------------------------------
     def _adjacency(self) -> tuple[np.ndarray, np.ndarray]:
-        """Dense [V,V] direct-edge latency (ns; 0 = no edge) and
-        reliability matrices, keeping the cheapest parallel edge."""
-        V = self.n_vertices
-        lat = np.zeros((V, V), dtype=np.int64)
-        rel = np.zeros((V, V), dtype=np.float32)
-
-        def _store(s, d, l, r):
-            if lat[s, d] == 0 or l < lat[s, d]:
-                lat[s, d] = l
-                rel[s, d] = r
-
-        for s, d, l, r in zip(self.edge_src, self.edge_dst,
-                              self.edge_latency_ns, self.edge_reliability):
-            _store(s, d, l, r)
-            if not self.directed:
-                _store(d, s, l, r)
-        return lat, rel
+        return dense_adjacency(self.n_vertices, self.directed,
+                               self.edge_src, self.edge_dst,
+                               self.edge_latency_ns,
+                               self.edge_reliability)
 
     def _check_connected(self) -> None:
         """Single (strongly-)connected component (topology.c:659-716)."""
@@ -254,109 +417,6 @@ class Topology:
 
     # ------------------------------------------------------------------
     def _compute_paths(self) -> None:
-        V = self.n_vertices
         direct_lat, direct_rel = self._adjacency()
-
-        if not self.use_shortest_path:
-            path_lat = direct_lat.copy()
-            path_rel = direct_rel.copy()
-        else:
-            path_lat, path_rel = self._all_pairs_shortest(direct_lat,
-                                                          direct_rel)
-
-        # Self paths (topology.c:1431-1576): self-loop edge as-is,
-        # otherwise cheapest incident edge doubled.
-        for v in range(V):
-            options: list[tuple[int, float]] = []
-            if direct_lat[v, v] > 0:
-                options.append((int(direct_lat[v, v]),
-                                float(direct_rel[v, v])))
-            out = [(int(2 * direct_lat[v, u]), float(direct_rel[v, u] ** 2))
-                   for u in range(V) if u != v and direct_lat[v, u] > 0]
-            options.extend(out)
-            if options:
-                path_lat[v, v], path_rel[v, v] = min(options)
-            else:
-                path_lat[v, v], path_rel[v, v] = 0, 1.0
-
-        # Clamp only *zero*-latency paths to 1 ms like the reference
-        # (topology.c:1788) — sub-millisecond edges are legitimate.
-        zero = path_lat <= 0
-        if zero.any():
-            path_rel = np.where(zero, 1.0, path_rel)
-            path_lat = np.where(zero, _MIN_PATH_LATENCY_NS, path_lat)
-
-        self.latency_ns = path_lat.astype(np.int64)
-        self.reliability = path_rel.astype(np.float32)
-
-    def _all_pairs_shortest(self, direct_lat: np.ndarray,
-                            direct_rel: np.ndarray
-                            ) -> tuple[np.ndarray, np.ndarray]:
-        """All-pairs Dijkstra by latency; reliability is accumulated
-        along the chosen (latency-)shortest path via the predecessor
-        tree, replacing the reference's lazy per-source
-        igraph_get_shortest_paths_dijkstra (topology.c:1682-1701)."""
-        V = self.n_vertices
-        try:
-            from scipy.sparse import csr_matrix
-            from scipy.sparse.csgraph import dijkstra
-        except ImportError:
-            return self._all_pairs_minplus(direct_lat, direct_rel)
-
-        # Exclude self-loops from transit paths (the reference's Dijkstra
-        # operates on the simple graph; self paths are computed separately).
-        w = direct_lat.astype(np.float64)
-        np.fill_diagonal(w, 0.0)
-        graph = csr_matrix(w)
-        dist, pred = dijkstra(graph, directed=True, return_predecessors=True)
-        if np.isinf(dist).any():
-            raise GmlError("graph is not connected (no path between some "
-                           "vertex pair)")
-
-        # Walk the predecessor tree breadth-first from each source:
-        # rel[s,d] = rel[s,pred[d]] * edge_rel[pred[d],d]. Hop levels are
-        # found by fixpoint (hops[s,d] = hops[s,pred]+1), <= diameter
-        # iterations of O(V^2) vectorized work.
-        hops = np.full((V, V), -1, dtype=np.int64)
-        np.fill_diagonal(hops, 0)
-        for _ in range(V):
-            pending = (pred >= 0) & (hops < 0)
-            if not pending.any():
-                break
-            s_idx, d_idx = np.nonzero(pending)
-            parent_hops = hops[s_idx, pred[s_idx, d_idx]]
-            ready = parent_hops >= 0
-            if not ready.any():
-                break
-            hops[s_idx[ready], d_idx[ready]] = parent_hops[ready] + 1
-
-        rel = np.zeros((V, V), dtype=np.float64)
-        np.fill_diagonal(rel, 1.0)
-        for h in range(1, int(hops.max()) + 1):
-            s_idx, d_idx = np.nonzero(hops == h)
-            pr = pred[s_idx, d_idx]
-            rel[s_idx, d_idx] = rel[s_idx, pr] * direct_rel[pr, d_idx]
-
-        lat = np.rint(dist).astype(np.int64)
-        return lat, rel.astype(np.float32)
-
-    def _all_pairs_minplus(self, direct_lat: np.ndarray,
-                           direct_rel: np.ndarray
-                           ) -> tuple[np.ndarray, np.ndarray]:
-        """Dense Floyd-Warshall carrying reliability, scipy-free."""
-        V = self.n_vertices
-        # float64 avoids int64 INF+INF overflow; ns latencies are far
-        # below 2**53 so the arithmetic stays exact.
-        lat = np.where(direct_lat > 0, direct_lat.astype(np.float64), np.inf)
-        np.fill_diagonal(lat, 0.0)
-        rel = np.where(direct_lat > 0, direct_rel.astype(np.float64), 0.0)
-        np.fill_diagonal(rel, 1.0)
-        for k in range(V):
-            via = lat[:, k, None] + lat[None, k, :]
-            better = via < lat
-            lat = np.where(better, via, lat)
-            rel = np.where(better, rel[:, k, None] * rel[None, k, :], rel)
-        if np.isinf(lat).any():
-            raise GmlError("graph is not connected (no path between some "
-                           "vertex pair)")
-        return np.rint(lat).astype(np.int64), rel.astype(np.float32)
+        self.latency_ns, self.reliability = compute_path_matrices(
+            direct_lat, direct_rel, self.use_shortest_path)

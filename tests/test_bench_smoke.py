@@ -39,3 +39,36 @@ def test_bench_smoke_emits_valid_json(tmp_path):
         occ = json.load(f)
     assert occ["measured"]["outbox_rows_max"] > 0
     assert occ["workload"]["n_hosts"] == 100
+
+
+@pytest.mark.slow
+def test_bench_cpu_fallback_ladder_branch(tmp_path):
+    """The cpu-fallback ladder branch — the untested path that
+    produced the BENCH_r05 0.0 (the 2.0s tgen_1000 slice ended exactly
+    at the clients' 2s start_time, dividing by zero). Driven directly
+    via BENCH_FORCE_FALLBACK (not the JAX_PLATFORMS=cpu non-fallback
+    path the smoke test above pins): the record must carry nonzero
+    numbers plus the NAMED tpu-unavailable diagnostic — never a bare
+    ZeroDivisionError."""
+    env = dict(os.environ,
+               BENCH_SMOKE="1",
+               BENCH_FORCE_FALLBACK="1",
+               SHADOW_TPU_OCC_DIR=str(tmp_path))
+    env.pop("JAX_PLATFORMS", None)     # the fallback forces cpu itself
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        cwd=ROOT, env=env, capture_output=True, text=True,
+        timeout=900)
+    lines = [ln for ln in p.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, p.stdout + p.stderr
+    result = json.loads(lines[0])
+    # fallback exits nonzero BY CONTRACT, with the named diagnostic —
+    # a CPU-vs-CPU ratio must never masquerade as a device benchmark
+    assert p.returncode == 1, (result, p.stderr[-2000:])
+    assert "tpu backend unavailable" in result.get("error", ""), result
+    assert "division" not in result.get("error", ""), result
+    # ... but the record still carries real numbers from the slice
+    assert result["value"] > 0, (result, p.stderr[-2000:])
+    assert result["platform"] == "cpu"
+    assert result["vs_baseline"] is None
+    assert result["ladder"]["tgen_100"]["speedup"] > 0

@@ -183,8 +183,9 @@ def test_large_random_graph_paths_match_floyd():
     lines.append("]")
     gml = "\n".join(lines)
     top = Topology.from_gml(gml)
+    from shadow_tpu.topology.graph import _all_pairs_minplus
     direct_lat, direct_rel = top._adjacency()
-    fb_lat, fb_rel = top._all_pairs_minplus(direct_lat, direct_rel)
+    fb_lat, fb_rel = _all_pairs_minplus(direct_lat, direct_rel, None)
     off = ~np.eye(V, dtype=bool)
     np.testing.assert_array_equal(top.latency_ns[off], fb_lat[off])
     np.testing.assert_allclose(top.reliability[off], fb_rel[off], rtol=1e-5)
