@@ -19,6 +19,17 @@ then runs the config once per policy and additionally requires every
 policy's per-host signature to be bit-identical to the first's — the
 cross-policy determinism matrix (the fault-injection CI rung pins
 serial/thread/tpu on examples/tgen_faults.yaml this way).
+
+`--ensemble` switches to the CAMPAIGN gate (shadow_tpu/ensemble/):
+the config must carry an `ensemble:` block. The gate runs the
+campaign twice (run-to-run bit-identity over every replica), then
+extracts replica `--replica` (default 0) and requires its per-host
+signature to bit-match a STANDALONE run with that replica's
+parameters under each `--policy` entry (default serial,tpu) — the
+replica-i == standalone-i contract the ensemble engine guarantees.
+Standalone runs pin experimental.runahead to the campaign's shared
+lookahead (the min over all replicas' tables), since the window
+sequence is part of the trace.
 """
 
 from __future__ import annotations
@@ -71,13 +82,127 @@ def compare_trees(a: str, b: str) -> list[str]:
     return diffs
 
 
+def run_ensemble_gate(config: str, policies: list[str],
+                      replica: int) -> int:
+    """Campaign determinism gate: run-to-run bit-identity of the whole
+    ensemble, plus replica-`replica` == standalone bit-identity under
+    each policy."""
+    import numpy as np
+
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+
+    cfg0 = load_config(config)
+    if cfg0.ensemble is None:
+        print(f"FAIL: {config} has no ensemble: block "
+              "(--ensemble needs a campaign config)")
+        return 1
+    R = cfg0.ensemble.replicas
+    if not (0 <= replica < R):
+        print(f"FAIL: --replica {replica} out of range (campaign has "
+              f"{R} replicas)")
+        return 1
+
+    def run_campaign(data_dir: str):
+        cfg = load_config(config)
+        cfg.general.data_directory = data_dir
+        # keep the campaign record out of the repo's artifacts/ (two
+        # gate runs would also race onto one fingerprint-derived path)
+        cfg.ensemble.record_path = os.path.join(data_dir,
+                                                "ENSEMBLE.json")
+        c = Controller(cfg)
+        stats = c.run()
+        if not stats.ok:
+            print("FAIL: campaign run reported not-ok")
+            sys.exit(1)
+        return c, c.runner.final_state
+
+    with tempfile.TemporaryDirectory() as tmp:
+        c1, f1 = run_campaign(os.path.join(tmp, "e1", "shadow.data"))
+        c2, f2 = run_campaign(os.path.join(tmp, "e2", "shadow.data"))
+        rc = 0
+        H = len(c1.sim.hosts)
+        for key in ("chk", "n_exec", "n_sent", "n_drop", "n_deliv"):
+            if not np.array_equal(np.asarray(f1[key]),
+                                  np.asarray(f2[key])):
+                rc = 1
+                print(f"DETERMINISM FAILURE: campaign {key} differs "
+                      "between two identical runs")
+        desc = c1.runner.worlds.descriptors[replica]
+        if desc["latency_scale"] != 1.0 or \
+                desc["packet_loss_delta"] != 0.0:
+            print(f"FAIL: replica {replica} varies "
+                  "latency_scale/packet_loss_delta, which no "
+                  "standalone config can reproduce — gate a replica "
+                  "with the base tables (typically replica 0)")
+            return 1
+        ens_la = c1.runner.lookahead
+        names = [h.name for h in c1.sim.hosts]
+        sig_e = [(names[i], int(f1["chk"][replica, i]),
+                  int(f1["n_exec"][replica, i]),
+                  int(f1["n_sent"][replica, i]),
+                  int(f1["n_drop"][replica, i]),
+                  int(f1["n_deliv"][replica, i]))
+                 for i in range(H)]
+        for policy in policies:
+            cfg = load_config(config)
+            scheds = cfg.ensemble.fault_schedules
+            sched = desc["fault_schedule"]
+            cfg.ensemble = None
+            cfg.experimental.scheduler_policy = policy
+            cfg.experimental.runahead = ens_la
+            cfg.general.seed = desc["seed"]
+            if sched == "none":
+                cfg.network.faults = []
+            elif sched != "base":
+                cfg.network.faults = list(scheds[sched])
+            cfg.general.data_directory = os.path.join(
+                tmp, f"alone_{policy}", "shadow.data")
+            c = Controller(cfg)
+            stats = c.run()
+            if not stats.ok:
+                print(f"FAIL: standalone {policy} run reported "
+                      "not-ok")
+                return 1
+            sig_a = [(h.name, h.trace_checksum, h.events_executed,
+                      h.packets_sent, h.packets_dropped,
+                      h.packets_delivered) for h in c.sim.hosts]
+            if sig_a != sig_e:
+                rc = 1
+                print(f"DETERMINISM FAILURE: campaign replica "
+                      f"{replica} diverges from the standalone "
+                      f"{policy} run with its parameters ({desc})")
+                for a, b in zip(sig_e, sig_a):
+                    if a != b:
+                        print(f"  {a[0]}: ensemble {a[1:]} != "
+                              f"standalone {b[1:]}")
+        if rc == 0:
+            print(f"ensemble determinism OK: {config} ({R} replicas "
+                  f"bit-identical across 2 campaign runs; replica "
+                  f"{replica} {desc} bit-matches standalone "
+                  f"{','.join(policies)})")
+        return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("config", nargs="?", default="examples/minimal.yaml")
-    ap.add_argument("--policy", default="serial")
+    ap.add_argument("--policy", default=None)
+    ap.add_argument("--ensemble", action="store_true",
+                    help="campaign gate: replica bit-identity vs "
+                         "standalone runs (config needs ensemble:)")
+    ap.add_argument("--replica", type=int, default=0,
+                    help="which replica to compare standalone "
+                         "(--ensemble only; default 0)")
     args = ap.parse_args()
 
-    policies = [p.strip() for p in args.policy.split(",") if p.strip()]
+    default_policy = "serial,tpu" if args.ensemble else "serial"
+    policies = [p.strip()
+                for p in (args.policy or default_policy).split(",")
+                if p.strip()]
+
+    if args.ensemble:
+        return run_ensemble_gate(args.config, policies, args.replica)
 
     with tempfile.TemporaryDirectory() as tmp:
         d1 = os.path.join(tmp, "run1", "shadow.data")

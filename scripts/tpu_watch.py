@@ -87,7 +87,8 @@ def main() -> int:
                          f"{ART}/PROFILE_tpu.json",
                          f"{ART}/PROFILE_tpu.log")
             log("profile done — running micro4 (gather attribution)")
-            run_and_save([sys.executable, "scripts/tpu_micro4.py"],
+            run_and_save([sys.executable, "scripts/tpu_micro.py",
+                          "--variant", "4"],
                          f"{ART}/MICRO4_tpu.json",
                          f"{ART}/MICRO4_tpu.log")
             log("micro4 done — running full-state tor_large")

@@ -73,6 +73,15 @@ def main(argv=None) -> int:
     log = slog.get_logger("cli")
     log.info("simulation finished at %s: %s",
              simtime.format_time(stats.end_time), stats.summary())
+    if stats.ensemble is not None:
+        # campaign summary: the per-replica breakdown + aggregates
+        # live in the ENSEMBLE record (ensemble/campaign.py)
+        rec = stats.ensemble
+        log.info("ensemble campaign %s: %d replicas, aggregate "
+                 "packets %d; per-replica checksums + "
+                 "mean/p5/p95/min/max in the ENSEMBLE record",
+                 rec["campaign"], rec["workload"]["replicas"],
+                 stats.packets_sent)
     return 0 if stats.ok else 1
 
 

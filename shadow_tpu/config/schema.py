@@ -533,27 +533,156 @@ class ExperimentalOptions:
         return out
 
 
+# ensemble vary axes: per-replica values that change array VALUES on
+# device (seeds, topology tables, epoch times) — never shapes. Axes
+# that would change shapes (host counts, capacities, stop_time) are
+# deliberately not offered.
+ENSEMBLE_VARY_AXES = ("seed", "latency_scale", "packet_loss_delta",
+                      "fault_schedule")
+ENSEMBLE_AGGREGATES = ("mean", "p5", "p95", "min", "max")
+
+
+@dataclass
+class EnsembleOptions:
+    """`ensemble` section (new; no reference analogue): run R
+    independent replicas of the device-twin workload in ONE compiled
+    program (shadow_tpu/ensemble/), varying only array values per
+    replica. Replica i is bit-identical to a standalone run with
+    replica i's parameters (the campaign determinism contract,
+    enforced by determinism_gate.py --ensemble)."""
+
+    replicas: int = 1
+    vary: dict = field(default_factory=dict)
+    # named alternative link-fault schedules for vary.fault_schedule
+    # (each a list of validated FaultEvents; "base" = the config's
+    # network.faults schedule, "none" = fault-free)
+    fault_schedules: dict = field(default_factory=dict)
+    aggregate: tuple = ENSEMBLE_AGGREGATES
+    record_path: str = ""        # "" = artifacts/ENSEMBLE_*.json
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EnsembleOptions":
+        from shadow_tpu.faults import LINK_KINDS
+
+        _check_keys("ensemble", d, {"replicas", "vary",
+                                    "fault_schedules", "aggregate",
+                                    "record_path"})
+        if "replicas" not in d:
+            raise ValueError("ensemble: missing required key "
+                             "'replicas'")
+        replicas = int(d["replicas"])
+        if replicas < 1:
+            raise ValueError("ensemble.replicas must be >= 1")
+        raw_vary = d.get("vary") or {}
+        if not isinstance(raw_vary, dict):
+            raise ValueError("ensemble.vary must be a mapping of "
+                             "axis -> per-replica value list")
+        _check_keys("ensemble.vary", raw_vary, set(ENSEMBLE_VARY_AXES))
+        if replicas > 1 and not raw_vary:
+            raise ValueError(
+                "ensemble: replicas > 1 with an empty vary block "
+                "would run identical replicas — declare at least one "
+                f"vary axis ({list(ENSEMBLE_VARY_AXES)})")
+        vary: dict = {}
+        for axis, vals in raw_vary.items():
+            if not isinstance(vals, list) or len(vals) != replicas:
+                raise ValueError(
+                    f"ensemble.vary.{axis} must list exactly one "
+                    f"value per replica ({replicas})")
+            if axis == "seed":
+                vary[axis] = [int(v) for v in vals]
+            elif axis == "latency_scale":
+                vary[axis] = [float(v) for v in vals]
+                if any(v <= 0 for v in vary[axis]):
+                    raise ValueError(
+                        "ensemble.vary.latency_scale values must be "
+                        "> 0")
+            elif axis == "packet_loss_delta":
+                vary[axis] = [float(v) for v in vals]
+                if any(not (0.0 <= v <= 1.0) for v in vary[axis]):
+                    raise ValueError(
+                        "ensemble.vary.packet_loss_delta values must "
+                        "be in [0, 1]")
+            else:                        # fault_schedule
+                vary[axis] = [str(v) for v in vals]
+        raw_scheds = d.get("fault_schedules") or {}
+        if not isinstance(raw_scheds, dict):
+            raise ValueError("ensemble.fault_schedules must be a "
+                             "mapping of name -> fault event list")
+        schedules: dict = {}
+        for name, evs in raw_scheds.items():
+            if name in ("base", "none"):
+                raise ValueError(
+                    f"ensemble.fault_schedules: {name!r} is reserved "
+                    "('base' = network.faults, 'none' = fault-free)")
+            if not isinstance(evs, list):
+                raise ValueError(
+                    f"ensemble.fault_schedules.{name} must be a list "
+                    "of fault events")
+            events = [_fault_from_dict(i, e) for i, e in enumerate(evs)]
+            bad = [e.kind for e in events if e.kind not in LINK_KINDS]
+            if bad:
+                raise ValueError(
+                    f"ensemble.fault_schedules.{name}: {bad} are "
+                    "manager-side host faults — ensemble campaigns "
+                    "run on the device engine and only vary link "
+                    f"faults ({list(LINK_KINDS)})")
+            schedules[name] = events
+        for name in vary.get("fault_schedule", ()):
+            if name not in ("base", "none") and name not in schedules:
+                raise ValueError(
+                    f"ensemble.vary.fault_schedule names unknown "
+                    f"schedule {name!r} (declare it under "
+                    "ensemble.fault_schedules, or use 'base'/'none')")
+        agg = d.get("aggregate")
+        if agg is None:
+            aggregate = ENSEMBLE_AGGREGATES
+        else:
+            if not isinstance(agg, list) or not agg:
+                raise ValueError("ensemble.aggregate must be a "
+                                 "non-empty list")
+            for a in agg:
+                _check_choice("ensemble", "aggregate", a,
+                              ENSEMBLE_AGGREGATES)
+            aggregate = tuple(agg)
+        return cls(replicas=replicas, vary=vary,
+                   fault_schedules=schedules, aggregate=aggregate,
+                   record_path=str(d.get("record_path", "") or ""))
+
+
 @dataclass
 class ConfigOptions:
     general: GeneralOptions = field(default_factory=GeneralOptions)
     network: NetworkOptions = field(default_factory=NetworkOptions)
     experimental: ExperimentalOptions = field(default_factory=ExperimentalOptions)
     hosts: list[HostOptions] = field(default_factory=list)
+    ensemble: Optional[EnsembleOptions] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "ConfigOptions":
         _check_keys("config", d, {"general", "network", "experimental",
                                   "hosts", "host_option_defaults",
-                                  "host_defaults"})
+                                  "host_defaults", "ensemble"})
         hosts = [HostOptions.from_dict(name, hd or {})
                  for name, hd in (d.get("hosts", {}) or {}).items()]
-        return cls(
+        ensemble = (EnsembleOptions.from_dict(d["ensemble"])
+                    if d.get("ensemble") else None)
+        out = cls(
             general=GeneralOptions.from_dict(d.get("general", {}) or {}),
             network=NetworkOptions.from_dict(d.get("network", {}) or {}),
             experimental=ExperimentalOptions.from_dict(
                 d.get("experimental", {}) or {}),
             hosts=hosts,
+            ensemble=ensemble,
         )
+        if ensemble is not None and \
+                out.experimental.scheduler_policy != "tpu":
+            raise ValueError(
+                "ensemble: multi-replica campaigns run as one vmapped "
+                "device program and require "
+                "experimental.scheduler_policy: tpu (run replicas as "
+                "separate processes on CPU policies)")
+        return out
 
     def total_hosts(self) -> int:
         return sum(h.quantity for h in self.hosts)

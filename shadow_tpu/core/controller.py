@@ -244,6 +244,24 @@ class Controller:
         self.runner = None
         self.manager = None
         net_judge = None
+        if cfg.ensemble is not None:
+            # R-replica campaign in one vmapped device program
+            # (shadow_tpu/ensemble/). No hybrid fallback: CPU host
+            # emulation cannot vmap, so a config whose apps lack a
+            # device twin fails loudly rather than silently running
+            # one replica.
+            from shadow_tpu.device.runner import NoDeviceTwin
+            from shadow_tpu.ensemble.campaign import EnsembleRunner
+            try:
+                self.runner = EnsembleRunner(self.sim, trace=trace)
+                return
+            except NoDeviceTwin as e:
+                raise ValueError(
+                    "ensemble: the config's apps have no fully-"
+                    f"vectorized device twin ({e}) — campaigns "
+                    "cannot fall back to hybrid CPU emulation; run "
+                    "the replicas as separate processes instead"
+                ) from e
         if policy_name == "tpu":
             from shadow_tpu.device.runner import DeviceRunner, NoDeviceTwin
             try:
@@ -308,6 +326,14 @@ class Controller:
         stop = cfg.general.stop_time
         if self.runner is not None:
             stats = self.runner.run(stop)
+            if stats.ensemble is not None:
+                rec = stats.ensemble
+                log.info(
+                    "ensemble campaign %s: %d replicas, "
+                    "packets_sent aggregates %s",
+                    rec["campaign"], rec["workload"]["replicas"],
+                    {k: round(v, 1) for k, v in
+                     rec["aggregates"]["packets_sent"].items()})
             occ = stats.occupancy
             if occ is not None and "planned" in occ:
                 # one-line audit of the adaptive plan: what it chose

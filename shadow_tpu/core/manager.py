@@ -76,6 +76,11 @@ class SimStats:
     occupancy: Optional[dict] = None
     # capacity re-plan/retry cycles the run needed (0 = the plan held)
     replans: int = 0
+    # ensemble campaign record (shadow_tpu/ensemble/campaign.py):
+    # per-replica results + aggregates; None outside ensemble runs.
+    # The top-level counters above then hold CAMPAIGN totals (summed
+    # over replicas)
+    ensemble: Optional[dict] = None
 
     def merge(self, other: "SimStats") -> None:
         self.events_executed += other.events_executed

@@ -210,13 +210,15 @@ def overflow_dims(state) -> tuple:
 
 
 def grow_heaps(host_state: dict, new_e: int) -> dict:
-    """Pad the five [H, E] heap arrays of a host-side state snapshot
-    to a larger event_capacity (rows are sorted; empty slots sort
-    last, so tail padding preserves the heap invariant)."""
+    """Pad the five [..., H, E] heap arrays of a host-side state
+    snapshot to a larger event_capacity (rows are sorted; empty slots
+    sort last, so tail padding preserves the heap invariant). Works
+    on standalone [H, E] states and on ensemble [R, H, E] stacks —
+    the slot axis is always last."""
     INF = np.int64(1) << np.int64(62)
     IMAX = np.int64(np.iinfo(np.int64).max)
     out = dict(host_state)
-    h, e = host_state["ht"].shape
+    *lead, e = host_state["ht"].shape
     if new_e < e:
         raise ValueError(f"cannot shrink event_capacity {e} -> {new_e} "
                          "on a live state")
@@ -224,19 +226,24 @@ def grow_heaps(host_state: dict, new_e: int) -> dict:
         return out
     fills = {"ht": INF, "hk": IMAX, "hm": 0, "hv": 0, "hw": 0}
     for k, fill in fills.items():
-        pad = np.full((h, new_e - e), fill, dtype=np.int64)
-        out[k] = np.concatenate([np.asarray(host_state[k]), pad], 1)
+        pad = np.full(tuple(lead) + (new_e - e,), fill,
+                      dtype=np.int64)
+        out[k] = np.concatenate([np.asarray(host_state[k]), pad], -1)
     return out
 
 
-def transfer(engine, starts, host_state: dict) -> dict:
+def transfer(engine, starts, host_state: dict,
+             template: dict = None) -> dict:
     """Place a host-side state snapshot onto a (re-planned) engine:
     pads the heaps to the engine's event_capacity and device_puts
-    every leaf with the sharding of a freshly built template state."""
+    every leaf with the sharding of a freshly built template state.
+    `template` overrides the standalone init_state template (the
+    ensemble runner passes its [R, ...] init_ensemble_state)."""
     from shadow_tpu._jax import jax
 
     host_state = grow_heaps(host_state, engine.config.event_capacity)
-    template = engine.init_state(starts)
+    if template is None:
+        template = engine.init_state(starts)
     if set(template) != set(host_state):
         raise ValueError(
             "state keys changed across re-plan: "

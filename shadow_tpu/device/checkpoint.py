@@ -34,10 +34,46 @@ to re-run a segment against the same global stop.
 from __future__ import annotations
 
 import json
+import os
 
 import numpy as np
 
 FORMAT = 1
+
+
+def probe_writable(path: str) -> None:
+    """Fail on an unwritable checkpoint_save path NOW, in
+    milliseconds — before a capacity warm-up spends minutes compiling,
+    and not after a multi-hour run when the state would be lost. The
+    probe must not leave a zero-byte decoy behind if the run later
+    dies before saving. Shared by DeviceRunner and EnsembleRunner."""
+    existed = os.path.lexists(path)
+    try:
+        with open(path, "ab"):
+            pass
+    except OSError as e:
+        raise ValueError(
+            f"checkpoint_save path {path!r} is not writable: "
+            f"{e}") from e
+    if not existed:
+        os.unlink(path)
+
+
+def prevalidate_resume(path: str, stop: int, save_path: str = "",
+                       save_time: int = 0) -> int:
+    """Pre-validate resume parameters from the npz meta alone (no
+    array payloads), for the same fail-fast reason as probe_writable.
+    Returns the saved pause time. Shared by both runners."""
+    t_peek = int(peek_meta(path)["sim_time"])
+    if t_peek >= stop:
+        raise ValueError(
+            f"checkpoint_load: saved state pauses at {t_peek} ns, "
+            f"at/after stop_time {stop} ns — nothing to resume")
+    if save_path and save_time and min(stop, save_time) <= t_peek:
+        raise ValueError(
+            f"checkpoint_save_time {min(stop, save_time)} ns is not "
+            f"after the run's start time {t_peek} ns")
+    return t_peek
 
 
 def _fingerprint(engine) -> dict:
@@ -98,11 +134,14 @@ def _flatten(state):
 
 
 def save_state(engine, state, path: str, sim_time: int,
-               final_stop: int = 0) -> None:
+               final_stop: int = 0, extra_meta: dict = None) -> None:
     """Write `state` (a live, possibly sharded device pytree) plus
     the pause `sim_time`, the run's global stop (`final_stop` — the
     window-clamping bound the saved prefix was computed against), and
-    the engine fingerprint to `path`."""
+    the engine fingerprint to `path`. `extra_meta` (the ensemble
+    runner's campaign fingerprint stamp) lands under meta["ensemble"]
+    — its presence marks a campaign checkpoint, which standalone runs
+    refuse to resume."""
     from shadow_tpu._jax import jax
 
     host_state = jax.device_get(state)
@@ -125,6 +164,8 @@ def save_state(engine, state, path: str, sim_time: int,
                       "outbox_compact")},
         "keys": [k for k, _ in named],
     }
+    if extra_meta:
+        meta["ensemble"] = dict(extra_meta)
     arrays = {f"leaf_{i}": np.asarray(v)
               for i, (_, v) in enumerate(named)}
     with open(path, "wb") as f:
@@ -146,12 +187,16 @@ def peek_fingerprint(path: str) -> dict:
     return peek_meta(path)["fingerprint"]
 
 
-def load_state(engine, starts, path: str, final_stop: int = 0):
+def load_state(engine, starts, path: str, final_stop: int = 0,
+               template: dict = None):
     """Load a checkpoint into a fresh engine: builds a template state
     via `init_state(starts)` (for tree structure + shardings),
     validates the fingerprint, the run's global stop, and every
     leaf's shape/dtype, and device_puts each saved leaf with the
-    template leaf's sharding.
+    template leaf's sharding. `template` overrides the standalone
+    template (the ensemble runner passes init_ensemble_state's
+    [R, ...] stack); a campaign checkpoint (meta["ensemble"] present)
+    refuses to load without one.
 
     `final_stop` is this run's global stop; a checkpoint saved
     against a different one is rejected (the saved prefix's windows
@@ -182,6 +227,12 @@ def load_state(engine, starts, path: str, final_stop: int = 0):
             "not bit-match an uninterrupted run (re-run from scratch "
             "or restore the original stop_time)")
 
+    if meta.get("ensemble") and template is None:
+        raise ValueError(
+            f"checkpoint {path} was saved by an ensemble campaign "
+            f"({meta['ensemble']}); a standalone run cannot resume "
+            "it — load it under the same ensemble config")
+
     fp, want = meta["fingerprint"], _fingerprint(engine)
     if fp != want:
         diffs = {k: (fp.get(k), want[k]) for k in want
@@ -190,7 +241,8 @@ def load_state(engine, starts, path: str, final_stop: int = 0):
             f"checkpoint {path} does not match this simulation "
             f"(saved vs configured): {diffs}")
 
-    template = engine.init_state(starts)
+    if template is None:
+        template = engine.init_state(starts)
     named, treedef = _flatten(template)
     want_keys = [k for k, _ in named]
     saved_keys = meta["keys"]

@@ -43,7 +43,12 @@ import numpy as np
 
 from shadow_tpu._jax import jax, jnp, shard_map
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import (
+    Mesh,
+    NamedSharding,
+    PartitionSpec,
+    PartitionSpec as P,
+)
 
 from shadow_tpu import simtime
 from shadow_tpu.core.event import (
@@ -153,7 +158,7 @@ class EngineConfig:
     # hoisted judge): True = one-hot masked sums over the V*V table
     # (unrolled; only legal for V*V <= 128) — no gather; False =
     # indexed gather. None = False everywhere until the on-chip
-    # micro (scripts/tpu_micro4.py) decides. Selection is exact
+    # micro (scripts/tpu_micro.py --variant 4) decides. Selection is exact
     # (single nonzero term), so traces are bit-identical either way.
     table_onehot: Optional[bool] = None
 
@@ -167,9 +172,27 @@ class DeviceEngine:
                  mesh: Optional[Mesh] = None,
                  bw_up_bits: Optional[np.ndarray] = None,
                  bw_down_bits: Optional[np.ndarray] = None,
-                 epoch_times: Optional[np.ndarray] = None):
+                 epoch_times: Optional[np.ndarray] = None,
+                 ensemble=None):
         self.config = config
         self.app = app
+        # ensemble worlds (shadow_tpu/ensemble/spec.py EnsembleWorlds,
+        # duck-typed to avoid the import cycle): stacked per-replica
+        # (latency, reliability, epoch_times, seed keys). When set,
+        # replica 0 is the engine's base world (standard program,
+        # fingerprints) and _build_program additionally compiles the
+        # vmapped R-replica campaign program. Compile-time branch
+        # flags (ALL_REL1, the i32 latency bound) are evaluated over
+        # the WHOLE stack — one lossy replica must not let the
+        # lossless fast path skip every replica's drop rolls.
+        self.ensemble = ensemble
+        if ensemble is not None:
+            # the stacked tables arrive i32/f32 — build_worlds
+            # (ensemble/spec.py) enforces the i32 latency bound over
+            # every replica before the cast, so no re-check here
+            latency_ns = np.asarray(ensemble.latency[0])
+            reliability = np.asarray(ensemble.reliability[0])
+            epoch_times = np.asarray(ensemble.epoch_times[0])
         # d2 survivor bitmasks are one uint32 word: a larger train
         # would silently lose packets (ADVICE r3 #2 — fail loudly)
         assert getattr(app, "max_train", 1) <= 32, \
@@ -375,7 +398,6 @@ class DeviceEngine:
         D = max(1, app.max_draws)
         H_loc, H_pad = self.H_loc, self.H_pad
         n_shards = self.n_shards
-        seed_pair = self.seed_pair
         LOOKAHEAD = np.int64(max(1, cfg.lookahead))
         BOOT_END = np.int64(cfg.bootstrap_end)
         MB = bool(cfg.model_bandwidth)
@@ -439,26 +461,26 @@ class DeviceEngine:
         POP_ONEHOT = (cfg.pop_onehot
                       if cfg.pop_onehot is not None
                       else platform == "tpu")
-        # fault epochs: the [T] epoch start times bake into the
-        # program as a constant (they are part of the compiled
-        # schedule exactly like the capacities); each lookup selects
-        # its epoch by SEND time with a comparison count — the
-        # vectorized twin of the CPU model's binary search
-        # (faults.FaultTable.epoch_of). T == 1 (no faults) keeps the
-        # [V,V] matrices and the original 2-operand gather, so the
-        # fault-free program is byte-identical to before.
+        # fault epochs: the [T] epoch start times are part of the
+        # compiled schedule exactly like the capacities, but ride the
+        # program as a TRACED [T] vector (the `wrld` tuple below) so
+        # the vmapped ensemble program can vary them per replica;
+        # each lookup selects its epoch by SEND time with a
+        # comparison count — the vectorized twin of the CPU model's
+        # binary search (faults.FaultTable.epoch_of). T == 1 (no
+        # faults) keeps the [V,V] matrices and the original 2-operand
+        # gather, so the fault-free program is byte-identical.
         T_EP = len(self.epoch_times)
-        ep_t = jnp.asarray(self.epoch_times)
 
-        def _ep_of(t):
-            return (t[..., None] >= ep_t).sum(-1).astype(jnp.int32) - 1
+        def _ep_of(t, ept):
+            return (t[..., None] >= ept).sum(-1).astype(jnp.int32) - 1
 
-        def _tbl(tab, t, sv, dv):
+        def _tbl(tab, t, sv, dv, ept):
             """Topology-table gather at send time t; tab is [V,V]
             (single epoch) or [T,V,V] (fault schedule)."""
             if T_EP == 1:
                 return tab[sv, dv]
-            return tab[_ep_of(t), sv, dv]
+            return tab[_ep_of(t, ept), sv, dv]
 
         # one-hot topology-table lookups (see EngineConfig.table_onehot)
         TAB_ONEHOT = bool(cfg.table_onehot) and V * V <= 128 \
@@ -472,8 +494,12 @@ class DeviceEngine:
                          V * V)
         # statically lossless topologies (all reliability == 1) never
         # drop: packet_drop_mask is False for every row regardless of
-        # the roll, so the threefry batch is skipped outright
-        ALL_REL1 = bool((self.reliability >= 1.0).all())
+        # the roll, so the threefry batch is skipped outright. Under
+        # an ensemble the check spans every replica's table — one
+        # lossy replica keeps the rolls for all.
+        ALL_REL1 = bool((np.asarray(
+            self.ensemble.reliability if self.ensemble is not None
+            else self.reliability) >= 1.0).all())
 
         # model-NIC constants (host/model_nic.py twins; keep in
         # lockstep with its arithmetic — trace equality depends on it)
@@ -516,7 +542,13 @@ class DeviceEngine:
 
         # ---------------- inner loop body: one event per host ----------
         # (up to P events for an app's declared burst hosts)
-        def _step(carry, win_end, gid, host_vertex, lat, rel):
+        # `wrld` is the traced per-world tuple (lat, rel, seed k1,
+        # seed k2, epoch times): everything a replica may vary without
+        # changing shapes — the ensemble program vmaps over a stacked
+        # axis of exactly these plus the state.
+        def _step(carry, win_end, gid, host_vertex, wrld):
+            lat, rel, sk1, sk2, ept = wrld
+            seed_pair = (sk1, sk2)
             state, ob, blk, dirty = carry
             head = state["head"]
             if P > 1:
@@ -718,9 +750,9 @@ class DeviceEngine:
                 dstv = host_vertex[jnp.clip(dst, 0, H_pad - 1)]
                 # epoch keyed on the SEND time (lane_t), matching the
                 # CPU model's judge(now=send time) under faults
-                latv = _tbl(lat, lane_t, srcv,
-                            dstv).astype(jnp.int64)              # [H,K]
-                relv = _tbl(rel, lane_t, srcv, dstv)
+                latv = _tbl(lat, lane_t, srcv, dstv,
+                            ept).astype(jnp.int64)               # [H,K]
+                relv = _tbl(rel, lane_t, srcv, dstv, ept)
             if not HOIST and C > 1:
                 # packet TRAINS: one drop roll per packet, keyed by the
                 # exact (src, pkt_seq0+j) sequence individual sends
@@ -948,8 +980,8 @@ class DeviceEngine:
                 # moves the phase boundary, never the per-host pop
                 # order (the trace is bit-identical either way)
                 hvg = host_vertex[gid][:, None]                  # [H,1]
-                selflat = _tbl(lat, depart, hvg,
-                               hvg).astype(jnp.int64)
+                selflat = _tbl(lat, depart, hvg, hvg,
+                               ept).astype(jnp.int64)
                 self_in = send_valid & (dst == gid[:, None]) & \
                     (depart + selflat < win_end)
                 tim_in = timer_valid & (timer_t < win_end)
@@ -1079,7 +1111,7 @@ class DeviceEngine:
             return state, _seg_take(perm, rows, starts, counts, IN), \
                 counts.astype(jnp.int32)
 
-        def _judge_outbox(state, ob, gid, host_vertex, lat, rel,
+        def _judge_outbox(state, ob, gid, host_vertex, wrld,
                           win_end):
             """Per-phase network judgment of the raw outbox — the
             worker_sendPacket semantics (ref worker.c:520-579) hoisted
@@ -1088,6 +1120,8 @@ class DeviceEngine:
             per-source packet seq, send time), causality bump, and the
             sent/dropped counters. Runs once per phase over [H, OB]
             instead of once per pop iteration over [H, K]."""
+            lat, rel, sk1, sk2, ept = wrld
+            seed_pair = (sk1, sk2)
             ft, fm, fv = ob["t"], ob["m"], ob["v"]
             kindrow = lo32(fm)
             is_send = (ft < INF) & ((kindrow & 0xFF) == KIND_PACKET)
@@ -1117,8 +1151,9 @@ class DeviceEngine:
                 # the same epoch the CPU twin reads. Empty rows
                 # (ft == INF) gather the last epoch harmlessly — they
                 # are masked by is_send everywhere downstream.
-                latv = _tbl(lat, ft, srcv, dstv).astype(jnp.int64)
-                relv = _tbl(rel, ft, srcv, dstv)
+                latv = _tbl(lat, ft, srcv, dstv,
+                            ept).astype(jnp.int64)
+                relv = _tbl(rel, ft, srcv, dstv, ept)
 
             # per-row packet-seq base: state["packet_seq"] is already
             # the END of the phase; outbox columns sit in consumption
@@ -1437,11 +1472,11 @@ class DeviceEngine:
                                   flat["s"], flat["v"], lo, hi)]
             return _merge_rows(state, parts)
 
-        def _exchange(state, ob, gid, my_shard, host_vertex, lat, rel,
+        def _exchange(state, ob, gid, my_shard, host_vertex, wrld,
                       win_end):
             if HOIST:
                 state, ob = _judge_outbox(state, ob, gid, host_vertex,
-                                          lat, rel, win_end)
+                                          wrld, win_end)
             if CP:
                 state = _count_paths(state, ob, host_vertex)
             # occupancy: exchangeable outbox rows per host this phase
@@ -1552,8 +1587,7 @@ class DeviceEngine:
         # win_end / stalled on an in-window insert), then flushes. The
         # window advances only when no host has events under the
         # barrier; the predicate is a collective, so all shards agree.
-        def _round(state, win_end, gid, my_shard, host_vertex, lat,
-                   rel):
+        def _round(state, win_end, gid, my_shard, host_vertex, wrld):
             def _phase(state):
                 ob = {"t": jnp.full((H_loc, OB), INF, jnp.int64)}
                 for f in ("k", "m", "s", "v"):
@@ -1569,7 +1603,7 @@ class DeviceEngine:
                 carry = lax.while_loop(
                     cond,
                     lambda c: _step(c, win_end, gid, host_vertex,
-                                    lat, rel),
+                                    wrld),
                     (state, ob, jnp.int32(0), dirty))
                 state2, ob, blk, _ = carry
                 state2["occ_trips"] = jnp.maximum(
@@ -1585,7 +1619,7 @@ class DeviceEngine:
                 return lax.cond(
                     go,
                     lambda s: _exchange(s, ob, gid, my_shard,
-                                        host_vertex, lat, rel,
+                                        host_vertex, wrld,
                                         win_end),
                     lambda s: s,
                     state2)
@@ -1610,7 +1644,7 @@ class DeviceEngine:
         def _axis_min(x):
             return lax.all_gather(jnp.reshape(x, (1,)), AXIS).min()
 
-        def _run_shard(state, host_vertex, lat, rel, stop, final_stop):
+        def _run_shard(state, host_vertex, wrld, stop, final_stop):
             # `stop` is where THIS invocation pauses (a traced scalar,
             # so one compiled program serves every slice length);
             # `final_stop` is the simulation end that window boundaries
@@ -1633,7 +1667,7 @@ class DeviceEngine:
                 state, nxt, rounds = c
                 win_end = jnp.minimum(nxt + LOOKAHEAD, final_stop)
                 state = _round(state, win_end, gid, my_shard,
-                               host_vertex, lat, rel)
+                               host_vertex, wrld)
                 return state, next_time(state), rounds + 1
 
             state, _, rounds = lax.while_loop(
@@ -1642,11 +1676,11 @@ class DeviceEngine:
 
         # one window as a standalone jitted step (also used by
         # __graft_entry__; works on any mesh size including 1)
-        def _one_round(state, win_end, host_vertex, lat, rel):
+        def _one_round(state, win_end, host_vertex, wrld):
             my_shard = lax.axis_index(AXIS)
             gid = (my_shard * H_loc + hidx).astype(jnp.int32)
             state = _round(state, win_end, gid, my_shard,
-                           host_vertex, lat, rel)
+                           host_vertex, wrld)
             nxt = _axis_min(
                 _take_head(state["ht"], state["head"], INF).min())
             return state, nxt
@@ -1656,7 +1690,7 @@ class DeviceEngine:
         # needs pop-loop vs exchange vs merge attribution; these split
         # jits let a host-side driver time each piece. They are traced
         # lazily (first call), so the normal path pays nothing.
-        def _pop_shard(state, ob, host_vertex, lat, rel, win_end):
+        def _pop_shard(state, ob, host_vertex, wrld, win_end):
             my_shard = lax.axis_index(AXIS)
             gid = (my_shard * H_loc + hidx).astype(jnp.int32)
             dirty = jnp.zeros((H_loc,), bool)
@@ -1668,18 +1702,17 @@ class DeviceEngine:
 
             state, ob, blk, _ = lax.while_loop(
                 cond,
-                lambda c: _step(c, win_end, gid, host_vertex, lat,
-                                rel),
+                lambda c: _step(c, win_end, gid, host_vertex, wrld),
                 (state, ob, jnp.int32(0), dirty))
             state["occ_trips"] = jnp.maximum(
                 state["occ_trips"], jnp.reshape(blk, (1,)))
             return state, ob, jnp.reshape(blk, (1,))
 
-        def _flush_shard(state, ob, host_vertex, lat, rel, win_end):
+        def _flush_shard(state, ob, host_vertex, wrld, win_end):
             my_shard = lax.axis_index(AXIS)
             gid = (my_shard * H_loc + hidx).astype(jnp.int32)
             return _exchange(state, ob, gid, my_shard, host_vertex,
-                             lat, rel, win_end)
+                             wrld, win_end)
 
         spec_keys = ("ht", "hk", "hm", "hv", "hw", "head",
                      "event_seq", "packet_seq", "app_seq", "app",
@@ -1692,31 +1725,63 @@ class DeviceEngine:
         specs = {k: self._shard_spec for k in spec_keys}
         ob_specs = {f: self._shard_spec for f in XF}
         repl = self._repl_spec
+        wspec = (repl,) * 5          # (lat, rel, k1, k2, epoch_times)
         self._run = jax.jit(shard_map(
             _run_shard, mesh=self.mesh,
-            in_specs=(specs, repl, repl, repl, repl, repl),
+            in_specs=(specs, repl, wspec, repl, repl),
             out_specs=(specs, repl),
             check_vma=False,
         ))
         self._round_step = jax.jit(shard_map(
             _one_round, mesh=self.mesh,
-            in_specs=(specs, repl, repl, repl, repl),
+            in_specs=(specs, repl, repl, wspec),
             out_specs=(specs, repl),
             check_vma=False,
         ))
         self._pop_phase = jax.jit(shard_map(
             _pop_shard, mesh=self.mesh,
-            in_specs=(specs, ob_specs, repl, repl, repl, repl),
+            in_specs=(specs, ob_specs, repl, wspec, repl),
             out_specs=(specs, ob_specs, self._shard_spec),
             check_vma=False,
         ))
         self._flush_phase = jax.jit(shard_map(
             _flush_shard, mesh=self.mesh,
-            in_specs=(specs, ob_specs, repl, repl, repl, repl),
+            in_specs=(specs, ob_specs, repl, wspec, repl),
             out_specs=specs,
             check_vma=False,
         ))
         self._ob_shape_global = (H_pad, OB)
+
+        # ---------------- ensemble program -----------------------------
+        # The R-replica campaign: the SAME per-shard round program,
+        # vmapped over a leading replica axis of (state, world) INSIDE
+        # the host shard_map — the replica axis composes outside the
+        # mesh axis, so multichip exchange is untouched and each
+        # replica's trace is the standalone program's, value for value
+        # (vmap batches while_loops by freezing finished replicas'
+        # carries with selects — it never re-executes their updates).
+        # Only array VALUES vary per replica (seed keys, topology
+        # tables, epoch times); every shape is shared.
+        if self.ensemble is not None:
+            # NB: `P` (the PartitionSpec alias) is shadowed by the
+            # burst width in this scope — use the unaliased name
+            ens_spec = PartitionSpec(None, *self._shard_spec)
+            especs = {k: ens_spec for k in spec_keys}
+
+            def _run_ens_shard(states, host_vertex, wrlds, stop,
+                               final_stop):
+                return jax.vmap(
+                    lambda st, w: _run_shard(st, host_vertex, w,
+                                             stop, final_stop),
+                    in_axes=(0, 0))(states, wrlds)
+
+            self._run_ens = jax.jit(shard_map(
+                _run_ens_shard, mesh=self.mesh,
+                in_specs=(especs, repl, wspec, repl, repl),
+                out_specs=(especs, repl),
+                check_vma=False,
+            ))
+            self._ens_spec = ens_spec
 
         def _probe(state):
             head = state["head"]
@@ -1729,6 +1794,25 @@ class DeviceEngine:
         self._probe = jax.jit(_probe)
 
     # ------------------------------------------------------------------
+    def world(self):
+        """The traced world tuple (lat, rel, seed k1, seed k2,
+        epoch_times) for the engine's own base world, replicated over
+        the mesh — everything a run may vary without changing shapes
+        (the ensemble program stacks R of these). Cached: the arrays
+        are fixed at construction, and run()/profile() call per
+        segment — re-uploading the tables each dispatch would be pure
+        waste over a tunneled TPU."""
+        if getattr(self, "_world_dev", None) is None:
+            repl = NamedSharding(self.mesh, self._repl_spec)
+            k1, k2 = self.seed_pair
+            self._world_dev = (
+                jax.device_put(jnp.asarray(self.latency), repl),
+                jax.device_put(jnp.asarray(self.reliability), repl),
+                jax.device_put(jnp.asarray(k1), repl),
+                jax.device_put(jnp.asarray(k2), repl),
+                jax.device_put(jnp.asarray(self.epoch_times), repl))
+        return self._world_dev
+
     def run(self, state: dict, stop: Optional[int] = None,
             final_stop: Optional[int] = None):
         """Run to `stop` (default config.stop_time); returns
@@ -1740,12 +1824,76 @@ class DeviceEngine:
         trace — is identical to an unsegmented run."""
         repl = NamedSharding(self.mesh, self._repl_spec)
         hv = jax.device_put(jnp.asarray(self.host_vertex), repl)
-        lat = jax.device_put(jnp.asarray(self.latency), repl)
-        rel = jax.device_put(jnp.asarray(self.reliability), repl)
         stop_v = jnp.int64(self.config.stop_time if stop is None
                            else stop)
         final_v = stop_v if final_stop is None else jnp.int64(final_stop)
-        return self._run(state, hv, lat, rel, stop_v, final_v)
+        return self._run(state, hv, self.world(), stop_v, final_v)
+
+    # ------------------------------------------------------------------
+    # ensemble campaign (shadow_tpu/ensemble/): R replicas in one
+    # compiled program
+    # ------------------------------------------------------------------
+    def init_ensemble_state(self, starts: list[tuple]) -> dict:
+        """[R, ...]-stacked initial state: every replica starts from
+        the identical boot/stop schedule (vary axes change values —
+        seeds, tables — never the start layout), so the stack is one
+        on-device broadcast of the standalone initial state."""
+        if self.ensemble is None:
+            raise ValueError("engine was built without ensemble "
+                             "worlds")
+        base = self.init_state(starts)
+        if getattr(self, "_ens_broadcaster", None) is None:
+            # one jitted whole-dict broadcast, cached on the engine:
+            # a fresh jit per leaf per call would retrace every leaf
+            # on every init (warm-up, re-plan retries, resume
+            # templates all re-init)
+            R = int(self.ensemble.R)
+            ens_shard = NamedSharding(self.mesh, self._ens_spec)
+            self._ens_broadcaster = jax.jit(
+                lambda tree: {
+                    k: jnp.broadcast_to(v[None], (R,) + v.shape)
+                    for k, v in tree.items()},
+                out_shardings=ens_shard)
+        return self._ens_broadcaster(base)
+
+    def ensemble_worlds_device(self):
+        """The stacked per-replica world tuple, replicated over the
+        mesh (the replica axis is vmapped, not sharded). Cached like
+        world(): run_ensemble is called once per heartbeat/dispatch
+        segment, and the stacked tables never change after build."""
+        if getattr(self, "_ens_world_dev", None) is None:
+            ens = self.ensemble
+            repl = NamedSharding(self.mesh, self._repl_spec)
+            self._ens_world_dev = (
+                jax.device_put(jnp.asarray(
+                    np.asarray(ens.latency, dtype=np.int32)), repl),
+                jax.device_put(jnp.asarray(
+                    np.asarray(ens.reliability,
+                               dtype=np.float32)), repl),
+                jax.device_put(jnp.asarray(
+                    np.asarray(ens.seed_k1, dtype=np.uint32)), repl),
+                jax.device_put(jnp.asarray(
+                    np.asarray(ens.seed_k2, dtype=np.uint32)), repl),
+                jax.device_put(jnp.asarray(
+                    np.asarray(ens.epoch_times,
+                               dtype=np.int64)), repl),
+            )
+        return self._ens_world_dev
+
+    def run_ensemble(self, states: dict, stop: Optional[int] = None,
+                     final_stop: Optional[int] = None):
+        """Advance all R replicas to `stop` in one dispatch of the
+        vmapped program; returns ([R, ...] states, [R] rounds).
+        Window clamping stays on `final_stop` exactly as in `run`, so
+        segmented campaigns (heartbeats, dispatch_segment) replay the
+        unsegmented window sequence per replica."""
+        repl = NamedSharding(self.mesh, self._repl_spec)
+        hv = jax.device_put(jnp.asarray(self.host_vertex), repl)
+        stop_v = jnp.int64(self.config.stop_time if stop is None
+                           else stop)
+        final_v = stop_v if final_stop is None else jnp.int64(final_stop)
+        return self._run_ens(states, hv, self.ensemble_worlds_device(),
+                             stop_v, final_v)
 
     def profile(self, state: dict, stop: Optional[int] = None) -> dict:
         """Phase-split run with host-side wall timing: the same round
@@ -1760,8 +1908,7 @@ class DeviceEngine:
         repl = NamedSharding(self.mesh, self._repl_spec)
         shard = NamedSharding(self.mesh, self._shard_spec)
         hv = jax.device_put(jnp.asarray(self.host_vertex), repl)
-        lat = jax.device_put(jnp.asarray(self.latency), repl)
-        rel = jax.device_put(jnp.asarray(self.reliability), repl)
+        wrld = self.world()
         stop_t = self.config.stop_time if stop is None else stop
         LA = max(1, self.config.lookahead)
 
@@ -1780,10 +1927,9 @@ class DeviceEngine:
         # compile both split programs up front so timings are steady
         t0 = _time.perf_counter()
         win0 = jnp.int64(0)
-        s_w, ob_w, _ = self._pop_phase(state, _ob(), hv, lat, rel,
-                                       win0)
-        jax.block_until_ready(self._flush_phase(s_w, ob_w, hv, lat,
-                                                rel, win0))
+        s_w, ob_w, _ = self._pop_phase(state, _ob(), hv, wrld, win0)
+        jax.block_until_ready(self._flush_phase(s_w, ob_w, hv, wrld,
+                                                win0))
         jax.block_until_ready(self._probe(state))
         prof["compile_s"] = _time.perf_counter() - t0
 
@@ -1796,13 +1942,13 @@ class DeviceEngine:
             win_end = jnp.int64(min(nxt + LA, stop_t))
             while True:
                 t0 = _time.perf_counter()
-                state, ob, _ = self._pop_phase(state, _ob(), hv, lat,
-                                               rel, win_end)
+                state, ob, _ = self._pop_phase(state, _ob(), hv, wrld,
+                                               win_end)
                 jax.block_until_ready(state)
                 prof["pop_s"] += _time.perf_counter() - t0
 
                 t0 = _time.perf_counter()
-                state = self._flush_phase(state, ob, hv, lat, rel,
+                state = self._flush_phase(state, ob, hv, wrld,
                                           win_end)
                 jax.block_until_ready(state)
                 prof["flush_s"] += _time.perf_counter() - t0

@@ -155,7 +155,8 @@ def device_twin(sim) -> DeviceApp:
 
 
 class DeviceRunner:
-    def __init__(self, sim, trace: Optional[list] = None, mesh=None):
+    def __init__(self, sim, trace: Optional[list] = None, mesh=None,
+                 defer_engine: bool = False):
         if getattr(sim, "host_faults", None):
             # host crash/restart are manager-side events (processes
             # are killed and respawned) — the device engine has no
@@ -203,7 +204,11 @@ class DeviceRunner:
         # filled by the occupancy planner (capacity_plan: auto|path)
         # and widened by the overflow re-plan/retry loop
         self._capacity_overrides: dict = {}
-        self.engine = self._build_engine()
+        # defer_engine: the EnsembleRunner reuses this class for twin
+        # mapping + knob plumbing but builds ITS engine with the
+        # stacked replica worlds — constructing a standalone engine
+        # here too would be pure waste
+        self.engine = None if defer_engine else self._build_engine()
         self.final_state: Optional[dict] = None
         self.occ_record: Optional[dict] = None
         self.replans = 0
@@ -212,10 +217,20 @@ class DeviceRunner:
         # (bench.py) and a re-used runner keeps its plan
         self._planned = False
 
-    def _build_engine(self) -> DeviceEngine:
+    def _build_engine(self, ensemble=None,
+                      lookahead: Optional[int] = None,
+                      seed: Optional[int] = None) -> DeviceEngine:
         """Construct the engine from the config's static knobs plus
         any planner/retry capacity overrides (re-invoked by the
-        re-plan loop; a capacity change recompiles the program)."""
+        re-plan loop; a capacity change recompiles the program).
+
+        `ensemble`/`lookahead`/`seed` are the EnsembleRunner's
+        overrides: with ensemble worlds the DeviceEngine constructor
+        swaps in replica 0's tables itself, the campaign shares one
+        conservative lookahead, and the engine seed is replica 0's —
+        everything else (knob plumbing, outbox floors, strategy
+        tristates) is identical, so campaigns reuse this one builder
+        instead of copy-pasting it."""
         sim = self.sim
         cfg = sim.cfg
         xp = cfg.experimental
@@ -253,10 +268,11 @@ class DeviceRunner:
         return DeviceEngine(
             EngineConfig(
                 n_hosts=len(sim.hosts),
-                lookahead=max(1, sim.lookahead),
+                lookahead=(max(1, sim.lookahead)
+                           if lookahead is None else lookahead),
                 stop_time=cfg.general.stop_time,
                 bootstrap_end=cfg.general.bootstrap_end_time,
-                seed=cfg.general.seed,
+                seed=cfg.general.seed if seed is None else seed,
                 exchange=xp.exchange,
                 model_bandwidth=xp.model_bandwidth,
                 count_paths=xp.count_paths,
@@ -271,6 +287,7 @@ class DeviceRunner:
             latency_ns=latency_ns,
             reliability=reliability,
             epoch_times=epoch_times,
+            ensemble=ensemble,
             mesh=self._mesh,
             bw_up_bits=np.array([h.bw_up_bits for h in sim.hosts],
                                 dtype=np.int64),
@@ -506,41 +523,18 @@ class DeviceRunner:
             # WRITES artifacts/OCC_*.json)
             self.occ_record = None
         if xp.checkpoint_save:
-            # fail on an unwritable path NOW, in milliseconds — before
-            # the capacity warm-up spends minutes compiling, and not
-            # after a multi-hour run when the state would be lost.
-            # The probe must not leave a zero-byte decoy behind if
-            # the run later dies before saving
-            import os as _os
-            existed = _os.path.lexists(xp.checkpoint_save)
-            try:
-                with open(xp.checkpoint_save, "ab"):
-                    pass
-            except OSError as e:
-                raise ValueError(
-                    f"checkpoint_save path {xp.checkpoint_save!r} "
-                    f"is not writable: {e}") from e
-            if not existed:
-                _os.unlink(xp.checkpoint_save)
+            from shadow_tpu.device import checkpoint
+            checkpoint.probe_writable(xp.checkpoint_save)
         if xp.checkpoint_load:
             # pre-validate the resume parameters from the npz meta
             # alone, for the same reason as the writability probe:
             # fail in milliseconds, not after the capacity warm-up
             # spends minutes compiling
             from shadow_tpu.device import checkpoint
-            t_peek = int(checkpoint.peek_meta(
-                xp.checkpoint_load)["sim_time"])
-            if t_peek >= stop:
-                raise ValueError(
-                    f"checkpoint_load: saved state pauses at "
-                    f"{t_peek} ns, at/after stop_time {stop} ns — "
-                    f"nothing to resume")
-            if xp.checkpoint_save and xp.checkpoint_save_time and \
-                    min(stop, xp.checkpoint_save_time) <= t_peek:
-                raise ValueError(
-                    f"checkpoint_save_time "
-                    f"{min(stop, xp.checkpoint_save_time)} ns is not "
-                    f"after the run's start time {t_peek} ns")
+            checkpoint.prevalidate_resume(
+                xp.checkpoint_load, stop,
+                save_path=xp.checkpoint_save,
+                save_time=xp.checkpoint_save_time)
         if xp.capacity_plan != "static" and not self._planned:
             self._plan_capacities(stop)
         if xp.checkpoint_load:

@@ -1,0 +1,26 @@
+"""Ensemble engine: vmapped multi-replica simulation campaigns.
+
+``ensemble:`` configs run R independent replicas of a device-twin
+workload in ONE compiled program — the engine vmaps the fused round
+step over a replica axis composed outside the mesh shard axis, so a
+seed/latency/loss/fault sweep pays one compile and one dispatch
+stream instead of N. See spec.py (replica worlds + the determinism
+contract) and campaign.py (the runner + ENSEMBLE_*.json record).
+"""
+
+from shadow_tpu.ensemble.spec import (
+    EnsembleWorlds,
+    build_worlds,
+    campaign_fingerprint,
+    seed_key_np,
+)
+from shadow_tpu.ensemble.campaign import EnsembleRunner, aggregate
+
+__all__ = [
+    "EnsembleWorlds",
+    "EnsembleRunner",
+    "aggregate",
+    "build_worlds",
+    "campaign_fingerprint",
+    "seed_key_np",
+]

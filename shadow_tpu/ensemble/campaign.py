@@ -1,0 +1,549 @@
+"""EnsembleRunner: R-replica simulation campaigns in one program.
+
+The ensemble twin of device/runner.py's DeviceRunner: maps the config
+to its vectorized device app, builds ONE engine whose program carries
+a leading replica axis (vmapped outside the mesh shard axis), plans
+capacities once from the worst-case replica, advances all replicas in
+heartbeat/dispatch segments with per-replica heartbeat lines, and
+emits an ``artifacts/ENSEMBLE_*.json`` campaign record with
+per-replica checksums plus aggregate statistics.
+
+Why one program: a seed/loss/fault sweep as N serial processes pays
+the XLA compile and every dispatch N times; as one vmapped program it
+pays them once, and the replica axis rides the vector units the small
+per-host shapes leave idle. Replica *i* stays bit-identical to a
+standalone run with replica *i*'s parameters (spec.py's contract), so
+campaign aggregates are statistics over *real* runs, not
+approximations.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from shadow_tpu import simtime
+from shadow_tpu._jax import jax
+from shadow_tpu.core.manager import SimStats
+from shadow_tpu.device import capacity
+from shadow_tpu.device.runner import DeviceRunner, NoDeviceTwin
+from shadow_tpu.ensemble.spec import EnsembleWorlds, build_worlds
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("ensemble")
+
+RECORD_FORMAT = 1
+# per-replica per-host checksum lists stay inline below this host
+# count; larger campaigns keep the sha256 digest only
+CHK_INLINE_HOSTS = 64
+
+_AGG_OPS = {
+    "mean": np.mean,
+    "min": np.min,
+    "max": np.max,
+    "p5": lambda v: np.percentile(v, 5),
+    "p95": lambda v: np.percentile(v, 95),
+}
+
+
+def aggregate(values, which) -> dict:
+    """Aggregate one per-replica metric vector with the configured
+    statistics (mean/p5/p95/min/max)."""
+    v = np.asarray(values, np.float64)
+    return {k: float(_AGG_OPS[k](v)) for k in which}
+
+
+class EnsembleRunner:
+    """Runs the ``ensemble:`` campaign of a built simulation. Raises
+    NoDeviceTwin when the config's apps have no fully-vectorized
+    device twin — there is no hybrid fallback for campaigns (CPU host
+    emulation cannot vmap), so the Controller surfaces that loudly
+    instead of silently running one replica."""
+
+    def __init__(self, sim, trace: Optional[list] = None, mesh=None):
+        eopts = sim.cfg.ensemble
+        if eopts is None:
+            raise ValueError("EnsembleRunner needs an ensemble: "
+                             "config block")
+        if trace is not None:
+            raise ValueError(
+                "ensemble campaigns do not record python event "
+                "traces; use the per-replica checksums in the "
+                "ENSEMBLE record")
+        if getattr(sim, "host_faults", None):
+            raise ValueError(
+                "ensemble: host_crash/host_restart faults are "
+                "manager-side events — the campaign engine cannot "
+                "run them (vary link faults via "
+                "ensemble.fault_schedules instead)")
+        # reuse DeviceRunner wholesale for the single-replica twin
+        # mapping, knob plumbing, and engine construction — the
+        # campaign engine is the same engine with ensemble worlds
+        # (defer_engine: the standalone engine it would build is dead
+        # weight here)
+        self._base = DeviceRunner(sim, trace=None, mesh=mesh,
+                                  defer_engine=True)
+        self.app = self._base.app
+        self.sim = sim
+        self.worlds: EnsembleWorlds = build_worlds(sim, eopts)
+        if hasattr(self.app, "seed_pair") and \
+                len(set(int(s) for s in self.worlds.seeds)) > 1:
+            # TorDevice bakes its route seed into the program as a
+            # compile constant — a seed sweep would leave every
+            # replica's routes identical and silently break the
+            # replica-i == standalone-i contract
+            raise ValueError(
+                "ensemble: vary.seed is not supported for "
+                f"{type(self.app).__name__} (it derives app-internal "
+                "RNG from the seed at build time); sweep "
+                "latency/loss/faults instead")
+        self.engine = self._build_engine()
+        self.replans = 0
+        self._planned = False
+        self.occ_record: Optional[dict] = None
+        self.record: Optional[dict] = None
+        self.final_state: Optional[dict] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def lookahead(self) -> int:
+        """The campaign's shared lookahead window: the min over every
+        replica's table (each replica's standalone floor is >= it, so
+        it is conservative for all). determinism_gate --ensemble pins
+        standalone comparison runs to this value via
+        experimental.runahead."""
+        xp = self.sim.cfg.experimental
+        if xp.runahead is not None:
+            return max(1, xp.runahead)
+        return max(1, min(self.worlds.lookahead, self.sim.lookahead))
+
+    def _build_engine(self):
+        """The DeviceRunner's engine builder with the ensemble worlds
+        attached: the engine swaps in replica 0's tables as its base
+        world and additionally compiles the vmapped campaign program.
+        One builder serves both runners — knob plumbing, outbox
+        floors, and strategy tristates cannot drift apart."""
+        return self._base._build_engine(
+            ensemble=self.worlds,
+            lookahead=self.lookahead,
+            seed=int(self.worlds.seeds[0]))
+
+    @property
+    def _capacity_overrides(self) -> dict:
+        return self._base._capacity_overrides
+
+    @_capacity_overrides.setter
+    def _capacity_overrides(self, value: dict) -> None:
+        self._base._capacity_overrides = value
+
+    # ------------------------------------------------------------------
+    def _worst_case_view(self, states) -> dict:
+        """Reduce the [R, ...] occupancy/overflow leaves to the
+        standalone shapes capacity.measure expects: elementwise MAX
+        over the replica axis for high-water marks (the worst-case
+        replica sizes the shared capacities), SUM for the loud
+        overflow counters (any replica's loss fails the campaign)."""
+        view = {}
+        for k in ("occ_heap", "occ_ob", "occ_in", "occ_x",
+                  "occ_trips", "occ_phases"):
+            view[k] = np.asarray(jax.device_get(states[k])).max(0)
+        for k in ("overflow", "x_overflow"):
+            view[k] = np.asarray(jax.device_get(states[k])).sum(0)
+        return view
+
+    def _plan_capacities(self, stop: int) -> None:
+        """capacity_plan on the campaign: the warm-up slice runs the
+        ENSEMBLE program, so the plan sizes every capacity from the
+        worst-case replica's measured occupancy — one replica with a
+        hot hub cannot overflow the others' tight plan."""
+        xp = self.sim.cfg.experimental
+        mode = xp.capacity_plan
+        if xp.checkpoint_load:
+            # same contract as DeviceRunner._plan_capacities: the
+            # fingerprint pins the SAVING engine's capacities, so a
+            # resume adopts them instead of re-planning (a fresh
+            # warm-up could plan smaller sizes and reject a valid
+            # campaign checkpoint — and would pay the warm-up compile
+            # on every resume for nothing)
+            from shadow_tpu.device import checkpoint
+            meta = checkpoint.peek_meta(xp.checkpoint_load)
+            caps = meta.get("capacities")
+            if caps is None:
+                caps = {k: meta["fingerprint"][k]
+                        for k in ("event_capacity", "outbox_capacity")}
+            self._capacity_overrides = {
+                k: int(v) for k, v in caps.items()}
+            self.engine = self._build_engine()
+            self._planned = True
+            log.warning("capacity_plan: %s skipped — checkpoint_load "
+                        "resumes the campaign with the saved "
+                        "engine's capacities %s", mode,
+                        self._capacity_overrides)
+            return
+        static_knobs = {
+            k: getattr(self.engine.config, k)
+            for k in ("event_capacity", "outbox_capacity",
+                      "exchange_capacity", "exchange_in_capacity",
+                      "outbox_compact")}
+        if mode == "auto":
+            warm = xp.capacity_warmup or max(1, stop // 8)
+            warm = min(warm, stop)
+            seg = xp.dispatch_segment
+            states = self.engine.init_ensemble_state(self.sim.starts)
+            for attempt in range(capacity.MAX_REPLANS + 1):
+                t = 0
+                dims = ()
+                while t < warm:
+                    nxt = min(warm, t + seg) if seg else warm
+                    states, _ = self.engine.run_ensemble(
+                        states, stop=nxt, final_stop=stop)
+                    t = nxt
+                    dims = capacity.overflow_dims(states)
+                    if dims:
+                        break
+                if not dims:
+                    break
+                if attempt == capacity.MAX_REPLANS:
+                    raise RuntimeError(
+                        f"ensemble capacity warm-up still overflows "
+                        f"after {capacity.MAX_REPLANS} doublings on "
+                        f"{dims}")
+                self._capacity_overrides = capacity.widen(
+                    self._capacity_overrides, dims,
+                    self.engine.effective)
+                log.warning("ensemble capacity warm-up overflowed on "
+                            "%s; retrying with %s", dims,
+                            self._capacity_overrides)
+                self.engine = self._build_engine()
+                states = self.engine.init_ensemble_state(
+                    self.sim.starts)
+            record = capacity.measure(
+                self.engine, self._worst_case_view(states),
+                source=f"ensemble-warmup:{warm}ns")
+        else:
+            record = capacity.load_record(mode)
+            want = {"app": type(self.app).__name__,
+                    "app_fp": capacity.app_fingerprint(self.app),
+                    "n_hosts": len(self.sim.hosts)}
+            got = {k: record["workload"].get(k) for k in want}
+            if got != want:
+                raise ValueError(
+                    f"occupancy record {mode} was measured on {got}; "
+                    f"this campaign is {want} — re-measure with "
+                    "capacity_plan: auto")
+        planned = capacity.plan(
+            record,
+            per_iter=self.engine.effective["M_out"],
+            floor_iters=4 if self._base._burst > 1 else 8,
+            n_shards=self.engine.n_shards)
+        record["planned"] = planned
+        record["static"] = static_knobs
+        self.occ_record = record
+        self._capacity_overrides = dict(planned)
+        self.engine = self._build_engine()
+        self._planned = True
+        log.info("ensemble capacity plan (%s): %s  [measured %s]",
+                 mode, planned, record["measured"])
+
+    # ------------------------------------------------------------------
+    def _emit_heartbeats(self, now: int, states) -> None:
+        """Per-replica heartbeat lines at a segment boundary: replica
+        totals from the device counters (the [R, H] arrays are a few
+        KB — never the heaps)."""
+        H = len(self.sim.hosts)
+        n_exec = np.asarray(jax.device_get(states["n_exec"]))[:, :H]
+        n_sent = np.asarray(jax.device_get(states["n_sent"]))[:, :H]
+        n_drop = np.asarray(jax.device_get(states["n_drop"]))[:, :H]
+        n_deliv = np.asarray(jax.device_get(states["n_deliv"]))[:, :H]
+        for r in range(self.worlds.R):
+            log.info("[ensemble-heartbeat] t=%s replica=%d events=%d "
+                     "sent=%d dropped=%d delivered=%d",
+                     simtime.format_time(now), r,
+                     int(n_exec[r].sum()), int(n_sent[r].sum()),
+                     int(n_drop[r].sum()), int(n_deliv[r].sum()))
+
+    def _advance(self, states, t_start: int, pause: int, stop: int):
+        """Segmented advance of all replicas with the overflow
+        re-plan/retry loop (the DeviceRunner contract: a plan that
+        undershoots costs one re-run from the last known-good state,
+        never the campaign)."""
+        xp = self.sim.cfg.experimental
+        hb = self.sim.cfg.general.heartbeat_interval
+        seg = xp.dispatch_segment
+        retry_ok = xp.capacity_plan != "static"
+        budget = self.engine.config.max_rounds
+        good_states, good_t = (states if retry_ok else None), t_start
+        rounds_vec = np.zeros(self.worlds.R, np.int64)
+        budget_hit = False
+        overflowed = False
+        t = t_start
+        next_hb = (t // hb + 1) * hb if hb else None
+        while t < pause:
+            nxt = pause
+            if next_hb is not None:
+                nxt = min(nxt, next_hb)
+            if seg:
+                nxt = min(nxt, t + seg)
+            states, seg_rounds = self.engine.run_ensemble(
+                states, stop=nxt, final_stop=stop)
+            dims = capacity.overflow_dims(states)
+            if dims:
+                if not retry_ok or \
+                        self.replans >= capacity.MAX_REPLANS:
+                    rounds_vec += np.asarray(seg_rounds)
+                    t = nxt
+                    overflowed = True
+                    break
+                self.replans += 1
+                self._capacity_overrides = capacity.widen(
+                    self._capacity_overrides, dims,
+                    self.engine.effective)
+                log.warning(
+                    "ensemble capacity overflow on %s in (%d, %d] "
+                    "ns; re-plan #%d with %s, re-running from "
+                    "t=%d ns", dims, good_t, nxt, self.replans,
+                    self._capacity_overrides, good_t)
+                self.engine = self._build_engine()
+                states = capacity.transfer(
+                    self.engine, self.sim.starts,
+                    jax.device_get(good_states),
+                    template=self.engine.init_ensemble_state(
+                        self.sim.starts))
+                good_states = states
+                t = good_t
+                next_hb = (t // hb + 1) * hb if hb else None
+                continue
+            rounds_vec += np.asarray(seg_rounds)
+            t = nxt
+            if int(rounds_vec.max()) >= budget:
+                if t < pause:
+                    log.warning("max_rounds (%d) exhausted during "
+                                "campaign segmentation; stopping",
+                                budget)
+                budget_hit = True
+                break
+            if next_hb is not None and t >= next_hb and t < stop:
+                self._emit_heartbeats(t, states)
+                next_hb += hb
+            if retry_ok:
+                good_states, good_t = states, t
+        return states, rounds_vec, t, budget_hit, overflowed
+
+    # ------------------------------------------------------------------
+    def record_path(self) -> str:
+        """Canonical campaign record path (ensemble.record_path
+        overrides; SHADOW_TPU_OCC_DIR redirects the artifacts dir —
+        the same env tests already use to keep runs out of the
+        repo)."""
+        eopts = self.sim.cfg.ensemble
+        if eopts.record_path:
+            return eopts.record_path
+        directory = os.environ.get("SHADOW_TPU_OCC_DIR", "artifacts")
+        return os.path.join(
+            directory,
+            f"ENSEMBLE_{type(self.app).__name__}"
+            f"_{len(self.sim.hosts)}_{self.worlds.campaign_fp}.json")
+
+    def _build_record(self, final: dict, rounds_r, wall: float,
+                      ok: bool) -> dict:
+        import hashlib
+
+        H = len(self.sim.hosts)
+        w = self.worlds
+        eopts = self.sim.cfg.ensemble
+        metrics = {
+            "events_executed": final["n_exec"][:, :H].sum(1),
+            "packets_sent": final["n_sent"][:, :H].sum(1),
+            "packets_dropped": final["n_drop"][:, :H].sum(1),
+            "packets_delivered": final["n_deliv"][:, :H].sum(1),
+            "rounds": np.asarray(rounds_r),
+        }
+        replicas = []
+        for r in range(w.R):
+            chk = np.ascontiguousarray(final["chk"][r, :H])
+            entry = dict(w.descriptors[r])
+            entry.update({
+                "events_executed": int(metrics["events_executed"][r]),
+                "packets_sent": int(metrics["packets_sent"][r]),
+                "packets_dropped": int(metrics["packets_dropped"][r]),
+                "packets_delivered": int(
+                    metrics["packets_delivered"][r]),
+                "host_checksums_sha256": hashlib.sha256(
+                    chk.tobytes()).hexdigest()[:16],
+            })
+            if H <= CHK_INLINE_HOSTS:
+                entry["host_checksums"] = [int(c) for c in chk]
+            replicas.append(entry)
+        return {
+            "format": RECORD_FORMAT,
+            "campaign": w.campaign_fp,
+            "workload": {
+                "app": type(self.app).__name__,
+                "n_hosts": H,
+                "stop_time": int(self.sim.cfg.general.stop_time),
+                "replicas": w.R,
+                "lookahead": self.lookahead,
+            },
+            "vary": w.descriptors,
+            "replicas": replicas,
+            "aggregates": {
+                name: aggregate(vals, eopts.aggregate)
+                for name, vals in metrics.items()},
+            "wall_s": round(wall, 3),
+            "replans": self.replans,
+            "ok": bool(ok),
+        }
+
+    # ------------------------------------------------------------------
+    def run(self, stop: int) -> SimStats:
+        from shadow_tpu.device import checkpoint
+
+        xp = self.sim.cfg.experimental
+        self.replans = 0
+        w = self.worlds
+        if xp.checkpoint_save:
+            checkpoint.probe_writable(xp.checkpoint_save)
+        if xp.checkpoint_load:
+            meta = checkpoint.peek_meta(xp.checkpoint_load)
+            camp = (meta.get("ensemble") or {}).get("campaign")
+            if camp is None:
+                raise ValueError(
+                    f"checkpoint {xp.checkpoint_load} was saved by a "
+                    "standalone run — an ensemble campaign cannot "
+                    "resume it")
+            if camp != w.campaign_fp:
+                raise ValueError(
+                    f"checkpoint {xp.checkpoint_load} belongs to "
+                    f"campaign {camp}; this config builds "
+                    f"{w.campaign_fp} — the vary block or schedules "
+                    "changed, so the saved replicas would diverge")
+            checkpoint.prevalidate_resume(
+                xp.checkpoint_load, stop,
+                save_path=xp.checkpoint_save,
+                save_time=xp.checkpoint_save_time)
+        if xp.capacity_plan != "static" and not self._planned:
+            self._plan_capacities(stop)
+        if xp.checkpoint_load:
+            states, t_start = checkpoint.load_state(
+                self.engine, self.sim.starts, xp.checkpoint_load,
+                final_stop=stop,
+                template=self.engine.init_ensemble_state(
+                    self.sim.starts))
+            log.info("resumed campaign checkpoint %s at t=%d ns",
+                     xp.checkpoint_load, t_start)
+        else:
+            states = self.engine.init_ensemble_state(self.sim.starts)
+            t_start = 0
+        pause = stop
+        if xp.checkpoint_save:
+            if xp.checkpoint_save_time:
+                pause = min(stop, xp.checkpoint_save_time)
+            if pause <= t_start:
+                raise ValueError(
+                    f"checkpoint_save_time {pause} ns is not after "
+                    f"the campaign's start time {t_start} ns")
+        t0 = time.perf_counter()
+        states, rounds_r, t_end, budget_hit, overflowed = \
+            self._advance(states, t_start, pause, stop)
+        rounds = int(np.asarray(rounds_r).max())
+        if xp.checkpoint_save:
+            if budget_hit or overflowed:
+                log.error("%s before the checkpoint boundary — NOT "
+                          "saving %s",
+                          "max_rounds exhausted" if budget_hit
+                          else "capacity overflow (events lost)",
+                          xp.checkpoint_save)
+            else:
+                checkpoint.save_state(
+                    self.engine, states, xp.checkpoint_save, t_end,
+                    final_stop=stop,
+                    extra_meta={"campaign": w.campaign_fp,
+                                "replicas": int(w.R)})
+                log.info("campaign checkpoint saved at t=%d ns -> %s",
+                         t_end, xp.checkpoint_save)
+        stat_keys = [k for k in states
+                     if k not in ("ht", "hk", "hm", "hv", "hw")]
+        final = {k: np.asarray(v) for k, v in jax.device_get(
+            {k: states[k] for k in stat_keys}).items()}
+        wall = time.perf_counter() - t0
+        self.final_state = final
+        H = len(self.sim.hosts)
+
+        # `final` already holds every counter host-side — the
+        # worst-case reduction reuses it rather than re-fetching the
+        # same [R, ...] arrays from device
+        occ = capacity.measure(self.engine,
+                               self._worst_case_view(final),
+                               source="ensemble-run")
+        occ["workload"]["replicas"] = int(w.R)
+        if self.occ_record is not None:
+            self.occ_record["final_measured"] = occ["measured"]
+            self.occ_record["effective"] = occ["effective"]
+            self.occ_record["replans"] = self.replans
+            self.occ_record["applied"] = dict(
+                self._capacity_overrides)
+        else:
+            self.occ_record = occ
+
+        overflow = int(final["overflow"][:, :H].sum())
+        x_overflow = int(final["x_overflow"][:, :H].sum())
+        ok = overflow == 0 and x_overflow == 0 and not budget_hit
+        self.record = self._build_record(final, rounds_r, wall, ok)
+        path = self.record_path()
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.record, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+            log.info("ensemble record -> %s", path)
+        except OSError as e:
+            log.warning("could not write ensemble record %s: %s",
+                        path, e)
+
+        n_exec_total = int(final["n_exec"][:, :H].sum())
+        log.info("ensemble perf: %d replicas, %d rounds in %.2fs "
+                 "wall (%.0f events/s aggregate)", w.R, rounds, wall,
+                 n_exec_total / wall if wall > 0 else 0.0)
+
+        stats = SimStats()
+        stats.end_time = t_end
+        stats.rounds = int(rounds)
+        stats.occupancy = self.occ_record
+        stats.replans = self.replans
+        stats.ensemble = self.record
+        # campaign totals (all replicas) — the aggregate view; the
+        # per-replica breakdown lives in the record
+        stats.events_executed = n_exec_total
+        stats.packets_sent = int(final["n_sent"][:, :H].sum())
+        stats.packets_dropped = int(final["n_drop"][:, :H].sum())
+        stats.packets_delivered = int(final["n_deliv"][:, :H].sum())
+        if overflow:
+            stats.ok = False
+            log.error("ensemble engine overflow: %d events lost — "
+                      "raise experimental.event_capacity/"
+                      "outbox_capacity, or set capacity_plan: auto",
+                      overflow)
+        if x_overflow:
+            stats.ok = False
+            log.error("ensemble exchange overflow: %d rows exceeded "
+                      "the per-shard-pair capacity — raise "
+                      "experimental.exchange_capacity or use "
+                      "capacity_plan: auto", x_overflow)
+
+        # replica 0's per-host results reflect onto the Host objects:
+        # the determinism gate's signature path (and any tooling that
+        # reads hosts) sees the base replica, which must bit-match a
+        # standalone run with replica 0's parameters
+        for h in self.sim.hosts:
+            i = h.host_id
+            h.events_executed = int(final["n_exec"][0, i])
+            h.packets_sent = int(final["n_sent"][0, i])
+            h.packets_dropped = int(final["n_drop"][0, i])
+            h.packets_delivered = int(final["n_deliv"][0, i])
+            h.trace_checksum = int(final["chk"][0, i])
+        return stats

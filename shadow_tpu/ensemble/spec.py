@@ -1,0 +1,197 @@
+"""Replica worlds: the value-only parameter stacks an ensemble varies.
+
+An ensemble campaign runs R replicas of one device-twin workload in a
+single compiled program (device/engine.py vmaps the fused round step
+over a leading replica axis, outside the host shard axis). The ONLY
+things a replica may vary are array *values* the engine already takes
+as traced inputs — the seed key pair, the topology latency/reliability
+tables, and the fault-epoch start times. Shapes are shared: every
+replica sees the same hosts, capacities, stop time, and epoch count
+(shorter fault schedules pad with never-reached epochs that repeat
+their last real matrices).
+
+This module turns the validated ``ensemble:`` config block
+(config/schema.py EnsembleOptions) into an :class:`EnsembleWorlds` —
+the stacked numpy arrays the engine consumes — plus the campaign
+fingerprint that stamps checkpoints and the ENSEMBLE_*.json record.
+
+Determinism contract: replica *i*'s slice of the stacked world is
+value-identical to the world a standalone run with replica *i*'s
+parameters would build, so replica *i*'s trace is bit-identical to
+that standalone run (determinism_gate.py --ensemble enforces it in
+CI). The one shared scalar is the lookahead window: the campaign uses
+the MIN over all replicas' tables (conservative for every replica); a
+standalone comparison run pins ``experimental.runahead`` to it when
+its own floor differs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+# pad value for never-reached fault epochs: the engine's INF sentinel,
+# far above any reachable sim time, so the epoch select can never pick
+# a padded epoch for a real send (empty outbox rows gather it
+# harmlessly — they are masked downstream)
+FAR_EPOCH = np.int64(1) << np.int64(62)
+
+
+@dataclass
+class EnsembleWorlds:
+    """Stacked per-replica world arrays (engine constructor input).
+
+    latency/reliability are ``[R, V, V]`` when no replica has a fault
+    schedule, else ``[R, T, V, V]`` with the shared padded epoch count
+    T; epoch_times is ``[R, T]``; the seed key halves are ``[R]``
+    uint32 (prng.seed_key split per replica).
+    """
+
+    R: int
+    latency: np.ndarray
+    reliability: np.ndarray
+    epoch_times: np.ndarray
+    seed_k1: np.ndarray
+    seed_k2: np.ndarray
+    seeds: np.ndarray              # [R] engine seeds
+    lookahead: int                 # min latency over every replica
+    descriptors: list = field(default_factory=list)
+    campaign_fp: str = ""
+
+
+def seed_key_np(seed: int) -> tuple[np.uint32, np.uint32]:
+    """numpy twin of device/prng.seed_key — the same 64-bit mask and
+    split, so the traced per-replica keys are bit-identical to the
+    scalars a standalone engine would close over."""
+    s = int(seed) & 0xFFFF_FFFF_FFFF_FFFF
+    return np.uint32(s >> 32), np.uint32(s & 0xFFFF_FFFF)
+
+
+def campaign_fingerprint(R: int, seeds, descriptors,
+                         latency: np.ndarray, reliability: np.ndarray,
+                         epoch_times: np.ndarray) -> str:
+    """Digest of everything that defines the campaign's replica set.
+    Checkpoints stamp it (resuming a campaign against an edited vary
+    block must fail loudly) and the ENSEMBLE record carries it."""
+    h = hashlib.sha256()
+    h.update(f"R={R}".encode())
+    h.update(np.asarray(seeds, np.int64).tobytes())
+    for d in descriptors:
+        h.update(repr(sorted(d.items())).encode())
+    for a in (latency, reliability, epoch_times):
+        a = np.ascontiguousarray(a)
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:12]
+
+
+def build_worlds(sim, eopts) -> EnsembleWorlds:
+    """Compile the ``ensemble:`` block against a built simulation into
+    the stacked world arrays. `sim` is a BuiltSimulation (topology +
+    base fault table already compiled); `eopts` the validated
+    EnsembleOptions."""
+    from shadow_tpu import faults as faultmod
+
+    cfg = sim.cfg
+    R = int(eopts.replicas)
+    vary = eopts.vary
+    seeds = [int(s) for s in vary.get("seed",
+                                      [cfg.general.seed] * R)]
+    scales = [float(x) for x in vary.get("latency_scale", [1.0] * R)]
+    deltas = [float(x) for x in vary.get("packet_loss_delta",
+                                         [0.0] * R)]
+    names = [str(n) for n in vary.get("fault_schedule", ["base"] * R)]
+
+    # compile each distinct named schedule once against the topology
+    # (the same dense_adjacency + shortest-path pipeline the base
+    # network.faults schedule went through at build time)
+    tables: dict = {}
+
+    def table_for(name: str):
+        if name not in tables:
+            if name == "base":
+                tables[name] = sim.fault_table
+            elif name == "none":
+                tables[name] = None
+            else:
+                tables[name] = faultmod.compile_link_faults(
+                    sim.topology, eopts.fault_schedules[name])
+        return tables[name]
+
+    base_lat = np.asarray(sim.topology.latency_ns, np.int64)
+    base_rel = np.asarray(sim.topology.reliability, np.float32)
+    per = []
+    T_max = 1
+    for r in range(R):
+        tab = table_for(names[r])
+        if tab is None:
+            times = np.zeros(1, np.int64)
+            lat = base_lat[None]
+            rel = base_rel[None].astype(np.float64)
+        else:
+            times = np.asarray(tab.times, np.int64)
+            lat = np.asarray(tab.latency_ns, np.int64)
+            rel = np.asarray(tab.reliability,
+                             np.float32).astype(np.float64)
+        if scales[r] != 1.0:
+            lat = np.maximum(1, np.rint(
+                lat.astype(np.float64) * scales[r])).astype(np.int64)
+        if deltas[r] != 0.0:
+            rel = np.clip(rel - deltas[r], 0.0, 1.0)
+        per.append((times, lat, rel.astype(np.float32)))
+        T_max = max(T_max, len(times))
+
+    lats, rels, eps = [], [], []
+    for times, lat, rel in per:
+        pad = T_max - len(times)
+        if pad:
+            # never-reached epochs repeating the last real matrices:
+            # value-identical lookups for every reachable send time
+            times = np.concatenate(
+                [times, np.full(pad, FAR_EPOCH, np.int64)])
+            lat = np.concatenate([lat, np.repeat(lat[-1:], pad, 0)])
+            rel = np.concatenate([rel, np.repeat(rel[-1:], pad, 0)])
+        eps.append(times)
+        lats.append(lat)
+        rels.append(rel)
+    latency = np.stack(lats)               # [R, T, V, V]
+    reliability = np.stack(rels)
+    epoch_times = np.stack(eps)            # [R, T]
+    if T_max == 1:
+        # fault-free campaigns keep the plain [R, V, V] matrices so
+        # each replica's program matches the pre-fault-layer engine
+        # byte for byte (the same squeeze the standalone engine does)
+        latency = latency[:, 0]
+        reliability = reliability[:, 0]
+
+    if (latency > np.iinfo(np.int32).max).any():
+        bad = [r for r in range(R)
+               if (latency[r] > np.iinfo(np.int32).max).any()]
+        raise ValueError(
+            f"ensemble: replica(s) {bad} have scaled path latencies "
+            "above ~2.1 s — they do not fit the i32 device latency "
+            "matrix (lower vary.latency_scale)")
+
+    k1 = np.empty(R, np.uint32)
+    k2 = np.empty(R, np.uint32)
+    for r, s in enumerate(seeds):
+        k1[r], k2[r] = seed_key_np(s)
+
+    descriptors = [
+        {"replica": r, "seed": seeds[r], "latency_scale": scales[r],
+         "packet_loss_delta": deltas[r], "fault_schedule": names[r]}
+        for r in range(R)]
+    return EnsembleWorlds(
+        R=R,
+        latency=latency.astype(np.int32),
+        reliability=reliability.astype(np.float32),
+        epoch_times=epoch_times,
+        seed_k1=k1, seed_k2=k2,
+        seeds=np.asarray(seeds, np.int64),
+        lookahead=int(latency.min()),
+        descriptors=descriptors,
+        campaign_fp=campaign_fingerprint(
+            R, seeds, descriptors, latency, reliability, epoch_times),
+    )

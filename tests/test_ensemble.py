@@ -1,0 +1,327 @@
+"""Ensemble engine: vmapped multi-replica campaigns.
+
+Fast layers (schema validation, replica-world building, aggregation)
+run in tier-1; everything that compiles a DeviceEngine program is
+marked slow (the tier-1 budget rule). The full campaign determinism
+matrix — replica-0 vs standalone serial AND tpu — additionally runs
+in CI via `determinism_gate.py --ensemble` on
+examples/ensemble_seed_sweep.yaml.
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.config.schema import EnsembleOptions
+
+SMALL = """
+general: {{stop_time: 1500ms, seed: 1}}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [ directed 0
+        node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ]
+        edge [ source 0 target 1 latency "5 ms" packet_loss 0.02 ]
+        edge [ source 1 target 1 latency "10 ms" packet_loss 0.0 ] ]
+experimental:
+  scheduler_policy: tpu
+{ensemble}
+hosts:
+  server:
+    network_node_id: 0
+    processes: [{{path: "model:tgen_server", start_time: 50ms}}]
+  client:
+    quantity: 4
+    network_node_id: 1
+    processes:
+    - path: model:tgen_client
+      args: server=server size=60KiB count=2 pause=100ms retry=300ms
+      start_time: 100ms
+"""
+
+ENSEMBLE_BLOCK = """
+ensemble:
+  replicas: 2
+  vary:
+    seed: [1, 9]
+"""
+
+
+def _cfg(ensemble: str = ""):
+    return load_config_str(SMALL.format(ensemble=ensemble))
+
+
+# ------------------------------------------------------------- schema
+def test_schema_requires_tpu_policy():
+    bad = SMALL.format(ensemble=ENSEMBLE_BLOCK).replace(
+        "scheduler_policy: tpu", "scheduler_policy: serial")
+    with pytest.raises(ValueError, match="scheduler_policy: tpu"):
+        load_config_str(bad)
+
+
+def test_schema_vary_length_must_match_replicas():
+    with pytest.raises(ValueError, match="one.*value per replica"):
+        EnsembleOptions.from_dict(
+            {"replicas": 3, "vary": {"seed": [1, 2]}})
+
+
+def test_schema_rejects_unknown_axis_and_empty_vary():
+    with pytest.raises(ValueError, match="unknown key"):
+        EnsembleOptions.from_dict(
+            {"replicas": 2, "vary": {"stop_time": [1, 2]}})
+    with pytest.raises(ValueError, match="empty vary"):
+        EnsembleOptions.from_dict({"replicas": 2})
+
+
+def test_schema_rejects_bad_axis_values():
+    with pytest.raises(ValueError, match="latency_scale"):
+        EnsembleOptions.from_dict(
+            {"replicas": 2, "vary": {"latency_scale": [1.0, 0.0]}})
+    with pytest.raises(ValueError, match="packet_loss_delta"):
+        EnsembleOptions.from_dict(
+            {"replicas": 2, "vary": {"packet_loss_delta": [0.0, 1.5]}})
+
+
+def test_schema_fault_schedule_rules():
+    # unknown schedule name
+    with pytest.raises(ValueError, match="unknown schedule"):
+        EnsembleOptions.from_dict(
+            {"replicas": 2,
+             "vary": {"fault_schedule": ["base", "storm"]}})
+    # reserved names
+    with pytest.raises(ValueError, match="reserved"):
+        EnsembleOptions.from_dict(
+            {"replicas": 1, "vary": {"seed": [1]},
+             "fault_schedules": {"base": []}})
+    # host faults are manager-side: never in a campaign schedule
+    with pytest.raises(ValueError, match="host faults"):
+        EnsembleOptions.from_dict(
+            {"replicas": 2,
+             "vary": {"fault_schedule": ["base", "crashy"]},
+             "fault_schedules": {"crashy": [
+                 {"kind": "host_crash", "time": "1s",
+                  "host": "client0"}]}})
+
+
+def test_schema_aggregate_choices():
+    opts = EnsembleOptions.from_dict(
+        {"replicas": 2, "vary": {"seed": [1, 2]},
+         "aggregate": ["mean", "max"]})
+    assert opts.aggregate == ("mean", "max")
+    with pytest.raises(ValueError, match="aggregate"):
+        EnsembleOptions.from_dict(
+            {"replicas": 2, "vary": {"seed": [1, 2]},
+             "aggregate": ["median"]})
+
+
+# ------------------------------------------------------ worlds (spec)
+def _worlds(cfg):
+    from shadow_tpu.core.controller import build
+    from shadow_tpu.ensemble.spec import build_worlds
+
+    sim = build(cfg)
+    return build_worlds(sim, cfg.ensemble)
+
+
+def test_worlds_seed_sweep_keys_match_engine_seed_key():
+    from shadow_tpu.device import prng
+
+    cfg = _cfg(ENSEMBLE_BLOCK)
+    w = _worlds(cfg)
+    assert w.R == 2
+    assert w.latency.shape[0] == 2 and w.latency.ndim == 3
+    assert (w.epoch_times == 0).all()          # fault-free: T == 1
+    for r, seed in enumerate([1, 9]):
+        k1, k2 = prng.seed_key(seed)
+        assert int(w.seed_k1[r]) == int(k1)
+        assert int(w.seed_k2[r]) == int(k2)
+    # replica 0 is the base world bit-for-bit
+    assert w.descriptors[0]["seed"] == 1
+
+
+def test_worlds_fault_schedule_padding_and_lookahead():
+    from shadow_tpu.ensemble.spec import FAR_EPOCH
+
+    block = """
+ensemble:
+  replicas: 2
+  vary:
+    fault_schedule: [none, slow]
+  fault_schedules:
+    slow:
+      - {kind: degrade, time: 500ms, duration: 200ms, source: 0,
+         target: 1, latency_multiplier: 3}
+"""
+    cfg = _cfg(block)
+    w = _worlds(cfg)
+    # degrade creates epochs [0, 500ms, 700ms]; the fault-free
+    # replica pads to the shared T with never-reached epochs
+    assert w.epoch_times.shape == (2, 3)
+    assert list(w.epoch_times[1]) == [0, 500_000_000, 700_000_000]
+    assert w.epoch_times[0][0] == 0
+    assert (w.epoch_times[0][1:] == FAR_EPOCH).all()
+    # padded epochs repeat the last real matrices
+    assert (w.latency[0][0] == w.latency[0][1]).all()
+    # lookahead = min over every replica's every epoch (degrade only
+    # raises latency, so the base 5 ms floor stands)
+    assert w.lookahead == 5_000_000
+
+
+def test_worlds_loss_delta_and_scale():
+    block = """
+ensemble:
+  replicas: 2
+  vary:
+    latency_scale: [1.0, 2.0]
+    packet_loss_delta: [0.0, 0.5]
+"""
+    cfg = _cfg(block)
+    w = _worlds(cfg)
+    assert (w.latency[1] == 2 * w.latency[0]).all()
+    assert np.allclose(
+        np.clip(w.reliability[0] - 0.5, 0.0, 1.0), w.reliability[1])
+    assert w.lookahead == int(w.latency[0].min())
+
+
+def test_campaign_fingerprint_tracks_vary():
+    cfg_a = _cfg(ENSEMBLE_BLOCK)
+    cfg_b = _cfg(ENSEMBLE_BLOCK.replace("[1, 9]", "[1, 10]"))
+    assert _worlds(cfg_a).campaign_fp != _worlds(cfg_b).campaign_fp
+    # same vary -> same fingerprint (stable identity for resume)
+    assert _worlds(cfg_a).campaign_fp == _worlds(cfg_a).campaign_fp
+
+
+# -------------------------------------------------------- aggregation
+def test_aggregate_ops():
+    from shadow_tpu.ensemble.campaign import aggregate
+
+    vals = [10, 20, 30, 40]
+    agg = aggregate(vals, ("mean", "p5", "p95", "min", "max"))
+    assert agg["mean"] == 25.0
+    assert agg["min"] == 10.0 and agg["max"] == 40.0
+    assert 10.0 <= agg["p5"] <= 20.0
+    assert 30.0 <= agg["p95"] <= 40.0
+    assert aggregate([7], ("mean",)) == {"mean": 7.0}
+
+
+# ---------------------------------------------- campaign runs (slow)
+@pytest.mark.slow
+def test_campaign_replica_bit_identity_and_record(tmp_path):
+    """The tentpole contract on a small seed sweep: every replica's
+    slice bit-matches a standalone device run with that replica's
+    seed, campaign totals are the per-replica sums, and the ENSEMBLE
+    record lands with per-replica checksums + aggregates. (The CI
+    gate additionally pins replica-0 against the serial oracle.)"""
+    from shadow_tpu.core.controller import Controller
+
+    rec_path = tmp_path / "ENSEMBLE_test.json"
+    block = ENSEMBLE_BLOCK + f"  record_path: {rec_path}\n"
+    cfg = _cfg(block)
+    c = Controller(cfg)
+    stats = c.run()
+    assert stats.ok
+    final = c.runner.final_state
+    H = len(c.sim.hosts)
+
+    total = 0
+    for r, seed in enumerate([1, 9]):
+        cfg2 = _cfg()
+        cfg2.general.seed = seed
+        c2 = Controller(cfg2)
+        s2 = c2.run()
+        assert s2.ok
+        chk = np.array([h.trace_checksum for h in c2.sim.hosts])
+        assert (chk == final["chk"][r, :H]).all(), \
+            f"replica {r} diverged from standalone seed {seed}"
+        assert (np.array([h.events_executed for h in c2.sim.hosts])
+                == final["n_exec"][r, :H]).all()
+        total += s2.packets_sent
+    assert stats.packets_sent == total
+    assert stats.ensemble is not None
+
+    # replica 0's results surface on the Host objects (gate contract)
+    assert [h.trace_checksum for h in c.sim.hosts] == \
+        [int(x) for x in final["chk"][0, :H]]
+
+    import json
+    with open(rec_path) as f:
+        rec = json.load(f)
+    assert rec["campaign"] == c.runner.worlds.campaign_fp
+    assert len(rec["replicas"]) == 2
+    assert rec["replicas"][1]["seed"] == 9
+    assert rec["replicas"][0]["host_checksums"] == \
+        [int(x) for x in final["chk"][0, :H]]
+    agg = rec["aggregates"]["packets_sent"]
+    assert agg["min"] <= agg["mean"] <= agg["max"]
+    assert rec["ok"] is True
+
+
+@pytest.mark.slow
+def test_campaign_checkpoint_resume_and_guards(tmp_path):
+    """Checkpointing a campaign stamps the campaign fingerprint;
+    resume restores all replicas bit-identically; an edited vary
+    block or a standalone run refuses the saved state."""
+    from shadow_tpu.core.controller import Controller
+
+    rec = tmp_path / "rec.json"
+    block = ENSEMBLE_BLOCK + f"  record_path: {rec}\n"
+
+    ref = Controller(_cfg(block))
+    assert ref.run().ok
+    ref_chk = ref.runner.final_state["chk"].copy()
+
+    ck = str(tmp_path / "camp.npz")
+    cfg = _cfg(block)
+    cfg.experimental.checkpoint_save = ck
+    cfg.experimental.checkpoint_save_time = 800_000_000
+    s1 = Controller(cfg).run()
+    assert s1.end_time == 800_000_000
+
+    cfg2 = _cfg(block)
+    cfg2.experimental.checkpoint_load = ck
+    c2 = Controller(cfg2)
+    assert c2.run().ok
+    assert (np.asarray(c2.runner.final_state["chk"])
+            == np.asarray(ref_chk)).all()
+
+    # edited campaign -> fingerprint mismatch, refused
+    cfg3 = _cfg(block.replace("[1, 9]", "[1, 11]"))
+    cfg3.experimental.checkpoint_load = ck
+    with pytest.raises(ValueError, match="campaign"):
+        Controller(cfg3).run()
+
+    # standalone run -> campaign checkpoints are not loadable
+    cfg4 = _cfg()
+    cfg4.experimental.checkpoint_load = ck
+    with pytest.raises(ValueError, match="ensemble campaign"):
+        Controller(cfg4).run()
+
+
+@pytest.mark.slow
+def test_campaign_capacity_plan_worst_case(tmp_path):
+    """capacity_plan: auto on a campaign sizes from the worst-case
+    replica's warm-up occupancy; traces stay bit-identical to the
+    statically-sized campaign."""
+    from shadow_tpu.core.controller import Controller
+
+    block = ENSEMBLE_BLOCK + f"  record_path: {tmp_path / 'a.json'}\n"
+    ref = Controller(_cfg(block))
+    assert ref.run().ok
+    ref_chk = ref.runner.final_state["chk"].copy()
+
+    import os
+    os.environ["SHADOW_TPU_OCC_DIR"] = str(tmp_path)
+    try:
+        cfg = _cfg(block.replace("a.json", "b.json"))
+        cfg.experimental.capacity_plan = "auto"
+        c = Controller(cfg)
+        stats = c.run()
+        assert stats.ok
+        assert stats.occupancy["planned"]["event_capacity"] >= 2
+        assert (np.asarray(c.runner.final_state["chk"])
+                == np.asarray(ref_chk)).all()
+    finally:
+        del os.environ["SHADOW_TPU_OCC_DIR"]

@@ -1,0 +1,90 @@
+"""Direct unit tests for utils/counters.py and utils/pqueue.py —
+previously exercised only indirectly through the engine/host suites,
+so a regression in either surfaced as an opaque simulation diff."""
+
+from shadow_tpu.utils.counters import Counter
+from shadow_tpu.utils.pqueue import PriorityQueue
+
+
+# ---------------------------------------------------------------- Counter
+def test_counter_add_sub_get():
+    c = Counter()
+    assert c.get("pkts") == 0           # absent names read as zero
+    c.add("pkts")
+    c.add("pkts", 4)
+    c.sub("pkts", 2)
+    assert c.get("pkts") == 3
+    c.sub("deficit", 5)                 # sub may go negative (merge
+    assert c.get("deficit") == -5       # semantics need signed counts)
+
+
+def test_counter_merge_accumulates_disjoint_and_shared():
+    a, b = Counter(), Counter()
+    a.add("syscalls", 10)
+    a.add("events", 1)
+    b.add("syscalls", 5)
+    b.add("drops", 2)
+    a.merge(b)
+    assert a.as_dict() == {"syscalls": 15, "events": 1, "drops": 2}
+    # merge reads, never mutates, the source
+    assert b.as_dict() == {"syscalls": 5, "drops": 2}
+
+
+def test_counter_as_dict_is_a_copy():
+    c = Counter()
+    c.add("x")
+    d = c.as_dict()
+    d["x"] = 99
+    assert c.get("x") == 1
+
+
+def test_counter_str_sorted_by_name():
+    c = Counter()
+    c.add("zeta", 2)
+    c.add("alpha", 1)
+    assert str(c) == "{alpha:1, zeta:2}"
+
+
+# ----------------------------------------------------------- PriorityQueue
+def test_pqueue_orders_by_key():
+    q = PriorityQueue()
+    for key, item in [(5, "e"), (1, "a"), (3, "c")]:
+        q.push(key, item)
+    assert q.peek() == (1, "a")
+    assert q.peek_key() == 1
+    assert [q.pop() for _ in range(3)] == [(1, "a"), (3, "c"),
+                                          (5, "e")]
+
+
+def test_pqueue_empty_semantics():
+    q = PriorityQueue()
+    assert not q
+    assert len(q) == 0
+    assert q.peek() is None
+    assert q.peek_key() is None
+    assert q.pop() is None
+
+
+def test_pqueue_tuple_keys_total_order():
+    """Event keys are (time, src, seq) tuples; the unique trailing seq
+    makes ties impossible — the deterministic total order every engine
+    relies on."""
+    q = PriorityQueue()
+    q.push((10, 1, 2), "b")
+    q.push((10, 1, 1), "a")
+    q.push((9, 99, 99), "first")
+    assert q.pop() == ((9, 99, 99), "first")
+    assert q.pop() == ((10, 1, 1), "a")
+    assert q.pop() == ((10, 1, 2), "b")
+    assert len(q) == 0
+
+
+def test_pqueue_interleaved_push_pop():
+    q = PriorityQueue()
+    q.push(4, "d")
+    q.push(2, "b")
+    assert q.pop() == (2, "b")
+    q.push(1, "a")
+    q.push(3, "c")
+    assert bool(q)
+    assert [q.pop()[1] for _ in range(3)] == ["a", "c", "d"]
