@@ -658,6 +658,9 @@ def main() -> int:
             from shadow_tpu.device import capacity
             occ_path, occ = _occ_records[headline_path]
             try:
+                # atomic tmp+os.replace (utils/artifacts.py): a bench
+                # killed mid-write must not leave truncated JSON that
+                # a later capacity_plan: <path> run chokes on
                 capacity.save_record(occ, occ_path)
                 result["occupancy_record"] = occ_path
                 log(f"occupancy record -> {occ_path}")

@@ -20,6 +20,16 @@ policy's per-host signature to be bit-identical to the first's — the
 cross-policy determinism matrix (the fault-injection CI rung pins
 serial/thread/tpu on examples/tgen_faults.yaml this way).
 
+`--preempt` switches to the PREEMPTION gate (device/supervise.py):
+run the config uninterrupted (tpu policy), then run it supervised in
+a subprocess (periodic validated checkpoints + state audit), SIGTERM
+it as soon as the first rotating checkpoint lands, require the
+distinct preemption rc (75, EX_TEMPFAIL), resume from the rotation
+base, and require the resumed trace to bit-match the uninterrupted
+run. Combine with `--ensemble` to preempt a campaign mid-flight
+instead (the resumed replica stack must bit-match the uninterrupted
+campaign's).
+
 `--ensemble` switches to the CAMPAIGN gate (shadow_tpu/ensemble/):
 the config must carry an `ensemble:` block. The gate runs the
 campaign twice (run-to-run bit-identity over every replica), then
@@ -184,6 +194,129 @@ def run_ensemble_gate(config: str, policies: list[str],
         return rc
 
 
+def _preempt_child(config: str, base: str, every_ns: int,
+                   data_dir: str, ensemble: bool):
+    """Launch the supervised run as a child CLI process (the gate
+    needs a real SIGTERM against a real process, not an in-process
+    flag), SIGTERM it once the first rotating checkpoint exists, and
+    return its exit code."""
+    import signal
+    import subprocess
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    overrides = [
+        "-o", f"experimental.checkpoint_save={base}",
+        "-o", f"experimental.checkpoint_every={every_ns}ns",
+        "-o", "experimental.state_audit=true",
+        "-o", f"general.data_directory={data_dir}",
+    ]
+    if not ensemble:
+        overrides += ["-o", "experimental.scheduler_policy=tpu"]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys; from shadow_tpu.cli import main; "
+         "sys.exit(main(sys.argv[1:]))", config] + overrides,
+        env=env, cwd=repo)
+    import glob as _glob
+    deadline = time.monotonic() + 900
+    signaled = False
+    while proc.poll() is None and time.monotonic() < deadline:
+        if not signaled and _glob.glob(_glob.escape(base) + ".t*"):
+            proc.send_signal(signal.SIGTERM)
+            signaled = True
+        time.sleep(0.05)
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait()
+        print("FAIL: supervised run hung past the gate deadline")
+        return -1
+    if not signaled:
+        print("FAIL: the run finished before the first rotating "
+              "checkpoint appeared — shrink checkpoint_every or grow "
+              "stop_time so the gate can preempt mid-flight")
+        return -1
+    return proc.returncode
+
+
+def run_preempt_gate(config: str, ensemble: bool) -> int:
+    """SIGTERM mid-run -> resume must bit-match the uninterrupted
+    run, and the preempted process must exit with the distinct
+    preemption rc."""
+    import numpy as np
+
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.device.supervise import EXIT_PREEMPTED
+
+    cfg0 = load_config(config)
+    if ensemble and cfg0.ensemble is None:
+        print(f"FAIL: {config} has no ensemble: block")
+        return 1
+    every_ns = max(1, cfg0.general.stop_time // 8)
+
+    def run_full(data_dir: str, extra=None):
+        # the policy override must ride load_config's override list:
+        # schema validation (checkpoint knobs require the tpu policy)
+        # runs during parsing, before any post-hoc attribute edit
+        extra = list(extra or [])
+        if not ensemble:
+            extra.append("experimental.scheduler_policy=tpu")
+        cfg = load_config(config, overrides=extra)
+        cfg.general.data_directory = data_dir
+        if ensemble:
+            cfg.ensemble.record_path = os.path.join(data_dir,
+                                                    "ENSEMBLE.json")
+        c = Controller(cfg)
+        stats = c.run()
+        if not stats.ok:
+            print("FAIL: run reported not-ok")
+            sys.exit(1)
+        if ensemble:
+            f = c.runner.final_state
+            return {k: np.asarray(f[k])
+                    for k in ("chk", "n_exec", "n_sent", "n_drop",
+                              "n_deliv")}
+        return [(h.name, h.trace_checksum, h.events_executed,
+                 h.packets_sent, h.packets_dropped,
+                 h.packets_delivered) for h in c.sim.hosts]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        sig_full = run_full(os.path.join(tmp, "full", "shadow.data"))
+        base = os.path.join(tmp, "ck.npz")
+        rc = _preempt_child(config, base, every_ns,
+                            os.path.join(tmp, "pre", "shadow.data"),
+                            ensemble)
+        if rc != EXIT_PREEMPTED:
+            print(f"FAIL: preempted run exited rc {rc}, expected "
+                  f"the distinct preemption rc {EXIT_PREEMPTED}")
+            return 1
+        sig_res = run_full(
+            os.path.join(tmp, "res", "shadow.data"),
+            extra=[f"experimental.checkpoint_load={base}"])
+        if ensemble:
+            bad = [k for k in sig_full
+                   if not np.array_equal(sig_full[k], sig_res[k])]
+            if bad:
+                print(f"DETERMINISM FAILURE: resumed campaign {bad} "
+                      "diverge from the uninterrupted campaign")
+                return 1
+        elif sig_res != sig_full:
+            print("DETERMINISM FAILURE: resumed run diverges from "
+                  "the uninterrupted run")
+            for a, b in zip(sig_full, sig_res):
+                if a != b:
+                    print(f"  {a[0]}: {a[1:]} != {b[1:]}")
+            return 1
+        kind = "ensemble campaign" if ensemble else "standalone tpu"
+        print(f"preemption OK: {config} ({kind}: SIGTERM mid-run -> "
+              f"rc {EXIT_PREEMPTED}, resume from the checkpoint "
+              "rotation bit-matches the uninterrupted run)")
+        return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("config", nargs="?", default="examples/minimal.yaml")
@@ -194,12 +327,19 @@ def main() -> int:
     ap.add_argument("--replica", type=int, default=0,
                     help="which replica to compare standalone "
                          "(--ensemble only; default 0)")
+    ap.add_argument("--preempt", action="store_true",
+                    help="preemption gate: SIGTERM a supervised run "
+                         "mid-flight, resume, require bit-identity "
+                         "with the uninterrupted run")
     args = ap.parse_args()
 
     default_policy = "serial,tpu" if args.ensemble else "serial"
     policies = [p.strip()
                 for p in (args.policy or default_policy).split(",")
                 if p.strip()]
+
+    if args.preempt:
+        return run_preempt_gate(args.config, args.ensemble)
 
     if args.ensemble:
         return run_ensemble_gate(args.config, policies, args.replica)

@@ -82,6 +82,17 @@ def main(argv=None) -> int:
                  "mean/p5/p95/min/max in the ENSEMBLE record",
                  rec["campaign"], rec["workload"]["replicas"],
                  stats.packets_sent)
+    if stats.preempted:
+        # graceful preemption (device/supervise.py): the run is
+        # incomplete but resumable — a DISTINCT rc so schedulers can
+        # tell "resume me" (75, EX_TEMPFAIL) apart from success and
+        # failure
+        from shadow_tpu.device.supervise import EXIT_PREEMPTED
+        log.warning("preempted at %s — resume with "
+                    "experimental.checkpoint_load: %s (rc %d)",
+                    simtime.format_time(stats.end_time),
+                    stats.resume_path, EXIT_PREEMPTED)
+        return EXIT_PREEMPTED
     return 0 if stats.ok else 1
 
 

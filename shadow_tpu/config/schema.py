@@ -394,6 +394,40 @@ class ExperimentalOptions:
     checkpoint_save: str = ""
     checkpoint_save_time: int = 0
     checkpoint_load: str = ""
+    # --- supervised runs (device/supervise.py) ---
+    # periodic validated checkpointing: every `checkpoint_every` sim
+    # ns of progress the run writes a rotating checkpoint
+    # (<checkpoint_save>.t<ns>, atomic tmp+rename, last
+    # `checkpoint_keep` retained), validated by the fingerprint/meta
+    # machinery plus the state_audit health word when enabled — so a
+    # corrupted checkpoint is never the one a crash-restart resumes
+    # from. 0 = off (the end-of-run checkpoint_save semantics are
+    # unchanged). checkpoint_load accepts the base path and resolves
+    # to the newest readable rotation entry.
+    checkpoint_every: int = 0
+    checkpoint_keep: int = 3
+    # compile the on-device invariant audit (engine.py AUD_* bits:
+    # heap order, clock monotonicity, counter non-negativity, packet
+    # conservation across exchange) into the round program. Cheap
+    # (reductions + one scalar collective per round); off by default
+    # — the un-audited program is byte-identical to before.
+    state_audit: bool = False
+    # transient-dispatch recovery: a device error matching the
+    # transient markers (RESOURCE_EXHAUSTED, device unavailable, ...)
+    # retries the failed segment from the last validated state up to
+    # `dispatch_retries` CONSECUTIVE times (the counter resets when a
+    # segment completes) with capped exponential backoff
+    # (`dispatch_retry_backoff` seconds base, doubling, 30 s cap).
+    dispatch_retries: int = 0
+    dispatch_retry_backoff: float = 0.5
+    # after exhausting retries: "abort" fails the run (the old
+    # behavior); "hybrid" saves the last validated state to
+    # <checkpoint_save>.failover (kept for a device-side resume) and
+    # re-runs on the hybrid backend with a loud diagnostic instead of
+    # aborting — CPU host state is rebuilt from t=0 (device arrays
+    # are not importable into CPU hosts), so the run finishes at the
+    # cost of replaying the lost prefix.
+    failover: str = "abort"
     mesh_axis: str = "hosts"
     device_batch_rounds: int = 64   # rounds fused into one device while_loop
     # hybrid mode: which CPU policy drives host emulation while the
@@ -414,6 +448,11 @@ class ExperimentalOptions:
     # device flush includes its XLA compile (tens of seconds on a
     # tunneled TPU), during which no event executes.
     round_watchdog: int = 0
+    # where the watchdog ALSO writes its per-host/per-process stall
+    # dump (atomic tmp+rename) when it fires — log lines scroll away
+    # or get truncated by supervisors; the file survives for
+    # post-mortem. "" = log only.
+    round_watchdog_dump: str = ""
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -425,6 +464,7 @@ class ExperimentalOptions:
                 v = d[f.name]
                 if f.name in ("runahead", "dispatch_segment",
                               "checkpoint_save_time",
+                              "checkpoint_every",
                               "capacity_warmup"):
                     v = parse_time_ns(v)
                 elif f.name in ("interface_buffer", "socket_recv_buffer",
@@ -432,6 +472,8 @@ class ExperimentalOptions:
                     v = parse_size_bytes(v)
                 elif f.type == "int":
                     v = int(v)
+                elif f.type == "float":
+                    v = float(v)
                 elif f.type == "bool":
                     v = bool(v)
                 setattr(out, f.name, v)
@@ -500,6 +542,34 @@ class ExperimentalOptions:
                 "policies execute managed OS processes, whose state "
                 "is not checkpointable — the reference has the same "
                 "limitation, i.e. no checkpoint at all)")
+        _check_choice("experimental", "failover", out.failover,
+                      ("abort", "hybrid"))
+        if out.checkpoint_every:
+            if not out.checkpoint_save:
+                raise ValueError(
+                    "experimental.checkpoint_every is set but "
+                    "checkpoint_save (the rotation base path) is not "
+                    "— periodic checkpoints would have nowhere to go")
+            if out.checkpoint_save_time:
+                raise ValueError(
+                    "experimental.checkpoint_every cannot combine "
+                    "with checkpoint_save_time: periodic supervision "
+                    "runs to stop_time writing rotating checkpoints, "
+                    "while checkpoint_save_time pauses the run at one "
+                    "boundary — pick one")
+        if out.state_audit and out.scheduler_policy != "tpu":
+            raise ValueError(
+                "experimental.state_audit compiles the invariant "
+                "audit into the DEVICE round program and requires "
+                "scheduler_policy: tpu")
+        if (out.dispatch_retries or out.failover != "abort") and \
+                out.scheduler_policy != "tpu":
+            raise ValueError(
+                "experimental.dispatch_retries/failover supervise "
+                "DEVICE dispatches and require scheduler_policy: tpu")
+        if out.dispatch_retry_backoff < 0:
+            raise ValueError(
+                "experimental.dispatch_retry_backoff must be >= 0")
         if out.model_bandwidth and out.judge_placement == "flush":
             raise ValueError(
                 "experimental.judge_placement: flush cannot combine "
@@ -508,6 +578,9 @@ class ExperimentalOptions:
         for name, minimum in (("event_capacity", 2),
                               ("dispatch_segment", 0),
                               ("checkpoint_save_time", 0),
+                              ("checkpoint_every", 0),
+                              ("checkpoint_keep", 1),
+                              ("dispatch_retries", 0),
                               ("outbox_capacity", 1),
                               ("exchange_capacity", 0),
                               ("exchange_in_capacity", 0),
@@ -682,6 +755,14 @@ class ConfigOptions:
                 "device program and require "
                 "experimental.scheduler_policy: tpu (run replicas as "
                 "separate processes on CPU policies)")
+        if ensemble is not None and \
+                out.experimental.failover == "hybrid":
+            raise ValueError(
+                "ensemble: experimental.failover: hybrid is not "
+                "available for campaigns (CPU host emulation cannot "
+                "vmap replicas) — campaigns retry transient dispatch "
+                "errors and otherwise fail loudly with the last "
+                "validated checkpoint on disk")
         return out
 
     def total_hosts(self) -> int:

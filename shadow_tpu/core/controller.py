@@ -321,11 +321,73 @@ class Controller:
             ),
         )
 
+    def _failover_run(self, exc) -> SimStats:
+        """Dispatch retries exhausted under failover: hybrid — finish
+        the run on the hybrid backend (CPU host emulation + device
+        network judge) instead of aborting. CPU host state cannot be
+        rebuilt from device arrays, so the hybrid run replays from
+        t=0; the last validated device checkpoint stays on disk to pin
+        a device-side resume once the accelerator returns. Determinism
+        makes the replayed results bit-identical to what the device
+        run would have produced."""
+        import copy
+
+        log.error(
+            "DEVICE FAILOVER: %s — re-running on the hybrid backend "
+            "from t=0 (device state is not importable into CPU "
+            "hosts; the prefix up to t=%d ns is replayed). The "
+            "validated device checkpoint %s remains for a "
+            "device-side resume.", exc, exc.sim_time,
+            exc.checkpoint_path or "<none>")
+        cfg2 = copy.deepcopy(self.cfg)
+        xp = cfg2.experimental
+        xp.scheduler_policy = "hybrid"
+        # supervision/planning knobs are device-only; the schema would
+        # reject them on a CPU policy, and the hybrid replay must not
+        # try to checkpoint or re-plan
+        xp.checkpoint_save = ""
+        xp.checkpoint_save_time = 0
+        xp.checkpoint_load = ""
+        xp.checkpoint_every = 0
+        xp.capacity_plan = "static"
+        xp.capacity_warmup = 0
+        xp.state_audit = False
+        xp.dispatch_retries = 0
+        xp.failover = "abort"
+        inner = Controller(cfg2)
+        stats = inner.run()
+        stats.failover_checkpoint = exc.checkpoint_path
+        # reflect the replayed per-host results onto THIS sim's hosts:
+        # anything reading c.sim.hosts after the run (the determinism
+        # gate's signature path, summary tooling) must see the real
+        # counters, not the abandoned device run's zeros
+        for mine, theirs in zip(self.sim.hosts, inner.sim.hosts):
+            mine.events_executed = theirs.events_executed
+            mine.packets_sent = theirs.packets_sent
+            mine.packets_dropped = theirs.packets_dropped
+            mine.packets_delivered = theirs.packets_delivered
+            mine.trace_checksum = theirs.trace_checksum
+        return stats
+
     def run(self) -> SimStats:
         cfg = self.cfg
         stop = cfg.general.stop_time
         if self.runner is not None:
-            stats = self.runner.run(stop)
+            from shadow_tpu.device.supervise import DeviceFailover
+            try:
+                stats = self.runner.run(stop)
+            except DeviceFailover as e:
+                return self._failover_run(e)
+            if stats.preempted:
+                log.warning(
+                    "run preempted at %s: resume checkpoint %s "
+                    "(set experimental.checkpoint_load to continue)",
+                    simtime.format_time(stats.end_time),
+                    stats.resume_path)
+            if stats.retries:
+                log.warning("run absorbed %d transient device "
+                            "dispatch retr%s", stats.retries,
+                            "y" if stats.retries == 1 else "ies")
             if stats.ensemble is not None:
                 rec = stats.ensemble
                 log.info(
@@ -360,7 +422,8 @@ class Controller:
         if cfg.experimental.round_watchdog:
             from shadow_tpu.core.manager import RoundWatchdog
             watchdog = RoundWatchdog(
-                m, cfg.experimental.round_watchdog)
+                m, cfg.experimental.round_watchdog,
+                dump_path=cfg.experimental.round_watchdog_dump)
             watchdog.start()
         try:
             next_time = m.policy.next_event_time()

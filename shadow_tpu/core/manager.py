@@ -76,6 +76,17 @@ class SimStats:
     occupancy: Optional[dict] = None
     # capacity re-plan/retry cycles the run needed (0 = the plan held)
     replans: int = 0
+    # supervised-run outcomes (device/supervise.py): transient device
+    # dispatch retries the run absorbed; whether it was gracefully
+    # preempted (SIGTERM/SIGINT drain — the run is INCOMPLETE and
+    # resumable from resume_path, and the CLI exits EXIT_PREEMPTED)
+    retries: int = 0
+    preempted: bool = False
+    resume_path: str = ""
+    # set when the tpu policy failed over to the hybrid backend
+    # mid-run (the device checkpoint named here pins a device-side
+    # resume; the hybrid results replayed from t=0)
+    failover_checkpoint: str = ""
     # ensemble campaign record (shadow_tpu/ensemble/campaign.py):
     # per-replica results + aggregates; None outside ensemble runs.
     # The top-level counters above then hold CAMPAIGN totals (summed
@@ -689,10 +700,14 @@ class RoundWatchdog:
     with a diagnostic instead of hanging.
 
     `on_stall(dump)` is injectable for tests; the default logs the
-    dump, marks stats not-ok, and interrupts the main thread."""
+    dump, marks stats not-ok, and interrupts the main thread.
+    `dump_path` (experimental.round_watchdog_dump) additionally
+    persists the dump to a file via the atomic tmp+rename helper —
+    written BEFORE on_stall runs, so even a custom handler (or a
+    truncated log) leaves the post-mortem on disk."""
 
     def __init__(self, manager: Manager, interval_s: float,
-                 on_stall=None):
+                 on_stall=None, dump_path: str = ""):
         if interval_s <= 0:
             raise ValueError("round_watchdog interval must be > 0")
         self._m = manager
@@ -700,6 +715,7 @@ class RoundWatchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.on_stall = on_stall or self._default_stall
+        self.dump_path = dump_path
         self.fired = False
 
     def _progress(self) -> tuple:
@@ -731,7 +747,21 @@ class RoundWatchdog:
                 continue
             if _time.monotonic() - last_t >= self.interval:
                 self.fired = True
-                self.on_stall(self._m.dump_state())
+                dump = self._m.dump_state()
+                if self.dump_path:
+                    try:
+                        from shadow_tpu.utils.artifacts import \
+                            atomic_write_text
+                        atomic_write_text(
+                            f"round watchdog stall dump (no progress "
+                            f"for {self.interval:.0f}s wall)\n"
+                            f"{dump}\n", self.dump_path)
+                        log.info("watchdog stall dump -> %s",
+                                 self.dump_path)
+                    except OSError as e:
+                        log.warning("could not write watchdog dump "
+                                    "%s: %s", self.dump_path, e)
+                self.on_stall(dump)
                 return
 
     def _default_stall(self, dump: str) -> None:

@@ -275,11 +275,9 @@ def record_path(engine, directory: str = "") -> str:
 
 
 def save_record(record: dict, path: str) -> None:
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(record, f, indent=1, sort_keys=True)
-    os.replace(tmp, path)
+    from shadow_tpu.utils.artifacts import atomic_write_json
+
+    atomic_write_json(record, path)
 
 
 def load_record(path: str) -> dict:

@@ -134,7 +134,8 @@ def _flatten(state):
 
 
 def save_state(engine, state, path: str, sim_time: int,
-               final_stop: int = 0, extra_meta: dict = None) -> None:
+               final_stop: int = 0, extra_meta: dict = None,
+               audit_meta: dict = None) -> None:
     """Write `state` (a live, possibly sharded device pytree) plus
     the pause `sim_time`, the run's global stop (`final_stop` — the
     window-clamping bound the saved prefix was computed against), and
@@ -166,10 +167,22 @@ def save_state(engine, state, path: str, sim_time: int,
     }
     if extra_meta:
         meta["ensemble"] = dict(extra_meta)
+    if audit_meta is not None:
+        # the supervisor's validation stamp (device/supervise.py): the
+        # on-device invariant audit word was checked clean before this
+        # state was written, so a resume can trust it
+        meta["audit"] = dict(audit_meta)
     arrays = {f"leaf_{i}": np.asarray(v)
               for i, (_, v) in enumerate(named)}
-    with open(path, "wb") as f:
-        np.savez_compressed(f, __meta__=json.dumps(meta), **arrays)
+    # atomic tmp+rename: a SIGKILL (or a preemption that outruns the
+    # drain) mid-save must never leave a truncated npz where a valid
+    # checkpoint used to be — the previous rotation entry survives
+    from shadow_tpu.utils.artifacts import atomic_write
+
+    atomic_write(
+        path,
+        lambda f: np.savez_compressed(f, __meta__=json.dumps(meta),
+                                      **arrays))
 
 
 def peek_meta(path: str) -> dict:
@@ -246,20 +259,47 @@ def load_state(engine, starts, path: str, final_stop: int = 0,
     named, treedef = _flatten(template)
     want_keys = [k for k, _ in named]
     saved_keys = meta["keys"]
-    # the occ_* telemetry leaves postdate FORMAT 1 checkpoints: a
-    # record saved without them still loads, with the template's
-    # zeroed counters (high-water marks then cover the resumed
-    # segment only — the trace itself is unaffected)
+    # auxiliary leaves may differ between the saving and resuming
+    # engines without perturbing the trace: the occ_* telemetry
+    # (postdates FORMAT 1 checkpoints — zeroed counters then cover
+    # the resumed segment only) and the aud* invariant-audit leaves
+    # (experimental.state_audit may be toggled across a save/resume
+    # pair; the audit is reseeded below so it stays exact). Any other
+    # key difference is a real layout change and fails loudly.
+    def _aux(k: str) -> bool:
+        return "'occ_" in k or "'aud" in k
+
     missing = [k for k in want_keys if k not in saved_keys]
-    telemetry_only = missing and all("'occ_" in k for k in missing) \
-        and saved_keys == [k for k in want_keys if k not in missing]
-    if want_keys != saved_keys and not telemetry_only:
+    extra = [k for k in saved_keys if k not in want_keys]
+    aux_only = all(_aux(k) for k in missing) and \
+        all(_aux(k) for k in extra) and \
+        [k for k in saved_keys if k not in extra] == \
+        [k for k in want_keys if k not in missing]
+    if want_keys != saved_keys and not aux_only:
         raise ValueError(
             f"checkpoint {path}: state layout changed "
             f"(saved keys != this engine's state keys)")
     leaves = []
     for key, tmpl in named:
         if key not in saved:
+            if key == "['aud_tx']":
+                # reseed the conservation ledger from the saved
+                # counters so the global identity (rows produced ==
+                # rows popped + rows live + rows counted lost) holds
+                # at the resume point — the audit only ever balances
+                # the SUM, so this per-host reseed is exact
+                ht = saved["['ht']"]
+                head = saved["['head']"]
+                E = ht.shape[-1]
+                live = ((np.arange(E) >= head[..., None]) &
+                        (ht < (np.int64(1) << np.int64(62)))) \
+                    .sum(-1)
+                recon = (saved["['n_exec']"].astype(np.int64) + live
+                         + saved["['overflow']"].astype(np.int64)
+                         + saved["['x_overflow']"].astype(np.int64))
+                leaves.append(jax.device_put(
+                    recon.astype(np.int64), tmpl.sharding))
+                continue
             leaves.append(tmpl)
             continue
         arr = saved[key]

@@ -84,6 +84,22 @@ AXIS = "hosts"
 NIC_KEYS = ("tx_free", "rx_free", "cd_fa", "cd_next", "cd_cnt",
             "cd_last", "cd_drop")
 
+# on-device invariant audit (EngineConfig.audit / experimental.
+# state_audit): per-host "health word" bitmask accumulated by cheap
+# reductions compiled into the round program. A nonzero word marks the
+# state corrupted — the supervisor (device/supervise.py) refuses to
+# checkpoint it, so a bad state is never the one a run resumes from.
+AUD_HEAP = 1       # heap rows out of (t, key) order, or head out of
+                   # [0, E] — the pop loop would replay/skip events
+AUD_CLOCK = 2      # a host popped an event earlier than one it
+                   # already executed (per-host clock monotonicity)
+AUD_COUNTER = 4    # a cumulative counter went negative (i32 wrap or
+                   # corrupted arithmetic)
+AUD_CONSERVE = 8   # event-row conservation broke: rows produced !=
+                   # rows executed + rows live in heaps + rows counted
+                   # lost — the exchange dropped something silently
+AUD_KEYS = ("aud", "aud_t", "aud_tx")
+
 
 @dataclass
 class EngineConfig:
@@ -161,6 +177,16 @@ class EngineConfig:
     # micro (scripts/tpu_micro.py --variant 4) decides. Selection is exact
     # (single nonzero term), so traces are bit-identical either way.
     table_onehot: Optional[bool] = None
+    # on-device invariant audit (experimental.state_audit): compile a
+    # per-host health word of cheap reductions into the round program
+    # — heap order, per-host clock monotonicity, counter
+    # non-negativity, and event-row conservation across the exchange
+    # (see the AUD_* bits above). Off by default: the audited program
+    # carries three extra state leaves and one extra collective per
+    # round; with audit off the compiled program is byte-identical to
+    # an un-audited build. Traces are bit-identical either way (the
+    # audit only reads existing values).
+    audit: bool = False
 
 
 class DeviceEngine:
@@ -361,6 +387,17 @@ class DeviceEngine:
             "occ_trips": np.zeros(self.n_shards, dtype=np.int32),
             "occ_phases": np.zeros(self.n_shards, dtype=np.int32),
         }
+        if self.config.audit:
+            # invariant-audit leaves (AUD_* bits above):
+            #   aud    [H] the health word (0 = every invariant held)
+            #   aud_t  [H] last popped event time (clock monotonicity)
+            #   aud_tx [H] cumulative event rows this host produced —
+            #              seeded with the boot/stop rows so the
+            #              conservation identity holds from round 0
+            small["aud"] = zeros_i32.copy()
+            small["aud_t"] = np.zeros(H, dtype=np.int64)
+            small["aud_tx"] = ((t0s != INF).astype(np.int64)
+                               + (t1s != INF).astype(np.int64))
         if self.config.count_paths:
             V = self.n_vertices
             small["path_cnt"] = np.zeros((self.n_shards, V * V),
@@ -461,6 +498,10 @@ class DeviceEngine:
         POP_ONEHOT = (cfg.pop_onehot
                       if cfg.pop_onehot is not None
                       else platform == "tpu")
+        # on-device invariant audit (see the AUD_* bits): every audit
+        # op sits behind this flag so the un-audited program is
+        # byte-identical to a pre-audit build
+        AUDIT = bool(cfg.audit)
         # fault epochs: the [T] epoch start times are part of the
         # compiled schedule exactly like the capacities, but ride the
         # program as a TRACED [T] vector (the `wrld` tuple below) so
@@ -609,6 +650,21 @@ class DeviceEngine:
 
             state["n_exec"] = state["n_exec"] + \
                 popcnt.astype(jnp.int32)
+            if AUDIT:
+                # per-host clock monotonicity: popping an event older
+                # than one already executed means the heap (or a
+                # resume) handed events out of order
+                prev_t = state["aud_t"]
+                state["aud"] = state["aud"] | jnp.where(
+                    runnable & (pt < prev_t),
+                    jnp.int32(AUD_CLOCK), jnp.int32(0))
+                if P > 1:
+                    last_t = jnp.where(activeP, ptP,
+                                       jnp.int64(0)).max(-1)
+                else:
+                    last_t = pt
+                state["aud_t"] = jnp.where(
+                    runnable, jnp.maximum(prev_t, last_t), prev_t)
             # with the model NIC, a packet pops twice: the RX stage
             # (KIND_PACKET: bandwidth+CoDel, no app) and the delivery
             # (KIND_PACKET_READY). Deliveries are the READY pops then.
@@ -1485,6 +1541,14 @@ class DeviceEngine:
                 state["occ_ob"],
                 (ob["t"] < DROP_T).sum(-1).astype(jnp.int32))
             state["occ_phases"] = state["occ_phases"] + jnp.int32(1)
+            if AUDIT:
+                # conservation ledger: every exchangeable row
+                # (post-judge t < DROP_T — sends, timers, READY
+                # reinserts) must land in some host's heap or be
+                # counted into overflow/x_overflow; _audit_round
+                # balances this ledger against pops + live rows
+                state["aud_tx"] = state["aud_tx"] + \
+                    (ob["t"] < DROP_T).sum(-1).astype(jnp.int64)
             if MERGE_GLOBAL:
                 return _exchange_global(state, ob, gid, my_shard)
             state, skey, perm, rows = _flat_sorted(state, ob, gid)
@@ -1581,6 +1645,53 @@ class DeviceEngine:
                 (state["ht"] < INF).sum(-1).astype(jnp.int32))
             return state
 
+        # ---------------- round-end invariant audit --------------------
+        # The health word: four cheap reduction-only checks folded
+        # into each host's `aud` bitmask at the end of every round.
+        # Reductions + one scalar all_gather only — no sorts, no
+        # gathers — so an audited run costs a fraction of one flush.
+        def _axis_sum64(x):
+            return lax.all_gather(
+                jnp.reshape(x.astype(jnp.int64), (1,)), AXIS).sum()
+
+        def _audit_round(state):
+            head, ht, hk = state["head"], state["ht"], state["hk"]
+            # heap rows must be (t, key)-lexicographically sorted
+            # (INF-padded tails sort last by construction) and the
+            # head cursor in [0, E]
+            ok_heap = ((ht[:, :-1] < ht[:, 1:]) |
+                       ((ht[:, :-1] == ht[:, 1:]) &
+                        (hk[:, :-1] <= hk[:, 1:]))).all(-1)
+            ok_heap = ok_heap & (head >= 0) & (head <= E)
+            neg = jnp.zeros(ht.shape[0], bool)
+            for key in ("n_exec", "n_sent", "n_drop", "n_deliv",
+                        "event_seq", "packet_seq", "app_seq"):
+                neg = neg | (state[key] < 0)
+            # event-row conservation: rows produced (boot/stop seed +
+            # every exchanged outbox row) == rows popped + rows live
+            # in heaps + rows loudly counted lost. The balance is
+            # global (a packet leaves one shard and lands on
+            # another), so the per-shard differences sum over the
+            # mesh — a collective, uniform across shards exactly like
+            # the round predicates around it.
+            live = ((jnp.arange(E)[None, :] >= head[:, None]) &
+                    (ht < INF)).sum()
+            diff = state["aud_tx"].sum() - (
+                state["n_exec"].astype(jnp.int64).sum()
+                + live.astype(jnp.int64)
+                + state["overflow"].astype(jnp.int64).sum()
+                + state["x_overflow"].astype(jnp.int64).sum())
+            conserved = _axis_sum64(diff) == 0
+            aud = state["aud"]
+            aud = aud | jnp.where(ok_heap, jnp.int32(0),
+                                  jnp.int32(AUD_HEAP))
+            aud = aud | jnp.where(neg, jnp.int32(AUD_COUNTER),
+                                  jnp.int32(0))
+            aud = aud | jnp.where(conserved, jnp.int32(0),
+                                  jnp.int32(AUD_CONSERVE))
+            state["aud"] = aud
+            return state
+
         # ---------------- one round (window) ---------------------------
         # A window may take several phases: each phase pops up to B
         # events per host (or until every host is drained below
@@ -1634,6 +1745,8 @@ class DeviceEngine:
                 lambda c: c[1],
                 lambda c: (lambda s: (s, more(s)))(_phase(c[0])),
                 (state, more(state)))
+            if AUDIT:
+                state = _audit_round(state)
             return state
 
         # ---------------- full run ------------------------------------
@@ -1720,6 +1833,7 @@ class DeviceEngine:
                      "overflow", "x_overflow", "chk",
                      "occ_heap", "occ_ob", "occ_in", "occ_x",
                      "occ_trips", "occ_phases") + \
+            (AUD_KEYS if AUDIT else ()) + \
             (NIC_KEYS if MB else ()) + \
             (("path_cnt",) if CP else ())
         specs = {k: self._shard_spec for k in spec_keys}

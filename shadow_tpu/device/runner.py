@@ -212,6 +212,14 @@ class DeviceRunner:
         self.final_state: Optional[dict] = None
         self.occ_record: Optional[dict] = None
         self.replans = 0
+        # supervision plumbing (device/supervise.py): the rotating
+        # checkpoint writer and the SIGTERM/SIGINT drain guard, set up
+        # per run() invocation; the shared advance loop reads them
+        self.checkpointer = None
+        self.guard = None
+        self.retries = 0
+        # campaign checkpoint stamp (EnsembleRunner overrides)
+        self._ck_extra_meta: Optional[dict] = None
         # set once _plan_capacities has sized the engine: run() skips
         # re-planning, so a caller may plan ahead of its timed window
         # (bench.py) and a re-used runner keeps its plan
@@ -280,6 +288,7 @@ class DeviceRunner:
                 merge_global=_tristate(xp.merge_strategy, "global"),
                 pop_onehot=_tristate(xp.pop_strategy, "onehot"),
                 table_onehot=_tristate(xp.table_strategy, "onehot"),
+                audit=xp.state_audit,
                 **knobs,
             ),
             self.app,
@@ -295,7 +304,8 @@ class DeviceRunner:
                                   dtype=np.int64),
         )
 
-    def _plan_capacities(self, stop: int) -> None:
+    def _plan_capacities(self, stop: int,
+                         load_path: Optional[str] = None) -> None:
         """capacity_plan: auto|<path> — size the engine's capacities
         from measured occupancy instead of the hand-tuned knobs.
         `auto` runs a short warm-up slice on the statically-sized
@@ -303,13 +313,16 @@ class DeviceRunner:
         match the real run's prefix); a path consumes a previously
         written OCC record. Either way the planned engine's traces
         bit-match the static engine's whenever nothing overflows, and
-        the overflow retry loop (see _advance) covers the undershoot
-        case loudly."""
+        the overflow retry loop (supervise.advance) covers the
+        undershoot case loudly. `load_path` is the rotation-resolved
+        checkpoint_load path (run() resolves it once)."""
         from shadow_tpu.device import capacity
 
         xp = self.sim.cfg.experimental
         mode = xp.capacity_plan
-        if xp.checkpoint_load:
+        if load_path is None:
+            load_path = xp.checkpoint_load
+        if load_path:
             # the checkpoint fingerprint pins the saved engine's
             # capacities — a checkpoint written under a plan carries
             # the PLANNER's sizes, not the config's static knobs, so
@@ -318,7 +331,7 @@ class DeviceRunner:
             # capacities instead; an overflow past the resume point
             # still re-plans through the normal retry loop.
             from shadow_tpu.device import checkpoint
-            meta = checkpoint.peek_meta(xp.checkpoint_load)
+            meta = checkpoint.peek_meta(load_path)
             caps = meta.get("capacities")
             if caps is None:
                 # pre-"capacities" checkpoints: only the two
@@ -430,92 +443,14 @@ class DeviceRunner:
             h.packets_dropped = int(n_drop[i])
             h.tracker.heartbeat(now, h)
 
-    def _advance(self, state, t_start: int, pause: int, stop: int):
-        """Advance [t_start, pause) in segments (heartbeat and/or
-        dispatch-segment boundaries; a single segment when neither is
-        configured), checking the loud overflow counters at each
-        boundary. Under a capacity plan (capacity_plan != static) an
-        overflow re-plans with doubled headroom on the offending
-        dimension and re-runs from the last known-good state instead
-        of failing the run; static runs keep the old loud-failure
-        contract. Returns (state, rounds, t_end, budget_hit,
-        overflowed)."""
-        from shadow_tpu.device import capacity
-
-        xp = self.sim.cfg.experimental
-        hb = self.sim.cfg.general.heartbeat_interval
-        seg = xp.dispatch_segment
-        retry_ok = xp.capacity_plan != "static"
-        budget = self.engine.config.max_rounds
-        # last known-good snapshot: device refs are immutable, so
-        # holding the pytree costs nothing to take — but it pins the
-        # previous segment's buffers (a second full state, tens of MB
-        # at the 10k rung), so static runs, which can never retry,
-        # don't keep one
-        good_state, good_t = (state if retry_ok else None), t_start
-        rounds = 0
-        budget_hit = False
-        overflowed = False
-        t = t_start
-        next_hb = (t // hb + 1) * hb if hb else None
-        while t < pause:
-            nxt = pause
-            if next_hb is not None:
-                nxt = min(nxt, next_hb)
-            if seg:
-                nxt = min(nxt, t + seg)
-            state, seg_rounds = self.engine.run(state, stop=nxt,
-                                                final_stop=stop)
-            dims = capacity.overflow_dims(state)
-            if dims:
-                if not retry_ok or \
-                        self.replans >= capacity.MAX_REPLANS:
-                    rounds += int(seg_rounds)
-                    t = nxt
-                    overflowed = True
-                    break           # loud failure (stats.ok = False)
-                self.replans += 1
-                self._capacity_overrides = capacity.widen(
-                    self._capacity_overrides, dims,
-                    self.engine.effective)
-                log.warning(
-                    "capacity overflow on %s in (%d, %d] ns; "
-                    "re-plan #%d with %s, re-running from t=%d ns",
-                    dims, good_t, nxt, self.replans,
-                    self._capacity_overrides, good_t)
-                self.engine = self._build_engine()
-                state = capacity.transfer(
-                    self.engine, self.sim.starts,
-                    jax.device_get(good_state))
-                good_state = state
-                t = good_t
-                next_hb = (t // hb + 1) * hb if hb else None
-                continue
-            rounds += int(seg_rounds)
-            t = nxt
-            if rounds >= budget:
-                if t < pause:
-                    # enforced cumulatively (per-invocation caps would
-                    # reset each segment); don't emit a heartbeat for
-                    # an interval the budget cut short
-                    log.warning("max_rounds (%d) exhausted during "
-                                "segmentation; stopping", budget)
-                budget_hit = True
-                break
-            if next_hb is not None and t >= next_hb and t < stop:
-                self._emit_heartbeats(t, state)
-                next_hb += hb
-            if retry_ok:
-                good_state, good_t = state, t
-        return state, rounds, t, budget_hit, overflowed
-
     def run(self, stop: int) -> SimStats:
         import time as _time
 
-        from shadow_tpu.device import capacity
+        from shadow_tpu.device import capacity, supervise
 
         xp = self.sim.cfg.experimental
         self.replans = 0
+        self.retries = 0
         if xp.capacity_plan == "static":
             # a re-used runner must not merge this run's measurements
             # into a stale record from an earlier run (the merge
@@ -525,22 +460,26 @@ class DeviceRunner:
         if xp.checkpoint_save:
             from shadow_tpu.device import checkpoint
             checkpoint.probe_writable(xp.checkpoint_save)
+        load_path = ""
         if xp.checkpoint_load:
+            # rotation-aware resolution (a supervised run's base path
+            # resolves to its newest readable rotation entry), then
             # pre-validate the resume parameters from the npz meta
-            # alone, for the same reason as the writability probe:
-            # fail in milliseconds, not after the capacity warm-up
-            # spends minutes compiling
+            # alone — fail in milliseconds, not after the capacity
+            # warm-up spends minutes compiling
             from shadow_tpu.device import checkpoint
+            load_path = supervise.resolve_checkpoint(
+                xp.checkpoint_load)
             checkpoint.prevalidate_resume(
-                xp.checkpoint_load, stop,
+                load_path, stop,
                 save_path=xp.checkpoint_save,
                 save_time=xp.checkpoint_save_time)
         if xp.capacity_plan != "static" and not self._planned:
-            self._plan_capacities(stop)
-        if xp.checkpoint_load:
+            self._plan_capacities(stop, load_path=load_path)
+        if load_path:
             from shadow_tpu.device import checkpoint
             state, t_start = checkpoint.load_state(
-                self.engine, self.sim.starts, xp.checkpoint_load,
+                self.engine, self.sim.starts, load_path,
                 final_stop=stop)
             if t_start >= stop:
                 raise ValueError(
@@ -548,7 +487,7 @@ class DeviceRunner:
                     f"{t_start} ns, at/after stop_time {stop} ns — "
                     f"nothing to resume")
             log.info("resumed checkpoint %s at t=%d ns",
-                     xp.checkpoint_load, t_start)
+                     load_path, t_start)
         else:
             state = self.engine.init_state(self.sim.starts)
             t_start = 0
@@ -564,14 +503,34 @@ class DeviceRunner:
                 raise ValueError(
                     f"checkpoint_save_time {pause} ns is not after "
                     f"the run's start time {t_start} ns")
+        # supervision (device/supervise.py): the rotating checkpoint
+        # writer and the SIGTERM/SIGINT drain guard — installed when
+        # a checkpoint_save path exists AND the run has segment
+        # boundaries for the drain to fire at (supervise.make_guard)
+        self.checkpointer = None
+        if xp.checkpoint_every:
+            self.checkpointer = supervise.Checkpointer(
+                xp.checkpoint_save, xp.checkpoint_every,
+                xp.checkpoint_keep, final_stop=stop,
+                extra_meta=self._ck_extra_meta,
+                audit_enabled=xp.state_audit)
+        self.guard = supervise.make_guard(self.sim.cfg)
+        import contextlib
         t0 = _time.perf_counter()
-        # segmented advance + the overflow re-plan/retry loop; a
-        # boundary that lands exactly on `pause` still emits its
-        # heartbeat (an uninterrupted run would); only the global end
-        # suppresses — resume restarts past the saved t, so the pair
-        # emits each boundary exactly once
-        state, rounds, t_end, budget_hit, overflowed = self._advance(
-            state, t_start, pause, stop)
+        # shared segmented advance (supervise.advance): heartbeat /
+        # dispatch-segment / checkpoint boundaries, the overflow
+        # re-plan loop, dispatch retry, audit validation, and the
+        # preemption drain. A boundary that lands exactly on `pause`
+        # still emits its heartbeat (an uninterrupted run would); only
+        # the global end suppresses — resume restarts past the saved
+        # t, so the pair emits each boundary exactly once
+        with (self.guard if self.guard is not None
+              else contextlib.nullcontext()):
+            state, adv = supervise.advance(self, state, t_start,
+                                           pause, stop)
+        rounds, t_end = int(np.max(adv.rounds)), adv.t_end
+        budget_hit, overflowed = adv.budget_hit, adv.overflowed
+        self.retries = adv.retries
         if xp.checkpoint_save:
             if budget_hit or overflowed:
                 # budget: the simulation stopped at an unknown
@@ -585,11 +544,18 @@ class DeviceRunner:
                           "max_rounds exhausted" if budget_hit
                           else "capacity overflow (events lost)",
                           xp.checkpoint_save)
+            elif adv.preempted:
+                # the drain already saved the resume checkpoint
+                # (adv.resume_path); a second, later-stamped save here
+                # would shadow it with identical content
+                pass
             else:
                 from shadow_tpu.device import checkpoint
-                checkpoint.save_state(self.engine, state,
-                                      xp.checkpoint_save, t_end,
-                                      final_stop=stop)
+                checkpoint.save_state(
+                    self.engine, state, xp.checkpoint_save, t_end,
+                    final_stop=stop,
+                    audit_meta=({"enabled": True, "violations": 0}
+                                if xp.state_audit else None))
                 log.info("checkpoint saved at t=%d ns -> %s (run %s)",
                          t_end, xp.checkpoint_save,
                          "complete" if t_end >= stop else
@@ -632,13 +598,20 @@ class DeviceRunner:
             self.occ_record["effective"] = occ["effective"]
             self.occ_record["replans"] = self.replans
             self.occ_record["applied"] = dict(self._capacity_overrides)
-            path = capacity.record_path(self.engine)
-            try:
-                capacity.save_record(self.occ_record, path)
-                log.info("occupancy record -> %s", path)
-            except OSError as e:
-                log.warning("could not write occupancy record %s: %s",
-                            path, e)
+            if adv.preempted:
+                # a preempted run's high-water marks cover only the
+                # executed prefix — don't publish them as a workload
+                # record the planner would size from
+                log.info("occupancy record not written (run "
+                         "preempted)")
+            else:
+                path = capacity.record_path(self.engine)
+                try:
+                    capacity.save_record(self.occ_record, path)
+                    log.info("occupancy record -> %s", path)
+                except OSError as e:
+                    log.warning("could not write occupancy record "
+                                "%s: %s", path, e)
         else:
             self.occ_record = occ
 
@@ -647,6 +620,9 @@ class DeviceRunner:
         stats.rounds = int(rounds)
         stats.occupancy = self.occ_record
         stats.replans = self.replans
+        stats.retries = self.retries
+        stats.preempted = adv.preempted
+        stats.resume_path = adv.resume_path
         stats.events_executed = n_exec_total
         stats.packets_sent = int(final["n_sent"][:H].sum())
         stats.packets_dropped = int(final["n_drop"][:H].sum())

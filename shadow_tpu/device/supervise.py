@@ -1,0 +1,600 @@
+"""Supervised device runs: periodic validated checkpoints, graceful
+preemption, and dispatch retry/failover.
+
+PR 2 made the *simulated* world fault-tolerant (link outages, host
+crashes); this module makes the simulator process itself survivable.
+Production training/inference stacks treat preemption and
+checkpoint-restart as first-class, and multi-hour 10k-host or
+ensemble campaigns need the same three guarantees:
+
+1. **Periodic validated checkpointing** — every ``checkpoint_every``
+   sim ns the run writes a rotating checkpoint
+   (``<checkpoint_save>.t<ns>``, atomic tmp+rename, last
+   ``checkpoint_keep`` retained). A checkpoint is written only from a
+   VALIDATED state: the loud overflow counters are clean and, with
+   ``state_audit`` on, the on-device health word (engine.py AUD_*
+   bits) is zero — so a corrupted state is never the one a
+   crash-restart resumes from. ``checkpoint_load`` accepts the base
+   path and resolves to the newest *readable* rotation entry,
+   skipping truncated files.
+
+2. **Graceful preemption** — SIGTERM/SIGINT set a drain flag; the
+   in-flight dispatch segment finishes, a resume checkpoint is saved
+   at the segment boundary, and the process exits with
+   ``EXIT_PREEMPTED`` (75, EX_TEMPFAIL). Because the engine clamps
+   event windows on the *global* stop, the resumed run is
+   bit-identical to the uninterrupted one (the checkpoint contract).
+   A second signal aborts hard (handlers restored, KeyboardInterrupt).
+
+3. **Dispatch retry + failover** — a transient device error
+   (RESOURCE_EXHAUSTED, device unavailable, ...) retries the failed
+   segment from the last validated state with capped exponential
+   backoff (``dispatch_retries`` / ``dispatch_retry_backoff``). After
+   exhausting retries, ``failover: hybrid`` saves the last validated
+   state to disk and raises :class:`DeviceFailover`, which the
+   Controller answers by re-running on the hybrid backend with a loud
+   diagnostic instead of aborting — the device checkpoint remains on
+   disk for a device-side resume.
+
+:func:`advance` is the single segmented-advance loop both
+``DeviceRunner`` and ``EnsembleRunner`` now share: it generalizes the
+overflow re-plan/retry loop PR 1 built into one recovery path for all
+failure classes (capacity overflow, transient dispatch errors, audit
+violations, preemption).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("supervise")
+
+# distinct exit code for a graceful preemption (EX_TEMPFAIL): the
+# operator/scheduler can tell "resume me" apart from success (0) and
+# failure (1)
+EXIT_PREEMPTED = 75
+
+# exponential backoff cap between dispatch retries (wall seconds)
+BACKOFF_CAP_S = 30.0
+
+# substrings marking a device error as transient (worth retrying from
+# the last validated state). Matched against str(exc) — XLA surfaces
+# these as XlaRuntimeError messages whose class identity varies by
+# jaxlib version, so the message is the stable surface.
+TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "device unavailable",
+    "failed to connect",
+    "Socket closed",
+    "out of memory",
+)
+
+AUDIT_BIT_NAMES = {
+    1: "heap-order/head-bounds",
+    2: "clock-monotonicity",
+    4: "counter-negativity",
+    8: "packet-conservation",
+}
+
+
+class AuditFailure(RuntimeError):
+    """The on-device invariant audit found a corrupted state. The run
+    stops rather than writing (or running past) a checkpoint that a
+    restart would trust."""
+
+
+class DeviceFailover(RuntimeError):
+    """Dispatch retries exhausted under ``failover: hybrid``: carries
+    the last validated checkpoint (for a later device-side resume) and
+    the sim time it pins. The Controller catches this and re-runs the
+    config on the hybrid backend."""
+
+    def __init__(self, message: str, checkpoint_path: str = "",
+                 sim_time: int = 0):
+        super().__init__(message)
+        self.checkpoint_path = checkpoint_path
+        self.sim_time = int(sim_time)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Whether a dispatch error is worth retrying from the last
+    validated state (vs a programming error that would just recur)."""
+    text = str(exc)
+    return any(m in text for m in TRANSIENT_MARKERS)
+
+
+def decode_audit(word: int) -> list[str]:
+    """Health-word bitmask -> the named invariants it violates."""
+    return [name for bit, name in sorted(AUDIT_BIT_NAMES.items())
+            if word & bit]
+
+
+def check_audit(state, where: str = "", last_good: str = "") -> None:
+    """Validate the on-device health word of a (standalone [H] or
+    ensemble [R, H]) state. No-op when the engine was built without
+    the audit. Raises :class:`AuditFailure` naming the violated
+    invariants — and the last validated checkpoint, if any — on a
+    nonzero word."""
+    if "aud" not in state:
+        return
+    from shadow_tpu._jax import jax
+
+    aud = np.asarray(jax.device_get(state["aud"]))
+    if not aud.any():
+        return
+    names = decode_audit(int(np.bitwise_or.reduce(aud, axis=None)))
+    hint = (f"; last validated checkpoint: {last_good}" if last_good
+            else "; no validated checkpoint exists yet")
+    raise AuditFailure(
+        f"state audit failed{f' at {where}' if where else ''}: "
+        f"violated invariant(s) {names} on "
+        f"{int((aud != 0).sum())} host slot(s) — the state is "
+        f"corrupted and will not be checkpointed or run further"
+        f"{hint}")
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT drain handler, installed for the duration of a
+    supervised run (context manager). The first signal sets
+    ``requested`` — the advance loop finishes the in-flight dispatch
+    segment, saves a resume checkpoint, and returns preempted. A
+    second signal restores the original handlers and raises
+    KeyboardInterrupt (hard abort escape hatch). Outside the main
+    thread signal handlers cannot be installed; the guard then stays
+    inactive and the run behaves as before."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self.requested = False
+        self.signum: int = 0
+        self.active = False
+        self._orig: dict = {}
+
+    def request(self) -> None:
+        """Programmatic preemption (tests, embedding harnesses)."""
+        self.requested = True
+
+    def _handle(self, signum, frame):
+        if self.requested:
+            self._restore()
+            raise KeyboardInterrupt(
+                f"second {signal.Signals(signum).name} during drain — "
+                "aborting hard (state NOT saved)")
+        self.requested = True
+        self.signum = signum
+        log.warning(
+            "received %s: draining — finishing the in-flight dispatch "
+            "segment, then saving a resume checkpoint and exiting "
+            "with rc %d (send the signal again to abort hard)",
+            signal.Signals(signum).name, EXIT_PREEMPTED)
+
+    def _restore(self) -> None:
+        for s, h in self._orig.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, OSError):
+                pass
+        self._orig.clear()
+        self.active = False
+
+    def __enter__(self) -> "PreemptionGuard":
+        try:
+            for s in self.SIGNALS:
+                self._orig[s] = signal.signal(s, self._handle)
+            self.active = True
+        except ValueError:
+            # not the main thread: leave signal disposition alone
+            self._restore()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._restore()
+
+
+def drain_possible(cfg) -> bool:
+    """Whether a run under this config ever reaches a segment
+    boundary before its pause — the only points a preemption drain
+    can fire. Without one (no checkpoint_every, no dispatch_segment,
+    no heartbeat) the whole run is ONE dispatch segment: installing
+    the guard would swallow SIGTERM/SIGINT while promising a drain
+    that can never happen, strictly worse than the default signal
+    disposition — so the runners leave the signals alone and log
+    why."""
+    xp = cfg.experimental
+    return bool(xp.checkpoint_every or xp.dispatch_segment
+                or cfg.general.heartbeat_interval)
+
+
+def make_guard(cfg):
+    """The runners' guard factory: a PreemptionGuard when a drain can
+    actually fire, else None (with a hint, once per run)."""
+    if not cfg.experimental.checkpoint_save:
+        return None
+    if not drain_possible(cfg):
+        log.info(
+            "preemption drain inactive: the run has no segment "
+            "boundaries (set experimental.checkpoint_every or "
+            "dispatch_segment, or general.heartbeat_interval, to "
+            "make SIGTERM drain to a resume checkpoint)")
+        return None
+    return PreemptionGuard()
+
+
+def rotation_entries(base: str) -> list[tuple[int, str]]:
+    """Existing rotation files for a checkpoint base path, sorted by
+    sim time ascending: ``<base>.t<15-digit-ns>``. Non-numeric
+    suffixes (in-flight ``.tmp`` files) are ignored."""
+    out = []
+    for p in glob.glob(glob.escape(base) + ".t*"):
+        suffix = p[len(base) + 2:]
+        if suffix.isdigit():
+            out.append((int(suffix), p))
+    return sorted(out)
+
+
+def resolve_checkpoint(path: str) -> str:
+    """``checkpoint_load`` resolution: a concrete file wins; otherwise
+    the newest READABLE rotation entry of the base path (a truncated
+    npz — the file a kill outran — is skipped with a warning, so the
+    resume lands on the last validated checkpoint, exactly the
+    rotation's purpose)."""
+    if os.path.exists(path):
+        return path
+    entries = rotation_entries(path)
+    if not entries:
+        raise ValueError(
+            f"checkpoint_load: {path!r} does not exist and has no "
+            f"rotation entries ({path}.t*) — nothing to resume")
+    from shadow_tpu.device import checkpoint
+
+    for t, p in reversed(entries):
+        try:
+            meta = checkpoint.peek_meta(p)
+            if meta.get("format") != checkpoint.FORMAT:
+                raise ValueError(f"format {meta.get('format')}")
+        except Exception as e:      # noqa: BLE001 — any unreadable entry
+            log.warning("skipping unreadable checkpoint %s (%s); "
+                        "falling back to the previous rotation entry",
+                        p, e)
+            continue
+        log.info("checkpoint_load: %s resolved to rotation entry %s "
+                 "(t=%d ns)", path, p, t)
+        return p
+    raise ValueError(
+        f"checkpoint_load: every rotation entry of {path!r} is "
+        "unreadable — nothing to resume")
+
+
+class Checkpointer:
+    """Rotating last-K checkpoint writer for one supervised run.
+    Every write goes through the atomic tmp+rename path in
+    checkpoint.save_state; pruning happens only after a successful
+    replace, so there is always at least one complete checkpoint on
+    disk once the first boundary passes."""
+
+    def __init__(self, base: str, every: int, keep: int,
+                 final_stop: int, extra_meta: dict = None,
+                 audit_enabled: bool = False):
+        self.base = base
+        self.every = int(every)
+        self.keep = max(1, int(keep))
+        self.final_stop = int(final_stop)
+        self.extra_meta = extra_meta
+        self.audit_enabled = bool(audit_enabled)
+        self.last_path = ""
+        self.last_t = -1
+
+    def next_after(self, t: int) -> int:
+        return (t // self.every + 1) * self.every
+
+    def save(self, engine, state, t: int) -> str:
+        from shadow_tpu.device import checkpoint
+
+        path = f"{self.base}.t{t:015d}"
+        checkpoint.save_state(
+            engine, state, path, t, final_stop=self.final_stop,
+            extra_meta=self.extra_meta,
+            audit_meta={"enabled": self.audit_enabled,
+                        "violations": 0})
+        self.last_path, self.last_t = path, t
+        self._prune()
+        log.info("rotating checkpoint at t=%d ns -> %s "
+                 "(keep %d; resume with checkpoint_load: %s)",
+                 t, path, self.keep, self.base)
+        return path
+
+    def _prune(self) -> None:
+        entries = rotation_entries(self.base)
+        for _, p in entries[:-self.keep]:
+            try:
+                os.unlink(p)
+            except OSError as e:
+                log.warning("could not prune old checkpoint %s: %s",
+                            p, e)
+
+
+@dataclass
+class AdvanceResult:
+    """What supervise.advance hands back to the runner, beyond the
+    final state: the (per-replica) round counts and every way the
+    advance can end short of `pause`."""
+
+    rounds: np.ndarray = field(
+        default_factory=lambda: np.int64(0))
+    t_end: int = 0
+    budget_hit: bool = False
+    overflowed: bool = False
+    preempted: bool = False
+    resume_path: str = ""
+    retries: int = 0
+
+
+def advance(runner, state, t_start: int, pause: int, stop: int,
+            ensemble: bool = False):
+    """The shared segmented-advance loop (DeviceRunner and
+    EnsembleRunner both delegate here): advance [t_start, pause) in
+    segments cut at heartbeat / dispatch-segment / checkpoint
+    boundaries, validating the state at every boundary and recovering
+    from each failure class:
+
+    * capacity overflow  -> widen + re-plan, re-run from the last
+      known-good state (PR 1's loop, non-static plans only);
+    * transient dispatch error -> capped-backoff retry from the last
+      validated state; exhausted -> DeviceFailover (failover: hybrid)
+      or re-raise;
+    * audit violation    -> AuditFailure (fatal: never checkpoint or
+      run forward a corrupted state);
+    * preemption request -> save a resume checkpoint at the boundary
+      and return preempted.
+
+    Returns (state, AdvanceResult).
+    """
+    from shadow_tpu._jax import jax
+    from shadow_tpu.device import capacity, checkpoint
+
+    xp = runner.sim.cfg.experimental
+    hb = runner.sim.cfg.general.heartbeat_interval
+    seg = xp.dispatch_segment
+    ck: Checkpointer = getattr(runner, "checkpointer", None)
+    guard: PreemptionGuard = getattr(runner, "guard", None)
+    audit_on = bool(xp.state_audit)
+    retry_ok = xp.capacity_plan != "static"
+    supervised = bool(ck is not None
+                      or (guard is not None and guard.active)
+                      or xp.dispatch_retries
+                      or xp.failover != "abort")
+    # last known-good snapshot: device refs are immutable, so holding
+    # the pytree costs nothing to take — but it pins the previous
+    # segment's buffers, so plain static runs (which can never retry)
+    # still skip it; every supervised failure class needs it
+    keep_good = retry_ok or supervised
+    budget = runner.engine.config.max_rounds
+    label = "ensemble " if ensemble else ""
+
+    def run_segment(st, nxt):
+        if ensemble:
+            return runner.engine.run_ensemble(st, stop=nxt,
+                                              final_stop=stop)
+        return runner.engine.run(st, stop=nxt, final_stop=stop)
+
+    def replace_state(host_state):
+        # place a host-side snapshot back onto the (possibly rebuilt)
+        # engine with fresh device buffers
+        if ensemble:
+            return capacity.transfer(
+                runner.engine, runner.sim.starts, host_state,
+                template=runner.engine.init_ensemble_state(
+                    runner.sim.starts))
+        return capacity.transfer(runner.engine, runner.sim.starts,
+                                 host_state)
+
+    def drain_save(st, t):
+        """The preemption resume checkpoint: reuse the rotation entry
+        just written at this boundary, else write one."""
+        if ck is not None:
+            if ck.last_t == t:
+                return ck.last_path
+            return ck.save(runner.engine, st, t)
+        path = xp.checkpoint_save
+        checkpoint.save_state(
+            runner.engine, st, path, t, final_stop=stop,
+            extra_meta=getattr(runner, "_ck_extra_meta", None),
+            audit_meta={"enabled": audit_on, "violations": 0})
+        return path
+
+    res = AdvanceResult()
+    good_state, good_t = (state if keep_good else None), t_start
+    failures = 0
+    t = t_start
+    next_hb = (t // hb + 1) * hb if hb else None
+    next_ck = ck.next_after(t) if ck is not None else None
+    while t < pause:
+        nxt = pause
+        if next_hb is not None:
+            nxt = min(nxt, next_hb)
+        if seg:
+            nxt = min(nxt, t + seg)
+        if next_ck is not None:
+            nxt = min(nxt, next_ck)
+        try:
+            state, seg_rounds = run_segment(state, nxt)
+            # both device_gets below synchronize, so asynchronously
+            # raised dispatch errors surface inside this try
+            dims = capacity.overflow_dims(state)
+            seg_rounds = np.asarray(jax.device_get(seg_rounds))
+        except AuditFailure:
+            raise
+        except Exception as e:      # noqa: BLE001 — classified below
+            if not is_transient(e) or good_state is None:
+                raise
+            # `failures` counts CONSECUTIVE failures of the current
+            # segment (reset on every completed segment): unrelated
+            # transient incidents hours apart must not pool into one
+            # exhausted budget — a genuinely dead device still
+            # exhausts it, because its segment never completes
+            failures += 1
+            res.retries += 1
+            if failures > xp.dispatch_retries:
+                _escalate(runner, e, good_state, good_t, stop,
+                          ensemble, ck)
+            delay = min(
+                xp.dispatch_retry_backoff * (2 ** (failures - 1)),
+                BACKOFF_CAP_S)
+            log.warning(
+                "transient %sdevice dispatch error in (%d, %d] ns "
+                "(%s); retry %d/%d from the last validated state "
+                "t=%d ns after %.1fs backoff", label, good_t, nxt,
+                e, failures, xp.dispatch_retries, good_t, delay)
+            if delay:
+                time.sleep(delay)
+            state = _recover_state(runner, good_state, replace_state,
+                                   ck, stop, ensemble)
+            good_state = state
+            t = good_t
+            next_hb = (t // hb + 1) * hb if hb else None
+            next_ck = ck.next_after(t) if ck is not None else None
+            continue
+        if dims:
+            if not retry_ok or runner.replans >= capacity.MAX_REPLANS:
+                res.rounds = res.rounds + seg_rounds
+                t = nxt
+                res.overflowed = True
+                break           # loud failure (stats.ok = False)
+            runner.replans += 1
+            runner._capacity_overrides = capacity.widen(
+                runner._capacity_overrides, dims,
+                runner.engine.effective)
+            log.warning(
+                "%scapacity overflow on %s in (%d, %d] ns; re-plan "
+                "#%d with %s, re-running from t=%d ns", label, dims,
+                good_t, nxt, runner.replans,
+                runner._capacity_overrides, good_t)
+            runner.engine = runner._build_engine()
+            state = replace_state(jax.device_get(good_state))
+            good_state = state
+            t = good_t
+            next_hb = (t // hb + 1) * hb if hb else None
+            next_ck = ck.next_after(t) if ck is not None else None
+            continue
+        res.rounds = res.rounds + seg_rounds
+        t = nxt
+        failures = 0        # the segment completed; see above
+        if int(np.max(res.rounds)) >= budget:
+            if t < pause:
+                # enforced cumulatively (per-invocation caps would
+                # reset each segment); don't emit a heartbeat for an
+                # interval the budget cut short
+                log.warning("max_rounds (%d) exhausted during "
+                            "%ssegmentation; stopping", budget, label)
+            res.budget_hit = True
+            break
+        if audit_on:
+            # the boundary state is validated BEFORE it becomes the
+            # known-good snapshot or a checkpoint — a corrupted state
+            # is never the one a retry or a restart resumes from
+            check_audit(state, where=f"t={t} ns",
+                        last_good=(ck.last_path if ck is not None
+                                   else ""))
+        if next_hb is not None and t >= next_hb and t < stop:
+            runner._emit_heartbeats(t, state)
+            next_hb += hb
+        if next_ck is not None and t >= next_ck and t < stop:
+            ck.save(runner.engine, state, t)
+            next_ck = ck.next_after(t)
+        if keep_good:
+            good_state, good_t = state, t
+        if guard is not None and guard.requested and t < pause:
+            # a signal that lands during the FINAL segment needs no
+            # drain — the run reached its pause/stop and completes
+            # normally (the t >= pause case falls out of the loop)
+            res.resume_path = drain_save(state, t)
+            res.preempted = True
+            log.warning(
+                "%srun preempted at t=%d ns: resume checkpoint -> %s "
+                "(re-run with experimental.checkpoint_load: %s to "
+                "continue; the resumed run is bit-identical to an "
+                "uninterrupted one)", label, t, res.resume_path,
+                ck.base if ck is not None else res.resume_path)
+            break
+    res.t_end = t
+    return state, res
+
+
+def _recover_state(runner, good_state, replace_state, ck, stop,
+                   ensemble):
+    """Re-place the last validated state onto fresh device buffers for
+    a dispatch retry. If even fetching the held snapshot fails (the
+    device that owned it is gone), fall back to the last rotating
+    checkpoint on disk."""
+    from shadow_tpu._jax import jax
+    from shadow_tpu.device import checkpoint
+
+    try:
+        return replace_state(jax.device_get(good_state))
+    except Exception as fetch_err:      # noqa: BLE001
+        if ck is None or not ck.last_path:
+            raise
+        log.warning("could not recover the in-memory state (%s); "
+                    "reloading the last validated checkpoint %s",
+                    fetch_err, ck.last_path)
+        template = (runner.engine.init_ensemble_state(runner.sim.starts)
+                    if ensemble else None)
+        state, _ = checkpoint.load_state(
+            runner.engine, runner.sim.starts, ck.last_path,
+            final_stop=stop, template=template)
+        return state
+
+
+def _escalate(runner, exc, good_state, good_t, stop, ensemble, ck):
+    """Retries exhausted: under ``failover: hybrid`` persist the last
+    validated state and raise DeviceFailover for the Controller;
+    otherwise re-raise the dispatch error."""
+    from shadow_tpu._jax import jax
+    from shadow_tpu.device import checkpoint
+
+    xp = runner.sim.cfg.experimental
+    if xp.failover != "hybrid" or ensemble:
+        raise exc
+    path, t_pin = "", good_t
+    if ck is not None and ck.last_path:
+        path, t_pin = ck.last_path, ck.last_t
+    try:
+        host_good = jax.device_get(good_state)
+        fo_path = ((xp.checkpoint_save + ".failover")
+                   if xp.checkpoint_save else
+                   os.path.join(runner.sim.cfg.general.data_directory,
+                                "device_failover.npz"))
+        checkpoint.save_state(
+            runner.engine, host_good, fo_path, good_t,
+            final_stop=stop,
+            audit_meta={"enabled": bool(xp.state_audit),
+                        "violations": 0})
+        path, t_pin = fo_path, good_t
+    except Exception as save_err:       # noqa: BLE001
+        if not path:
+            log.error("failover: could not persist the last "
+                      "validated state (%s) and no rotating "
+                      "checkpoint exists — re-raising the dispatch "
+                      "error", save_err)
+            raise exc from None
+        log.warning("failover: could not persist the in-memory state "
+                    "(%s); the last rotating checkpoint %s (t=%d ns) "
+                    "pins the device-side resume", save_err, path,
+                    t_pin)
+    raise DeviceFailover(
+        f"device dispatch failed permanently after "
+        f"{xp.dispatch_retries} retries ({exc}); last validated "
+        f"state at t={t_pin} ns saved to {path or '<none>'}",
+        checkpoint_path=path, sim_time=t_pin) from exc

@@ -19,7 +19,6 @@ approximations.
 
 from __future__ import annotations
 
-import json
 import os
 import time
 from typing import Optional
@@ -32,6 +31,7 @@ from shadow_tpu.core.manager import SimStats
 from shadow_tpu.device import capacity
 from shadow_tpu.device.runner import DeviceRunner, NoDeviceTwin
 from shadow_tpu.ensemble.spec import EnsembleWorlds, build_worlds
+from shadow_tpu.utils.artifacts import atomic_write_json
 from shadow_tpu.utils.slog import get_logger
 
 log = get_logger("ensemble")
@@ -103,10 +103,18 @@ class EnsembleRunner:
                 "latency/loss/faults instead")
         self.engine = self._build_engine()
         self.replans = 0
+        self.retries = 0
         self._planned = False
         self.occ_record: Optional[dict] = None
         self.record: Optional[dict] = None
         self.final_state: Optional[dict] = None
+        # supervision plumbing (device/supervise.py), set per run();
+        # campaign checkpoints carry the campaign stamp so standalone
+        # runs refuse them
+        self.checkpointer = None
+        self.guard = None
+        self._ck_extra_meta = {"campaign": self.worlds.campaign_fp,
+                               "replicas": int(self.worlds.R)}
 
     # ------------------------------------------------------------------
     @property
@@ -155,14 +163,17 @@ class EnsembleRunner:
             view[k] = np.asarray(jax.device_get(states[k])).sum(0)
         return view
 
-    def _plan_capacities(self, stop: int) -> None:
+    def _plan_capacities(self, stop: int,
+                         load_path: Optional[str] = None) -> None:
         """capacity_plan on the campaign: the warm-up slice runs the
         ENSEMBLE program, so the plan sizes every capacity from the
         worst-case replica's measured occupancy — one replica with a
         hot hub cannot overflow the others' tight plan."""
         xp = self.sim.cfg.experimental
         mode = xp.capacity_plan
-        if xp.checkpoint_load:
+        if load_path is None:
+            load_path = xp.checkpoint_load
+        if load_path:
             # same contract as DeviceRunner._plan_capacities: the
             # fingerprint pins the SAVING engine's capacities, so a
             # resume adopts them instead of re-planning (a fresh
@@ -170,7 +181,7 @@ class EnsembleRunner:
             # campaign checkpoint — and would pay the warm-up compile
             # on every resume for nothing)
             from shadow_tpu.device import checkpoint
-            meta = checkpoint.peek_meta(xp.checkpoint_load)
+            meta = checkpoint.peek_meta(load_path)
             caps = meta.get("capacities")
             if caps is None:
                 caps = {k: meta["fingerprint"][k]
@@ -266,73 +277,6 @@ class EnsembleRunner:
                      int(n_exec[r].sum()), int(n_sent[r].sum()),
                      int(n_drop[r].sum()), int(n_deliv[r].sum()))
 
-    def _advance(self, states, t_start: int, pause: int, stop: int):
-        """Segmented advance of all replicas with the overflow
-        re-plan/retry loop (the DeviceRunner contract: a plan that
-        undershoots costs one re-run from the last known-good state,
-        never the campaign)."""
-        xp = self.sim.cfg.experimental
-        hb = self.sim.cfg.general.heartbeat_interval
-        seg = xp.dispatch_segment
-        retry_ok = xp.capacity_plan != "static"
-        budget = self.engine.config.max_rounds
-        good_states, good_t = (states if retry_ok else None), t_start
-        rounds_vec = np.zeros(self.worlds.R, np.int64)
-        budget_hit = False
-        overflowed = False
-        t = t_start
-        next_hb = (t // hb + 1) * hb if hb else None
-        while t < pause:
-            nxt = pause
-            if next_hb is not None:
-                nxt = min(nxt, next_hb)
-            if seg:
-                nxt = min(nxt, t + seg)
-            states, seg_rounds = self.engine.run_ensemble(
-                states, stop=nxt, final_stop=stop)
-            dims = capacity.overflow_dims(states)
-            if dims:
-                if not retry_ok or \
-                        self.replans >= capacity.MAX_REPLANS:
-                    rounds_vec += np.asarray(seg_rounds)
-                    t = nxt
-                    overflowed = True
-                    break
-                self.replans += 1
-                self._capacity_overrides = capacity.widen(
-                    self._capacity_overrides, dims,
-                    self.engine.effective)
-                log.warning(
-                    "ensemble capacity overflow on %s in (%d, %d] "
-                    "ns; re-plan #%d with %s, re-running from "
-                    "t=%d ns", dims, good_t, nxt, self.replans,
-                    self._capacity_overrides, good_t)
-                self.engine = self._build_engine()
-                states = capacity.transfer(
-                    self.engine, self.sim.starts,
-                    jax.device_get(good_states),
-                    template=self.engine.init_ensemble_state(
-                        self.sim.starts))
-                good_states = states
-                t = good_t
-                next_hb = (t // hb + 1) * hb if hb else None
-                continue
-            rounds_vec += np.asarray(seg_rounds)
-            t = nxt
-            if int(rounds_vec.max()) >= budget:
-                if t < pause:
-                    log.warning("max_rounds (%d) exhausted during "
-                                "campaign segmentation; stopping",
-                                budget)
-                budget_hit = True
-                break
-            if next_hb is not None and t >= next_hb and t < stop:
-                self._emit_heartbeats(t, states)
-                next_hb += hb
-            if retry_ok:
-                good_states, good_t = states, t
-        return states, rounds_vec, t, budget_hit, overflowed
-
     # ------------------------------------------------------------------
     def record_path(self) -> str:
         """Canonical campaign record path (ensemble.record_path
@@ -400,41 +344,45 @@ class EnsembleRunner:
 
     # ------------------------------------------------------------------
     def run(self, stop: int) -> SimStats:
-        from shadow_tpu.device import checkpoint
+        from shadow_tpu.device import checkpoint, supervise
 
         xp = self.sim.cfg.experimental
         self.replans = 0
+        self.retries = 0
         w = self.worlds
         if xp.checkpoint_save:
             checkpoint.probe_writable(xp.checkpoint_save)
+        load_path = ""
         if xp.checkpoint_load:
-            meta = checkpoint.peek_meta(xp.checkpoint_load)
+            load_path = supervise.resolve_checkpoint(
+                xp.checkpoint_load)
+            meta = checkpoint.peek_meta(load_path)
             camp = (meta.get("ensemble") or {}).get("campaign")
             if camp is None:
                 raise ValueError(
-                    f"checkpoint {xp.checkpoint_load} was saved by a "
+                    f"checkpoint {load_path} was saved by a "
                     "standalone run — an ensemble campaign cannot "
                     "resume it")
             if camp != w.campaign_fp:
                 raise ValueError(
-                    f"checkpoint {xp.checkpoint_load} belongs to "
+                    f"checkpoint {load_path} belongs to "
                     f"campaign {camp}; this config builds "
                     f"{w.campaign_fp} — the vary block or schedules "
                     "changed, so the saved replicas would diverge")
             checkpoint.prevalidate_resume(
-                xp.checkpoint_load, stop,
+                load_path, stop,
                 save_path=xp.checkpoint_save,
                 save_time=xp.checkpoint_save_time)
         if xp.capacity_plan != "static" and not self._planned:
-            self._plan_capacities(stop)
-        if xp.checkpoint_load:
+            self._plan_capacities(stop, load_path=load_path)
+        if load_path:
             states, t_start = checkpoint.load_state(
-                self.engine, self.sim.starts, xp.checkpoint_load,
+                self.engine, self.sim.starts, load_path,
                 final_stop=stop,
                 template=self.engine.init_ensemble_state(
                     self.sim.starts))
             log.info("resumed campaign checkpoint %s at t=%d ns",
-                     xp.checkpoint_load, t_start)
+                     load_path, t_start)
         else:
             states = self.engine.init_ensemble_state(self.sim.starts)
             t_start = 0
@@ -446,9 +394,26 @@ class EnsembleRunner:
                 raise ValueError(
                     f"checkpoint_save_time {pause} ns is not after "
                     f"the campaign's start time {t_start} ns")
+        self.checkpointer = None
+        if xp.checkpoint_every:
+            self.checkpointer = supervise.Checkpointer(
+                xp.checkpoint_save, xp.checkpoint_every,
+                xp.checkpoint_keep, final_stop=stop,
+                extra_meta=self._ck_extra_meta,
+                audit_enabled=xp.state_audit)
+        self.guard = supervise.make_guard(self.sim.cfg)
+        import contextlib
         t0 = time.perf_counter()
-        states, rounds_r, t_end, budget_hit, overflowed = \
-            self._advance(states, t_start, pause, stop)
+        with (self.guard if self.guard is not None
+              else contextlib.nullcontext()):
+            states, adv = supervise.advance(self, states, t_start,
+                                            pause, stop,
+                                            ensemble=True)
+        rounds_r = np.broadcast_to(np.asarray(adv.rounds),
+                                   (self.worlds.R,))
+        t_end = adv.t_end
+        budget_hit, overflowed = adv.budget_hit, adv.overflowed
+        self.retries = adv.retries
         rounds = int(np.asarray(rounds_r).max())
         if xp.checkpoint_save:
             if budget_hit or overflowed:
@@ -457,12 +422,16 @@ class EnsembleRunner:
                           "max_rounds exhausted" if budget_hit
                           else "capacity overflow (events lost)",
                           xp.checkpoint_save)
+            elif adv.preempted:
+                # the drain already saved the resume checkpoint
+                pass
             else:
                 checkpoint.save_state(
                     self.engine, states, xp.checkpoint_save, t_end,
                     final_stop=stop,
-                    extra_meta={"campaign": w.campaign_fp,
-                                "replicas": int(w.R)})
+                    extra_meta=self._ck_extra_meta,
+                    audit_meta=({"enabled": True, "violations": 0}
+                                if xp.state_audit else None))
                 log.info("campaign checkpoint saved at t=%d ns -> %s",
                          t_end, xp.checkpoint_save)
         stat_keys = [k for k in states
@@ -493,17 +462,19 @@ class EnsembleRunner:
         x_overflow = int(final["x_overflow"][:, :H].sum())
         ok = overflow == 0 and x_overflow == 0 and not budget_hit
         self.record = self._build_record(final, rounds_r, wall, ok)
-        path = self.record_path()
-        try:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(self.record, f, indent=1, sort_keys=True)
-            os.replace(tmp, path)
-            log.info("ensemble record -> %s", path)
-        except OSError as e:
-            log.warning("could not write ensemble record %s: %s",
-                        path, e)
+        if adv.preempted:
+            # a preempted campaign's counters cover only the executed
+            # prefix — the resumed run writes the real record
+            log.info("ensemble record not written (campaign "
+                     "preempted; resume from %s)", adv.resume_path)
+        else:
+            path = self.record_path()
+            try:
+                atomic_write_json(self.record, path)
+                log.info("ensemble record -> %s", path)
+            except OSError as e:
+                log.warning("could not write ensemble record %s: %s",
+                            path, e)
 
         n_exec_total = int(final["n_exec"][:, :H].sum())
         log.info("ensemble perf: %d replicas, %d rounds in %.2fs "
@@ -515,6 +486,9 @@ class EnsembleRunner:
         stats.rounds = int(rounds)
         stats.occupancy = self.occ_record
         stats.replans = self.replans
+        stats.retries = self.retries
+        stats.preempted = adv.preempted
+        stats.resume_path = adv.resume_path
         stats.ensemble = self.record
         # campaign totals (all replicas) — the aggregate view; the
         # per-replica breakdown lives in the record

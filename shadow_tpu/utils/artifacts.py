@@ -1,0 +1,57 @@
+"""Atomic artifact writes: one tmp-file + os.replace helper.
+
+Every JSON/state artifact the simulator emits (OCC_*.json occupancy
+records, ENSEMBLE_*.json campaign records, device checkpoints, the
+round-watchdog stall dump) must never be observable half-written: a
+mid-write kill (SIGKILL, OOM, a preemption that outruns the drain)
+used to leave truncated JSON that later loads choke on with a bare
+parse error. POSIX rename is atomic within a filesystem, so every
+writer here lands the full payload in a sibling tmp file and
+os.replace()s it into place — readers see the old content or the new
+content, never a prefix.
+
+The tmp name carries the pid so two concurrent runs racing onto one
+canonical path (two bench invocations sharing an OCC record) never
+interleave into each other's tmp file; the loser's os.replace simply
+lands second.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def atomic_write(path: str, write_fn, mode: str = "wb") -> None:
+    """Write via `write_fn(file_object)` into `path + .<pid>.tmp`,
+    fsync, then atomically os.replace into place. On any failure the
+    tmp file is removed — no decoy artifacts."""
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(obj, path: str, **json_kwargs) -> None:
+    """Serialize `obj` BEFORE opening the tmp file (a non-serializable
+    object must not even leave a tmp behind), then write atomically."""
+    json_kwargs.setdefault("indent", 1)
+    json_kwargs.setdefault("sort_keys", True)
+    text = json.dumps(obj, **json_kwargs)
+    atomic_write(path, lambda f: f.write(text), mode="w")
+
+
+def atomic_write_text(text: str, path: str) -> None:
+    atomic_write(path, lambda f: f.write(text), mode="w")
