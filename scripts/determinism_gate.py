@@ -18,7 +18,14 @@ Defaults to examples/minimal.yaml with the serial policy.
 then runs the config once per policy and additionally requires every
 policy's per-host signature to be bit-identical to the first's — the
 cross-policy determinism matrix (the fault-injection CI rung pins
-serial/thread/tpu on examples/tgen_faults.yaml this way).
+serial/thread/tpu on examples/tgen_faults.yaml this way). A tpu
+entry may pin the exchange variant with a ":" suffix
+("tpu:all_to_all,tpu:all_gather,tpu:two_phase,tpu:auto") — the
+forced-multichip CI rung runs this matrix under
+XLA_FLAGS=--xla_force_host_platform_device_count=4, pinning every
+cross-shard exchange schedule bit-identical to the serial oracle;
+"tpu:auto" turns on capacity_plan: auto so the choice resolves from
+a measured occ_x record.
 
 `--preempt` switches to the PREEMPTION gate (device/supervise.py):
 run the config uninterrupted (tpu policy), then run it supervised in
@@ -55,11 +62,35 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def run_once(config: str, policy: str, data_dir: str):
+    """One gated run. `policy` may carry an exchange-variant suffix
+    for the device engine — "tpu:two_phase", "tpu:all_gather",
+    "tpu:auto", ... — the forced-multichip CI rung pins every
+    exchange schedule bit-identical to the serial oracle this way.
+    "tpu:auto" additionally turns on capacity_plan: auto so the
+    choice actually resolves from a measured occ_x record."""
     from shadow_tpu.config import load_config
     from shadow_tpu.core.controller import Controller
 
+    policy, _, exchange = policy.partition(":")
     cfg = load_config(config)
     cfg.experimental.scheduler_policy = policy
+    if exchange:
+        if policy != "tpu":
+            print(f"FAIL: exchange suffix {exchange!r} only applies "
+                  "to the tpu policy")
+            sys.exit(1)
+        # the suffix lands after load_config's schema validation, so
+        # re-check it here — a typo must FAIL cleanly, not surface as
+        # a deep engine traceback after the build work
+        valid = ("all_to_all", "all_gather", "two_phase", "auto")
+        if exchange not in valid:
+            print(f"FAIL: exchange suffix {exchange!r} is not one of "
+                  f"{list(valid)}")
+            sys.exit(1)
+        cfg.experimental.exchange = exchange
+        if exchange == "auto" and \
+                cfg.experimental.capacity_plan == "static":
+            cfg.experimental.capacity_plan = "auto"
     cfg.general.data_directory = data_dir
     c = Controller(cfg)
     stats = c.run()

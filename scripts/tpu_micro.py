@@ -18,6 +18,12 @@ variant 3 — the candidate gatherless flush (double-sort merge) timed
 variant 4 — the round's remaining gathers + one-hot pop head reads:
   host_vertex/table gathers vs unrolled one-hot sums, P=1 and P=8 pop
   reads. Args: [reps].
+variant 5 — the cross-shard exchange in isolation (IPU-dissection
+  style attribution): the flush phase timed per exchange schedule —
+  dense auto-sized all_to_all, occ_x-planned (compacted) all_to_all,
+  two_phase, all_gather — at a real config's shapes on the visible
+  mesh, with per-flush ICI rows/bytes from the engine's static
+  accounting. Args: [config] [stop_s] [reps].
 
 Every variant prints ONE JSON line. Timings use pipelined (async)
 dispatches with one final block so per-call overhead amortizes away —
@@ -620,7 +626,105 @@ def variant4(args: list[str]) -> int:
     return 0
 
 
-VARIANTS = {1: variant1, 2: variant2, 3: variant3, 4: variant4}
+# ---------------------------------------------------------------------
+# variant 5: the cross-shard exchange in isolation
+# ---------------------------------------------------------------------
+def variant5(args: list[str]) -> int:
+    """Flush-phase wall + per-flush ICI volume per exchange schedule
+    at a real config's shapes. Each schedule gets its own engine:
+    `dense` is the blind 4x auto-sized all_to_all pack (the
+    pre-planner baseline), `planned` sizes every capacity (CAP
+    included) from a measured warm-up record, `two_phase` and
+    `all_gather` run the alternative schedules under the same plan.
+    Single-shard meshes still time the flush (sort/merge work), with
+    ICI volume 0."""
+    cfg_path = args[0] if len(args) > 0 else "examples/tgen_1000.yaml"
+    stop_s = float(args[1]) if len(args) > 1 else 3.0
+    reps = int(args[2]) if len(args) > 2 else REPS
+
+    from shadow_tpu import simtime
+    from shadow_tpu._jax import jax, jnp
+    from jax.sharding import NamedSharding
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.device.engine import INF
+
+    stop = simtime.from_seconds(stop_s)
+    res = {"variant": 5, "config": cfg_path,
+           "platform": jax.devices()[0].platform,
+           "n_devices": len(jax.devices()),
+           "slice_sim_s": stop_s, "reps": reps, "schedules": {}}
+
+    def build(label, exchange, planned):
+        cfg = load_config(cfg_path)
+        cfg.experimental.scheduler_policy = "tpu"
+        cfg.general.stop_time = stop
+        cfg.experimental.exchange = exchange
+        if planned:
+            cfg.experimental.capacity_plan = "auto"
+            cfg.experimental.capacity_warmup = min(
+                stop, simtime.from_seconds(3.0))
+        else:
+            # the dense baseline: blind auto CAP, no compaction
+            cfg.experimental.outbox_compact = 0
+            cfg.experimental.exchange_capacity = 0
+        c = Controller(cfg)
+        if planned:
+            c.runner._plan_capacities(stop)
+        return c
+
+    for label, exchange, planned in (
+            ("dense_all_to_all", "all_to_all", False),
+            ("planned_all_to_all", "all_to_all", True),
+            ("planned_two_phase", "two_phase", True),
+            ("planned_all_gather", "all_gather", True)):
+        t_build = time.perf_counter()
+        c = build(label, exchange, planned)
+        eng = c.runner.engine
+        eff = dict(eng.effective)
+        # mid-run state + one popped phase's outbox, flush timed alone
+        st = eng.init_state(c.sim.starts)
+        st_mid, _ = eng.run(st, stop=stop // 2, final_stop=stop)
+        jax.block_until_ready(st_mid)
+        repl = NamedSharding(eng.mesh, eng._repl_spec)
+        shard = NamedSharding(eng.mesh, eng._shard_spec)
+        hv = jax.device_put(jnp.asarray(eng.host_vertex), repl)
+        wrld = eng.world()
+        nxt, _ = map(int, eng._probe(st_mid))
+        win_end = jnp.int64(min(nxt + max(1, eng.config.lookahead),
+                                stop))
+        ob = {"t": jax.device_put(
+            jnp.full(eng._ob_shape_global, INF, jnp.int64), shard)}
+        for f in ("k", "m", "s", "v"):
+            ob[f] = jax.device_put(
+                jnp.zeros(eng._ob_shape_global, jnp.int64), shard)
+        st_pop, ob_full, _ = eng._pop_phase(st_mid, ob, hv, wrld,
+                                            win_end)
+        jax.block_until_ready(ob_full)
+        ms = timed_ms(
+            f"flush {label}", lambda: eng._flush_phase(
+                st_pop, ob_full, hv, wrld, win_end), reps)
+        res["schedules"][label] = {
+            "flush_ms": ms,
+            "build_s": round(time.perf_counter() - t_build, 1),
+            "ici_rows_per_flush": eff["ICI_rows_per_flush"],
+            "ici_bytes_per_flush": eff["ICI_bytes_per_flush"],
+            "CAP": eff["CAP"], "CAP2": eff["CAP2"],
+            "CX": eff["CX"], "OB": eff["OB"],
+            "tp_groups": eff["tp_groups"],
+        }
+    dense = res["schedules"]["dense_all_to_all"]
+    plan = res["schedules"]["planned_all_to_all"]
+    if plan["ici_rows_per_flush"]:
+        res["ici_reduction_planned_vs_dense"] = round(
+            dense["ici_rows_per_flush"] / plan["ici_rows_per_flush"],
+            2)
+    print(json.dumps(res), flush=True)
+    return 0
+
+
+VARIANTS = {1: variant1, 2: variant2, 3: variant3, 4: variant4,
+            5: variant5}
 
 
 def main() -> int:
@@ -630,14 +734,15 @@ def main() -> int:
                     choices=sorted(VARIANTS),
                     help="1 round-step attribution (default), "
                          "2 sorts-vs-gathers, 3 gatherless flush, "
-                         "4 remaining gathers + one-hot pop")
+                         "4 remaining gathers + one-hot pop, "
+                         "5 exchange-in-isolation")
     ap.add_argument("args", nargs="*",
-                    help="variant args (v1: [config] [stop_s] "
+                    help="variant args (v1/v5: [config] [stop_s] "
                          "[reps]; v2-4: [reps])")
     ns = ap.parse_args()
 
     signal.signal(signal.SIGALRM, lambda *a: sys.exit(9))
-    signal.alarm(30 * 60 if ns.variant == 1 else 20 * 60)
+    signal.alarm(30 * 60 if ns.variant in (1, 5) else 20 * 60)
     return VARIANTS[ns.variant](ns.args)
 
 

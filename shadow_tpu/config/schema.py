@@ -318,8 +318,19 @@ class ExperimentalOptions:
     # --- TPU engine knobs (new; absent from the reference) ---
     event_capacity: int = 64        # device event slots per host
     outbox_capacity: int = 32       # device packet sends per host per round
-    exchange: str = "all_to_all"    # all_to_all | all_gather
+    # cross-shard exchange schedule: "all_to_all" (direct per-pair
+    # buffers), "all_gather" (replicate whole outboxes; hub-heavy
+    # traffic), "two_phase" (hierarchical intra-group then
+    # inter-group schedule with aggregated per-phase buffers; skewed
+    # sparse traffic), or "auto" (pick per workload from the measured
+    # occupancy record — needs capacity_plan auto/<path> on a
+    # multi-chip mesh, otherwise resolves to all_to_all). Traces are
+    # bit-identical across variants (docs/exchange.md).
+    exchange: str = "all_to_all"
     exchange_capacity: int = 0      # per shard-pair rows; 0 = auto-size
+    # two_phase phase-2 (inter-group forward) buffer rows; 0 =
+    # auto-size. Ignored by the other exchange variants.
+    exchange_capacity2: int = 0
     # per-host arrivals accepted per flush (the merge-sort width is
     # event_capacity + this, so it is a first-order term of flush
     # cost); 0 = event_capacity. Too small fails LOUDLY via the
@@ -486,7 +497,8 @@ class ExperimentalOptions:
         _check_choice("experimental", "router_queue",
                       out.router_queue, ("codel", "single", "static"))
         _check_choice("experimental", "exchange",
-                      out.exchange, ("all_gather", "all_to_all"))
+                      out.exchange, ("all_gather", "all_to_all",
+                                     "two_phase", "auto"))
         _check_choice("experimental", "judge_placement",
                       out.judge_placement, ("auto", "flush", "step"))
         _check_choice("experimental", "merge_strategy",
@@ -583,6 +595,7 @@ class ExperimentalOptions:
                               ("dispatch_retries", 0),
                               ("outbox_capacity", 1),
                               ("exchange_capacity", 0),
+                              ("exchange_capacity2", 0),
                               ("exchange_in_capacity", 0),
                               ("outbox_compact", 0),
                               ("burst_pops", 0),

@@ -48,14 +48,58 @@ SLACK = 2
 # attempt doubles the offending dimension, so 6 covers a 64x miss)
 MAX_REPLANS = 6
 
+# the engine's full capacity-knob surface, in one place: the planner
+# plans them, checkpoints stamp them, resumes adopt them, and the
+# runners snapshot the static baseline from them — a new knob joins
+# here and every consumer follows.
+CAPACITY_KNOBS = ("event_capacity", "outbox_capacity",
+                  "exchange_capacity", "exchange_capacity2",
+                  "exchange_in_capacity", "outbox_compact")
+
 # overflow counter -> the capacity dimensions it implicates. The
 # merge/arrival `overflow` counter cannot distinguish a short heap
 # from a short arrival window, so both grow together; `x_overflow`
-# covers both the shard-pair CAP and the compaction width.
+# covers the shard-pair CAP (both phases of a two_phase schedule)
+# and the compaction width.
 OVERFLOW_DIMS = {
     "overflow": ("event_capacity", "exchange_in_capacity"),
-    "x_overflow": ("exchange_capacity", "outbox_compact"),
+    "x_overflow": ("exchange_capacity", "exchange_capacity2",
+                   "outbox_compact"),
 }
+
+# two_phase must beat the direct all_to_all's estimated ICI volume by
+# this factor before `exchange: auto` picks it (two collectives + an
+# extra on-device sort are only worth real bandwidth savings)
+TWO_PHASE_MARGIN = 0.9
+
+
+def dense_auto_cap(h_loc: int, outbox: int, event_capacity: int,
+                   n_shards: int) -> int:
+    """The engine's blind per-pair CAP when exchange_capacity is 0:
+    4x the balanced share of the whole outbox, "for skewed traffic".
+    ONE definition, shared by the engine's auto-sizing and by the
+    bench/micro reports that quote the dense baseline a measured plan
+    replaces — the reduction factor must never be computed against a
+    stale copy of this heuristic."""
+    r = h_loc * outbox
+    return min(r, max(64, event_capacity,
+                      (4 * r + n_shards - 1) // n_shards))
+
+
+def group_split(n_shards: int) -> tuple[int, int]:
+    """Two-phase exchange group factorization: n_shards = g * ng with
+    g (the intra-group size, phase 1) the largest divisor <= sqrt —
+    so both phases have as few peers as possible. A prime shard count
+    degenerates to (1, n_shards): phase 1 is empty and phase 2 is the
+    direct exchange, correct but profitless (auto never picks it)."""
+    g = 1
+    for d in range(2, int(math.isqrt(n_shards)) + 1):
+        if n_shards % d == 0:
+            g = d
+    # isqrt catches d <= sqrt; the co-divisor may be the better g when
+    # n_shards is a perfect square times a small factor — keep g as
+    # the largest divisor not exceeding isqrt (g <= ng always)
+    return g, n_shards // g
 
 
 def app_scalars(app) -> dict:
@@ -103,11 +147,20 @@ def measure(engine, state, source: str = "run") -> dict:
                      "occ_trips", "occ_phases", "overflow",
                      "x_overflow")}
     eff = dict(engine.effective)
+    # the full per-(src shard, dst shard) high-water matrix rides the
+    # record (a few ints per shard pair): the exchange planner sizes
+    # the direct per-pair CAP from its max and the two_phase per-PHASE
+    # caps from its row/column aggregates, and choose_exchange
+    # compares the variants' estimated ICI volumes from it
+    pairs = np.asarray(occ["occ_x"], dtype=np.int64)
+    if pairs.ndim > 2:          # ensemble stacks reduce to worst-case
+        pairs = pairs.max(axis=tuple(range(pairs.ndim - 2)))
     measured = {
         "heap_rows_max": int(occ["occ_heap"][:H].max(initial=0)),
         "outbox_rows_max": int(occ["occ_ob"][:H].max(initial=0)),
         "arrivals_per_flush_max": int(occ["occ_in"][:H].max(initial=0)),
         "exchange_rows_max": int(occ["occ_x"].max(initial=0)),
+        "exchange_pairs": [[int(v) for v in row] for row in pairs],
         "pop_trips_max": int(occ["occ_trips"].max(initial=0)),
         "phases": int(occ["occ_phases"].max(initial=0)),
         "overflow": int(occ["overflow"][:H].sum()),
@@ -128,8 +181,77 @@ def measure(engine, state, source: str = "run") -> dict:
     }
 
 
+def merged_measured(record: dict) -> dict:
+    """The record's `measured` maxima merged with `final_measured`
+    (elementwise for the pair matrix): a capacity_plan: <path> replay
+    sizes for steady state, not just the warm-up prefix."""
+    m = dict(record["measured"])
+    for k, v in record.get("final_measured", {}).items():
+        if k not in m:
+            continue
+        if k == "exchange_pairs":
+            a = np.asarray(m[k], dtype=np.int64)
+            b = np.asarray(v, dtype=np.int64)
+            if a.shape == b.shape:
+                m[k] = np.maximum(a, b).tolist()
+        else:
+            m[k] = max(m[k], v)
+    return m
+
+
+def pair_matrix(m: dict, n_shards: int) -> np.ndarray:
+    """The per-(src shard, dst shard) high-water matrix of a merged
+    `measured` dict. Records written before the matrix existed (or
+    measured on a different shard count) fall back to the scalar
+    per-pair max replicated everywhere off-diagonal — a safe upper
+    bound that never undershoots what the scalar plan would have."""
+    pairs = np.asarray(m.get("exchange_pairs", []), dtype=np.int64)
+    if pairs.shape != (n_shards, n_shards):
+        pairs = np.full((n_shards, n_shards),
+                        int(m.get("exchange_rows_max", 0)),
+                        dtype=np.int64)
+        np.fill_diagonal(pairs, 0)
+    return pairs
+
+
+def two_phase_caps(pairs: np.ndarray, headroom: float = HEADROOM
+                   ) -> tuple[int, int]:
+    """Per-phase capacities of the hierarchical two_phase schedule
+    from the pair high-water matrix. Shard s = (group a, rank b) with
+    g = group_split(S)[0]:
+
+    * phase 1 (intra-group): s ships ONE buffer per in-group rank r
+      holding every row destined to rank r in ANY group, so CAP1 must
+      hold max over (s, r) of sum_a pairs[s, a*g + r];
+    * phase 2 (inter-group): intermediate (a, b) forwards its whole
+      group's rows destined (a', b), so CAP2 must hold max over
+      (a, b, a' != a) of sum_{s in group a} pairs[s, a'*g + b].
+
+    Sums of per-pair high-water marks upper-bound the high-water of
+    the sum, so a plan from these caps can only overshoot — an
+    undershoot (the warm-up missed steady state) still fails loudly
+    and re-plans, exactly like the direct CAP."""
+    S = pairs.shape[0]
+    g, ng = group_split(S)
+    def pad(x: int) -> int:
+        return int(math.ceil(int(x) * headroom)) + SLACK
+    # [S, ng, g]: sender s -> (dst group a, dst rank r)
+    by_dst = pairs.reshape(S, ng, g)
+    cap1 = int(by_dst.sum(axis=1).max(initial=0))
+    # [ng, g, ng, g]: (src group, src rank) -> (dst group, dst rank)
+    by_both = pairs.reshape(ng, g, ng, g)
+    # intermediate (a, b) -> dst group a': sum over src ranks in a of
+    # rows destined (a', b); mask the a' == a diagonal (delivered in
+    # phase 1, never forwarded)
+    fwd = by_both.sum(axis=1)            # [a, a', b]
+    eye = np.eye(ng, dtype=bool)[:, :, None]
+    cap2 = int(np.where(eye, 0, fwd).max(initial=0))
+    return max(8, pad(cap1)), max(8, pad(cap2))
+
+
 def plan(record: dict, per_iter: int, floor_iters: int = 4,
-         n_shards: int = 1, headroom: float = HEADROOM) -> dict:
+         n_shards: int = 1, headroom: float = HEADROOM,
+         exchange: str = "all_to_all") -> dict:
     """Measured occupancies -> EngineConfig capacity overrides.
 
     per_iter is the outbox row cost of one pop iteration (K_eff + T
@@ -139,11 +261,14 @@ def plan(record: dict, per_iter: int, floor_iters: int = 4,
     Saved records carry both the warm-up slice maxima (`measured`)
     and, once the runner finishes, the full run's (`final_measured`)
     — plan from the elementwise max so a capacity_plan: <path> replay
-    sizes for steady state, not just the warm-up prefix."""
-    m = dict(record["measured"])
-    for k, v in record.get("final_measured", {}).items():
-        if k in m:
-            m[k] = max(m[k], v)
+    sizes for steady state, not just the warm-up prefix.
+
+    `exchange` is the (resolved) exchange variant the engine will
+    run: the direct all_to_all sizes one per-pair CAP from the occ_x
+    high-water mark; two_phase sizes its two per-phase caps from the
+    pair matrix aggregates (two_phase_caps); all_gather ships whole
+    compacted outboxes and needs no CAP at all."""
+    m = merged_measured(record)
 
     def pad(x: int) -> int:
         return int(math.ceil(x * headroom)) + SLACK
@@ -160,17 +285,82 @@ def plan(record: dict, per_iter: int, floor_iters: int = 4,
     outbox_compact = cx if cx < (3 * outbox_capacity) // 4 else 0
     # per shard-pair exchange rows: only meaningful multi-shard; 0
     # keeps the engine's own auto-sizing when nothing was measured
+    exchange_capacity = 0
+    exchange_capacity2 = 0
     if n_shards > 1 and m["exchange_rows_max"] > 0:
-        exchange_capacity = max(8, pad(m["exchange_rows_max"]))
-    else:
-        exchange_capacity = 0
+        if exchange == "two_phase":
+            exchange_capacity, exchange_capacity2 = two_phase_caps(
+                pair_matrix(m, n_shards), headroom)
+        elif exchange != "all_gather":
+            exchange_capacity = max(8, pad(m["exchange_rows_max"]))
     return {
         "event_capacity": event_capacity,
         "outbox_capacity": outbox_capacity,
         "exchange_capacity": exchange_capacity,
+        "exchange_capacity2": exchange_capacity2,
         "exchange_in_capacity": exchange_in,
         "outbox_compact": outbox_compact,
     }
+
+
+def estimate_ici_rows(record: dict, n_shards: int,
+                      per_iter: int, floor_iters: int = 4,
+                      headroom: float = HEADROOM) -> dict:
+    """Estimated per-flush ICI rows each variant would ship per shard
+    under a plan from this record (buffers ship at capacity — padding
+    included — so the estimate is the planned cap times the peer
+    count, exactly what the wire carries)."""
+    m = merged_measured(record)
+    S = n_shards
+    if S <= 1:
+        return {"all_to_all": 0, "two_phase": 0, "all_gather": 0}
+
+    def pad(x: int) -> int:
+        return int(math.ceil(x * headroom)) + SLACK
+
+    pairs = pair_matrix(m, S)
+    cap = max(8, pad(int(pairs.max(initial=0))))
+    g, ng = group_split(S)
+    cap1, cap2 = two_phase_caps(pairs, headroom)
+    # all_gather replicates each shard's whole compacted outbox
+    p = plan(record, per_iter, floor_iters, n_shards=S,
+             headroom=headroom, exchange="all_gather")
+    w = p["outbox_compact"] or p["outbox_capacity"]
+    h_loc = -(-record["workload"]["n_hosts"] // S)
+    return {
+        "all_to_all": (S - 1) * cap,
+        "two_phase": (g - 1) * cap1 + (ng - 1) * cap2,
+        "all_gather": (S - 1) * h_loc * w,
+    }
+
+
+def choose_exchange(record: dict, n_shards: int, per_iter: int,
+                    floor_iters: int = 4,
+                    headroom: float = HEADROOM) -> tuple[str, dict]:
+    """`exchange: auto` resolution from a measured occupancy record:
+    compare the variants' estimated per-flush ICI rows and pick the
+    cheapest. two_phase must beat the direct all_to_all by
+    TWO_PHASE_MARGIN (its two collectives + extra on-device sort are
+    only worth real bandwidth savings), and a degenerate group split
+    (prime shard count) never qualifies. Returns (variant, info)."""
+    est = estimate_ici_rows(record, n_shards, per_iter, floor_iters,
+                            headroom)
+    info = {"estimates": est, "n_shards": n_shards,
+            "group_split": list(group_split(n_shards))}
+    if n_shards <= 1:
+        return "all_to_all", info
+    choice = "all_to_all"
+    if est["all_gather"] < est["all_to_all"]:
+        choice = "all_gather"
+    g, _ = group_split(n_shards)
+    # two_phase must beat the DIRECT schedule by the margin (the
+    # documented rule) and also be the overall minimum
+    if g > 1 and est["two_phase"] < \
+            TWO_PHASE_MARGIN * est["all_to_all"] and \
+            est["two_phase"] < est[choice]:
+        choice = "two_phase"
+    info["chosen"] = choice
+    return choice, info
 
 
 def widen(knobs: dict, dims: tuple, effective: dict) -> dict:
@@ -187,6 +377,13 @@ def widen(knobs: dict, dims: tuple, effective: dict) -> dict:
         elif dim == "exchange_capacity":
             if effective["CAP"] > 0:
                 out[dim] = 2 * max(out.get(dim) or 0, effective["CAP"])
+        elif dim == "exchange_capacity2":
+            # only live on the two_phase schedule (CAP2 > 0); the
+            # x_overflow counter cannot tell which phase lost rows,
+            # so both caps double together
+            if effective.get("CAP2", 0) > 0:
+                out[dim] = 2 * max(out.get(dim) or 0,
+                                   effective["CAP2"])
         elif dim == "outbox_compact":
             # a compaction width that lost rows first doubles, then
             # turns off once it stops paying for itself

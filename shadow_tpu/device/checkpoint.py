@@ -41,6 +41,13 @@ import numpy as np
 FORMAT = 1
 
 
+def _capacity_knobs():
+    # deferred: checkpoint.py stays importable without pulling the
+    # capacity module at import time
+    from shadow_tpu.device.capacity import CAPACITY_KNOBS
+    return CAPACITY_KNOBS
+
+
 def probe_writable(path: str) -> None:
     """Fail on an unwritable checkpoint_save path NOW, in
     milliseconds — before a capacity warm-up spends minutes compiling,
@@ -160,9 +167,11 @@ def save_state(engine, state, path: str, sim_time: int,
         # the overflow + re-plan cycle past the resume point)
         "capacities": {
             k: int(getattr(engine.config, k))
-            for k in ("event_capacity", "outbox_capacity",
-                      "exchange_capacity", "exchange_in_capacity",
-                      "outbox_compact")},
+            for k in _capacity_knobs()},
+        # the exchange schedule the saving engine compiled: traces
+        # are variant-invariant, but a resume under `exchange: auto`
+        # adopts it so the adopted capacities stay meaningful
+        "exchange": str(engine.config.exchange),
         "keys": [k for k, _ in named],
     }
     if extra_meta:

@@ -112,15 +112,30 @@ class EngineConfig:
     seed: int = 1
     max_rounds: int = 1 << 62    # safety valve
     # cross-shard packet exchange: "all_to_all" moves only each
-    # (src shard, dst shard) pair's rows over ICI (two-phase: sort by
+    # (src shard, dst shard) pair's rows over ICI (sort by
     # destination shard, then lax.all_to_all on [n_shards, CAP]
     # buffers); "all_gather" replicates every shard's whole outbox
-    # (simple, bandwidth ∝ H_pad*OB per device)
+    # (simple, bandwidth ∝ H_pad*OB per device); "two_phase" is the
+    # hierarchical schedule (direct-connect style, arxiv 2309.13541):
+    # shards factor into groups of g = capacity.group_split(S)[0],
+    # phase 1 exchanges intra-group by destination RANK, phase 2
+    # forwards inter-group — per-phase buffers aggregate over whole
+    # rank/group sets, so one hot pair borrows headroom from quiet
+    # pairs instead of padding every pair to the worst.
     exchange: str = "all_to_all"
     # per (src shard, dst shard) row capacity; 0 = auto-size from the
     # outbox volume with 4x headroom for skewed traffic. Overflow is
     # counted per source host and fails the run, never silently lost.
+    # Under two_phase this is the PHASE-1 per-peer buffer (rows per
+    # destination rank, summed over destination groups).
     exchange_capacity: int = 0
+    # two_phase phase-2 per-peer buffer (rows one intermediate
+    # forwards to one destination group); 0 = auto-size. Unused by
+    # the other exchange variants. Overflow is counted against the
+    # ORIGINAL sending host (cross-shard: one scalar collective
+    # decides the loss branch, then a psum'd histogram lands each
+    # lost row on its sender's shard) and fails the run.
+    exchange_capacity2: int = 0
     # per-host arrivals accepted per flush (merge width = E + this);
     # 0 = event_capacity. Overflow is counted and fails the run.
     exchange_in_capacity: int = 0
@@ -429,6 +444,15 @@ class DeviceEngine:
     def _build_program(self):
         cfg = self.config
         app = self.app
+        if cfg.exchange not in ("all_to_all", "all_gather",
+                                "two_phase"):
+            # "auto" resolves in the runner (capacity.choose_exchange
+            # over the OCC record) — the engine only compiles concrete
+            # schedules
+            raise ValueError(
+                f"EngineConfig.exchange={cfg.exchange!r}: the engine "
+                "needs a concrete variant (all_to_all | all_gather | "
+                "two_phase); 'auto' is resolved by the runner")
         E = cfg.event_capacity
         K = app.max_sends
         T = app.max_timers
@@ -469,12 +493,32 @@ class DeviceEngine:
         # first-order flush win; too small is LOUD (overflow counter)
         IN = cfg.exchange_in_capacity or E
         SPAN = np.int64(H_pad) * OB   # okey < SPAN
+        from shadow_tpu.device.capacity import (
+            dense_auto_cap,
+            group_split,
+        )
+        TP_G, TP_NG = (group_split(n_shards)
+                       if cfg.exchange == "two_phase" else
+                       (1, n_shards))
         if cfg.exchange == "all_to_all" and n_shards > 1:
+            CAP = cfg.exchange_capacity or \
+                dense_auto_cap(H_loc, OB, E, n_shards)
+            CAP2 = 0
+        elif cfg.exchange == "two_phase" and n_shards > 1:
+            # phase-1 buffers aggregate a sender's rows per dst RANK
+            # (over all groups); phase-2 buffers aggregate a whole
+            # group's forwards per dst group. The blind auto sizes
+            # assume 4x-of-balanced skew exactly like the direct
+            # CAP's; the planner replaces both with measured sums.
             R = H_loc * OB
             CAP = cfg.exchange_capacity or \
-                min(R, max(64, E, (4 * R + n_shards - 1) // n_shards))
+                min(R, max(64, E, (4 * R + TP_G - 1) // TP_G))
+            CAP2 = cfg.exchange_capacity2 or \
+                min(TP_G * CAP,
+                    max(64, E,
+                        (4 * R * TP_G + n_shards - 1) // n_shards))
         else:
-            CAP = 0
+            CAP = CAP2 = 0
 
         # Judgment hoist: without the fluid NIC, a send's network
         # judgment (latency gather + drop rolls + causality bump) does
@@ -1065,10 +1109,36 @@ class DeviceEngine:
         CX = min(cfg.outbox_compact or OB, OB)
 
         # effective (post-auto-sizing) capacities, for the occupancy
-        # record and the planner's re-plan arithmetic
+        # record and the planner's re-plan arithmetic. ICI_* is the
+        # per-flush cross-chip traffic each shard SENDS (buffers ship
+        # at capacity, padding included — that IS the wire cost), so
+        # bench/tpu_micro report exchanged volume without touching
+        # device state: rows/round = ICI_rows_per_flush * phases /
+        # rounds.
+        if n_shards <= 1:
+            ici_rows, ici_arrays = 0, 0
+        elif cfg.exchange == "all_to_all":
+            # [n_shards, CAP] buffers; the self slot never crosses ICI
+            ici_rows = (n_shards - 1) * int(CAP)
+            # 5 field arrays, + the shipped sort keys on the window
+            # merge path (the global merge re-derives order)
+            ici_arrays = 5 if MERGE_GLOBAL else 6
+        elif cfg.exchange == "two_phase":
+            ici_rows = (TP_G - 1) * int(CAP) + \
+                (TP_NG - 1) * int(CAP2)
+            ici_arrays = 6          # keys route phase 2 on both paths
+        else:                       # all_gather replicates everything
+            ici_rows = (n_shards - 1) * H_loc * CX
+            ici_arrays = 5 if MERGE_GLOBAL else 7   # + skey + perm
         self.effective = {"E": E, "B": B, "OB": OB, "IN": IN,
-                          "CAP": int(CAP), "CX": CX, "M_out": M_out,
-                          "n_shards": n_shards}
+                          "CAP": int(CAP), "CAP2": int(CAP2),
+                          "CX": CX, "M_out": M_out,
+                          "n_shards": n_shards,
+                          "exchange": cfg.exchange,
+                          "tp_groups": [int(TP_G), int(TP_NG)],
+                          "ICI_rows_per_flush": int(ici_rows),
+                          "ICI_bytes_per_flush":
+                              int(ici_rows) * ici_arrays * 8}
 
         def _flat_sorted(state, ob, gid):
             slot = jnp.arange(OB, dtype=jnp.int64)[None, :]
@@ -1409,37 +1479,48 @@ class DeviceEngine:
                 (state["ht"] < INF).sum(-1).astype(jnp.int32))
             return state
 
-        def _pack_remote(state, skey, perm, rows, my_shard,
-                         ship_keys):
-            """Pack genuinely remote rows into [n_shards, CAP] and
-            move them with one all_to_all; self-shard rows never
-            enter the pack (zero ICI, zero CAP). CAP overflow is
-            attributed to the SENDING host (it owns the sizing knob)
-            via a segment-rank scan + 1-key sort + searchsorted
-            histogram — scatter-free like everything else.
-            `ship_keys` additionally moves each row's skey (the
-            window merge re-sorts arrivals by it; the global merge
-            orders by (t, key) and skips the extra operand)."""
-            G = H_loc * CX
+        # pack plumbing shared by the direct and two-phase schedules:
+        # BOTH must account shard segments, occ_x demand, and loud
+        # per-sender loss identically, or the cross-variant
+        # determinism/planner contracts silently desynchronize — so
+        # each piece exists exactly once.
+        def _shard_edges(skey):
+            """Per-destination-shard [start, count) segments of a
+            sorted key array."""
             bound = (jnp.arange(n_shards + 1, dtype=jnp.int64)
                      * H_loc * SPAN)
             edges = jnp.searchsorted(skey, bound)
-            starts, nxt = edges[:-1], edges[1:]
-            counts = nxt - starts
-            remote = jnp.arange(n_shards) != my_shard
-            counts = jnp.where(remote, counts, 0)
-            # occupancy: rows this shard ships to each dst shard —
-            # what exchange_capacity (CAP) must hold per pair
+            return edges[:-1], edges[1:] - edges[:-1]
+
+        def _shard_segments(state, skey, my_shard):
+            """_shard_edges with the self shard's count zeroed (the
+            bypass owns those rows) and the occ_x pair telemetry
+            updated — what exchange_capacity must hold per pair."""
+            starts, counts = _shard_edges(skey)
+            counts = jnp.where(jnp.arange(n_shards) != my_shard,
+                               counts, 0)
             state["occ_x"] = jnp.maximum(
                 state["occ_x"], counts.astype(jnp.int32)[None, :])
-            idx = jnp.arange(G, dtype=jnp.int64)
-            shard_of = skey // (H_loc * SPAN)
+            return state, starts, counts
+
+        def _within_shard_rank(skey):
+            """(dst shard, within-segment rank) per sorted row — the
+            position a row competes for inside its destination
+            segment. Empty rows (IMAX keys) share the n_shards
+            sentinel segment."""
+            idx = jnp.arange(skey.shape[0], dtype=jnp.int64)
+            shard_of = jnp.minimum(skey // (H_loc * SPAN),
+                                   jnp.int64(n_shards))
             is_new = jnp.concatenate(
                 [jnp.array([True]), shard_of[1:] != shard_of[:-1]])
             seg0 = lax.associative_scan(
                 jnp.maximum, jnp.where(is_new, idx, 0))
-            lost_mask = (skey < IMAX) & ((idx - seg0) >= CAP) & \
-                (shard_of != my_shard.astype(jnp.int64))
+            return shard_of, idx - seg0
+
+        def _lost_to_local(state, lost_mask, skey, my_shard):
+            """Attribute lost rows to the LOCAL sending host (it owns
+            the sizing knob): 1-key sort + searchsorted histogram,
+            scatter-free like everything else."""
             src_loc = (skey % SPAN) // OB \
                 - my_shard.astype(jnp.int64) * H_loc
             lk = lax.sort(jnp.where(lost_mask, src_loc, IMAX))
@@ -1447,6 +1528,24 @@ class DeviceEngine:
                 lk, jnp.arange(H_loc + 1, dtype=jnp.int64))
             state["x_overflow"] = state["x_overflow"] + \
                 (hb[1:] - hb[:-1]).astype(jnp.int32)
+            return state
+
+        def _pack_remote(state, skey, perm, rows, my_shard,
+                         ship_keys):
+            """Pack genuinely remote rows into [n_shards, CAP] and
+            move them with one all_to_all; self-shard rows never
+            enter the pack (zero ICI, zero CAP). CAP overflow is
+            attributed to the SENDING host. `ship_keys` additionally
+            moves each row's skey (the window merge re-sorts arrivals
+            by it; the global merge orders by (t, key) and skips the
+            extra operand)."""
+            G = H_loc * CX
+            state, starts, counts = _shard_segments(state, skey,
+                                                    my_shard)
+            shard_of, rank = _within_shard_rank(skey)
+            lost_mask = (skey < IMAX) & (rank >= CAP) & \
+                (shard_of != my_shard.astype(jnp.int64))
+            state = _lost_to_local(state, lost_mask, skey, my_shard)
             win = _seg_take(perm, rows, starts, counts, CAP)
             moved = {f: lax.all_to_all(
                 win[f], AXIS, split_axis=0, concat_axis=0)
@@ -1467,6 +1566,146 @@ class DeviceEngine:
                     kwin, AXIS, split_axis=0,
                     concat_axis=0).reshape(n_shards * CAP)
             return state, moved, kmoved
+
+        # ---------------- two-phase hierarchical exchange --------------
+        # (exchange: two_phase) shard s = (group a, rank b) with
+        # g = TP_G intra-group shards. Phase 1 ships each remote row
+        # to the IN-GROUP peer whose rank matches the destination's
+        # rank (rows destined inside the group arrive final there);
+        # phase 2 forwards across groups at fixed rank. Both phases
+        # decompose into peer-offset ppermutes (neighbor schedules, in
+        # the spirit of the direct-connect all-to-all schedules,
+        # arxiv 2309.13541), and both buffers AGGREGATE many
+        # destination pairs — a skewed pair borrows headroom from
+        # quiet pairs instead of padding every [src, dst] slot to the
+        # worst pair, which is where the ICI volume win comes from.
+        # Determinism: rows carry their skey through both hops and the
+        # merge orders arrivals by it (window path) or by (t, key)
+        # (global path) — the route cannot reorder anything, so traces
+        # are bit-identical to the direct all_to_all whenever neither
+        # overflows (both fail loudly).
+        TP_FIELDS = ("key",) + XF       # stacked ppermute channels
+
+        def _tp_mask(ch, vals, ok):
+            fill = IMAX if ch in ("key", "k") else \
+                (INF if ch == "t" else 0)
+            return jnp.where(ok, vals, fill)
+
+        def _pack_two_phase(state, skey, perm, rows, my_shard):
+            """Returns (state, keys, rows) of everything this shard
+            received over both phases: phase-1 arrivals (deliveries
+            AND forwards — callers mask non-local destinations) plus
+            phase-2 arrivals (always local). CAP/CAP2 overflow is
+            LOUD: phase-1 loss lands on the local sending host;
+            phase-2 loss happens at the intermediate, so its count is
+            psum'd home to the original sender's shard (behind a
+            uniform-predicate cond — healthy flushes pay one scalar
+            collective, nothing more)."""
+            G = skey.shape[0]
+            g, ng = TP_G, TP_NG
+            my64 = my_shard.astype(jnp.int64)
+            my_g, my_b = my64 // g, my64 % g
+            state, starts, counts = _shard_segments(state, skey,
+                                                    my_shard)
+
+            counts2 = counts.reshape(ng, g)      # [dst group, rank]
+            ends2 = jnp.cumsum(counts2, axis=0)
+            off2 = ends2 - counts2               # exclusive, by group
+            tot_rank = ends2[-1]                 # [g]
+
+            # phase-1 overflow: within one RANK buffer, a row's slot
+            # is its within-dst-shard rank plus the offset of earlier
+            # groups' blocks; slots >= CAP never ship — counted
+            # against the local sending host, like the direct pack
+            shard_of, rank1 = _within_shard_rank(skey)
+            d_clip = jnp.clip(shard_of, 0, n_shards - 1)
+            pos1 = rank1 + off2.reshape(-1)[d_clip]
+            lost1 = (skey < IMAX) & (shard_of != my64) & (pos1 >= CAP)
+            state = _lost_to_local(state, lost1, skey, my_shard)
+
+            # phase-1 buffers, keyed by peer OFFSET o (slot o goes to
+            # in-group peer (a, (b+o) % g)): concatenated per-group
+            # blocks of the rows destined that peer's rank
+            ranks = (my_b + jnp.arange(g, dtype=jnp.int64)) % g
+            ends_o = jnp.take(ends2, ranks, axis=1).T     # [g, ng]
+            off_o = jnp.take(off2, ranks, axis=1).T       # [g, ng]
+            starts_o = jnp.take(starts.reshape(ng, g), ranks,
+                                axis=1).T                 # [g, ng]
+            j1 = jnp.arange(CAP, dtype=jnp.int64)[None, :]
+            a_star = jnp.clip(
+                (ends_o[:, None, :] <= j1[..., None]).sum(-1),
+                0, ng - 1)                                # [g, CAP]
+            srcpos = jnp.take_along_axis(starts_o, a_star, axis=1) \
+                + (j1 - jnp.take_along_axis(off_o, a_star, axis=1))
+            ok1 = j1 < tot_rank[ranks][:, None]
+            cidx = jnp.clip(srcpos, 0, G - 1).reshape(-1)
+            pidx = jnp.take(perm, cidx)
+            chans = []
+            for ch in TP_FIELDS:
+                # keys live in SORTED order (cidx); payload rows stay
+                # unsorted and go through the sort permutation (pidx)
+                v = jnp.take(skey, cidx) if ch == "key" \
+                    else jnp.take(rows[ch], pidx)
+                chans.append(_tp_mask(ch, v.reshape(g, CAP), ok1))
+            sbuf = jnp.stack(chans)                       # [C, g, CAP]
+
+            parts1 = [sbuf[:, 0]]
+            for o in range(1, g):
+                perm_o = [(s, (s // g) * g + ((s % g) + o) % g)
+                          for s in range(n_shards)]
+                parts1.append(lax.ppermute(sbuf[:, o], AXIS, perm_o))
+            C = len(TP_FIELDS)
+            recv1 = jnp.stack(parts1, axis=1).reshape(C, g * CAP)
+
+            # phase 2: re-sort the received rows by skey (dst-shard
+            # segments; every received row is destined rank my_b), my
+            # own segment stays as deliveries, each other group's
+            # segment forwards in one offset ppermute
+            RK = g * CAP
+            rkey_s, rperm = lax.sort(
+                (recv1[0], jnp.arange(RK, dtype=jnp.int64)),
+                num_keys=1)
+            starts_r, counts_r = _shard_edges(rkey_s)
+            shard_r, rank2 = _within_shard_rank(rkey_s)
+            lost2 = (rkey_s < IMAX) & (shard_r != my64) & \
+                (rank2 >= CAP2)
+            n_lost2 = _axis_sum64(lost2.sum())
+
+            def _attr2(_):
+                # the lost rows' senders live on OTHER shards (this
+                # shard is only the intermediate): histogram by
+                # global source gid, psum over the mesh, and keep the
+                # local window — each loss lands on its true sender
+                sg = jnp.where(lost2, (rkey_s % SPAN) // OB, IMAX)
+                sgs = lax.sort(sg)
+                hbg = jnp.searchsorted(
+                    sgs, jnp.arange(H_pad + 1, dtype=jnp.int64))
+                hist = lax.psum(
+                    (hbg[1:] - hbg[:-1]).astype(jnp.int32), AXIS)
+                return lax.dynamic_slice(
+                    hist, (my_shard * H_loc,), (H_loc,))
+
+            state["x_overflow"] = state["x_overflow"] + lax.cond(
+                n_lost2 > 0, _attr2,
+                lambda _: jnp.zeros(H_loc, jnp.int32), 0)
+
+            j2 = jnp.arange(CAP2, dtype=jnp.int64)
+            parts2 = []
+            for q in range(1, ng):
+                dq = ((my_g + q) % ng) * g + my_b
+                ok2 = j2 < jnp.minimum(counts_r[dq], CAP2)
+                pidx2 = jnp.take(
+                    rperm, jnp.clip(starts_r[dq] + j2, 0, RK - 1))
+                buf2 = jnp.stack([
+                    _tp_mask(ch, jnp.take(recv1[c], pidx2), ok2)
+                    for c, ch in enumerate(TP_FIELDS)])
+                perm_q = [(s, ((s // g + q) % ng) * g + s % g)
+                          for s in range(n_shards)]
+                parts2.append(lax.ppermute(buf2, AXIS, perm_q))
+
+            out = jnp.concatenate([recv1] + parts2, axis=1)
+            return state, out[0], \
+                {f: out[c + 1] for c, f in enumerate(XF)}
 
         def _compact_flat(state, ob):
             """Gatherless outbox compaction for the GLOBAL merge
@@ -1510,6 +1749,20 @@ class DeviceEngine:
                              rows["s"], rows["v"], lo, hi),
                     _ob_rows(moved["t"], moved["k"], moved["m"],
                              moved["s"], moved["v"], lo, hi),
+                ]
+            elif n_shards > 1 and cfg.exchange == "two_phase":
+                # hierarchical exchange; the received block still
+                # holds the forwards this shard relayed (and any
+                # phase-2 loss) — _ob_rows' [lo, hi) destination mask
+                # drops them, so only true arrivals reach the merge
+                state, skey, perm, rows = _flat_sorted(state, ob, gid)
+                state, kout, rout = _pack_two_phase(
+                    state, skey, perm, rows, my_shard)
+                parts = [
+                    _ob_rows(rows["t"], rows["k"], rows["m"],
+                             rows["s"], rows["v"], lo, hi),
+                    _ob_rows(rout["t"], rout["k"], rout["m"],
+                             rout["s"], rout["v"], lo, hi),
                 ]
             elif n_shards > 1:
                 # all_gather fallback: replicate every shard's
@@ -1575,6 +1828,20 @@ class DeviceEngine:
                     (kmoved, jnp.arange(G, dtype=jnp.int64)),
                     num_keys=1)
                 rows = moved
+            elif n_shards > 1 and cfg.exchange == "two_phase":
+                # self-shard bypass identical to the direct path;
+                # the two-phase received block still holds relayed
+                # forwards, whose skeys fall outside this shard's
+                # host boundaries — _host_windows never takes them
+                state, inc2, arr2 = _host_windows(state, skey, perm,
+                                                  rows, my_shard)
+                state, kout, rout = _pack_two_phase(
+                    state, skey, perm, rows, my_shard)
+                G = kout.shape[0]
+                skey, perm = lax.sort(
+                    (kout, jnp.arange(G, dtype=jnp.int64)),
+                    num_keys=1)
+                rows = rout
             elif n_shards > 1:
                 # all_gather fallback: replicate every shard's rows,
                 # then one global key re-sort (debug / hub-heavy)

@@ -204,6 +204,11 @@ class DeviceRunner:
         # filled by the occupancy planner (capacity_plan: auto|path)
         # and widened by the overflow re-plan/retry loop
         self._capacity_overrides: dict = {}
+        # `exchange: auto` resolution (capacity.choose_exchange over
+        # the OCC record): None until a plan/record/checkpoint picks
+        # a concrete variant; the engine builder falls back to
+        # all_to_all meanwhile (warm-up slices, static plans)
+        self._exchange_choice: str = ""
         # defer_engine: the EnsembleRunner reuses this class for twin
         # mapping + knob plumbing but builds ITS engine with the
         # stacked replica worlds — constructing a standalone engine
@@ -257,10 +262,18 @@ class DeviceRunner:
             "event_capacity": xp.event_capacity,
             "outbox_capacity": outbox,
             "exchange_capacity": xp.exchange_capacity,
+            "exchange_capacity2": xp.exchange_capacity2,
             "exchange_in_capacity": xp.exchange_in_capacity,
             "outbox_compact": xp.outbox_compact,
         }
         knobs.update(self._capacity_overrides)
+        # exchange: auto resolves to whatever the planner (or an
+        # adopted checkpoint) chose; before any record exists — the
+        # warm-up slice, static plans — the direct all_to_all stands
+        # in (it measures the occ_x pair matrix auto needs)
+        exchange = xp.exchange
+        if exchange == "auto":
+            exchange = self._exchange_choice or "all_to_all"
         # link-fault epoch table (shadow_tpu/faults.py): the engine
         # carries the stacked [T,V,V] matrices and selects the active
         # epoch inside the jitted program; without faults it gets the
@@ -281,7 +294,7 @@ class DeviceRunner:
                 stop_time=cfg.general.stop_time,
                 bootstrap_end=cfg.general.bootstrap_end_time,
                 seed=cfg.general.seed if seed is None else seed,
-                exchange=xp.exchange,
+                exchange=exchange,
                 model_bandwidth=xp.model_bandwidth,
                 count_paths=xp.count_paths,
                 judge_hoist=_tristate(xp.judge_placement, "flush"),
@@ -330,16 +343,7 @@ class DeviceRunner:
             # produce a loud fingerprint mismatch. Adopt the saved
             # capacities instead; an overflow past the resume point
             # still re-plans through the normal retry loop.
-            from shadow_tpu.device import checkpoint
-            meta = checkpoint.peek_meta(load_path)
-            caps = meta.get("capacities")
-            if caps is None:
-                # pre-"capacities" checkpoints: only the two
-                # layout-determining knobs ride the fingerprint
-                caps = {k: meta["fingerprint"][k]
-                        for k in ("event_capacity", "outbox_capacity")}
-            self._capacity_overrides = {
-                k: int(v) for k, v in caps.items()}
+            self._adopt_checkpoint_caps(load_path)
             self.engine = self._build_engine()
             self._planned = True
             log.warning("capacity_plan: %s skipped — checkpoint_load "
@@ -350,11 +354,8 @@ class DeviceRunner:
         # build, captured BEFORE any warm-up widen-retry rebuilds the
         # engine (else an overflowed warm-up reports the doubled
         # values as "static")
-        static_knobs = {
-            k: getattr(self.engine.config, k)
-            for k in ("event_capacity", "outbox_capacity",
-                      "exchange_capacity", "exchange_in_capacity",
-                      "outbox_compact")}
+        static_knobs = {k: getattr(self.engine.config, k)
+                        for k in capacity.CAPACITY_KNOBS}
         if mode == "auto":
             warm = xp.capacity_warmup or max(1, stop // 8)
             warm = min(warm, stop)
@@ -405,19 +406,68 @@ class DeviceRunner:
                     f"occupancy record {mode} was measured on {got}; "
                     f"this simulation is {want} — re-measure with "
                     "capacity_plan: auto")
+        exchange = self._resolve_exchange(record)
         planned = capacity.plan(
             record,
             per_iter=self.engine.effective["M_out"],
             floor_iters=4 if self._burst > 1 else 8,
-            n_shards=self.engine.n_shards)
+            n_shards=self.engine.n_shards,
+            exchange=exchange)
         record["planned"] = planned
         record["static"] = static_knobs
         self.occ_record = record
         self._capacity_overrides = dict(planned)
         self.engine = self._build_engine()
         self._planned = True
-        log.info("capacity plan (%s): %s  [measured %s]", mode,
-                 planned, record["measured"])
+        log.info("capacity plan (%s, exchange %s): %s  [measured %s]",
+                 mode, exchange, planned, record["measured"])
+
+    def _adopt_checkpoint_caps(self, load_path: str) -> None:
+        """Checkpoint resume under a capacity plan: adopt the SAVED
+        engine's capacity knobs (the fingerprint pins them — a fresh
+        plan would only produce a loud mismatch) and, under
+        `exchange: auto`, the saved exchange schedule the caps were
+        planned for. ONE adopt path for both runners — the campaign
+        delegates here so standalone and ensemble resumes can never
+        drift."""
+        from shadow_tpu.device import checkpoint
+
+        meta = checkpoint.peek_meta(load_path)
+        caps = meta.get("capacities")
+        if caps is None:
+            # pre-"capacities" checkpoints: only the two
+            # layout-determining knobs ride the fingerprint
+            caps = {k: meta["fingerprint"][k]
+                    for k in ("event_capacity", "outbox_capacity")}
+        self._capacity_overrides = {k: int(v)
+                                    for k, v in caps.items()}
+        if self.sim.cfg.experimental.exchange == "auto":
+            self._exchange_choice = meta.get("exchange",
+                                             "all_to_all")
+
+    def _resolve_exchange(self, record: dict, engine=None) -> str:
+        """The exchange variant the planned engine will compile:
+        the config's explicit choice, or — under `exchange: auto` —
+        capacity.choose_exchange over the measured occ_x pair matrix
+        (stamped into the record so the decision is auditable).
+        Shared by DeviceRunner and EnsembleRunner (which passes its
+        own campaign engine; this runner's may be deferred)."""
+        from shadow_tpu.device import capacity
+
+        engine = engine if engine is not None else self.engine
+        xp = self.sim.cfg.experimental
+        if xp.exchange != "auto":
+            return xp.exchange
+        choice, info = capacity.choose_exchange(
+            record, engine.n_shards,
+            per_iter=engine.effective["M_out"],
+            floor_iters=4 if self._burst > 1 else 8)
+        record["exchange_auto"] = info
+        self._exchange_choice = choice
+        if engine.n_shards > 1:
+            log.info("exchange: auto -> %s (per-flush ICI row "
+                     "estimates %s)", choice, info["estimates"])
+        return choice
 
     def _emit_heartbeats(self, now: int, state) -> None:
         """Per-host [shadow-heartbeat] CSV lines from device counters

@@ -179,15 +179,8 @@ class EnsembleRunner:
             # resume adopts them instead of re-planning (a fresh
             # warm-up could plan smaller sizes and reject a valid
             # campaign checkpoint — and would pay the warm-up compile
-            # on every resume for nothing)
-            from shadow_tpu.device import checkpoint
-            meta = checkpoint.peek_meta(load_path)
-            caps = meta.get("capacities")
-            if caps is None:
-                caps = {k: meta["fingerprint"][k]
-                        for k in ("event_capacity", "outbox_capacity")}
-            self._capacity_overrides = {
-                k: int(v) for k, v in caps.items()}
+            # on every resume for nothing). ONE shared adopt path.
+            self._base._adopt_checkpoint_caps(load_path)
             self.engine = self._build_engine()
             self._planned = True
             log.warning("capacity_plan: %s skipped — checkpoint_load "
@@ -195,11 +188,8 @@ class EnsembleRunner:
                         "engine's capacities %s", mode,
                         self._capacity_overrides)
             return
-        static_knobs = {
-            k: getattr(self.engine.config, k)
-            for k in ("event_capacity", "outbox_capacity",
-                      "exchange_capacity", "exchange_in_capacity",
-                      "outbox_compact")}
+        static_knobs = {k: getattr(self.engine.config, k)
+                        for k in capacity.CAPACITY_KNOBS}
         if mode == "auto":
             warm = xp.capacity_warmup or max(1, stop // 8)
             warm = min(warm, stop)
@@ -246,19 +236,25 @@ class EnsembleRunner:
                     f"occupancy record {mode} was measured on {got}; "
                     f"this campaign is {want} — re-measure with "
                     "capacity_plan: auto")
+        # the worst-case view reduced occ_x over replicas, so the
+        # auto choice (and the per-phase caps) cover every replica
+        exchange = self._base._resolve_exchange(record,
+                                                engine=self.engine)
         planned = capacity.plan(
             record,
             per_iter=self.engine.effective["M_out"],
             floor_iters=4 if self._base._burst > 1 else 8,
-            n_shards=self.engine.n_shards)
+            n_shards=self.engine.n_shards,
+            exchange=exchange)
         record["planned"] = planned
         record["static"] = static_knobs
         self.occ_record = record
         self._capacity_overrides = dict(planned)
         self.engine = self._build_engine()
         self._planned = True
-        log.info("ensemble capacity plan (%s): %s  [measured %s]",
-                 mode, planned, record["measured"])
+        log.info("ensemble capacity plan (%s, exchange %s): %s  "
+                 "[measured %s]", mode, exchange, planned,
+                 record["measured"])
 
     # ------------------------------------------------------------------
     def _emit_heartbeats(self, now: int, states) -> None:

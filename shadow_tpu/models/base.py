@@ -21,7 +21,15 @@ match bit-for-bit across engines.
 from __future__ import annotations
 
 import shlex
+from functools import lru_cache
 from typing import Any
+
+
+@lru_cache(maxsize=4096)
+def _split_cached(args: str) -> tuple[str, ...]:
+    # every host of a quantity-N group carries the identical args
+    # string; shlex dominates the 100k-host build without this memo
+    return tuple(shlex.split(args))
 
 
 def parse_kv_args(args: Any) -> dict[str, str]:
@@ -32,7 +40,7 @@ def parse_kv_args(args: Any) -> dict[str, str]:
     if isinstance(args, (list, tuple)):
         parts = [str(p) for p in args]
     else:
-        parts = shlex.split(str(args or ""))
+        parts = _split_cached(str(args or ""))
     out = {}
     for p in parts:
         k, eq, v = p.partition("=")

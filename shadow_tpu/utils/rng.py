@@ -41,27 +41,37 @@ def _derive(seed: int, label: str) -> int:
 
 
 class SeededRandom:
-    """Deterministic RNG node in the controller->manager->host hierarchy."""
+    """Deterministic RNG node in the controller->manager->host
+    hierarchy. The numpy generator is built LAZILY: device-engine
+    runs create one node per host but never draw from most of them,
+    and the eager PCG64 spin-up was a measurable slice of the
+    100k-host build."""
 
     def __init__(self, seed: int):
         self.seed = int(seed)
-        self._rng = np.random.Generator(np.random.PCG64(self.seed))
+        self._rng = None
+
+    @property
+    def rng(self) -> np.random.Generator:
+        if self._rng is None:
+            self._rng = np.random.Generator(np.random.PCG64(self.seed))
+        return self._rng
 
     def child(self, label: str) -> "SeededRandom":
         return SeededRandom(_derive(self.seed, label))
 
     def random(self) -> float:
-        return float(self._rng.random())
+        return float(self.rng.random())
 
     def randint(self, low: int, high: int) -> int:
         """Uniform integer in [low, high)."""
-        return int(self._rng.integers(low, high))
+        return int(self.rng.integers(low, high))
 
     def shuffle(self, items: list) -> None:
-        self._rng.shuffle(items)
+        self.rng.shuffle(items)
 
     def np_rng(self) -> np.random.Generator:
-        return self._rng
+        return self.rng
 
 
 def base_key(seed: int) -> jax.Array:

@@ -33,6 +33,20 @@ def test_bench_smoke_emits_valid_json(tmp_path):
     assert "error" not in result, result
     assert result["value"] > 0
     assert result["ladder"]["tgen_100"]["speedup"] > 0
+    # a non-fallback run is stamped so, explicitly
+    assert result["fallback"] is False
+    # the multichip rung ran on the virtual 8-device mesh (conftest's
+    # XLA_FLAGS reach the subprocess) and recorded ICI volume next to
+    # throughput
+    mc = result["multichip"]
+    assert "error" not in mc, mc
+    if "skipped" not in mc:
+        assert mc["n_chips"] > 1
+        assert mc["pkts_per_s"] > 0
+        assert mc["ici_rows_per_flush"] > 0
+        assert mc["ici_rows_per_round"] > 0
+        assert mc["exchange"] in ("all_to_all", "all_gather",
+                                  "two_phase")
     # the run's measured occupancy landed for tune_10k.py to reuse
     occ_path = result["occupancy_record"]
     with open(occ_path) as f:
@@ -70,5 +84,6 @@ def test_bench_cpu_fallback_ladder_branch(tmp_path):
     # ... but the record still carries real numbers from the slice
     assert result["value"] > 0, (result, p.stderr[-2000:])
     assert result["platform"] == "cpu"
+    assert result["fallback"] is True      # the explicit stamp
     assert result["vs_baseline"] is None
     assert result["ladder"]["tgen_100"]["speedup"] > 0

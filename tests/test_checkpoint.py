@@ -82,8 +82,8 @@ def test_pause_save_resume_bitmatches_uninterrupted(tmp_path):
     from shadow_tpu.device import checkpoint
     caps = checkpoint.peek_meta(ck)["capacities"]
     assert set(caps) == {"event_capacity", "outbox_capacity",
-                         "exchange_capacity", "exchange_in_capacity",
-                         "outbox_compact"}
+                         "exchange_capacity", "exchange_capacity2",
+                         "exchange_in_capacity", "outbox_compact"}
 
 
 def test_tor_pause_resume_bitmatches(tmp_path):

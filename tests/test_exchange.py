@@ -1,0 +1,408 @@
+"""Cross-shard exchange: variant determinism, occupancy-driven
+sizing, and LOUD overflow attribution.
+
+The exchange contract this file pins (docs/exchange.md):
+
+* traces are bit-identical across exchange variants (all_to_all /
+  all_gather / two_phase / auto) and match the CPU serial oracle;
+* an undersized exchange capacity attributes every lost row to the
+  SENDING host — including across shards on the two_phase schedule,
+  where the loss happens at an intermediate — and fails the run
+  loudly (stats.ok False), never silently;
+* the planner sizes the per-pair CAP from the measured occ_x
+  high-water marks (measured * HEADROOM + SLACK), far below the
+  engine's blind 4x auto padding on sparse workloads.
+
+Tests run on the conftest's 8 virtual CPU devices, so every
+multi-shard path (ppermute schedules included) executes for real.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.device import capacity
+
+# 16 hosts over the 8-device mesh -> H_loc = 2: gids (2s, 2s+1) share
+# shard s, so two clients on one shard can overload one shard pair.
+# Order matters: yaml declaration order IS gid order.
+XCHG_YAML = """
+general:
+  stop_time: 2s
+  seed: 3
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+        edge [ source 0 target 0 latency "10 ms" packet_loss 0.0 ]
+        edge [ source 0 target 1 latency "5 ms" packet_loss 0.0 ]
+        edge [ source 1 target 1 latency "10 ms" packet_loss 0.0 ]
+      ]
+experimental:
+  scheduler_policy: {policy}
+  event_capacity: 48
+  exchange_in_capacity: 48
+{extra}
+hosts:
+{hosts}
+"""
+
+CLIENT = """  {name}:
+    quantity: {q}
+    network_node_id: 1
+    processes:
+    - path: model:tgen_client
+      args: server=srv size=1KiB count=1 pause=500ms retry=10s
+      start_time: 100ms
+"""
+SERVER = """  srv:
+    network_node_id: 0
+    processes: [{path: model:tgen_server, start_time: 50ms}]
+"""
+FILLER = """  {name}:
+    quantity: {q}
+    network_node_id: 0
+"""
+
+
+def _hosts(lead_fillers: int, clients: int, tail_fillers: int) -> str:
+    out = ""
+    if lead_fillers:
+        out += FILLER.format(name="pad_a", q=lead_fillers)
+    out += CLIENT.format(name="cli", q=clients)
+    out += SERVER
+    if tail_fillers:
+        out += FILLER.format(name="pad_b", q=tail_fillers)
+    return out
+
+
+def _run(policy: str, hosts: str, extra: str = ""):
+    cfg = load_config_str(XCHG_YAML.format(policy=policy, extra=extra,
+                                           hosts=hosts))
+    c = Controller(cfg)
+    stats = c.run()
+    return stats, c
+
+
+def _sig(c):
+    return [(h.name, h.trace_checksum, h.events_executed,
+             h.packets_sent, h.packets_delivered) for h in c.sim.hosts]
+
+
+# --------------------------------------------------------------------
+# variant determinism: every exchange schedule, bit-identical to the
+# serial oracle on the 8-shard mesh
+# --------------------------------------------------------------------
+def test_exchange_variants_bit_identical_to_serial_oracle():
+    hosts = _hosts(0, 2, 13)          # clients gid 0-1, server gid 2
+    _, cs = _run("serial", hosts)
+    want = _sig(cs)
+    for variant in ("all_to_all", "all_gather", "two_phase"):
+        stats, c = _run("tpu", hosts,
+                        extra=f"  exchange: {variant}\n")
+        assert stats.ok, variant
+        assert c.runner.engine.config.exchange == variant
+        assert _sig(c) == want, f"{variant} diverged from serial"
+        eff = c.runner.engine.effective
+        assert eff["exchange"] == variant
+        if variant != "all_gather":
+            assert eff["ICI_rows_per_flush"] > 0
+
+
+def test_exchange_auto_without_plan_falls_back_to_all_to_all():
+    hosts = _hosts(0, 2, 13)
+    stats, c = _run("tpu", hosts, extra="  exchange: auto\n")
+    assert stats.ok
+    assert c.runner.engine.config.exchange == "all_to_all"
+
+
+def test_exchange_auto_resolves_from_measured_record(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("SHADOW_TPU_OCC_DIR", str(tmp_path))
+    hosts = _hosts(0, 2, 13)
+    _, cs = _run("serial", hosts)
+    stats, c = _run("tpu", hosts,
+                    extra="  exchange: auto\n"
+                          "  capacity_plan: auto\n"
+                          "  capacity_warmup: 500ms\n")
+    assert stats.ok
+    rec = c.runner.occ_record
+    info = rec["exchange_auto"]
+    assert info["chosen"] == c.runner.engine.config.exchange
+    assert info["chosen"] in ("all_to_all", "all_gather", "two_phase")
+    assert set(info["estimates"]) == {"all_to_all", "all_gather",
+                                      "two_phase"}
+    # the planned caps came from the occ_x pair matrix, and the trace
+    # still matches the oracle under the chosen schedule
+    assert _sig(c) == _sig(cs)
+    assert "exchange_pairs" in rec["measured"]
+
+
+# --------------------------------------------------------------------
+# loud overflow attribution (the sending host, both merge paths)
+# --------------------------------------------------------------------
+@pytest.mark.parametrize("merge", ["window", "global"])
+def test_x_overflow_attributed_to_sending_host(merge):
+    """Two clients on shard 0 burst one REQ each at the same window
+    toward the server on shard 1; exchange_capacity=1 holds only the
+    first (lower okey = lower gid) row. The second row's loss must
+    land on ITS sender (gid 1) exactly, and the run must fail
+    loudly."""
+    hosts = _hosts(0, 2, 13)          # clients gid 0-1, srv gid 2
+    stats, c = _run(
+        "tpu", hosts,
+        extra=("  exchange: all_to_all\n"
+               "  exchange_capacity: 1\n"
+               f"  merge_strategy: {merge}\n"))
+    assert not stats.ok               # LOUD failure, never silent
+    xov = np.asarray(c.runner.final_state["x_overflow"])
+    assert xov[1] >= 1, xov           # the overflowing sender
+    assert xov[0] == 0 and (xov[2:] == 0).all(), xov
+    assert stats.packets_delivered < 4  # the lost REQ cost traffic
+
+
+@pytest.mark.parametrize("merge", ["window", "global"])
+def test_two_phase_overflow_attributed_across_shards(merge):
+    """two_phase phase-2 loss happens at the INTERMEDIATE shard, not
+    the sender's: clients on shard 1 (group 0, rank 1) reach the
+    server on shard 2 (group 1, rank 0) via shard 0, where
+    exchange_capacity2=1 drops the second row. The count must still
+    land on the true sender (gid 3, on shard 1) via the psum'd
+    histogram."""
+    hosts = _hosts(2, 2, 11)          # clients gid 2-3, srv gid 4
+    stats, c = _run(
+        "tpu", hosts,
+        extra=("  exchange: two_phase\n"
+               "  exchange_capacity2: 1\n"
+               f"  merge_strategy: {merge}\n"))
+    assert not stats.ok
+    xov = np.asarray(c.runner.final_state["x_overflow"])
+    assert xov[3] >= 1, xov           # the overflowing sender
+    assert (np.delete(xov, 3) == 0).all(), xov
+
+
+def test_two_phase_phase1_overflow_attributed_locally():
+    """Phase-1 loss (exchange_capacity=1 on an intra-group pair)
+    never leaves the sender's shard — straight local attribution,
+    same as the direct all_to_all pack."""
+    hosts = _hosts(0, 2, 13)          # clients gid 0-1 -> srv gid 2
+    stats, c = _run(
+        "tpu", hosts,
+        extra=("  exchange: two_phase\n"
+               "  exchange_capacity: 1\n"))
+    assert not stats.ok
+    xov = np.asarray(c.runner.final_state["x_overflow"])
+    assert xov[1] >= 1, xov
+    assert xov[0] == 0 and (xov[2:] == 0).all(), xov
+
+
+# --------------------------------------------------------------------
+# degenerate meshes
+# --------------------------------------------------------------------
+def test_two_phase_on_prime_shard_count_matches_all_to_all():
+    """group_split(3) = (1, 3): phase 1 is empty and phase 2 is the
+    direct exchange — correct, just profitless (auto never picks
+    it)."""
+    from shadow_tpu._jax import jax
+    from jax.sharding import Mesh
+    from shadow_tpu import simtime
+    from shadow_tpu.device.apps import PholdDevice
+    from shadow_tpu.device.engine import DeviceEngine, EngineConfig
+    from shadow_tpu.topology.graph import Topology
+
+    top = Topology.builtin_1_gbit_switch()
+    H = 6
+    hv = np.zeros(H, np.int32)
+    starts = [(h, simtime.from_millis(1), -1) for h in range(H)]
+    mesh = Mesh(np.array(jax.devices()[:3]), ("hosts",))
+
+    def run(exchange):
+        eng = DeviceEngine(
+            EngineConfig(n_hosts=H, event_capacity=16,
+                         outbox_capacity=8,
+                         lookahead=top.min_latency_ns,
+                         stop_time=simtime.from_millis(120),
+                         seed=2, exchange=exchange),
+            PholdDevice(n_hosts_total=H, msgload=2, size=64),
+            host_vertex=hv, latency_ns=top.latency_ns,
+            reliability=top.reliability, mesh=mesh)
+        st, _ = eng.run(eng.init_state(starts))
+        return {k: np.asarray(st[k])
+                for k in ("chk", "n_exec", "x_overflow")}
+
+    a, b = run("all_to_all"), run("two_phase")
+    assert (b["x_overflow"] == 0).all()
+    assert (a["chk"] == b["chk"]).all()
+    assert (a["n_exec"] == b["n_exec"]).all()
+
+
+def test_engine_rejects_auto_exchange():
+    from shadow_tpu import simtime
+    from shadow_tpu.device.apps import PholdDevice
+    from shadow_tpu.device.engine import DeviceEngine, EngineConfig
+    from shadow_tpu.topology.graph import Topology
+
+    top = Topology.builtin_1_gbit_switch()
+    with pytest.raises(ValueError, match="auto"):
+        DeviceEngine(
+            EngineConfig(n_hosts=4, exchange="auto",
+                         lookahead=top.min_latency_ns,
+                         stop_time=simtime.from_millis(10)),
+            PholdDevice(n_hosts_total=4, msgload=1, size=64),
+            host_vertex=np.zeros(4, np.int32),
+            latency_ns=top.latency_ns,
+            reliability=top.reliability)
+
+
+# --------------------------------------------------------------------
+# planner math (no device work)
+# --------------------------------------------------------------------
+def _record(pairs, n_hosts=10000, eff=None):
+    pairs = np.asarray(pairs)
+    m = {
+        "heap_rows_max": 30, "outbox_rows_max": 6,
+        "arrivals_per_flush_max": 32,
+        "exchange_rows_max": int(pairs.max(initial=0)),
+        "exchange_pairs": pairs.tolist(),
+        "pop_trips_max": 6, "phases": 100,
+        "overflow": 0, "x_overflow": 0,
+    }
+    return {"format": capacity.FORMAT, "source": "test",
+            "workload": {"app": "TgenDevice", "n_hosts": n_hosts},
+            "measured": m, "effective": eff or {}}
+
+
+def test_group_split():
+    assert capacity.group_split(4) == (2, 2)
+    assert capacity.group_split(8) == (2, 4)
+    assert capacity.group_split(16) == (4, 4)
+    assert capacity.group_split(12) == (3, 4)
+    assert capacity.group_split(7) == (1, 7)
+    assert capacity.group_split(1) == (1, 1)
+
+
+def test_two_phase_caps_are_pair_sums():
+    # S=4, g=2: shard s=(a,b); CAP1 covers max over (s, rank) of the
+    # per-group sum, CAP2 the max group-total forward
+    pairs = np.zeros((4, 4), np.int64)
+    pairs[0, 1] = 5      # intra-group (0,0)->(0,1): rank-1 sum = 5
+    pairs[0, 3] = 7      # cross (0,0)->(1,1): rank-1 sum 5+7 = 12
+    pairs[1, 2] = 4      # cross (0,1)->(1,0)
+    cap1, cap2 = capacity.two_phase_caps(pairs, headroom=1.0)
+    # pad(x) at headroom 1.0 = x + SLACK
+    assert cap1 == max(8, 12 + capacity.SLACK)
+    # forwards: group 0 -> group 1 at rank 1: rows from (0,0)+(0,1)
+    # destined (1,1) = 7; at rank 0: destined (1,0) = 4
+    assert cap2 == max(8, 7 + capacity.SLACK)
+
+
+def test_plan_sizes_cap_from_occ_x_not_blind_headroom():
+    """The acceptance shape of the 10k rung: per-pair CAP tracks the
+    measured high-water mark (measured * HEADROOM + SLACK), and the
+    engine's blind 4x auto-pack would ship >= 2x more rows."""
+    S, H = 8, 10000
+    pairs = np.full((S, S), 40, np.int64)   # sparse, balanced-ish
+    np.fill_diagonal(pairs, 0)
+    rec = _record(pairs, n_hosts=H)
+    planned = capacity.plan(rec, per_iter=9, n_shards=S)
+    measured = int(pairs.max())
+    assert planned["exchange_capacity"] <= \
+        math.ceil(measured * capacity.HEADROOM) + capacity.SLACK
+    # the engine's 4x auto CAP at these shapes (H_loc * OB rows) —
+    # the ONE shared definition (capacity.dense_auto_cap)
+    auto_cap = capacity.dense_auto_cap(
+        H // S, planned["outbox_capacity"],
+        planned["event_capacity"], S)
+    assert auto_cap >= 2 * planned["exchange_capacity"], \
+        (auto_cap, planned)
+
+
+def test_plan_two_phase_gets_both_caps():
+    S = 8
+    pairs = np.full((S, S), 10, np.int64)
+    np.fill_diagonal(pairs, 0)
+    rec = _record(pairs)
+    p = capacity.plan(rec, per_iter=9, n_shards=S,
+                      exchange="two_phase")
+    assert p["exchange_capacity"] > 0
+    assert p["exchange_capacity2"] > 0
+    g, ng = capacity.group_split(S)
+    c1, c2 = capacity.two_phase_caps(pairs)
+    assert p["exchange_capacity"] == c1
+    assert p["exchange_capacity2"] == c2
+    # all_gather needs no CAP at all
+    pg = capacity.plan(rec, per_iter=9, n_shards=S,
+                       exchange="all_gather")
+    assert pg["exchange_capacity"] == 0
+    assert pg["exchange_capacity2"] == 0
+
+
+def test_choose_exchange_prefers_two_phase_on_skewed_sparse():
+    """One hot pair forces the direct CAP to its size for all
+    S*(S-1) buffers; the hierarchical schedule pays it on 1 + (ng-1)
+    peers only."""
+    S = 8
+    pairs = np.zeros((S, S), np.int64)
+    pairs[1, 6] = 200                  # single hot pair, cross-group
+    rec = _record(pairs)
+    choice, info = capacity.choose_exchange(rec, S, per_iter=9)
+    est = info["estimates"]
+    assert est["two_phase"] < est["all_to_all"]
+    assert choice == "two_phase"
+
+
+def test_choose_exchange_balanced_dense_stays_direct():
+    S = 4
+    pairs = np.full((S, S), 50, np.int64)
+    np.fill_diagonal(pairs, 0)
+    rec = _record(pairs)
+    choice, _ = capacity.choose_exchange(rec, S, per_iter=9)
+    assert choice == "all_to_all"
+
+
+def test_choose_exchange_single_shard_noop():
+    rec = _record(np.zeros((1, 1), np.int64), n_hosts=8)
+    choice, info = capacity.choose_exchange(rec, 1, per_iter=9)
+    assert choice == "all_to_all"
+    assert info["estimates"]["all_to_all"] == 0
+
+
+def test_pair_matrix_fallback_for_scalar_records():
+    """Records written before the pair matrix existed (or measured on
+    another shard count) fall back to the scalar per-pair max — a
+    safe upper bound."""
+    m = {"exchange_rows_max": 9}
+    pm = capacity.pair_matrix(m, 4)
+    assert pm.shape == (4, 4)
+    assert (np.diag(pm) == 0).all()
+    assert (pm + np.eye(4, dtype=np.int64) * 9 == 9).all()
+
+
+def test_merged_measured_merges_pair_matrices_elementwise():
+    rec = _record(np.array([[0, 3], [1, 0]]), n_hosts=4)
+    rec["final_measured"] = {
+        "exchange_rows_max": 5,
+        "exchange_pairs": [[0, 1], [5, 0]],
+    }
+    m = capacity.merged_measured(rec)
+    assert m["exchange_rows_max"] == 5
+    assert m["exchange_pairs"] == [[0, 3], [5, 0]]
+
+
+def test_widen_doubles_phase2_cap_only_when_live():
+    eff = {"E": 32, "IN": 32, "CAP": 16, "CAP2": 24, "CX": 0,
+           "OB": 32}
+    out = capacity.widen({}, ("exchange_capacity",
+                              "exchange_capacity2"), eff)
+    assert out["exchange_capacity"] == 32
+    assert out["exchange_capacity2"] == 48
+    eff2 = dict(eff, CAP2=0)
+    out2 = capacity.widen({}, ("exchange_capacity2",), eff2)
+    assert "exchange_capacity2" not in out2

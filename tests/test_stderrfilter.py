@@ -1,0 +1,59 @@
+"""utils/stderrfilter.py: known-noise XLA line filtering — the pure
+tail helper and the fd-level pipe filter the bench/graft entry points
+install (MULTICHIP_* tail capture satellite)."""
+
+import os
+
+from shadow_tpu.utils import stderrfilter
+
+# the shape of the real offender (MULTICHIP_r05.json): one multi-KB
+# line from cpu_aot_loader
+NOISE = ("1 14:23:23.702412 8979 cpu_aot_loader.cc:210] Loading "
+         "XLA:CPU AOT result. Target machine feature "
+         "+prefer-no-gather is not supported on the host machine. "
+         "Machine type used for XLA:CPU compilation doesn't match "
+         + "+avx512," * 400
+         + " This could lead to execution errors such as SIGILL.")
+
+
+def test_filter_tail_drops_noise_keeps_last_meaningful():
+    lines = [f"useful {i}" for i in range(20)]
+    text = "\n".join(lines[:5] + [NOISE] + lines[5:] + [NOISE, ""])
+    out = stderrfilter.filter_tail(text, keep=10)
+    assert "cpu_aot_loader" not in out
+    assert out.splitlines() == [f"useful {i}" for i in range(10, 20)]
+
+
+def test_filter_tail_all_noise_is_empty():
+    assert stderrfilter.filter_tail(NOISE + "\n" + NOISE) == ""
+
+
+def test_is_noise_line():
+    assert stderrfilter.is_noise_line(NOISE)
+    assert not stderrfilter.is_noise_line(
+        "E0000 something actually went wrong")
+
+
+def test_fd_filter_passes_real_lines_drops_noise(tmp_path):
+    path = tmp_path / "captured.log"
+    f = open(path, "wb")
+    fd = f.fileno()
+    filt = stderrfilter._FdFilter(fd)
+    os.write(fd, b"dryrun_multichip(8): 10 rounds OK\n")
+    os.write(fd, (NOISE + "\n").encode())
+    os.write(fd, b"tgen_1000 slice matches on 8 devices OK\n")
+    # unterminated trailing chunk must survive the close (crash
+    # output has no trailing newline)
+    os.write(fd, b"Traceback (most recent call last)")
+    filt.close()
+    f.close()
+    text = path.read_text()
+    assert "cpu_aot_loader" not in text
+    assert "dryrun_multichip(8): 10 rounds OK" in text
+    assert "tgen_1000 slice matches on 8 devices OK" in text
+    assert text.endswith("Traceback (most recent call last)")
+
+
+def test_fd_filter_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("SHADOW_TPU_STDERR_FILTER", "0")
+    assert stderrfilter.install_fd_filter() is None
