@@ -37,6 +37,17 @@ run. Combine with `--ensemble` to preempt a campaign mid-flight
 instead (the resumed replica stack must bit-match the uninterrupted
 campaign's).
 
+`--compile-cache` switches to the WARM-START gate (the persistent
+AOT compile cache, device/aotcache.py): run the config (tpu policy)
+three times against one shared cache directory — cold (must miss and
+store), warm (must HIT, skipping the compile), and with every cache
+entry deliberately corrupted (must degrade to a loud recompile) —
+and require all three runs bit-identical. This pins the cache
+correctness contract: a cache hit is bit-identical to a fresh
+compile, and a bad entry recompiles, never loads a wrong trace. On
+backends without executable serialization the bit-identity legs
+still run (stamped unsupported; the hit/miss pattern is waived).
+
 `--ensemble` switches to the CAMPAIGN gate (shadow_tpu/ensemble/):
 the config must carry an `ensemble:` block. The gate runs the
 campaign twice (run-to-run bit-identity over every replica), then
@@ -348,6 +359,96 @@ def run_preempt_gate(config: str, ensemble: bool) -> int:
         return 0
 
 
+def run_compile_cache_gate(config: str) -> int:
+    """Warm-start gate (device/aotcache.py): cold run populates the
+    cache, warm run must HIT and bit-match, a deliberately corrupted
+    cache must degrade to a recompile that still bit-matches."""
+    import glob as _glob
+
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.device.aotcache import ENTRY_SUFFIX
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cache_dir = os.path.join(tmp, "aot")
+
+        def once(tag: str):
+            cfg = load_config(config)
+            cfg.experimental.scheduler_policy = "tpu"
+            cfg.experimental.compile_cache = cache_dir
+            cfg.general.data_directory = os.path.join(
+                tmp, tag, "shadow.data")
+            c = Controller(cfg)
+            stats = c.run()
+            if not stats.ok:
+                print(f"FAIL: {tag} run reported not-ok")
+                sys.exit(1)
+            sig = [(h.name, h.trace_checksum, h.events_executed,
+                    h.packets_sent, h.packets_dropped,
+                    h.packets_delivered) for h in c.sim.hosts]
+            return sig, (stats.compile_cache or {})
+
+        sig_cold, rep_cold = once("cold")
+        unsupported = rep_cold.get("unsupported", False)
+        if not unsupported and not rep_cold.get("misses"):
+            print("FAIL: cold run against an empty cache directory "
+                  f"reported no compile miss ({rep_cold})")
+            return 1
+
+        sig_warm, rep_warm = once("warm")
+        rc = 0
+        if sig_warm != sig_cold:
+            rc = 1
+            print("DETERMINISM FAILURE: cache-hit run diverges from "
+                  "the fresh-compile run")
+            for a, b in zip(sig_cold, sig_warm):
+                if a != b:
+                    print(f"  {a[0]}: cold {a[1:]} != warm {b[1:]}")
+        if not unsupported:
+            if not rep_warm.get("hits") or rep_warm.get("misses"):
+                rc = 1
+                print("FAIL: warm run did not hit the populated "
+                      f"cache (hits={rep_warm.get('hits')}, "
+                      f"misses={rep_warm.get('misses')})")
+            if rep_warm.get("compile_s", 0) != 0:
+                rc = 1
+                print("FAIL: warm run still paid "
+                      f"{rep_warm['compile_s']}s of compile")
+
+        # corrupt every entry mid-payload: the next run must warn,
+        # recompile, and stay bit-identical — degradation is always
+        # to a fresh compile, never to a wrong trace
+        entries = _glob.glob(os.path.join(
+            cache_dir, "*" + ENTRY_SUFFIX))
+        if not unsupported and not entries:
+            print("FAIL: no cache entries on disk after two runs")
+            return 1
+        for p in entries:
+            size = os.path.getsize(p)
+            with open(p, "r+b") as f:
+                f.truncate(max(1, size // 3))
+        sig_corrupt, rep_corrupt = once("corrupt")
+        if sig_corrupt != sig_cold:
+            rc = 1
+            print("DETERMINISM FAILURE: the corrupted-cache run "
+                  "diverges from the fresh-compile run")
+        if not unsupported and rep_corrupt.get("hits"):
+            rc = 1
+            print("FAIL: a corrupted entry was reported as a cache "
+                  "hit — the corruption check is not firing")
+
+        if rc == 0:
+            mode = ("bit-identity only; executable serialization "
+                    "unsupported on this backend" if unsupported
+                    else f"cold miss {rep_cold.get('compile_s')}s "
+                         f"compile -> warm hit "
+                         f"{rep_warm.get('load_s')}s load -> "
+                         "corrupted entries recompiled")
+            print(f"compile-cache OK: {config} (3 runs bit-identical"
+                  f"; {mode})")
+        return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("config", nargs="?", default="examples/minimal.yaml")
@@ -362,12 +463,30 @@ def main() -> int:
                     help="preemption gate: SIGTERM a supervised run "
                          "mid-flight, resume, require bit-identity "
                          "with the uninterrupted run")
+    ap.add_argument("--compile-cache", action="store_true",
+                    help="warm-start gate: cold/warm/corrupted runs "
+                         "against one shared AOT compile cache must "
+                         "be bit-identical, with the warm run a "
+                         "cache hit and the corrupted run a loud "
+                         "recompile")
     args = ap.parse_args()
 
     default_policy = "serial,tpu" if args.ensemble else "serial"
     policies = [p.strip()
                 for p in (args.policy or default_policy).split(",")
                 if p.strip()]
+
+    if args.compile_cache:
+        if args.ensemble or args.preempt or args.policy:
+            # the warm-start gate runs the standalone tpu policy by
+            # construction — dropping a composability flag silently
+            # would test the wrong thing
+            print("FAIL: --compile-cache does not combine with "
+                  "--ensemble/--preempt/--policy (it runs the "
+                  "standalone tpu policy three times against one "
+                  "shared cache directory)")
+            return 1
+        return run_compile_cache_gate(args.config)
 
     if args.preempt:
         return run_preempt_gate(args.config, args.ensemble)

@@ -25,12 +25,19 @@ if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
 # the tunneled TPU, and the tuning sweep + bench + profiler compile
 # the same few programs across separate processes — the disk cache
 # turns every repeat into a hit. Opt-out via SHADOW_TPU_NO_CACHE.
+# This is JAX's built-in TRACING-level cache; it also serves as the
+# fallback for the engine's AOT executable cache
+# (shadow_tpu/device/aotcache.py) on backends whose PJRT client
+# cannot serialize executables. An explicit JAX_COMPILATION_CACHE_DIR
+# (the standard jax env var — CI's warm-start rung sets it) wins over
+# both repo defaults.
 if not os.environ.get("SHADOW_TPU_NO_CACHE"):
     try:
         jax.config.update(
             "jax_compilation_cache_dir",
-            os.environ.get("SHADOW_TPU_CACHE_DIR",
-                           os.path.expanduser("~/.cache/shadow_tpu_xla")))
+            os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or os.environ.get("SHADOW_TPU_CACHE_DIR")
+            or os.path.expanduser("~/.cache/shadow_tpu_xla"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           2.0)
     except Exception:                       # noqa: BLE001

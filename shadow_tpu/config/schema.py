@@ -11,6 +11,7 @@ configs parse unchanged.
 from __future__ import annotations
 
 import dataclasses
+import os
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -423,6 +424,23 @@ class ExperimentalOptions:
     # (reductions + one scalar collective per round); off by default
     # — the un-audited program is byte-identical to before.
     state_audit: bool = False
+    # persistent AOT compile cache (device/aotcache.py): "auto"
+    # serializes the engine's compiled executables under
+    # $SHADOW_TPU_AOT_DIR (default ~/.cache/shadow_tpu_aot) keyed by
+    # the full program fingerprint, so repeat processes (supervised
+    # restarts, failover re-runs, ensemble campaigns, CI rungs,
+    # bench iterations) skip the 40s+ XLA compile; "off" disables;
+    # any other value is the cache DIRECTORY path (it must look like
+    # a path — contain a separator or start with ./ ~ / — so a
+    # typo'd keyword fails at load, like capacity_plan). A cache hit
+    # is bit-identical to a fresh compile, and an unreadable/stale
+    # entry recompiles loudly (determinism_gate --compile-cache pins
+    # both). Backends without executable serialization fall back to
+    # JAX's built-in tracing cache (JAX_COMPILATION_CACHE_DIR).
+    compile_cache: str = "auto"
+    # total size cap for the cache directory, in MB; least-recently-
+    # used entries are evicted past it
+    compile_cache_cap_mb: int = 2048
     # transient-dispatch recovery: a device error matching the
     # transient markers (RESOURCE_EXHAUSTED, device unavailable, ...)
     # retries the failed segment from the last validated state up to
@@ -546,6 +564,33 @@ class ExperimentalOptions:
                 f"capacity_plan is {out.capacity_plan!r} — the "
                 "warm-up slice only runs under capacity_plan: auto, "
                 "so the knob would be silently ignored")
+        if isinstance(out.compile_cache, bool):
+            # YAML 1.1 reads bare `off`/`on` as booleans — map them
+            # back to the keywords the knob documents
+            out.compile_cache = "auto" if out.compile_cache else "off"
+        if not isinstance(out.compile_cache, str):
+            # any other YAML scalar (a bare number, a list) gets the
+            # knob's loud rejection, not a TypeError from the path
+            # check below
+            raise ValueError(
+                f"experimental.compile_cache: {out.compile_cache!r} "
+                "is neither 'auto', 'off', nor a cache directory "
+                "path")
+        if out.compile_cache not in ("auto", "off") and not (
+                os.sep in out.compile_cache
+                or out.compile_cache.startswith((".", "~", "/"))):
+            # cache directories always look like paths — anything
+            # else is a typo'd mode ("atuo", "on", ...) that would
+            # otherwise silently become a directory named after the
+            # typo (the capacity_plan rule, applied to a dir knob)
+            raise ValueError(
+                f"experimental.compile_cache: {out.compile_cache!r} "
+                "is neither 'auto', 'off', nor a cache directory "
+                "path (paths must contain a separator or start with "
+                "'./', '~', or '/')")
+        if out.compile_cache_cap_mb < 1:
+            raise ValueError(
+                "experimental.compile_cache_cap_mb must be >= 1")
         if (out.checkpoint_save or out.checkpoint_load) and \
                 out.scheduler_policy != "tpu":
             raise ValueError(

@@ -92,6 +92,10 @@ class SimStats:
     # The top-level counters above then hold CAMPAIGN totals (summed
     # over replicas)
     ensemble: Optional[dict] = None
+    # AOT compile-cache attribution (device/aotcache.py report():
+    # per-program hit/miss events + lower/compile/load walls); None
+    # on CPU policies or with experimental.compile_cache: off
+    compile_cache: Optional[dict] = None
 
     def merge(self, other: "SimStats") -> None:
         self.events_executed += other.events_executed

@@ -291,6 +291,16 @@ class DeviceEngine:
         self._shard_spec = P(AXIS)
         self._repl_spec = P()
         self._heap_builder = None       # jitted lazily by init_state
+        # persistent AOT compile cache (device/aotcache.py): the
+        # runner attaches one shared AotCache after construction;
+        # run()/run_ensemble()/profile() then dispatch each program
+        # through a cached (or freshly AOT-compiled + stored)
+        # executable resolved on first use. The executables live in
+        # _aot_exec — the _run/_pop_phase/... jit attributes stay
+        # untouched so tooling (and tests) can still .lower() them.
+        # None = plain lazy jit.
+        self.aot_cache = None
+        self._aot_exec: dict = {}
         self._build_program()
 
     # ------------------------------------------------------------------
@@ -1139,6 +1149,45 @@ class DeviceEngine:
                           "ICI_rows_per_flush": int(ici_rows),
                           "ICI_bytes_per_flush":
                               int(ici_rows) * ici_arrays * 8}
+        # the resolved compile-time surface of the traced programs:
+        # every value the trace bakes in as a constant (capacities,
+        # platform-resolved strategy flags, lookahead/bootstrap,
+        # fault epoch count, audit, ensemble width, ...). The AOT
+        # compile cache (device/aotcache.py) keys serialized
+        # executables on this dict — a knob that newly shapes the
+        # program must join here or stale cache entries would load
+        # for the wrong trace. Runtime-scalar inputs (stop,
+        # final_stop, seeds, the world tables' VALUES) stay out:
+        # they are traced, not baked.
+        self.program_facts = {
+            "n_hosts": int(cfg.n_hosts),
+            "h_pad": int(H_pad), "h_loc": int(H_loc),
+            "n_shards": int(n_shards),
+            "capacities": {"E": int(E), "OB": int(OB), "IN": int(IN),
+                           "CAP": int(CAP), "CAP2": int(CAP2),
+                           "CX": int(CX)},
+            "exchange": cfg.exchange,
+            "tp_groups": [int(TP_G), int(TP_NG)],
+            "lookahead": int(LOOKAHEAD),
+            "bootstrap_end": int(BOOT_END),
+            "max_rounds": int(cfg.max_rounds),
+            "fault_epochs": int(T_EP),
+            "audit": bool(AUDIT),
+            "model_bandwidth": bool(MB),
+            "count_paths": bool(CP),
+            "judge_hoist": bool(HOIST),
+            "merge_global": bool(MERGE_GLOBAL),
+            "pop_onehot": bool(POP_ONEHOT),
+            "table_onehot": bool(TAB_ONEHOT),
+            "all_rel1": bool(ALL_REL1),
+            "burst_pops": int(P),
+            "lanes": {"K": int(K), "K_eff": int(K_eff), "T": int(T),
+                      "D": int(D), "C": int(C), "M_out": int(M_out),
+                      "B": int(B)},
+            "n_vertices": int(V),
+            "ensemble_replicas": (int(self.ensemble.R)
+                                  if self.ensemble is not None else 0),
+        }
 
         def _flat_sorted(state, ob, gid):
             slot = jnp.arange(OB, dtype=jnp.int64)[None, :]
@@ -2175,6 +2224,17 @@ class DeviceEngine:
         self._probe = jax.jit(_probe)
 
     # ------------------------------------------------------------------
+    def _aot(self, name: str, jit_fn, args):
+        """Resolve program `name` through the AOT compile cache on
+        first use (cached executable, or AOT-compile + store on a
+        miss) and return the callable to dispatch — the original
+        lazy jit when no cache is attached or the cache layer
+        declined. One bookkeeping site for every cached program."""
+        if self.aot_cache is not None and name not in self._aot_exec:
+            self._aot_exec[name] = self.aot_cache.ensure(
+                self, name, jit_fn, args)
+        return self._aot_exec.get(name, jit_fn)
+
     def world(self):
         """The traced world tuple (lat, rel, seed k1, seed k2,
         epoch_times) for the engine's own base world, replicated over
@@ -2208,7 +2268,10 @@ class DeviceEngine:
         stop_v = jnp.int64(self.config.stop_time if stop is None
                            else stop)
         final_v = stop_v if final_stop is None else jnp.int64(final_stop)
-        return self._run(state, hv, self.world(), stop_v, final_v)
+        # warm start via the AOT cache: stops are runtime scalars, so
+        # the one executable serves every slice
+        args = (state, hv, self.world(), stop_v, final_v)
+        return self._aot("run", self._run, args)(*args)
 
     # ------------------------------------------------------------------
     # ensemble campaign (shadow_tpu/ensemble/): R replicas in one
@@ -2273,8 +2336,9 @@ class DeviceEngine:
         stop_v = jnp.int64(self.config.stop_time if stop is None
                            else stop)
         final_v = stop_v if final_stop is None else jnp.int64(final_stop)
-        return self._run_ens(states, hv, self.ensemble_worlds_device(),
-                             stop_v, final_v)
+        args = (states, hv, self.ensemble_worlds_device(), stop_v,
+                final_v)
+        return self._aot("run_ens", self._run_ens, args)(*args)
 
     def profile(self, state: dict, stop: Optional[int] = None) -> dict:
         """Phase-split run with host-side wall timing: the same round
@@ -2305,12 +2369,17 @@ class DeviceEngine:
         prof = {"rounds": 0, "phases": 0, "events": 0,
                 "pop_s": 0.0, "flush_s": 0.0, "probe_s": 0.0,
                 "compile_s": 0.0}
-        # compile both split programs up front so timings are steady
+        # compile both split programs up front so timings are steady;
+        # the AOT cache turns repeat profiles into warm starts (the
+        # split programs get their own cache keys)
         t0 = _time.perf_counter()
         win0 = jnp.int64(0)
-        s_w, ob_w, _ = self._pop_phase(state, _ob(), hv, wrld, win0)
-        jax.block_until_ready(self._flush_phase(s_w, ob_w, hv, wrld,
-                                                win0))
+        pop_fn = self._aot("pop", self._pop_phase,
+                           (state, _ob(), hv, wrld, win0))
+        s_w, ob_w, _ = pop_fn(state, _ob(), hv, wrld, win0)
+        flush_fn = self._aot("flush", self._flush_phase,
+                             (s_w, ob_w, hv, wrld, win0))
+        jax.block_until_ready(flush_fn(s_w, ob_w, hv, wrld, win0))
         jax.block_until_ready(self._probe(state))
         prof["compile_s"] = _time.perf_counter() - t0
 
@@ -2323,14 +2392,14 @@ class DeviceEngine:
             win_end = jnp.int64(min(nxt + LA, stop_t))
             while True:
                 t0 = _time.perf_counter()
-                state, ob, _ = self._pop_phase(state, _ob(), hv, wrld,
-                                               win_end)
+                state, ob, _ = pop_fn(state, _ob(), hv, wrld,
+                                      win_end)
                 jax.block_until_ready(state)
                 prof["pop_s"] += _time.perf_counter() - t0
 
                 t0 = _time.perf_counter()
-                state = self._flush_phase(state, ob, hv, wrld,
-                                          win_end)
+                state = flush_fn(state, ob, hv, wrld,
+                                 win_end)
                 jax.block_until_ready(state)
                 prof["flush_s"] += _time.perf_counter() - t0
                 prof["phases"] += 1

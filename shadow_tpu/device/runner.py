@@ -209,6 +209,13 @@ class DeviceRunner:
         # a concrete variant; the engine builder falls back to
         # all_to_all meanwhile (warm-up slices, static plans)
         self._exchange_choice: str = ""
+        # persistent AOT compile cache (device/aotcache.py): ONE
+        # instance per run, attached to every engine this runner
+        # builds — warm-up engines, re-planned engines, and resumed
+        # engines all consult the same cache, and its report is the
+        # run's loud hit/miss surface (SimStats.compile_cache)
+        from shadow_tpu.device import aotcache
+        self.aot_cache = aotcache.resolve_cache(cfg.experimental)
         # defer_engine: the EnsembleRunner reuses this class for twin
         # mapping + knob plumbing but builds ITS engine with the
         # stacked replica worlds — constructing a standalone engine
@@ -286,7 +293,7 @@ class DeviceRunner:
             latency_ns = sim.topology.latency_ns
             reliability = sim.topology.reliability
             epoch_times = None
-        return DeviceEngine(
+        engine = DeviceEngine(
             EngineConfig(
                 n_hosts=len(sim.hosts),
                 lookahead=(max(1, sim.lookahead)
@@ -316,6 +323,11 @@ class DeviceRunner:
             bw_down_bits=np.array([h.bw_down_bits for h in sim.hosts],
                                   dtype=np.int64),
         )
+        # every engine this runner builds (static, warm-up, planned,
+        # re-planned, resumed) shares the one AOT compile cache, so a
+        # rebuild at previously-seen capacities starts warm
+        engine.aot_cache = self.aot_cache
+        return engine
 
     def _plan_capacities(self, stop: int,
                          load_path: Optional[str] = None) -> None:
@@ -669,6 +681,10 @@ class DeviceRunner:
         stats.end_time = t_end
         stats.rounds = int(rounds)
         stats.occupancy = self.occ_record
+        if self.aot_cache is not None:
+            # loud hit/miss surface: the whole run's compile-cache
+            # attribution (warm-up + planned + re-planned engines)
+            self.aot_cache.publish(stats)
         stats.replans = self.replans
         stats.retries = self.retries
         stats.preempted = adv.preempted

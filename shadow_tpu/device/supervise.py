@@ -549,6 +549,13 @@ def _recover_state(runner, good_state, replace_state, ck, stop,
         log.warning("could not recover the in-memory state (%s); "
                     "reloading the last validated checkpoint %s",
                     fetch_err, ck.last_path)
+        # the snapshot's owner died, so the engine's compiled
+        # executables (bound to the dead device's buffers) are
+        # suspect too — rebuild the engine for the retry. The AOT
+        # compile cache (device/aotcache.py, attached by
+        # _build_engine) turns this recompile into a warm start:
+        # same capacities -> same program key -> cached executable.
+        runner.engine = runner._build_engine()
         template = (runner.engine.init_ensemble_state(runner.sim.starts)
                     if ensemble else None)
         state, _ = checkpoint.load_state(

@@ -88,6 +88,9 @@ class EnsembleRunner:
         self._base = DeviceRunner(sim, trace=None, mesh=mesh,
                                   defer_engine=True)
         self.app = self._base.app
+        # the campaign engine consults the same AOT compile cache the
+        # base runner resolved (one instance, one report)
+        self.aot_cache = self._base.aot_cache
         self.sim = sim
         self.worlds: EnsembleWorlds = build_worlds(sim, eopts)
         if hasattr(self.app, "seed_pair") and \
@@ -481,6 +484,8 @@ class EnsembleRunner:
         stats.end_time = t_end
         stats.rounds = int(rounds)
         stats.occupancy = self.occ_record
+        if self.aot_cache is not None:
+            self.aot_cache.publish(stats)
         stats.replans = self.replans
         stats.retries = self.retries
         stats.preempted = adv.preempted

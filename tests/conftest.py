@@ -13,6 +13,7 @@ initialization, which wins over both the env var and the plugin's write.
 """
 
 import os
+import tempfile
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -20,6 +21,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# isolate the default-on AOT compile cache (device/aotcache.py) from
+# the user's ~/.cache: tests still share one cache within the session
+# (identical engine configs across tests load instead of recompiling)
+# but never pollute or depend on state outside the run
+os.environ.setdefault("SHADOW_TPU_AOT_DIR",
+                      tempfile.mkdtemp(prefix="shadow_tpu_aot_test_"))
 
 import jax  # noqa: E402
 
