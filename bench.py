@@ -91,6 +91,18 @@ def _probe_tpu(timeout_s: int = 420) -> str:
         return "timeout"
 
 
+def backend_record(devs) -> dict:
+    """Backend identity stamped into every BENCH_*/MULTICHIP_*
+    record: jax/jaxlib versions, platform, and device kinds. Without
+    these, records from different backends (a cpu-fallback window vs
+    a real v4 window, or a jaxlib upgrade) are silently comparable —
+    previously only the aotcache keys knew them. Delegates to the
+    cache's own identity helper so the two surfaces agree."""
+    from shadow_tpu.device.aotcache import backend_identity
+
+    return backend_identity(devs)
+
+
 def init_backend():
     """Guarded backend init: probe the accelerator out-of-process
     (a wedged relay hangs rather than raises), retry once, then fall
@@ -363,6 +375,15 @@ def run_device(config_path: str, stop_s: float,
         raise RuntimeError(
             f"device run of {config_path} (stop={stop_s}s) overflowed "
             "— the capacity plan is wrong; see log for the knob")
+    if stats.telemetry is not None:
+        # the flight recorder's per-phase wall attribution
+        # (shadow_tpu/obs): the headline record carries it so the
+        # perf trajectory shows WHERE the wall went, not just how
+        # long it was
+        stamp = dict(stamp)
+        stamp["phase_walls"] = stats.telemetry.get("phases")
+        stamp["dominant_phase"] = stats.telemetry.get(
+            "dominant_phase")
     if stats.occupancy is not None:
         # measured high-water marks + the capacities that held them;
         # the headline run's record is written to artifacts/ in main()
@@ -445,10 +466,12 @@ def run_multichip_rung(n_chips: int, fell_back: bool,
                                "already used"}
     else:
         name = "tgen_10000"
+    from shadow_tpu._jax import jax as _jax
+
     config = f"examples/{name}.yaml"
     slice_s = MULTICHIP_SLICES[name]
     out = {"config": config, "slice_sim_s": slice_s,
-           "n_chips": n_chips}
+           "n_chips": n_chips, **backend_record(_jax.devices())}
     cfg = load(config, "tpu", slice_s)
     cfg.experimental.exchange = "auto"
     cfg.experimental.capacity_plan = "auto"
@@ -755,7 +778,9 @@ def main() -> int:
     try:
         devs, fell_back = init_backend()
         n_chips = len({d.id for d in devs})
-        result["platform"] = devs[0].platform
+        # backend identity (jax/jaxlib/platform/device kind): records
+        # from different backends must never be silently comparable
+        result.update(backend_record(devs))
         # explicit stamp: fallback rungs (BENCH_r03-r05) must never
         # be mistaken for TPU trajectory points
         result["fallback"] = bool(fell_back)
@@ -871,6 +896,11 @@ def main() -> int:
         result["first_dispatch_s"] = f_stamp.get("first_dispatch_s")
         result["cache_hit"] = f_stamp.get("cache_hit")
         result["compile_cache"] = f_stamp.get("compile_cache")
+        # where the full run's wall went (flight recorder, default
+        # summary mode): host/judge/dispatch/exchange/checkpoint/
+        # retry/compile/plan walls + the dominant phase
+        result["phase_walls"] = f_stamp.get("phase_walls")
+        result["dominant_phase"] = f_stamp.get("dominant_phase")
         result["ladder"] = ladder
 
         if headline_path in _occ_records:

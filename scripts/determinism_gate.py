@@ -48,6 +48,15 @@ compile, and a bad entry recompiles, never loads a wrong trace. On
 backends without executable serialization the bit-identity legs
 still run (stamped unsupported; the hit/miss pattern is waived).
 
+`--telemetry` switches to the FLIGHT-RECORDER gate (shadow_tpu/obs):
+run the config (tpu policy) under telemetry off / summary / trace
+and require bit-identical per-host signatures — tracing must never
+perturb the simulation. The trace run must leave a Perfetto-loadable
+TRACE_*.trace.json, the streamed TRACE_*.jsonl span log, and a
+METRICS_*.json whose per-phase walls sum to within 10% of the
+recorded total; $TELEMETRY_TRACE_OUT receives a copy of the
+.trace.json for CI artifact upload.
+
 `--ensemble` switches to the CAMPAIGN gate (shadow_tpu/ensemble/):
 the config must carry an `ensemble:` block. The gate runs the
 campaign twice (run-to-run bit-identity over every replica), then
@@ -449,6 +458,103 @@ def run_compile_cache_gate(config: str) -> int:
         return rc
 
 
+def run_telemetry_gate(config: str) -> int:
+    """Flight-recorder gate (shadow_tpu/obs): the same config under
+    telemetry off / summary / trace (tpu policy) must produce
+    bit-identical per-host signatures — tracing must never perturb
+    the simulation. The trace run must additionally leave a
+    Perfetto-loadable TRACE_*.trace.json, a streamed TRACE_*.jsonl,
+    and a METRICS_*.json whose per-phase walls sum to within 10% of
+    the recorded total. $TELEMETRY_TRACE_OUT (a file path) receives a
+    copy of the .trace.json so CI can upload it as an artifact."""
+    import glob as _glob
+    import json
+    import shutil
+
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+
+    with tempfile.TemporaryDirectory() as tmp:
+        sigs, summaries, tel_dirs = {}, {}, {}
+        for mode in ("off", "summary", "trace"):
+            cfg = load_config(config)
+            cfg.experimental.scheduler_policy = "tpu"
+            cfg.experimental.telemetry = mode
+            tel_dirs[mode] = os.path.join(tmp, f"tel_{mode}")
+            cfg.experimental.telemetry_path = tel_dirs[mode]
+            cfg.general.data_directory = os.path.join(
+                tmp, mode, "shadow.data")
+            c = Controller(cfg)
+            stats = c.run()
+            if not stats.ok:
+                print(f"FAIL: telemetry={mode} run reported not-ok")
+                return 1
+            sigs[mode] = [(h.name, h.trace_checksum,
+                           h.events_executed, h.packets_sent,
+                           h.packets_dropped, h.packets_delivered)
+                          for h in c.sim.hosts]
+            summaries[mode] = stats.telemetry
+        rc = 0
+        for mode in ("summary", "trace"):
+            if sigs[mode] != sigs["off"]:
+                rc = 1
+                print(f"DETERMINISM FAILURE: telemetry={mode} "
+                      "diverges from telemetry=off — tracing "
+                      "perturbed the simulation")
+                for a, b in zip(sigs["off"], sigs[mode]):
+                    if a != b:
+                        print(f"  {a[0]}: off {a[1:]} != {mode} "
+                              f"{b[1:]}")
+        if summaries["off"] is not None:
+            rc = 1
+            print("FAIL: telemetry=off still published a summary "
+                  "(SimStats.telemetry must be None)")
+        if not summaries["summary"] or \
+                "phases" not in (summaries["summary"] or {}):
+            rc = 1
+            print("FAIL: telemetry=summary published no phase walls")
+        traces = _glob.glob(os.path.join(tel_dirs["trace"],
+                                         "TRACE_*.trace.json"))
+        jsonls = _glob.glob(os.path.join(tel_dirs["trace"],
+                                         "TRACE_*.jsonl"))
+        metrics = _glob.glob(os.path.join(tel_dirs["trace"],
+                                          "METRICS_*.json"))
+        if not (traces and jsonls and metrics):
+            print(f"FAIL: trace run left trace.json={traces} "
+                  f"jsonl={jsonls} metrics={metrics} — expected all "
+                  "three artifacts")
+            return 1
+        with open(traces[0]) as f:
+            tr = json.load(f)
+        if not tr.get("traceEvents"):
+            rc = 1
+            print(f"FAIL: {traces[0]} has no traceEvents — not a "
+                  "loadable Chrome/Perfetto trace")
+        with open(metrics[0]) as f:
+            m = json.load(f)
+        total = m.get("total_wall_s", 0.0)
+        ssum = sum(m.get("phases", {}).values())
+        if total <= 0 or abs(ssum - total) > 0.1 * total:
+            rc = 1
+            print(f"FAIL: METRICS phase walls sum to {ssum:.3f}s vs "
+                  f"total {total:.3f}s — attribution is off by more "
+                  "than 10%")
+        out = os.environ.get("TELEMETRY_TRACE_OUT")
+        if out:
+            os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+            shutil.copyfile(traces[0], out)
+            print(f"trace artifact copied -> {out}")
+        if rc == 0:
+            dom = m.get("dominant_phase")
+            print(f"telemetry OK: {config} (off/summary/trace "
+                  "bit-identical; trace run wrote "
+                  f"{os.path.basename(traces[0])} + "
+                  f"{os.path.basename(metrics[0])}, phase walls sum "
+                  f"{ssum:.3f}s of {total:.3f}s total, dominant "
+                  f"phase {dom})")
+        return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("config", nargs="?", default="examples/minimal.yaml")
@@ -469,12 +575,31 @@ def main() -> int:
                          "be bit-identical, with the warm run a "
                          "cache hit and the corrupted run a loud "
                          "recompile")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="flight-recorder gate: telemetry off vs "
+                         "summary vs trace must be bit-identical, "
+                         "and the trace run must leave a Perfetto-"
+                         "loadable trace + a METRICS record whose "
+                         "phase walls sum to the total")
     args = ap.parse_args()
 
     default_policy = "serial,tpu" if args.ensemble else "serial"
     policies = [p.strip()
                 for p in (args.policy or default_policy).split(",")
                 if p.strip()]
+
+    if args.telemetry:
+        if args.ensemble or args.preempt or args.policy or \
+                args.compile_cache:
+            # the telemetry gate runs the standalone tpu policy under
+            # its three modes by construction — dropping another
+            # gate's flag silently would test the wrong thing
+            print("FAIL: --telemetry does not combine with "
+                  "--ensemble/--preempt/--policy/--compile-cache "
+                  "(it runs the standalone tpu policy once per "
+                  "telemetry mode)")
+            return 1
+        return run_telemetry_gate(args.config)
 
     if args.compile_cache:
         if args.ensemble or args.preempt or args.policy:

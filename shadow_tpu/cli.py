@@ -73,6 +73,16 @@ def main(argv=None) -> int:
     log = slog.get_logger("cli")
     log.info("simulation finished at %s: %s",
              simtime.format_time(stats.end_time), stats.summary())
+    if stats.telemetry:
+        # the flight recorder's where-did-the-wall-go pointer: the
+        # detailed one-table breakdown is scripts/trace_report.py's
+        # job; the log line names the dominant phase and artifacts
+        files = stats.telemetry.get("files") or {}
+        if files.get("metrics"):
+            log.info("telemetry: dominant phase %s — full breakdown: "
+                     "python scripts/trace_report.py %s",
+                     stats.telemetry["dominant_phase"],
+                     files["metrics"])
     if stats.ensemble is not None:
         # campaign summary: the per-replica breakdown + aggregates
         # live in the ENSEMBLE record (ensemble/campaign.py)

@@ -482,6 +482,20 @@ class ExperimentalOptions:
     # or get truncated by supervisors; the file survives for
     # post-mortem. "" = log only.
     round_watchdog_dump: str = ""
+    # flight recorder (shadow_tpu/obs, docs/observability.md): "off"
+    # records nothing (zero per-round work), "summary" (default)
+    # accumulates per-phase wall attribution into SimStats.telemetry
+    # (plus a recent-span ring for watchdog stall dumps), "trace"
+    # additionally streams a JSONL span log and writes a
+    # Perfetto-loadable TRACE_*.trace.json + METRICS_*.json record.
+    # Tracing never perturbs the simulation: traces are bit-identical
+    # across all three modes (determinism_gate --telemetry pins it).
+    telemetry: str = "summary"
+    # output DIRECTORY for the telemetry artifacts ("" = the
+    # artifacts dir, honoring $SHADOW_TPU_OCC_DIR like OCC/ENSEMBLE
+    # records). Setting it also makes `summary` mode write its
+    # METRICS_*.json (by default only `trace` writes files).
+    telemetry_path: str = ""
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -525,6 +539,18 @@ class ExperimentalOptions:
                       out.pop_strategy, ("auto", "onehot", "gather"))
         _check_choice("experimental", "table_strategy",
                       out.table_strategy, ("auto", "onehot", "gather"))
+        if isinstance(out.telemetry, bool):
+            # YAML 1.1 reads bare `off`/`on` as booleans — map them
+            # back to the knob's keywords (the compile_cache rule);
+            # `on` means the default-on mode, summary
+            out.telemetry = "summary" if out.telemetry else "off"
+        from shadow_tpu.obs.trace import MODES as TELEMETRY_MODES
+        _check_choice("experimental", "telemetry",
+                      out.telemetry, TELEMETRY_MODES)
+        if not isinstance(out.telemetry_path, str):
+            raise ValueError(
+                f"experimental.telemetry_path: {out.telemetry_path!r} "
+                "must be a directory path string")
         from shadow_tpu.host.tcp import CONGESTION_ALGORITHMS
         _check_choice("experimental", "tcp_congestion",
                       out.tcp_congestion,

@@ -244,6 +244,14 @@ class Controller:
         self.runner = None
         self.manager = None
         net_judge = None
+        # flight recorder (shadow_tpu/obs): ONE per run, attached to
+        # whichever executor this config resolves to and published as
+        # the module-global current() for call sites with no plumbing
+        # path (aotcache.ensure, capacity record I/O, engine.profile)
+        from shadow_tpu.obs import trace as obstrace
+        self.tracer = obstrace.resolve_tracer(cfg,
+                                              len(self.sim.hosts))
+        obstrace.set_current(self.tracer)
         if cfg.ensemble is not None:
             # R-replica campaign in one vmapped device program
             # (shadow_tpu/ensemble/). No hybrid fallback: CPU host
@@ -254,6 +262,7 @@ class Controller:
             from shadow_tpu.ensemble.campaign import EnsembleRunner
             try:
                 self.runner = EnsembleRunner(self.sim, trace=trace)
+                self.runner.tracer = self.tracer
                 return
             except NoDeviceTwin as e:
                 raise ValueError(
@@ -266,6 +275,7 @@ class Controller:
             from shadow_tpu.device.runner import DeviceRunner, NoDeviceTwin
             try:
                 self.runner = DeviceRunner(self.sim, trace=trace)
+                self.runner.tracer = self.tracer
                 return
             except NoDeviceTwin as e:
                 log.info("tpu policy -> hybrid: %s", e)
@@ -293,6 +303,7 @@ class Controller:
             policy_name = cfg.experimental.hybrid_cpu_policy
         from shadow_tpu.core.manager import NetOptions
         self.manager = Manager(
+            tracer=self.tracer,
             hosts=self.sim.hosts,
             policy=make_policy(policy_name,
                                n_workers=(cfg.experimental.workers
@@ -370,6 +381,38 @@ class Controller:
         return stats
 
     def run(self) -> SimStats:
+        """Run to stop_time. The flight recorder finalizes on EVERY
+        exit path — success, failover, or a raised error — so a
+        failed run still leaves its trace artifacts (the post-mortem
+        is most valuable exactly then), and the summary lands on
+        SimStats.telemetry for bench/tooling."""
+        stats = None
+        try:
+            stats = self._run_inner()
+            return stats
+        finally:
+            counters = None
+            if stats is not None:
+                counters = {"events": stats.events_executed,
+                            "packets": stats.packets_sent,
+                            "rounds": stats.rounds,
+                            "retries": stats.retries,
+                            "replans": stats.replans}
+            summary = self.tracer.finalize(
+                run_info={
+                    "policy": self.cfg.experimental.scheduler_policy,
+                    "n_hosts": len(self.sim.hosts),
+                    "stop_time": int(self.cfg.general.stop_time),
+                    "seed": int(self.cfg.general.seed)},
+                counters=counters)
+            if stats is not None and summary is not None and \
+                    stats.telemetry is None:
+                # already-set means a nested run (hybrid failover)
+                # published its own breakdown — keep it; the inner
+                # run is the one that produced these stats
+                stats.telemetry = summary
+
+    def _run_inner(self) -> SimStats:
         cfg = self.cfg
         stop = cfg.general.stop_time
         if self.runner is not None:

@@ -35,6 +35,7 @@ from shadow_tpu.core.netmodel import NetworkModel
 from shadow_tpu.core.scheduler.base import SchedulerPolicy
 from shadow_tpu.core.worker import SimContext
 from shadow_tpu.host.host import Host
+from shadow_tpu.obs.trace import NullTracer
 from shadow_tpu.utils import nprng
 from shadow_tpu.utils.checksum import chk_mix
 from shadow_tpu.utils.slog import get_logger, set_context, clear_context
@@ -96,6 +97,12 @@ class SimStats:
     # per-program hit/miss events + lower/compile/load walls); None
     # on CPU policies or with experimental.compile_cache: off
     compile_cache: Optional[dict] = None
+    # flight-recorder summary (shadow_tpu/obs): per-phase wall
+    # attribution (host_s/judge_s/dispatch_s/exchange_s/checkpoint_s/
+    # retry_s/...), span counts, and the paths of any TRACE_*/
+    # METRICS_* artifacts written. None with telemetry: off. bench.py
+    # stamps the phase walls into its records from here.
+    telemetry: Optional[dict] = None
 
     def merge(self, other: "SimStats") -> None:
         self.events_executed += other.events_executed
@@ -151,6 +158,12 @@ class Manager:
     # deferred per round and computed on the device in one batch
     # (device/judge.py); None = judge synchronously on CPU
     net_judge: Optional[object] = None
+    # flight recorder (shadow_tpu/obs): attached by the Controller;
+    # directly-constructed Managers (tests) get the inert NullTracer,
+    # so the flush path needs no None guards. Judge flushes record
+    # spans here, and the round watchdog embeds the recent-span ring
+    # in its stall dump.
+    tracer: object = field(default_factory=NullTracer)
 
     def __post_init__(self):
         from shadow_tpu.host.netstack import HostNetStack
@@ -427,32 +440,46 @@ class Manager:
         if not pending:
             return
         j = self.net_judge
-        if len(pending) < getattr(j, "min_batch", 0):
-            # adaptive: a round this small never amortizes the device
-            # dispatch — the synchronous CPU roll is bit-identical
-            # (same threefry chain), so only the wall clock changes
-            for rec in pending:
-                v = self.netmodel.judge(rec[0], rec[1], rec[2], rec[3])
-                self._apply_verdict(rec, v.delivered, v.deliver_time)
-            j.cpu_batches += 1
-            j.cpu_packets += len(pending)
+        with self.tracer.span("judge.flush", "judge",
+                              sim_t0=pending[0][0],
+                              sim_t1=self._barrier,
+                              pkts=len(pending)) as sp:
+            if len(pending) < getattr(j, "min_batch", 0):
+                # adaptive: a round this small never amortizes the
+                # device dispatch — the synchronous CPU roll is
+                # bit-identical (same threefry chain), so only the
+                # wall clock changes
+                for rec in pending:
+                    v = self.netmodel.judge(rec[0], rec[1], rec[2],
+                                            rec[3])
+                    self._apply_verdict(rec, v.delivered,
+                                        v.deliver_time)
+                j.cpu_batches += 1
+                j.cpu_packets += len(pending)
+                nm = self.netmodel
+                nm.record_paths(Counter(
+                    (int(nm.host_vertex[r[1]]),
+                     int(nm.host_vertex[r[2]])) for r in pending))
+                sp.add(where="cpu")
+                return
+            now = np.fromiter((p[0] for p in pending), np.int64,
+                              len(pending))
+            src = np.fromiter((p[1] for p in pending), np.int32,
+                              len(pending))
+            dst = np.fromiter((p[2] for p in pending), np.int32,
+                              len(pending))
+            seq = np.fromiter((p[3] for p in pending), np.int32,
+                              len(pending))
+            delivered, deliver_time = self.net_judge.judge_batch(
+                now, src, dst, seq)
             nm = self.netmodel
             nm.record_paths(Counter(
                 (int(nm.host_vertex[r[1]]), int(nm.host_vertex[r[2]]))
                 for r in pending))
-            return
-        now = np.fromiter((p[0] for p in pending), np.int64, len(pending))
-        src = np.fromiter((p[1] for p in pending), np.int32, len(pending))
-        dst = np.fromiter((p[2] for p in pending), np.int32, len(pending))
-        seq = np.fromiter((p[3] for p in pending), np.int32, len(pending))
-        delivered, deliver_time = self.net_judge.judge_batch(
-            now, src, dst, seq)
-        nm = self.netmodel
-        nm.record_paths(Counter(
-            (int(nm.host_vertex[r[1]]), int(nm.host_vertex[r[2]]))
-            for r in pending))
-        for i, rec in enumerate(pending):
-            self._apply_verdict(rec, bool(delivered[i]), deliver_time[i])
+            for i, rec in enumerate(pending):
+                self._apply_verdict(rec, bool(delivered[i]),
+                                    deliver_time[i])
+            sp.add(where="device")
 
     def run_window(self, window_start: int, window_end: int) -> int:
         """Execute all events in [window_start, window_end); return the
@@ -700,8 +727,10 @@ class RoundWatchdog:
     cheap progress signal (rounds + per-host executed-event counters)
     from a daemon thread; when NOTHING moves for `interval` wall
     seconds it dumps per-host/per-process state (Manager.dump_state:
-    current blocked syscall, quarantine counts) and aborts the run
-    with a diagnostic instead of hanging.
+    current blocked syscall, quarantine counts) plus the flight
+    recorder's last completed spans (shadow_tpu/obs — what the run
+    was DOING when it froze) and aborts the run with a diagnostic
+    instead of hanging.
 
     `on_stall(dump)` is injectable for tests; the default logs the
     dump, marks stats not-ok, and interrupts the main thread.
@@ -752,6 +781,15 @@ class RoundWatchdog:
             if _time.monotonic() - last_t >= self.interval:
                 self.fired = True
                 dump = self._m.dump_state()
+                # the flight recorder's recent-span ring shows what
+                # the run WAS doing (last dispatches, judge flushes,
+                # checkpoints), not just where it stopped — embedded
+                # in both the log dump and the on-disk post-mortem
+                tracer = getattr(self._m, "tracer", None)
+                recent = (tracer.format_recent()
+                          if tracer is not None else "")
+                if recent:
+                    dump = f"{dump}\n{recent}"
                 if self.dump_path:
                     try:
                         from shadow_tpu.utils.artifacts import \

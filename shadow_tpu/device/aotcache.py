@@ -55,6 +55,7 @@ import os
 import pickle
 import time
 
+from shadow_tpu.obs import trace as obstrace
 from shadow_tpu.utils.slog import get_logger
 
 log = get_logger("aotcache")
@@ -189,22 +190,30 @@ def code_digest() -> str:
     return _code_digest_cache
 
 
-def backend_signature(mesh) -> dict:
-    """The backend identity a serialized executable is only valid for:
-    jax/jaxlib versions, the platform, and the mesh's device kinds +
-    ordering (an executable compiled for devices [0..3] must not load
-    onto a differently-ordered mesh)."""
+def backend_identity(devs) -> dict:
+    """jax/jaxlib versions, platform, and device kinds for a device
+    list — the ONE definition of "backend identity", shared by the
+    cache key (backend_signature) and bench's record stamps, so the
+    two surfaces cannot drift on what identifies a backend."""
     import jax
     import jaxlib
 
-    devs = list(mesh.devices.flat)
     return {
         "jax": jax.__version__,
         "jaxlib": jaxlib.__version__,
         "platform": devs[0].platform,
         "device_kinds": sorted({d.device_kind for d in devs}),
-        "device_ids": [int(d.id) for d in devs],
     }
+
+
+def backend_signature(mesh) -> dict:
+    """The backend identity a serialized executable is only valid
+    for, plus the mesh's device ordering (an executable compiled for
+    devices [0..3] must not load onto a differently-ordered mesh)."""
+    devs = list(mesh.devices.flat)
+    sig = backend_identity(devs)
+    sig["device_ids"] = [int(d.id) for d in devs]
+    return sig
 
 
 def program_signature(engine, program: str) -> dict:
@@ -480,6 +489,12 @@ class AotCache:
                 ev["hit"] = True
                 ev["load_s"] = round(time.perf_counter() - t0, 3)
                 self.events.append(ev)
+                # flight-recorder attribution (shadow_tpu/obs): the
+                # cache's walls are already measured, the tracer only
+                # needs them on the run's timeline
+                obstrace.current().record(
+                    f"aot.load:{program}", "compile", ev["load_s"],
+                    hit=True, key=key)
                 log.info("compile cache HIT: %s <- %s (%.2fs load; "
                          "compile skipped)", program,
                          self.entry_path(key), ev["load_s"])
@@ -500,6 +515,14 @@ class AotCache:
                 t2 = time.perf_counter()
             ev["lower_s"] = round(t1 - t0, 3)
             ev["compile_s"] = round(t2 - t1, 3)
+            tr = obstrace.current()
+            # lower ended compile_s ago — placed before the compile
+            # on the timeline, not overlapping it on one track
+            tr.record(f"aot.lower:{program}", "compile",
+                      ev["lower_s"], ago_s=ev["compile_s"],
+                      hit=False)
+            tr.record(f"aot.compile:{program}", "compile",
+                      ev["compile_s"], hit=False, key=key)
         except Exception as e:          # noqa: BLE001
             # AOT lowering failed (exotic arg structure, backend
             # quirk): fall back to the lazy jit path, which compiles
@@ -541,6 +564,9 @@ class AotCache:
                 stored = False
             ev["serialize_s"] = round(time.perf_counter() - t0, 3)
             ev["stored"] = stored
+            obstrace.current().record(
+                f"aot.serialize:{program}", "compile",
+                ev["serialize_s"], stored=stored)
         self.events.append(ev)
         log.info("compile cache MISS: %s (lower %.2fs + compile "
                  "%.2fs%s) -> %s", program, ev["lower_s"],

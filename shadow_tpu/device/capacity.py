@@ -472,12 +472,18 @@ def record_path(engine, directory: str = "") -> str:
 
 
 def save_record(record: dict, path: str) -> None:
+    from shadow_tpu.obs import trace as obstrace
     from shadow_tpu.utils.artifacts import atomic_write_json
 
     atomic_write_json(record, path)
+    # flight-recorder marker: OCC record writes are plan-phase
+    # milestones worth a tick on the run timeline
+    obstrace.current().instant("occ.save", "plan", path=path)
 
 
 def load_record(path: str) -> dict:
+    from shadow_tpu.obs import trace as obstrace
+
     with open(path) as f:
         record = json.load(f)
     if record.get("format") != FORMAT:
@@ -487,4 +493,5 @@ def load_record(path: str) -> dict:
     for key in ("measured", "workload"):
         if key not in record:
             raise ValueError(f"occupancy record {path}: missing {key!r}")
+    obstrace.current().instant("occ.load", "plan", path=path)
     return record

@@ -2383,6 +2383,13 @@ class DeviceEngine:
         jax.block_until_ready(self._probe(state))
         prof["compile_s"] = _time.perf_counter() - t0
 
+        # the phase-split programs are the one place EXCHANGE wall is
+        # measured host-side (the fused run buries the flush inside
+        # the dispatch span) — record the splits as flight-recorder
+        # spans so a profiled run's trace shows pop vs flush lanes
+        from shadow_tpu.obs import trace as obstrace
+        tracer = obstrace.current()
+
         exec0 = int(jnp.sum(state["n_exec"]))
         t0 = _time.perf_counter()
         nxt, _ = map(int, self._probe(state))
@@ -2392,15 +2399,19 @@ class DeviceEngine:
             win_end = jnp.int64(min(nxt + LA, stop_t))
             while True:
                 t0 = _time.perf_counter()
-                state, ob, _ = pop_fn(state, _ob(), hv, wrld,
-                                      win_end)
-                jax.block_until_ready(state)
+                with tracer.span("profile.pop", "dispatch",
+                                 sim_t0=nxt, sim_t1=int(win_end)):
+                    state, ob, _ = pop_fn(state, _ob(), hv, wrld,
+                                          win_end)
+                    jax.block_until_ready(state)
                 prof["pop_s"] += _time.perf_counter() - t0
 
                 t0 = _time.perf_counter()
-                state = flush_fn(state, ob, hv, wrld,
-                                 win_end)
-                jax.block_until_ready(state)
+                with tracer.span("profile.flush", "exchange",
+                                 sim_t0=nxt, sim_t1=int(win_end)):
+                    state = flush_fn(state, ob, hv, wrld,
+                                     win_end)
+                    jax.block_until_ready(state)
                 prof["flush_s"] += _time.perf_counter() - t0
                 prof["phases"] += 1
 

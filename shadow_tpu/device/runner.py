@@ -23,6 +23,7 @@ import numpy as np
 
 from shadow_tpu._jax import jax
 from shadow_tpu.core.manager import SimStats, resolve_host_ref
+from shadow_tpu.obs import trace as obstrace
 from shadow_tpu.device.apps import (
     DeviceApp,
     PholdDevice,
@@ -230,6 +231,13 @@ class DeviceRunner:
         self.checkpointer = None
         self.guard = None
         self.retries = 0
+        # flight recorder (shadow_tpu/obs): the Controller attaches
+        # its run-wide tracer; None (direct construction in tests)
+        # falls through to the module-global current() in advance
+        self.tracer = None
+        # supervise-heartbeat rate mark: (wall, packets) at the last
+        # heartbeat, for the pkts/s-since-last-heartbeat log column
+        self._hb_mark = None
         # campaign checkpoint stamp (EnsembleRunner overrides)
         self._ck_extra_meta: Optional[dict] = None
         # set once _plan_capacities has sized the engine: run() skips
@@ -489,7 +497,14 @@ class DeviceRunner:
         segment pauses when the next event passes `now`, so events in
         [now, now+lookahead) of the last window are counted in THIS
         interval — up to one lookahead of skew vs the CPU tracker's
-        exact per-tick attribution. Totals always agree."""
+        exact per-tick attribution. Totals always agree.
+
+        One aggregate ``[supervise-heartbeat]`` line rides along with
+        the wall-clock pkts/s since the previous heartbeat and the
+        cumulative retry/replan counts, so a stalling or thrashing
+        run is visible from the log stream alone."""
+        from shadow_tpu import simtime
+        from shadow_tpu.device.supervise import heartbeat_rates
         from shadow_tpu.host.tracker import Tracker
 
         n_exec = np.asarray(state["n_exec"])
@@ -504,6 +519,14 @@ class DeviceRunner:
             h.packets_sent = int(n_sent[i])
             h.packets_dropped = int(n_drop[i])
             h.tracker.heartbeat(now, h)
+        H = len(self.sim.hosts)
+        sent_total = int(n_sent[:H].sum())
+        self._hb_mark, (rate,) = heartbeat_rates(self._hb_mark,
+                                                 [sent_total])
+        log.info("[supervise-heartbeat] t=%s events=%d sent=%d "
+                 "pkts/s=%s retries=%d replans=%d",
+                 simtime.format_time(now), int(n_exec[:H].sum()),
+                 sent_total, rate, self.retries, self.replans)
 
     def run(self, stop: int) -> SimStats:
         import time as _time
@@ -511,8 +534,10 @@ class DeviceRunner:
         from shadow_tpu.device import capacity, supervise
 
         xp = self.sim.cfg.experimental
+        tracer = self.tracer or obstrace.current()
         self.replans = 0
         self.retries = 0
+        self._hb_mark = None
         if xp.capacity_plan == "static":
             # a re-used runner must not merge this run's measurements
             # into a stale record from an earlier run (the merge
@@ -537,12 +562,16 @@ class DeviceRunner:
                 save_path=xp.checkpoint_save,
                 save_time=xp.checkpoint_save_time)
         if xp.capacity_plan != "static" and not self._planned:
-            self._plan_capacities(stop, load_path=load_path)
+            with tracer.span("capacity.plan", "plan",
+                             mode=xp.capacity_plan):
+                self._plan_capacities(stop, load_path=load_path)
         if load_path:
             from shadow_tpu.device import checkpoint
-            state, t_start = checkpoint.load_state(
-                self.engine, self.sim.starts, load_path,
-                final_stop=stop)
+            with tracer.span("checkpoint.load", "checkpoint",
+                             path=load_path):
+                state, t_start = checkpoint.load_state(
+                    self.engine, self.sim.starts, load_path,
+                    final_stop=stop)
             if t_start >= stop:
                 raise ValueError(
                     f"checkpoint_load: saved state pauses at "
@@ -613,11 +642,14 @@ class DeviceRunner:
                 pass
             else:
                 from shadow_tpu.device import checkpoint
-                checkpoint.save_state(
-                    self.engine, state, xp.checkpoint_save, t_end,
-                    final_stop=stop,
-                    audit_meta=({"enabled": True, "violations": 0}
-                                if xp.state_audit else None))
+                with tracer.span("checkpoint.save", "checkpoint",
+                                 sim_t0=t_end,
+                                 path=xp.checkpoint_save):
+                    checkpoint.save_state(
+                        self.engine, state, xp.checkpoint_save, t_end,
+                        final_stop=stop,
+                        audit_meta=({"enabled": True, "violations": 0}
+                                    if xp.state_audit else None))
                 log.info("checkpoint saved at t=%d ns -> %s (run %s)",
                          t_end, xp.checkpoint_save,
                          "complete" if t_end >= stop else
@@ -627,7 +659,8 @@ class DeviceRunner:
         # dominate wall time over a tunneled TPU if pulled back
         stat_keys = [k for k in state
                      if k not in ("ht", "hk", "hm", "hv", "hw")]
-        final = jax.device_get({k: state[k] for k in stat_keys})
+        with tracer.span("state.fetch", "host", sim_t0=t_end):
+            final = jax.device_get({k: state[k] for k in stat_keys})
         wall = _time.perf_counter() - t0
         self.final_state = final
         H = len(self.sim.hosts)

@@ -53,6 +53,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from shadow_tpu.obs import trace as obstrace
 from shadow_tpu.utils.slog import get_logger
 
 log = get_logger("supervise")
@@ -201,6 +202,27 @@ class PreemptionGuard:
 
     def __exit__(self, *exc) -> None:
         self._restore()
+
+
+def heartbeat_rates(mark, sent_totals):
+    """The ONE pkts/s-since-last-heartbeat rule for the
+    ``[supervise-heartbeat]`` and ``[ensemble-heartbeat]`` lines
+    (DeviceRunner and EnsembleRunner both delegate here so the two
+    surfaces cannot drift): given the previous ``(wall, totals)``
+    mark (or None) and the current cumulative sent totals (one entry
+    per line — the standalone runner passes one, the campaign one per
+    replica), return ``(new_mark, rates)`` with each rate a formatted
+    string. The first boundary rates "n/a" — there is no previous
+    mark, and a resumed run's counters include the pre-resume total,
+    so a since-start rate would lie."""
+    wall = time.perf_counter()
+    rates = ["n/a"] * len(sent_totals)
+    if mark is not None:
+        dw = wall - mark[0]
+        if dw > 0:
+            rates = [f"{(float(s) - float(p)) / dw:.0f}"
+                     for s, p in zip(sent_totals, mark[1])]
+    return (wall, [float(s) for s in sent_totals]), rates
 
 
 def drain_possible(cfg) -> bool:
@@ -359,11 +381,19 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
     * preemption request -> save a resume checkpoint at the boundary
       and return preempted.
 
+    Every unit of work records a flight-recorder span (shadow_tpu/obs
+    — dispatch segments with their sim windows and ICI counters,
+    heartbeats, checkpoint saves, retry backoffs, re-plans, the
+    preemption drain), tagged so trace_report can attribute the run's
+    wall. Tracing only reads values this loop already fetched, so
+    traces stay bit-identical across telemetry modes.
+
     Returns (state, AdvanceResult).
     """
     from shadow_tpu._jax import jax
     from shadow_tpu.device import capacity, checkpoint
 
+    tracer = getattr(runner, "tracer", None) or obstrace.current()
     xp = runner.sim.cfg.experimental
     hb = runner.sim.cfg.general.heartbeat_interval
     seg = xp.dispatch_segment
@@ -429,11 +459,34 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
         if next_ck is not None:
             nxt = min(nxt, next_ck)
         try:
-            state, seg_rounds = run_segment(state, nxt)
-            # both device_gets below synchronize, so asynchronously
-            # raised dispatch errors surface inside this try
-            dims = capacity.overflow_dims(state)
-            seg_rounds = np.asarray(jax.device_get(seg_rounds))
+            # the span covers the dispatch AND the device_gets that
+            # synchronize it — that pair is what "one segment costs"
+            # means on the wall clock. A raised dispatch error closes
+            # the span with an error tag, so retries show on the
+            # timeline as failed-dispatch + backoff + recover spans.
+            with tracer.span("dispatch", "dispatch", sim_t0=t,
+                             sim_t1=nxt) as sp:
+                state, seg_rounds = run_segment(state, nxt)
+                # both device_gets below synchronize, so
+                # asynchronously raised dispatch errors surface
+                # inside this try
+                dims = capacity.overflow_dims(state)
+                seg_rounds = np.asarray(jax.device_get(seg_rounds))
+                sp.add(rounds=int(np.max(seg_rounds)))
+                eff = runner.engine.effective
+                if eff.get("n_shards", 1) > 1:
+                    # exchange-flush attribution: the flush is fused
+                    # into the compiled round on-device, so its wall
+                    # is inside this span; the static per-flush ICI
+                    # volume (buffers ship at capacity) rides as
+                    # counters (engine.profile() measures the split
+                    # walls when real exchange timing is needed)
+                    sp.add(exchange=eff["exchange"],
+                           shards=eff["n_shards"],
+                           ici_rows_per_flush=eff[
+                               "ICI_rows_per_flush"],
+                           ici_bytes_per_flush=eff[
+                               "ICI_bytes_per_flush"])
         except AuditFailure:
             raise
         except Exception as e:      # noqa: BLE001 — classified below
@@ -446,6 +499,9 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
             # exhausts it, because its segment never completes
             failures += 1
             res.retries += 1
+            # live cumulative count: the supervise heartbeat line
+            # reports it mid-run, not just the end-of-run SimStats
+            runner.retries = res.retries
             if failures > xp.dispatch_retries:
                 _escalate(runner, e, good_state, good_t, stop,
                           ensemble, ck)
@@ -458,9 +514,16 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
                 "t=%d ns after %.1fs backoff", label, good_t, nxt,
                 e, failures, xp.dispatch_retries, good_t, delay)
             if delay:
-                time.sleep(delay)
-            state = _recover_state(runner, good_state, replace_state,
-                                   ck, stop, ensemble)
+                with tracer.span("retry.backoff", "retry",
+                                 sim_t0=good_t, sim_t1=nxt,
+                                 attempt=failures,
+                                 error=str(e)[:200]):
+                    time.sleep(delay)
+            with tracer.span("retry.recover", "retry", sim_t0=good_t,
+                             attempt=failures):
+                state = _recover_state(runner, good_state,
+                                       replace_state, ck, stop,
+                                       ensemble)
             good_state = state
             t = good_t
             next_hb = (t // hb + 1) * hb if hb else None
@@ -471,6 +534,8 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
                 res.rounds = res.rounds + seg_rounds
                 t = nxt
                 res.overflowed = True
+                tracer.instant("capacity.overflow", "plan", sim_t0=t,
+                               dims=list(dims))
                 break           # loud failure (stats.ok = False)
             runner.replans += 1
             runner._capacity_overrides = capacity.widen(
@@ -481,8 +546,11 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
                 "#%d with %s, re-running from t=%d ns", label, dims,
                 good_t, nxt, runner.replans,
                 runner._capacity_overrides, good_t)
-            runner.engine = runner._build_engine()
-            state = replace_state(jax.device_get(good_state))
+            with tracer.span("capacity.replan", "plan", sim_t0=good_t,
+                             sim_t1=nxt, dims=list(dims),
+                             replan=runner.replans):
+                runner.engine = runner._build_engine()
+                state = replace_state(jax.device_get(good_state))
             good_state = state
             t = good_t
             next_hb = (t // hb + 1) * hb if hb else None
@@ -499,6 +567,8 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
                 log.warning("max_rounds (%d) exhausted during "
                             "%ssegmentation; stopping", budget, label)
             res.budget_hit = True
+            tracer.instant("budget.exhausted", "host", sim_t0=t,
+                           budget=int(budget))
             break
         if audit_on:
             # the boundary state is validated BEFORE it becomes the
@@ -508,10 +578,13 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
                         last_good=(ck.last_path if ck is not None
                                    else ""))
         if next_hb is not None and t >= next_hb and t < stop:
-            runner._emit_heartbeats(t, state)
+            with tracer.span("heartbeat", "host", sim_t0=t):
+                runner._emit_heartbeats(t, state)
             next_hb += hb
         if next_ck is not None and t >= next_ck and t < stop:
-            ck.save(runner.engine, state, t)
+            with tracer.span("checkpoint.save", "checkpoint",
+                             sim_t0=t) as sp:
+                sp.add(path=ck.save(runner.engine, state, t))
             next_ck = ck.next_after(t)
         if keep_good:
             good_state, good_t = state, t
@@ -519,7 +592,12 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
             # a signal that lands during the FINAL segment needs no
             # drain — the run reached its pause/stop and completes
             # normally (the t >= pause case falls out of the loop)
-            res.resume_path = drain_save(state, t)
+            tracer.instant("preempt.request", "checkpoint", sim_t0=t,
+                           signum=guard.signum)
+            with tracer.span("checkpoint.drain_save", "checkpoint",
+                             sim_t0=t) as sp:
+                res.resume_path = drain_save(state, t)
+                sp.add(path=res.resume_path)
             res.preempted = True
             log.warning(
                 "%srun preempted at t=%d ns: resume checkpoint -> %s "

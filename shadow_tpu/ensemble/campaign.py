@@ -118,6 +118,12 @@ class EnsembleRunner:
         self.guard = None
         self._ck_extra_meta = {"campaign": self.worlds.campaign_fp,
                                "replicas": int(self.worlds.R)}
+        # flight recorder (shadow_tpu/obs): attached by the
+        # Controller; the shared advance loop records the spans
+        self.tracer = None
+        # ensemble-heartbeat rate mark: (wall, per-replica sent) at
+        # the last heartbeat, for the pkts/s-since-last column
+        self._hb_mark = None
 
     # ------------------------------------------------------------------
     @property
@@ -263,18 +269,27 @@ class EnsembleRunner:
     def _emit_heartbeats(self, now: int, states) -> None:
         """Per-replica heartbeat lines at a segment boundary: replica
         totals from the device counters (the [R, H] arrays are a few
-        KB — never the heaps)."""
+        KB — never the heaps). Each line carries the wall-clock
+        pkts/s since the previous heartbeat and the campaign's
+        cumulative retry/replan counts, so a stalled or thrashing
+        replica is visible from the log stream alone."""
+        from shadow_tpu.device.supervise import heartbeat_rates
+
         H = len(self.sim.hosts)
         n_exec = np.asarray(jax.device_get(states["n_exec"]))[:, :H]
         n_sent = np.asarray(jax.device_get(states["n_sent"]))[:, :H]
         n_drop = np.asarray(jax.device_get(states["n_drop"]))[:, :H]
         n_deliv = np.asarray(jax.device_get(states["n_deliv"]))[:, :H]
+        self._hb_mark, rates = heartbeat_rates(self._hb_mark,
+                                               n_sent.sum(1))
         for r in range(self.worlds.R):
             log.info("[ensemble-heartbeat] t=%s replica=%d events=%d "
-                     "sent=%d dropped=%d delivered=%d",
+                     "sent=%d dropped=%d delivered=%d pkts/s=%s "
+                     "retries=%d replans=%d",
                      simtime.format_time(now), r,
                      int(n_exec[r].sum()), int(n_sent[r].sum()),
-                     int(n_drop[r].sum()), int(n_deliv[r].sum()))
+                     int(n_drop[r].sum()), int(n_deliv[r].sum()),
+                     rates[r], self.retries, self.replans)
 
     # ------------------------------------------------------------------
     def record_path(self) -> str:
@@ -345,9 +360,13 @@ class EnsembleRunner:
     def run(self, stop: int) -> SimStats:
         from shadow_tpu.device import checkpoint, supervise
 
+        from shadow_tpu.obs import trace as obstrace
+
         xp = self.sim.cfg.experimental
+        tracer = self.tracer or obstrace.current()
         self.replans = 0
         self.retries = 0
+        self._hb_mark = None
         w = self.worlds
         if xp.checkpoint_save:
             checkpoint.probe_writable(xp.checkpoint_save)
@@ -373,13 +392,17 @@ class EnsembleRunner:
                 save_path=xp.checkpoint_save,
                 save_time=xp.checkpoint_save_time)
         if xp.capacity_plan != "static" and not self._planned:
-            self._plan_capacities(stop, load_path=load_path)
+            with tracer.span("capacity.plan", "plan",
+                             mode=xp.capacity_plan, ensemble=True):
+                self._plan_capacities(stop, load_path=load_path)
         if load_path:
-            states, t_start = checkpoint.load_state(
-                self.engine, self.sim.starts, load_path,
-                final_stop=stop,
-                template=self.engine.init_ensemble_state(
-                    self.sim.starts))
+            with tracer.span("checkpoint.load", "checkpoint",
+                             path=load_path):
+                states, t_start = checkpoint.load_state(
+                    self.engine, self.sim.starts, load_path,
+                    final_stop=stop,
+                    template=self.engine.init_ensemble_state(
+                        self.sim.starts))
             log.info("resumed campaign checkpoint %s at t=%d ns",
                      load_path, t_start)
         else:
@@ -425,18 +448,22 @@ class EnsembleRunner:
                 # the drain already saved the resume checkpoint
                 pass
             else:
-                checkpoint.save_state(
-                    self.engine, states, xp.checkpoint_save, t_end,
-                    final_stop=stop,
-                    extra_meta=self._ck_extra_meta,
-                    audit_meta=({"enabled": True, "violations": 0}
-                                if xp.state_audit else None))
+                with tracer.span("checkpoint.save", "checkpoint",
+                                 sim_t0=t_end,
+                                 path=xp.checkpoint_save):
+                    checkpoint.save_state(
+                        self.engine, states, xp.checkpoint_save,
+                        t_end, final_stop=stop,
+                        extra_meta=self._ck_extra_meta,
+                        audit_meta=({"enabled": True, "violations": 0}
+                                    if xp.state_audit else None))
                 log.info("campaign checkpoint saved at t=%d ns -> %s",
                          t_end, xp.checkpoint_save)
         stat_keys = [k for k in states
                      if k not in ("ht", "hk", "hm", "hv", "hw")]
-        final = {k: np.asarray(v) for k, v in jax.device_get(
-            {k: states[k] for k in stat_keys}).items()}
+        with tracer.span("state.fetch", "host", sim_t0=t_end):
+            final = {k: np.asarray(v) for k, v in jax.device_get(
+                {k: states[k] for k in stat_keys}).items()}
         wall = time.perf_counter() - t0
         self.final_state = final
         H = len(self.sim.hosts)

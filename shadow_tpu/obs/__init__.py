@@ -1,0 +1,12 @@
+"""Run-wide observability: the flight recorder (obs/trace.py) and its
+Perfetto export (obs/perfetto.py). See docs/observability.md."""
+
+from shadow_tpu.obs.trace import (       # noqa: F401
+    MODES,
+    NullTracer,
+    PHASES,
+    Tracer,
+    current,
+    resolve_tracer,
+    set_current,
+)

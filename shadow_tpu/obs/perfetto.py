@@ -1,0 +1,77 @@
+"""Chrome trace-event export: the flight recorder's span list as a
+Perfetto-loadable ``.trace.json``.
+
+The JSON object format of the Trace Event spec (the subset Perfetto's
+legacy importer and chrome://tracing both load): one ``"X"`` complete
+event per span with microsecond ``ts``/``dur``, grouped onto one
+named thread track per phase bucket so the timeline reads as
+swimlanes — dispatch / judge / exchange / checkpoint / retry /
+compile / plan / host — with sim-time windows and counters in each
+event's ``args``. Written atomically (utils/artifacts), so a kill
+mid-export never leaves a truncated trace the viewer chokes on.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.obs.trace import PHASES
+
+
+def to_trace_events(spans: list, meta: dict = None) -> dict:
+    """Span records (obs/trace.py ``_record`` dicts) -> the Trace
+    Event JSON object. Pure, so tests can pin the format without
+    touching disk."""
+    pid = 1
+    tids = {p: i + 1 for i, p in enumerate(PHASES)}
+    events = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "shadow-tpu flight recorder"},
+    }]
+    for phase, tid in tids.items():
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid, "args": {"name": phase}})
+        # sort_index pins the swimlane order to the PHASES order
+        # instead of Perfetto's name sort
+        events.append({"name": "thread_sort_index", "ph": "M",
+                       "pid": pid, "tid": tid,
+                       "args": {"sort_index": tid}})
+    for rec in spans:
+        tid = tids.get(rec["phase"])
+        if tid is None:
+            # free-form category: a lane of its own past the fixed set
+            tid = tids[rec["phase"]] = len(tids) + 1
+            events.append({"name": "thread_name", "ph": "M",
+                           "pid": pid, "tid": tid,
+                           "args": {"name": rec["phase"]}})
+        args = dict(rec.get("args") or {})
+        if "sim_t0" in rec:
+            args["sim_t0_ns"] = rec["sim_t0"]
+        if "sim_t1" in rec:
+            args["sim_t1_ns"] = rec["sim_t1"]
+        ts_us = round(rec["t0_s"] * 1e6, 3)
+        dur_us = round(rec["dur_s"] * 1e6, 3)
+        if dur_us <= 0:
+            # zero-duration record -> instant event (a vertical tick;
+            # an "X" with dur 0 renders as nothing)
+            events.append({"name": rec["name"], "ph": "i", "s": "t",
+                           "pid": pid, "tid": tid, "ts": ts_us,
+                           "args": args})
+        else:
+            events.append({"name": rec["name"], "ph": "X", "pid": pid,
+                           "tid": tid, "ts": ts_us, "dur": dur_us,
+                           "args": args})
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if meta:
+        out["metadata"] = {k: v for k, v in meta.items()
+                           if k in ("mode", "total_wall_s", "phases",
+                                    "dominant_phase", "run")}
+    return out
+
+
+def export(spans: list, path: str, meta: dict = None) -> str:
+    from shadow_tpu.utils.artifacts import atomic_write_json
+
+    # default=str: free-form span args must degrade to strings, not
+    # fail the export (the recorder's never-break-the-run contract)
+    atomic_write_json(to_trace_events(spans, meta), path, indent=None,
+                      default=str)
+    return path

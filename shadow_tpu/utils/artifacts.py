@@ -55,3 +55,54 @@ def atomic_write_json(obj, path: str, **json_kwargs) -> None:
 
 def atomic_write_text(text: str, path: str) -> None:
     atomic_write(path, lambda f: f.write(text), mode="w")
+
+
+class StreamedLines:
+    """Line-streamed artifact with atomic final placement — the JSONL
+    flight-recorder log's writer (shadow_tpu/obs). A span log must be
+    STREAMED (a hung run's partial log is exactly the post-mortem
+    artifact) but the canonical path must never hold a half-written
+    file, so lines land in ``<path>.<pid>.partial`` as they are
+    written (flushed every ``flush_every`` lines, so `tail -f` works)
+    and ``close()`` fsyncs and os.replace()s the stream into place —
+    the same tmp+rename contract as atomic_write, stretched over the
+    artifact's lifetime. ``abandon()`` (error paths) keeps the partial
+    file on disk: unlike a failed atomic_write, the prefix written so
+    far is evidence, not a decoy."""
+
+    def __init__(self, path: str, flush_every: int = 64):
+        self.path = path
+        self.partial = f"{path}.{os.getpid()}.partial"
+        self.flush_every = max(1, int(flush_every))
+        directory = os.path.dirname(path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        self._f = open(self.partial, "w")
+        self._pending = 0
+
+    def write_line(self, line: str) -> None:
+        self._f.write(line)
+        self._f.write("\n")
+        self._pending += 1
+        if self._pending >= self.flush_every:
+            self._f.flush()
+            self._pending = 0
+
+    def close(self) -> str:
+        """Finalize: flush, fsync, and atomically land the stream at
+        the canonical path. Returns the final path."""
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        os.replace(self.partial, self.path)
+        return self.path
+
+    def abandon(self) -> str:
+        """Stop writing but KEEP the partial file (error paths): the
+        prefix is the post-mortem. Returns the partial path."""
+        try:
+            self._f.flush()
+            self._f.close()
+        except OSError:
+            pass
+        return self.partial

@@ -1,0 +1,146 @@
+"""Direct unit tests for host/tracker.py heartbeat CSV lines.
+
+The `[shadow-heartbeat]` surface is parsed by existing shadow
+log-parsing workflows (docs/migrating_from_shadow.md), so its shape
+is a compatibility contract: the header row is emitted exactly once,
+the node/socket column counts stay stable and match their headers,
+and socket lines cover exactly the host's live TCP connections.
+Until now only the end-to-end device tests exercised it.
+"""
+
+import logging
+
+import pytest
+
+from shadow_tpu.host.tracker import Tracker
+
+
+class FakeEth:
+    def __init__(self):
+        self.bytes_sent = 0
+        self.bytes_received = 0
+
+
+class FakeSock:
+    class _State:
+        name = "ESTABLISHED"
+
+    def __init__(self, sent=3, retrans=1, received=42):
+        self.state = self._State()
+        self.segments_sent = sent
+        self.segments_retransmitted = retrans
+        self.bytes_received = received
+
+
+class FakeNet:
+    def __init__(self, conns=None):
+        self.eth = FakeEth()
+        self._conns = conns or {}
+
+
+class FakeHost:
+    def __init__(self, net=None):
+        self.packets_sent = 0
+        self.packets_dropped = 0
+        self.net = net
+        self.apps = ()
+
+
+def hb_lines(caplog, tag):
+    return [r.getMessage() for r in caplog.records
+            if f"[{tag}]" in r.getMessage()]
+
+
+@pytest.fixture
+def tracker_host(caplog):
+    caplog.set_level(logging.INFO, logger="shadow_tpu.heartbeat")
+    return Tracker("web0", 10**9), FakeHost()
+
+
+def test_node_header_emitted_once(tracker_host, caplog):
+    tr, host = tracker_host
+    tr.heartbeat(10**9, host)
+    tr.heartbeat(2 * 10**9, host)
+    tr.heartbeat(3 * 10**9, host)
+    assert len(hb_lines(caplog, "node-header")) == 1
+    assert len(hb_lines(caplog, "node")) == 3
+
+
+def test_node_column_count_matches_header(tracker_host, caplog):
+    tr, host = tracker_host
+    host.packets_sent = 7
+    host.packets_dropped = 2
+    tr.on_event()
+    tr.on_event()
+    tr.heartbeat(10**9, host)
+    header = hb_lines(caplog, "node-header")[0]
+    row = hb_lines(caplog, "node")[0]
+    cols = header.split("[node-header] ")[1].split(",")
+    vals = row.split("[node] ")[1].split(",")
+    assert len(vals) == len(cols) == 9
+    # time,name,events,packets-sent,packets-dropped,...
+    assert vals[0] == "1"
+    assert vals[1] == "web0"
+    assert vals[2] == "2"        # on_event x2 this interval
+    assert vals[3] == "7"
+    assert vals[4] == "2"
+
+
+def test_node_counters_are_interval_deltas(tracker_host, caplog):
+    tr, host = tracker_host
+    host.packets_sent = 5
+    tr.heartbeat(10**9, host)
+    host.packets_sent = 8        # +3 since the last beat
+    tr.heartbeat(2 * 10**9, host)
+    rows = [ln.split("[node] ")[1].split(",")
+            for ln in hb_lines(caplog, "node")]
+    assert rows[0][3] == "5"
+    assert rows[1][3] == "3"
+
+
+def test_set_events_total_diffs_cumulative(tracker_host, caplog):
+    # device path: the engine reports CUMULATIVE per-host event
+    # counts; the tracker diffs them into interval values
+    tr, host = tracker_host
+    tr.set_events_total(10)
+    tr.heartbeat(10**9, host)
+    tr.set_events_total(25)
+    tr.heartbeat(2 * 10**9, host)
+    rows = [ln.split("[node] ")[1].split(",")
+            for ln in hb_lines(caplog, "node")]
+    assert rows[0][2] == "10"
+    assert rows[1][2] == "15"
+
+
+def test_socket_lines_match_open_sockets(caplog):
+    caplog.set_level(logging.INFO, logger="shadow_tpu.heartbeat")
+    conns = {(8080, 3, 50000): FakeSock(sent=5, retrans=0,
+                                        received=100),
+             (8081, 4, 50001): FakeSock(sent=9, retrans=2,
+                                        received=7)}
+    host = FakeHost(net=FakeNet(conns))
+    tr = Tracker("srv", 10**9)
+    tr.heartbeat(10**9, host)
+    headers = hb_lines(caplog, "socket-header")
+    rows = hb_lines(caplog, "socket")
+    assert len(headers) == 1
+    assert len(rows) == len(conns)
+    n_cols = len(headers[0].split("[socket-header] ")[1].split(","))
+    for row in rows:
+        vals = row.split("[socket] ")[1].split(",")
+        assert len(vals) == n_cols == 9
+    # sorted by (local-port, peer, peer-port): 8080 first
+    first = rows[0].split("[socket] ")[1].split(",")
+    assert first[2] == "8080" and first[5] == "ESTABLISHED"
+    assert first[6] == "5" and first[7] == "0" and first[8] == "100"
+    # a second beat emits no second socket header
+    tr.heartbeat(2 * 10**9, host)
+    assert len(hb_lines(caplog, "socket-header")) == 1
+
+
+def test_no_socket_lines_without_connections(caplog):
+    caplog.set_level(logging.INFO, logger="shadow_tpu.heartbeat")
+    tr = Tracker("lonely", 10**9)
+    tr.heartbeat(10**9, FakeHost(net=FakeNet()))
+    assert not hb_lines(caplog, "socket-header")
+    assert not hb_lines(caplog, "socket")
