@@ -57,6 +57,14 @@ METRICS_*.json whose per-phase walls sum to within 10% of the
 recorded total; $TELEMETRY_TRACE_OUT receives a copy of the
 .trace.json for CI artifact upload.
 
+`--tuned` switches to the STRATEGY-AUTOTUNER gate (shadow_tpu/tune/):
+a real mini-tune writes a PLAN record through the full
+produce-persist-adopt pipeline; the adopted run and a COMPOSED
+adversarial plan (every applicable knob at its most aggressive
+candidate at once, reshaping ones included) must both bit-match the
+default-knob run — a tuned plan changes wall time only, and the
+composition of individually-pinned knobs stays pinned.
+
 `--ensemble` switches to the CAMPAIGN gate (shadow_tpu/ensemble/):
 the config must carry an `ensemble:` block. The gate runs the
 campaign twice (run-to-run bit-identity over every replica), then
@@ -555,6 +563,151 @@ def run_telemetry_gate(config: str) -> int:
         return rc
 
 
+def run_tuned_gate(config: str) -> int:
+    """Strategy-autotuner gate (shadow_tpu/tune/): a tuned plan must
+    change WALL time only. Three legs against one config (tpu
+    policy):
+
+    1. a real mini-tune (tune/trials.py coordinate descent, small
+       budget, quarter window) writes a PLAN record through
+       tune/plan.py — the full produce-persist-adopt pipeline runs,
+       and the record must carry the chosen knobs and the trial
+       ledger;
+    2. the adopted run (``strategy_plan: <plan>``) must bit-match
+       the default-knob run and surface adoption provenance;
+    3. a COMPOSED adversarial plan — every applicable knob moved to
+       its most aggressive candidate at once, including the
+       program-reshaping ones — must also bit-match: each knob is
+       individually bit-identity-pinned, and this leg pins the
+       composition the tuner relies on.
+    """
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller, build
+    from shadow_tpu.device.runner import device_twin
+    from shadow_tpu.tune import plan as planmod
+    from shadow_tpu.tune import space
+    from shadow_tpu.tune.trials import Tuner
+
+    cfg0 = load_config(config)
+    stop = cfg0.general.stop_time
+    sim = build(cfg0)
+    twin = device_twin(sim)
+    n_hosts = len(sim.hosts)
+    del sim
+
+    with tempfile.TemporaryDirectory() as tmp:
+        os.environ.setdefault("SHADOW_TPU_OCC_DIR",
+                              os.path.join(tmp, "occ"))
+
+        def once(tag: str, strategy_plan: str):
+            cfg = load_config(config)
+            cfg.experimental.scheduler_policy = "tpu"
+            cfg.experimental.strategy_plan = strategy_plan
+            cfg.general.data_directory = os.path.join(
+                tmp, tag, "shadow.data")
+            c = Controller(cfg)
+            stats = c.run()
+            if not stats.ok:
+                print(f"FAIL: {tag} run reported not-ok")
+                sys.exit(1)
+            sig = [(h.name, h.trace_checksum, h.events_executed,
+                    h.packets_sent, h.packets_dropped,
+                    h.packets_delivered) for h in c.sim.hosts]
+            return sig, stats
+
+        # leg 1: the real pipeline — tune, persist, reload
+        tuner = Tuner(config, window_ns=max(1, stop // 4), budget=6)
+        body = tuner.search("coordinate_descent")
+        plan_file = os.path.join(tmp, "PLAN_gate.json")
+        planmod.save_plan({
+            "format": planmod.FORMAT,
+            "workload": {**planmod.workload_stamp(twin, n_hosts),
+                         "stop_time": int(stop),
+                         "seed": int(cfg0.general.seed)},
+            "source": "determinism_gate --tuned",
+            **body,
+        }, plan_file)
+        rec = planmod.load_plan(plan_file)
+        if "trials" not in rec or not rec["trials"]:
+            print("FAIL: the PLAN record carries no trial ledger")
+            return 1
+        diverged = [t for t in rec["trials"]
+                    if "diverged" in t.get("error", "")]
+        if diverged:
+            print(f"DETERMINISM FAILURE: {len(diverged)} trial(s) "
+                  "diverged from the default-knob signature during "
+                  "the mini-tune")
+            return 1
+
+        sig_def, _ = once("default", "off")
+        sig_tuned, stats_tuned = once("tuned", plan_file)
+        rc = 0
+        if sig_tuned != sig_def:
+            rc = 1
+            print("DETERMINISM FAILURE: the tuned-plan run diverges "
+                  "from the default-knob run")
+            for a, b in zip(sig_def, sig_tuned):
+                if a != b:
+                    print(f"  {a[0]}: default {a[1:]} != tuned "
+                          f"{b[1:]}")
+        if stats_tuned.strategy_plan is None:
+            rc = 1
+            print("FAIL: the adopted run surfaced no strategy-plan "
+                  "provenance (SimStats.strategy_plan is None)")
+
+        # leg 3: the composed adversarial plan — every applicable
+        # knob at its most aggressive candidate at once
+        ctx = space.context(cfg0, n_shards=tuner.ctx["n_shards"])
+        ctx["policy"] = "tpu"
+        adversarial, adv_defaults = {}, {}
+        for knob in space.applicable(cfg0, ctx):
+            cur = space.current(cfg0, [knob])[knob.name]
+            cands = [c for c in knob.candidates(cfg0, ctx)
+                     if c != cur]
+            if cands:
+                adversarial[knob.name] = cands[-1]
+                # the tuned-from baseline: without it, adoption's
+                # hand-set check compares cadence knobs against the
+                # SCHEMA default (0/None) and would spuriously skip
+                # them on any config that enables supervision or
+                # heartbeats
+                adv_defaults[knob.name] = cur
+        adv_file = os.path.join(tmp, "PLAN_adversarial.json")
+        planmod.save_plan({
+            "format": planmod.FORMAT,
+            "workload": {**planmod.workload_stamp(twin, n_hosts),
+                         "stop_time": int(stop),
+                         "seed": int(cfg0.general.seed)},
+            "default": adv_defaults,
+            "knobs": adversarial,
+            "source": "determinism_gate --tuned (composed)",
+        }, adv_file)
+        sig_adv, stats_adv = once("adversarial", adv_file)
+        if sig_adv != sig_def:
+            rc = 1
+            print("DETERMINISM FAILURE: the composed adversarial "
+                  f"plan {adversarial} diverges from the "
+                  "default-knob run — a strategy-knob composition "
+                  "changes the simulation")
+            for a, b in zip(sig_def, sig_adv):
+                if a != b:
+                    print(f"  {a[0]}: default {a[1:]} != composed "
+                          f"{b[1:]}")
+        applied = (stats_adv.strategy_plan or {}).get("knobs", {})
+        missing = sorted(set(adversarial) - set(applied))
+        if missing:
+            rc = 1
+            print(f"FAIL: composed plan knobs {missing} were not "
+                  f"applied (provenance: {stats_adv.strategy_plan})")
+        if rc == 0:
+            print(f"tuned-plan OK: {config} (mini-tune "
+                  f"{rec['score']['trials']} trial(s) -> "
+                  f"{rec['knobs']}; adopted run and composed "
+                  f"adversarial plan {adversarial} both bit-match "
+                  "the default-knob run)")
+        return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("config", nargs="?", default="examples/minimal.yaml")
@@ -581,12 +734,28 @@ def main() -> int:
                          "and the trace run must leave a Perfetto-"
                          "loadable trace + a METRICS record whose "
                          "phase walls sum to the total")
+    ap.add_argument("--tuned", action="store_true",
+                    help="strategy-autotuner gate: a mini-tuned PLAN "
+                         "record and a composed adversarial plan "
+                         "must both bit-match the default-knob run "
+                         "(a tuned plan changes wall time only)")
     args = ap.parse_args()
 
     default_policy = "serial,tpu" if args.ensemble else "serial"
     policies = [p.strip()
                 for p in (args.policy or default_policy).split(",")
                 if p.strip()]
+
+    if args.tuned:
+        if args.ensemble or args.preempt or args.policy or \
+                args.compile_cache or args.telemetry:
+            # the tuned gate runs the standalone tpu policy against
+            # its three plan legs by construction
+            print("FAIL: --tuned does not combine with --ensemble/"
+                  "--preempt/--policy/--compile-cache/--telemetry "
+                  "(it runs the standalone tpu policy per plan leg)")
+            return 1
+        return run_tuned_gate(args.config)
 
     if args.telemetry:
         if args.ensemble or args.preempt or args.policy or \

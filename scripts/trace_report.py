@@ -10,10 +10,16 @@ lever it implicates. This is the concrete evidence the pipelining
 and auto-tuning work cite: e.g. a dispatch-dominant tgen_100 run is
 the per-round-dispatch-latency bottleneck MPMD overlap attacks.
 
+``--compare A B`` diffs two records phase-by-phase (delta walls +
+pkts/s) — the one-command before/after surface tuner trials and
+A/B runs use: run A is the baseline, run B the candidate, negative
+deltas mean B is cheaper.
+
 Usage:
   python scripts/trace_report.py artifacts/METRICS_tpu_1000.json
   python scripts/trace_report.py artifacts/TRACE_tpu_1000.jsonl
   python scripts/trace_report.py --top 10 <file>   # slowest spans too
+  python scripts/trace_report.py --compare METRICS_a.json METRICS_b.json
 """
 
 from __future__ import annotations
@@ -154,16 +160,99 @@ def print_report(m: dict, top: int = 0) -> None:
                   f"{r['name']}{window}")
 
 
+def _pkts_per_s(m: dict):
+    """packets/s of a record, when its counters carry packets (the
+    Controller stamps events/packets/rounds into METRICS summaries);
+    None otherwise — the compare table then shows walls only."""
+    pkts = (m.get("counters") or {}).get("packets")
+    total = m.get("total_wall_s") or 0.0
+    if pkts is None or total <= 0:
+        return None
+    return pkts / total
+
+
+def print_compare(a: dict, b: dict, name_a: str, name_b: str) -> None:
+    """Phase-by-phase diff of two records: A is the baseline, B the
+    candidate; delta = B - A (negative = B cheaper)."""
+    pa, pb = a["phases"], b["phases"]
+    keys = [f"{p}_s" for p in PHASES if f"{p}_s" in pa
+            or f"{p}_s" in pb]
+    keys += sorted((set(pa) | set(pb)) - set(keys))
+    print(f"flight-recorder comparison")
+    print(f"  A: {name_a}")
+    print(f"  B: {name_b}")
+    print()
+    print(f"  {'phase':<12} {'A_s':>10} {'B_s':>10} {'delta_s':>10} "
+          f"{'delta':>8}")
+    print(f"  {'-' * 12} {'-' * 10} {'-' * 10} {'-' * 10} {'-' * 8}")
+    rows = sorted(keys, key=lambda k: -(pa.get(k, 0.0)
+                                        + pb.get(k, 0.0)))
+    for key in rows:
+        wa, wb = pa.get(key, 0.0), pb.get(key, 0.0)
+        d = wb - wa
+        rel = f"{d / wa:+.1%}" if wa > 0 else ("new" if wb else "-")
+        print(f"  {key[:-2]:<12} {wa:>10.3f} {wb:>10.3f} {d:>+10.3f} "
+              f"{rel:>8}")
+    ta = a.get("total_wall_s", 0.0)
+    tb = b.get("total_wall_s", 0.0)
+    print(f"  {'-' * 12} {'-' * 10} {'-' * 10} {'-' * 10} {'-' * 8}")
+    rel = f"{(tb - ta) / ta:+.1%}" if ta > 0 else "-"
+    print(f"  {'total':<12} {ta:>10.3f} {tb:>10.3f} "
+          f"{tb - ta:>+10.3f} {rel:>8}")
+    ra, rb = _pkts_per_s(a), _pkts_per_s(b)
+    print()
+    if ra is not None and rb is not None:
+        speed = f" ({rb / ra:.2f}x)" if ra > 0 else ""
+        print(f"pkts/s: A {ra:,.0f} -> B {rb:,.0f}{speed}")
+    elif ra is None and rb is None:
+        print("pkts/s: n/a (no packet counters in either record)")
+    else:
+        # one-sided counters (e.g. a METRICS summary vs a raw JSONL
+        # aggregation): show the known side, never silently drop the
+        # throughput row
+        fmt = ("n/a" if ra is None else f"{ra:,.0f}",
+               "n/a" if rb is None else f"{rb:,.0f}")
+        print(f"pkts/s: A {fmt[0]} -> B {fmt[1]} (one record has no "
+              "packet counters)")
+    dom_a, dom_b = a.get("dominant_phase"), b.get("dominant_phase")
+    if dom_a and dom_b:
+        print(f"dominant phase: A {dom_a} -> B {dom_b}"
+              + ("" if dom_a == dom_b else "  <- shifted"))
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="per-phase wall breakdown of a flight-recorder "
                     "run")
-    ap.add_argument("path", help="METRICS_*.json or TRACE_*.jsonl "
-                                 "(.partial accepted)")
+    ap.add_argument("path", nargs="?",
+                    help="METRICS_*.json or TRACE_*.jsonl "
+                         "(.partial accepted)")
     ap.add_argument("--top", type=int, default=0,
                     help="also list the N slowest spans (jsonl input "
                          "only)")
+    ap.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                    help="diff two METRICS/JSONL records phase-by-"
+                         "phase (A = baseline, B = candidate)")
     args = ap.parse_args()
+    if args.compare:
+        if args.path:
+            print("trace_report: --compare takes exactly its two "
+                  "records (drop the positional path)",
+                  file=sys.stderr)
+            return 1
+        try:
+            a = load_metrics(args.compare[0])
+            b = load_metrics(args.compare[1])
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"trace_report: cannot read comparison input: {e}",
+                  file=sys.stderr)
+            return 1
+        print_compare(a, b, args.compare[0], args.compare[1])
+        return 0
+    if not args.path:
+        print("trace_report: need a METRICS/TRACE path (or "
+              "--compare A B)", file=sys.stderr)
+        return 1
     try:
         m = load_metrics(args.path)
     except (OSError, ValueError, json.JSONDecodeError) as e:

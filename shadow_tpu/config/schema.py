@@ -60,6 +60,41 @@ def _check_choice(section: str, name: str, value: str, choices) -> None:
         )
 
 
+def _keyword_or_path(name: str, value, keywords: tuple,
+                     path_hint: str, json_record: bool = False,
+                     bool_words: tuple = ()) -> str:
+    """The ONE keyword-vs-path validation for experimental knobs that
+    accept a mode keyword OR a filesystem path (capacity_plan,
+    compile_cache, strategy_plan — a new such knob joins here, not as
+    a fourth copy of the typo-rejection logic): normalize YAML 1.1
+    bare ``on``/``off`` booleans back to the knob's keywords
+    (`bool_words` = (off_word, on_word)), reject non-string scalars
+    with the knob's own message (never a TypeError from a path check),
+    pass keywords through, and require anything else to LOOK like the
+    kind of path the knob documents — ``.json`` record paths
+    (`json_record`) or directory-ish paths (a separator or a leading
+    ``./``/``~``/``/``). A typo'd keyword must fail at config load,
+    not minutes later as a raw FileNotFoundError deep inside the
+    run."""
+    if bool_words and isinstance(value, bool):
+        value = bool_words[1] if value else bool_words[0]
+    kws = " / ".join(repr(k) for k in keywords)
+    if not isinstance(value, str):
+        raise ValueError(
+            f"experimental.{name}: {value!r} is neither {kws} nor "
+            f"{path_hint}")
+    if value in keywords:
+        return value
+    looks_like_path = (value.endswith(".json") if json_record else
+                       (os.sep in value
+                        or value.startswith((".", "~", "/"))))
+    if not looks_like_path:
+        raise ValueError(
+            f"experimental.{name}: {value!r} is neither {kws} nor "
+            f"{path_hint}")
+    return value
+
+
 @dataclass
 class ProcessOptions:
     """One virtual process (configuration.rs:478-503)."""
@@ -496,6 +531,26 @@ class ExperimentalOptions:
     # records). Setting it also makes `summary` mode write its
     # METRICS_*.json (by default only `trace` writes files).
     telemetry_path: str = ""
+    # telemetry-driven strategy plans (shadow_tpu/tune/,
+    # docs/autotune.md): "off" ignores stored plans; "auto" adopts
+    # the workload's PLAN_<app>_<H>_<fp>.json record (written by
+    # scripts/tune.py next to the OCC records) when one exists; any
+    # other value is an explicit plan path (must end in .json — a
+    # typo'd keyword fails at load, like capacity_plan) whose
+    # workload fingerprint must match this simulation (loud mismatch
+    # refusal, never a silently wrong plan). Adoption changes WALL
+    # time only: every knob in the plan space is individually
+    # bit-identity-pinned, so a tuned run's traces equal the
+    # default-knob run's (determinism_gate --tuned pins the
+    # composition).
+    strategy_plan: str = "off"
+    # capacity-plan headroom factor override for capacity.plan's pad
+    # rule (planned = ceil(measured * headroom) + slack): 0 keeps the
+    # planner default (capacity.HEADROOM, 1.5). A tunable trade:
+    # more headroom buys fewer overflow re-plans at the cost of
+    # wider sorts and more ICI padding. Requires capacity_plan
+    # auto/<path> (there is nothing to pad on a static run).
+    capacity_headroom: float = 0.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -574,46 +629,45 @@ class ExperimentalOptions:
         if out.capacity_warmup < 0:
             raise ValueError(
                 "experimental.capacity_warmup must be >= 0")
-        if out.capacity_plan not in ("static", "auto") and \
-                not out.capacity_plan.endswith(".json"):
-            # record paths always end in .json (capacity.record_path
-            # writes OCC_*.json) — anything else is a typo'd mode
-            # that would otherwise surface minutes later as a raw
-            # FileNotFoundError deep inside the run
-            raise ValueError(
-                f"experimental.capacity_plan: {out.capacity_plan!r} "
-                "is neither 'static', 'auto', nor a path to a saved "
-                "OCC_*.json occupancy record")
+        # record paths always end in .json (capacity.record_path
+        # writes OCC_*.json); the shared helper owns the typo
+        # rejection
+        out.capacity_plan = _keyword_or_path(
+            "capacity_plan", out.capacity_plan, ("static", "auto"),
+            "a path to a saved OCC_*.json occupancy record",
+            json_record=True)
         if out.capacity_warmup and out.capacity_plan != "auto":
             raise ValueError(
                 "experimental.capacity_warmup is set but "
                 f"capacity_plan is {out.capacity_plan!r} — the "
                 "warm-up slice only runs under capacity_plan: auto, "
                 "so the knob would be silently ignored")
-        if isinstance(out.compile_cache, bool):
-            # YAML 1.1 reads bare `off`/`on` as booleans — map them
-            # back to the keywords the knob documents
-            out.compile_cache = "auto" if out.compile_cache else "off"
-        if not isinstance(out.compile_cache, str):
-            # any other YAML scalar (a bare number, a list) gets the
-            # knob's loud rejection, not a TypeError from the path
-            # check below
+        # cache directories always look like paths — anything else
+        # ("atuo", a bare number) is a typo'd mode that would
+        # otherwise silently become a directory named after the typo;
+        # YAML 1.1 bare off/on booleans normalize to the keywords
+        out.compile_cache = _keyword_or_path(
+            "compile_cache", out.compile_cache, ("auto", "off"),
+            "a cache directory path (paths must contain a separator "
+            "or start with './', '~', or '/')",
+            bool_words=("off", "auto"))
+        # strategy plans are .json records next to the OCC records
+        # (tune/plan.py); same bool normalization as compile_cache
+        out.strategy_plan = _keyword_or_path(
+            "strategy_plan", out.strategy_plan, ("auto", "off"),
+            "a path to a saved PLAN_*.json strategy record",
+            json_record=True, bool_words=("off", "auto"))
+        if out.capacity_headroom and out.capacity_headroom < 1.0:
             raise ValueError(
-                f"experimental.compile_cache: {out.compile_cache!r} "
-                "is neither 'auto', 'off', nor a cache directory "
-                "path")
-        if out.compile_cache not in ("auto", "off") and not (
-                os.sep in out.compile_cache
-                or out.compile_cache.startswith((".", "~", "/"))):
-            # cache directories always look like paths — anything
-            # else is a typo'd mode ("atuo", "on", ...) that would
-            # otherwise silently become a directory named after the
-            # typo (the capacity_plan rule, applied to a dir knob)
+                "experimental.capacity_headroom must be 0 (planner "
+                "default) or >= 1.0 — padding below the measured "
+                "high-water mark would guarantee overflow re-plans")
+        if out.capacity_headroom and out.capacity_plan == "static":
             raise ValueError(
-                f"experimental.compile_cache: {out.compile_cache!r} "
-                "is neither 'auto', 'off', nor a cache directory "
-                "path (paths must contain a separator or start with "
-                "'./', '~', or '/')")
+                "experimental.capacity_headroom is set but "
+                "capacity_plan is 'static' — the headroom factor "
+                "only shapes planned capacities, so the knob would "
+                "be silently ignored")
         if out.compile_cache_cap_mb < 1:
             raise ValueError(
                 "experimental.compile_cache_cap_mb must be >= 1")

@@ -289,7 +289,32 @@ class Controller:
                         "capacities to plan",
                         cfg.experimental.capacity_plan)
                 policy_name = "hybrid"
+        self.strategy_plan = None
         if policy_name == "hybrid":
+            # strategy-plan adoption for the hybrid path
+            # (tune/plan.py): the judge batching knob is the plan
+            # space's hybrid member, so hybrid runs need an adoption
+            # path too. The plan identity is the device twin's
+            # workload fingerprint — a config without one (the
+            # NoDeviceTwin fallback's usual cause) has no plan to
+            # match and skips with a log line. policy="hybrid" makes
+            # the gates see the policy actually running, not the
+            # config's pre-fallback `tpu`.
+            if cfg.experimental.strategy_plan != "off":
+                from shadow_tpu.device.runner import (
+                    NoDeviceTwin,
+                    device_twin,
+                )
+                from shadow_tpu.tune import plan as planmod
+                try:
+                    twin = device_twin(self.sim)
+                    self.strategy_plan = planmod.adopt(
+                        cfg, twin, len(self.sim.hosts),
+                        policy="hybrid")
+                except NoDeviceTwin as e:
+                    log.info("strategy_plan: no device twin to "
+                             "fingerprint this workload (%s) — no "
+                             "plan adopted", e)
             # CPU host emulation + batched device network judgment
             # (worker.c:520-579's hot path on the accelerator)
             from shadow_tpu.device.judge import DeviceJudge
@@ -506,6 +531,7 @@ class Controller:
                 watchdog.stop()
         m.finalize()
         m.stats.end_time = stop
+        m.stats.strategy_plan = self.strategy_plan
         if m.net_judge is not None:
             j = m.net_judge
             log.info("hybrid perf: %d packets judged on device in %d "

@@ -103,6 +103,14 @@ class SimStats:
     # METRICS_* artifacts written. None with telemetry: off. bench.py
     # stamps the phase walls into its records from here.
     telemetry: Optional[dict] = None
+    # strategy-plan provenance (shadow_tpu/tune/plan.py adopt()):
+    # which PLAN record steered this run's execution knobs, the
+    # knobs actually applied, and the ones skipped (hand-set or
+    # inapplicable). None when experimental.strategy_plan resolved
+    # to nothing. bench.py stamps this into its records — plans
+    # change wall time only, so provenance is what keeps tuned and
+    # default records honestly comparable.
+    strategy_plan: Optional[dict] = None
 
     def merge(self, other: "SimStats") -> None:
         self.events_executed += other.events_executed

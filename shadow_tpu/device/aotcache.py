@@ -53,6 +53,7 @@ import hashlib
 import json
 import os
 import pickle
+import threading
 import time
 
 from shadow_tpu.obs import trace as obstrace
@@ -283,6 +284,11 @@ class AotCache:
         self.unsupported = not serialization_supported()
         self.store_disabled = (False if self.unsupported
                                else not self._dir_writable())
+        # background entry pre-reads (prefetch): key -> (thread,
+        # slot). A plan/re-plan names the next program before its
+        # first dispatch, so the entry's disk read + pickle parse can
+        # overlap the state-transfer work instead of blocking load()
+        self._prefetched: dict = {}
         if not self.unsupported:
             # executable serialization and jax's tracing cache do
             # not compose (see _set_tracing_cache) — whenever this
@@ -322,24 +328,97 @@ class AotCache:
     def entry_path(self, key: str) -> str:
         return os.path.join(self.directory, key + ENTRY_SUFFIX)
 
+    def _read_entry(self, key: str, path: str) -> dict:
+        """Read + structurally validate one entry file (raises on any
+        problem). Shared by the synchronous load path and the
+        prefetch thread, so the two can never disagree on what a
+        valid entry is."""
+        with open(path, "rb") as f:
+            entry = pickle.load(f)
+        if not isinstance(entry, dict) or \
+                entry.get("format") != FORMAT or \
+                entry.get("key") != key:
+            raise ValueError(
+                f"format {entry.get('format')!r} / key "
+                f"{entry.get('key')!r} (want {FORMAT}/{key})")
+        return entry
+
+    def prefetch(self, key: str, program: str = "") -> bool:
+        """Start a BACKGROUND read+parse of `key`'s entry so a later
+        :meth:`load` finds it in memory (supervise.prefetch_programs
+        — a plan or re-plan names the next program while the current
+        segment's work still runs). Purely a wall-time optimization:
+        the thread only reads bytes and validates structure; the
+        deserialize into a live executable stays on the calling
+        thread, and any prefetch failure silently falls back to the
+        synchronous path. Returns True when a read was started."""
+        if self.unsupported or key in self._prefetched:
+            return False
+        path = self.entry_path(key)
+        if not os.path.exists(path):
+            return False
+        # bound the prediction set: a re-plan that supersedes an
+        # unconsumed prefetch (repeated widen() cycles) must not pin
+        # each superseded entry's multi-MB payload until process
+        # exit — keep only the newest few, oldest first out
+        while len(self._prefetched) >= 4:
+            self._prefetched.pop(next(iter(self._prefetched)))
+        slot: dict = {"entry": None, "dur_s": 0.0}
+
+        def _read():
+            t0 = time.perf_counter()
+            try:
+                slot["entry"] = self._read_entry(key, path)
+            except Exception:   # noqa: BLE001 — load() retries + warns
+                pass
+            slot["dur_s"] = time.perf_counter() - t0
+
+        th = threading.Thread(target=_read, daemon=True,
+                              name=f"aot-prefetch-{key[:8]}")
+        self._prefetched[key] = (th, slot)
+        th.start()
+        # the instant is recorded from the CALLING thread (the
+        # tracer's attribution stacks are per-thread; a worker-thread
+        # span would misattribute nothing but also belongs nowhere)
+        obstrace.current().instant(
+            f"compile.prefetch:{program or key[:8]}", "compile",
+            key=key)
+        log.info("compile cache: prefetching %s entry %s in the "
+                 "background", program or "program",
+                 self.entry_path(key))
+        return True
+
+    def _take_prefetched(self, key: str):
+        """Collect a finished (or in-flight — joined; it is a local
+        file read) prefetch for `key`, or None."""
+        item = self._prefetched.pop(key, None)
+        if item is None:
+            return None
+        th, slot = item
+        th.join(timeout=60.0)
+        if th.is_alive():       # a wedged filesystem: fall back
+            return None
+        if slot["entry"] is not None:
+            log.info("compile cache: prefetched entry served for "
+                     "%s (%.3fs background read)", key,
+                     slot["dur_s"])
+        return slot["entry"]
+
     def load(self, key: str):
         """Deserialize-and-load the cached executable for `key`, or
         None on a miss. ANY failure on an existing entry (truncated
         pickle, format drift, a backend that cannot load the blob) is
         a warned miss — the caller recompiles and the store path
-        atomically overwrites the bad entry."""
+        atomically overwrites the bad entry. A background
+        :meth:`prefetch` of the same key feeds this path its already-
+        parsed entry."""
         path = self.entry_path(key)
-        if not os.path.exists(path):
+        entry = self._take_prefetched(key)
+        if entry is None and not os.path.exists(path):
             return None
         try:
-            with open(path, "rb") as f:
-                entry = pickle.load(f)
-            if not isinstance(entry, dict) or \
-                    entry.get("format") != FORMAT or \
-                    entry.get("key") != key:
-                raise ValueError(
-                    f"format {entry.get('format')!r} / key "
-                    f"{entry.get('key')!r} (want {FORMAT}/{key})")
+            if entry is None:
+                entry = self._read_entry(key, path)
             from jax.experimental import serialize_executable as se
 
             loaded = se.deserialize_and_load(

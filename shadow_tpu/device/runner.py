@@ -179,6 +179,19 @@ class DeviceRunner:
             log.warning("tpu policy: pcap capture requires a CPU "
                         "scheduler policy (packets are device-resident "
                         "metadata here)")
+        # strategy-plan adoption (shadow_tpu/tune/plan.py,
+        # docs/autotune.md): under experimental.strategy_plan a
+        # stored PLAN record for this workload fingerprint re-tunes
+        # the config's execution knobs BEFORE anything below reads
+        # them. Adoption changes wall time only — every plan-space
+        # knob is bit-identity-pinned — and a fingerprint mismatch
+        # refuses loudly inside adopt(). The provenance rides
+        # SimStats.strategy_plan so bench can stamp it.
+        from shadow_tpu.tune import plan as planmod
+        self.strategy_plan = planmod.adopt(
+            cfg, self.app, len(sim.hosts),
+            n_shards=(mesh.devices.size if mesh is not None
+                      else len(jax.devices())))
         # flow control blocks a host's pops when the outbox lacks a
         # full-burst (max_sends) of headroom; at OB == K that means one
         # event per phase, paying one collective exchange per event.
@@ -366,6 +379,10 @@ class DeviceRunner:
             self._adopt_checkpoint_caps(load_path)
             self.engine = self._build_engine()
             self._planned = True
+            # the adopted capacities name the resume program: its AOT
+            # entry read overlaps the checkpoint load that follows
+            from shadow_tpu.device import supervise
+            supervise.prefetch_programs(self)
             log.warning("capacity_plan: %s skipped — checkpoint_load "
                         "resumes with the saved engine's capacities "
                         "%s", mode, self._capacity_overrides)
@@ -432,6 +449,7 @@ class DeviceRunner:
             per_iter=self.engine.effective["M_out"],
             floor_iters=4 if self._burst > 1 else 8,
             n_shards=self.engine.n_shards,
+            headroom=self._headroom(),
             exchange=exchange)
         record["planned"] = planned
         record["static"] = static_knobs
@@ -439,8 +457,25 @@ class DeviceRunner:
         self._capacity_overrides = dict(planned)
         self.engine = self._build_engine()
         self._planned = True
-        log.info("capacity plan (%s, exchange %s): %s  [measured %s]",
-                 mode, exchange, planned, record["measured"])
+        # the planned program is now named: overlap its AOT cache
+        # entry read with the init_state / checkpoint-load work that
+        # follows (supervise.prefetch_programs)
+        from shadow_tpu.device import supervise
+        supervise.prefetch_programs(self)
+        log.info("capacity plan (%s, exchange %s, headroom %g): %s  "
+                 "[measured %s]", mode, exchange, self._headroom(),
+                 planned, record["measured"])
+
+    def _headroom(self) -> float:
+        """The capacity planner's pad factor: the tunable
+        experimental.capacity_headroom when set, else the planner
+        default. One accessor shared by the plan and the
+        exchange-choice estimates so they can never pad
+        differently."""
+        from shadow_tpu.device import capacity
+
+        return (self.sim.cfg.experimental.capacity_headroom
+                or capacity.HEADROOM)
 
     def _adopt_checkpoint_caps(self, load_path: str) -> None:
         """Checkpoint resume under a capacity plan: adopt the SAVED
@@ -481,7 +516,8 @@ class DeviceRunner:
         choice, info = capacity.choose_exchange(
             record, engine.n_shards,
             per_iter=engine.effective["M_out"],
-            floor_iters=4 if self._burst > 1 else 8)
+            floor_iters=4 if self._burst > 1 else 8,
+            headroom=self._headroom())
         record["exchange_auto"] = info
         self._exchange_choice = choice
         if engine.n_shards > 1:
@@ -714,6 +750,7 @@ class DeviceRunner:
         stats.end_time = t_end
         stats.rounds = int(rounds)
         stats.occupancy = self.occ_record
+        stats.strategy_plan = self.strategy_plan
         if self.aot_cache is not None:
             # loud hit/miss surface: the whole run's compile-cache
             # attribution (warm-up + planned + re-planned engines)

@@ -225,6 +225,33 @@ def heartbeat_rates(mark, sent_totals):
     return (wall, [float(s) for s in sent_totals]), rates
 
 
+def prefetch_programs(runner, ensemble: bool = False) -> None:
+    """Cache-aware prefetch (the PR 6 ROADMAP leftover): when a
+    capacity plan, a strategy plan, or a re-plan has just named the
+    next program — a rebuilt engine whose executable the AOT cache
+    may hold — start that entry's background read NOW, so the work
+    that runs before the next dispatch (state transfer, checkpoint
+    load, init_state) overlaps the disk read instead of the first
+    ``ensure()`` paying it synchronously. Best-effort: no cache, an
+    unsupported backend, or a fingerprinting failure is a silent
+    no-op (the synchronous path still serves). Traced as a
+    ``compile.prefetch`` instant."""
+    cache = getattr(runner, "aot_cache", None)
+    engine = getattr(runner, "engine", None)
+    if cache is None or engine is None or cache.unsupported:
+        return
+    program = "run_ens" if ensemble else "run"
+    if program in getattr(engine, "_aot_exec", {}):
+        return              # this engine already resolved it
+    from shadow_tpu.device import aotcache
+
+    try:
+        key = aotcache.program_key(engine, program)
+    except Exception:       # noqa: BLE001 — ensure() will warn
+        return
+    cache.prefetch(key, program=program)
+
+
 def drain_possible(cfg) -> bool:
     """Whether a run under this config ever reaches a segment
     boundary before its pause — the only points a preemption drain
@@ -550,6 +577,9 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
                              sim_t1=nxt, dims=list(dims),
                              replan=runner.replans):
                 runner.engine = runner._build_engine()
+                # the re-plan just named the next program: its AOT
+                # entry read overlaps the state transfer below
+                prefetch_programs(runner, ensemble)
                 state = replace_state(jax.device_get(good_state))
             good_state = state
             t = good_t
@@ -634,6 +664,9 @@ def _recover_state(runner, good_state, replace_state, ck, stop,
         # _build_engine) turns this recompile into a warm start:
         # same capacities -> same program key -> cached executable.
         runner.engine = runner._build_engine()
+        # overlap the rebuilt program's AOT entry read with the
+        # checkpoint reload below
+        prefetch_programs(runner, ensemble)
         template = (runner.engine.init_ensemble_state(runner.sim.starts)
                     if ensemble else None)
         state, _ = checkpoint.load_state(

@@ -254,6 +254,7 @@ class EnsembleRunner:
             per_iter=self.engine.effective["M_out"],
             floor_iters=4 if self._base._burst > 1 else 8,
             n_shards=self.engine.n_shards,
+            headroom=self._base._headroom(),
             exchange=exchange)
         record["planned"] = planned
         record["static"] = static_knobs
@@ -261,6 +262,10 @@ class EnsembleRunner:
         self._capacity_overrides = dict(planned)
         self.engine = self._build_engine()
         self._planned = True
+        # overlap the planned program's AOT entry read with the
+        # ensemble init/load work that follows
+        from shadow_tpu.device import supervise
+        supervise.prefetch_programs(self, ensemble=True)
         log.info("ensemble capacity plan (%s, exchange %s): %s  "
                  "[measured %s]", mode, exchange, planned,
                  record["measured"])
@@ -511,6 +516,9 @@ class EnsembleRunner:
         stats.end_time = t_end
         stats.rounds = int(rounds)
         stats.occupancy = self.occ_record
+        # the campaign shares the base runner's plan adoption (the
+        # one mutation site, before any engine was built)
+        stats.strategy_plan = self._base.strategy_plan
         if self.aot_cache is not None:
             self.aot_cache.publish(stats)
         stats.replans = self.replans

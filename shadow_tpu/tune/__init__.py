@@ -1,0 +1,21 @@
+"""Strategy autotuner (docs/autotune.md).
+
+Telemetry-driven search over the engine's execution-strategy knobs,
+per workload fingerprint, with the winner persisted next to the OCC
+records so production runs self-tune:
+
+* :mod:`shadow_tpu.tune.space`  — the declared registry of tunable
+  knobs (valid ranges, whether each reshapes the compiled program);
+* :mod:`shadow_tpu.tune.trials` — short bounded-sim-window trials
+  through the normal Controller/supervise path, warm via the AOT
+  cache, scored on pkts/s with the flight recorder's per-phase walls
+  as the diagnostic surface; coordinate descent with early stopping,
+  successive halving when the budget allows;
+* :mod:`shadow_tpu.tune.plan`   — ``PLAN_<app>_<H>_<fp>.json``
+  persistence and fingerprint-verified adoption
+  (``experimental.strategy_plan: auto|off|<path>``).
+
+The hard contract: a tuned plan changes WALL time only — every knob
+in the space is individually bit-identity-pinned, and the tuner
+preserves that compositionally (determinism_gate --tuned).
+"""

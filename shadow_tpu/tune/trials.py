@@ -1,0 +1,451 @@
+"""Trial harness: bounded-window runs that score a knob assignment.
+
+Each trial is one REAL run of the workload through the normal
+Controller path (device runs go through the supervise segmented-
+advance loop, hybrid runs through the Manager) with a bounded
+sim-time window, the candidate assignment applied via tune/space, and
+every artifact redirected into a scratch directory — a trial must
+never clobber the workload's production OCC/ENSEMBLE records or
+checkpoints. Trials are WARM via the persistent AOT compile cache
+(every trial process shares it), and the score subtracts the
+compile/plan walls the flight recorder attributes — a reshaping
+candidate must win on steady-state throughput, not lose on a one-time
+compile the cache amortizes away.
+
+Score: packets routed per second of scored wall. Diagnostics: the
+tracer's per-phase walls ride every ledger entry, so a losing
+candidate's record says WHERE it lost (dispatch vs judge vs exchange
+vs checkpoint).
+
+Safety: every trial's per-host signature must bit-match the
+default-assignment run of the same window — the knobs are all
+individually bit-identity-pinned, and this guard catches a
+compositional violation before a plan can be written from it. A
+diverging trial is disqualified loudly, never selected.
+
+Search strategies:
+
+* ``coordinate_descent`` — one knob at a time from the defaults,
+  free runtime knobs first, repeated passes until a pass yields no
+  improvement (early stopping) or the trial budget runs out;
+* ``successive_halving`` — the assignment grid raced on a short
+  window, top half survives to a doubled window, repeated to the
+  full window (the budget-allowing mode: many candidates, few long
+  runs).
+
+Either way the winner must beat the full-window default baseline by
+``min_gain`` or the plan keeps the defaults — a tuned plan is
+no-slower-than-defaults by construction.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import shutil
+import tempfile
+import time
+from dataclasses import dataclass, field
+
+from shadow_tpu.tune import space
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("tune")
+
+# candidate-grid cap for successive halving: past this the harness
+# falls back to single-knob variants (the grid is exponential in
+# knob count; the ladder is not)
+MAX_GRID = 48
+# minimum relative throughput gain before a candidate unseats the
+# incumbent (small windows are noisy; chasing <2% on them overfits)
+MIN_GAIN = 0.02
+
+
+@dataclass
+class TrialResult:
+    """One ledger entry: the assignment, its walls, and its score."""
+
+    knobs: dict
+    window_ns: int
+    ok: bool = False
+    wall_s: float = 0.0
+    score_wall_s: float = 0.0
+    packets: int = 0
+    pkts_per_s: float = 0.0
+    phases: dict = field(default_factory=dict)
+    signature: str = ""
+    error: str = ""
+
+    def ledger(self) -> dict:
+        """JSON-able trial record for the PLAN file."""
+        out = {"knobs": dict(self.knobs),
+               "window_ns": int(self.window_ns),
+               "ok": bool(self.ok),
+               "wall_s": round(self.wall_s, 3),
+               "score_wall_s": round(self.score_wall_s, 3),
+               "packets": int(self.packets),
+               "pkts_per_s": round(self.pkts_per_s, 1)}
+        if self.phases:
+            out["phases"] = self.phases
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+@contextlib.contextmanager
+def _scratch_artifacts(directory: str):
+    """Redirect every artifact a trial writes (OCC records, ENSEMBLE
+    records, telemetry files — all honor $SHADOW_TPU_OCC_DIR) into
+    the trial's scratch directory."""
+    prev = os.environ.get("SHADOW_TPU_OCC_DIR")
+    os.environ["SHADOW_TPU_OCC_DIR"] = directory
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("SHADOW_TPU_OCC_DIR", None)
+        else:
+            os.environ["SHADOW_TPU_OCC_DIR"] = prev
+
+
+def _signature(hosts) -> str:
+    """One digest over the per-host signature tuple — the same
+    surface the determinism gate compares."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for hh in hosts:
+        h.update(repr((hh.name, hh.trace_checksum, hh.events_executed,
+                       hh.packets_sent, hh.packets_dropped,
+                       hh.packets_delivered)).encode())
+    return h.hexdigest()[:16]
+
+
+def run_trial(config_path: str, assignment: dict, window_ns: int,
+              policy: str = "", workdir: str = "") -> TrialResult:
+    """One scored run. `assignment` covers EVERY tuned knob (the
+    harness always passes full assignments, so a ledger entry is
+    self-describing); `policy` overrides the config's scheduler
+    policy when set; `workdir` hosts the trial's data directory and
+    redirected artifacts (a private tmpdir when empty)."""
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+
+    res = TrialResult(knobs=dict(assignment), window_ns=int(window_ns))
+    own_tmp = not workdir
+    if own_tmp:
+        workdir = tempfile.mkdtemp(prefix="shadow_tpu_trial_")
+    try:
+        cfg = load_config(config_path)
+        if policy:
+            cfg.experimental.scheduler_policy = policy
+        cfg.general.stop_time = int(window_ns)
+        cfg.general.data_directory = os.path.join(workdir,
+                                                  "shadow.data")
+        # trials measure candidates, they never consume a plan (a
+        # stored plan would silently shift every trial's baseline)
+        cfg.experimental.strategy_plan = "off"
+        if cfg.experimental.telemetry == "off":
+            # the per-phase walls are the score's input and the
+            # ledger's diagnostic surface; summary mode adds no
+            # device work, so forcing it cannot perturb the trial
+            cfg.experimental.telemetry = "summary"
+        if cfg.experimental.checkpoint_save:
+            # checkpoint cadence is tunable, so supervision stays ON
+            # in trials — but pointed at scratch, never at the
+            # production rotation the config names
+            cfg.experimental.checkpoint_save = os.path.join(
+                workdir, "trial_ck.npz")
+        if cfg.experimental.checkpoint_load:
+            cfg.experimental.checkpoint_load = ""
+        space.apply_assignment(cfg, assignment)
+        t0 = time.perf_counter()
+        with _scratch_artifacts(workdir):
+            c = Controller(cfg)
+            stats = c.run()
+        res.wall_s = time.perf_counter() - t0
+        res.packets = int(stats.packets_sent)
+        res.signature = _signature(c.sim.hosts)
+        tel = stats.telemetry or {}
+        res.phases = dict(tel.get("phases") or {})
+        total = float(tel.get("total_wall_s") or res.wall_s)
+        # score on the steady-state wall: the compile and plan walls
+        # are one-time costs the AOT cache / saved OCC record
+        # amortize in production, and counting them would punish
+        # every reshaping candidate for being new
+        res.score_wall_s = max(
+            1e-9, total - res.phases.get("compile_s", 0.0)
+            - res.phases.get("plan_s", 0.0))
+        res.pkts_per_s = res.packets / res.score_wall_s
+        res.ok = bool(stats.ok) and not stats.preempted
+        if not stats.ok:
+            res.error = "run reported not-ok (overflow?)"
+    except Exception as e:      # noqa: BLE001 — a failed candidate is
+        # a disqualified ledger entry, never the end of the search
+        res.error = f"{type(e).__name__}: {e}"
+        log.warning("trial %s failed: %s", assignment, res.error)
+    finally:
+        if own_tmp:
+            shutil.rmtree(workdir, ignore_errors=True)
+    return res
+
+
+class Tuner:
+    """One search over one workload's plan space. Collects the full
+    trial ledger; ``search()`` returns the pieces tune/plan.py
+    persists."""
+
+    def __init__(self, config_path: str, window_ns: int = 0,
+                 budget: int = 24, min_gain: float = MIN_GAIN,
+                 policy: str = ""):
+        from shadow_tpu.config import load_config
+
+        self.config_path = config_path
+        self.cfg = load_config(config_path)
+        self.policy = policy or self.cfg.experimental.scheduler_policy
+        if self.policy not in ("tpu", "hybrid"):
+            # the plan space is device-side; serial/thread configs
+            # tune their device twin
+            self.policy = "tpu"
+        self.cfg.experimental.scheduler_policy = self.policy
+        self.stop = int(self.cfg.general.stop_time)
+        self.window = int(window_ns) or self.stop
+        self.window = min(self.window, self.stop)
+        self.budget = int(budget)
+        self.min_gain = float(min_gain)
+        self.ledger: list[TrialResult] = []
+        self._baselines: dict[int, TrialResult] = {}
+        n_shards = 0
+        if self.policy == "tpu":
+            from shadow_tpu._jax import jax
+            n_shards = len(jax.devices())
+        self.ctx = space.context(self.cfg, n_shards=n_shards)
+        self.ctx["stop"] = self.window
+        self.knobs = space.applicable(self.cfg, self.ctx)
+        self.base = space.current(self.cfg, self.knobs)
+
+    # -- bookkeeping ---------------------------------------------------
+    @property
+    def trials_run(self) -> int:
+        return len(self.ledger)
+
+    def _exhausted(self) -> bool:
+        return self.trials_run >= self.budget
+
+    def trial(self, assignment: dict, window_ns: int) -> TrialResult:
+        t = run_trial(self.config_path, assignment, window_ns,
+                      policy=self.policy)
+        base = self._baselines.get(window_ns)
+        if base is not None and t.ok and base.ok and \
+                t.signature != base.signature:
+            # the compositional bit-identity guard: every knob is
+            # individually trace-invariant, so a diverging combo is a
+            # bug — disqualify it loudly and keep searching
+            t.ok = False
+            t.error = ("trace diverged from the default-knob run — "
+                       "disqualified (a strategy knob must never "
+                       "change the simulation)")
+            log.error("trial %s DIVERGED from the default-knob "
+                      "signature at window %d ns", assignment,
+                      window_ns)
+        self.ledger.append(t)
+        log.info("trial %d/%d window=%.3gs %s -> %s",
+                 self.trials_run, self.budget, window_ns / 1e9,
+                 assignment,
+                 f"{t.pkts_per_s:,.0f} pkts/s" if t.ok else
+                 f"FAILED ({t.error})")
+        return t
+
+    def baseline(self, window_ns: int) -> TrialResult:
+        """The default-assignment reference for a window: the score
+        to beat AND the signature every candidate must reproduce."""
+        if window_ns not in self._baselines:
+            t = run_trial(self.config_path, dict(self.base),
+                          window_ns, policy=self.policy)
+            self._baselines[window_ns] = t
+            self.ledger.append(t)
+            log.info("baseline window=%.3gs %s -> %s",
+                     window_ns / 1e9, self.base,
+                     f"{t.pkts_per_s:,.0f} pkts/s" if t.ok else
+                     f"FAILED ({t.error})")
+        return self._baselines[window_ns]
+
+    # -- strategies ----------------------------------------------------
+    def grid(self) -> list[dict]:
+        """Deterministic candidate grid for successive halving: the
+        cross product of every applicable knob's ladder, or (past
+        MAX_GRID) the single-knob variants."""
+        ladders = [(k, k.candidates(self.cfg, self.ctx))
+                   for k in self.knobs]
+        n = 1
+        for _, cands in ladders:
+            n *= max(1, len(cands))
+        out = []
+        if n <= MAX_GRID:
+            names = [k.name for k, _ in ladders]
+            for combo in itertools.product(
+                    *[c for _, c in ladders]):
+                out.append(dict(zip(names, combo)))
+        else:
+            for k, cands in ladders:
+                for c in cands:
+                    if c != self.base[k.name]:
+                        out.append({**self.base, k.name: c})
+        # the defaults always race too (they are the floor)
+        if dict(self.base) not in out:
+            out.insert(0, dict(self.base))
+        return out
+
+    def coordinate_descent(self) -> dict:
+        """One knob at a time from the defaults (free knobs first),
+        best candidate per knob, repeated passes until no pass
+        improves (early stopping) or the budget is spent."""
+        current = dict(self.base)
+        best = self.baseline(self.window)
+        if not best.ok:
+            raise RuntimeError(
+                f"default-knob baseline failed: {best.error}")
+        for _ in range(3):                  # passes
+            improved = False
+            for knob in self.knobs:
+                if self._exhausted():
+                    break
+                cands = [c for c in knob.candidates(self.cfg, self.ctx)
+                         if c != current[knob.name]]
+                knob_best = None
+                for cand in cands:
+                    if self._exhausted():
+                        break
+                    t = self.trial({**current, knob.name: cand},
+                                   self.window)
+                    if t.ok and (knob_best is None
+                                 or t.pkts_per_s >
+                                 knob_best.pkts_per_s):
+                        knob_best = t
+                if knob_best is not None and knob_best.pkts_per_s > \
+                        best.pkts_per_s * (1 + self.min_gain):
+                    best = knob_best
+                    current = dict(knob_best.knobs)
+                    improved = True
+            if not improved or self._exhausted():
+                break
+        return current
+
+    def successive_halving(self, grid: list = None) -> dict:
+        """Race the grid on a quarter window, halve the field, double
+        the window, repeat to the full window."""
+        survivors = self.grid() if grid is None else grid
+        windows = [w for w in (self.window // 4, self.window // 2,
+                               self.window)
+                   if w >= 1]
+        if not windows:
+            windows = [self.window]
+        windows[-1] = self.window
+        ranked: list[tuple[dict, TrialResult]] = []
+        for i, w in enumerate(dict.fromkeys(windows)):
+            # the rung's signature reference AND score floor — a
+            # failed baseline would silently disable the divergence
+            # guard for the whole rung, so it is fatal here exactly
+            # as in coordinate_descent
+            if not self.baseline(w).ok:
+                raise RuntimeError(
+                    f"default-knob baseline failed at window "
+                    f"{w} ns: {self.baseline(w).error}")
+            ranked = []
+            for a in survivors:
+                if self._exhausted():
+                    break
+                t = (self._baselines[w] if a == self.base
+                     else self.trial(a, w))
+                if t.ok:
+                    ranked.append((a, t))
+            if not ranked:
+                break
+            ranked.sort(key=lambda at: -at[1].pkts_per_s)
+            keep = max(1, (len(ranked) + 1) // 2)
+            survivors = [a for a, _ in ranked[:keep]]
+            log.info("halving rung %d (window %.3gs): %d -> %d "
+                     "candidate(s)", i + 1, w / 1e9, len(ranked),
+                     len(survivors))
+        return survivors[0] if survivors else dict(self.base)
+
+    # -- entry ---------------------------------------------------------
+    def search(self, strategy: str = "auto") -> dict:
+        """Run the search; returns the PLAN record body (un-persisted
+        — scripts/tune.py and the gate add the workload stamp and
+        write it via tune/plan.py)."""
+        # one discarded warm-up run before any scored trial: the
+        # first run in a process pays backend init and other one-time
+        # costs the per-phase subtraction cannot see, and the
+        # baseline always runs first — without this it would lose to
+        # every later candidate by exactly that bias
+        run_trial(self.config_path, dict(self.base),
+                  max(1, self.window // 4), policy=self.policy)
+        if not self.knobs:
+            log.warning("plan space is empty for this run shape "
+                        "(policy %s, %d shard(s)) — writing a "
+                        "defaults-only plan", self.policy,
+                        self.ctx.get("n_shards", 0))
+            chosen, strategy_used = dict(self.base), "none"
+        else:
+            grid = self.grid()
+            if strategy == "auto":
+                # halving pays off when the budget can race a real
+                # grid through three rungs; otherwise descend
+                strategy = ("successive_halving"
+                            if self.budget >= 2 * len(grid)
+                            and len(grid) > 3
+                            else "coordinate_descent")
+            if strategy == "coordinate_descent":
+                chosen = self.coordinate_descent()
+            elif strategy == "successive_halving":
+                chosen = self.successive_halving(grid)
+            else:
+                raise ValueError(f"unknown search strategy "
+                                 f"{strategy!r}")
+            strategy_used = strategy
+        base_t = self.baseline(self.window)
+        if not base_t.ok:
+            # without a good full-window baseline there is no score
+            # floor and no signature reference — a plan must never
+            # be written from an unguarded search
+            raise RuntimeError(
+                f"default-knob baseline failed: {base_t.error}")
+        if chosen != self.base:
+            final = next((t for t in reversed(self.ledger)
+                          if t.ok and t.knobs == chosen
+                          and t.window_ns == self.window), None)
+            if final is None:
+                final = self.trial(dict(chosen), self.window)
+            if not final.ok or final.pkts_per_s <= \
+                    base_t.pkts_per_s * (1 + self.min_gain):
+                # no-slower-than-defaults by construction: a winner
+                # that cannot beat the full-window baseline by the
+                # margin is not a winner
+                log.info("tuned candidate %s did not beat the "
+                         "defaults at the full window (%.0f vs "
+                         "%.0f pkts/s) — keeping the defaults",
+                         chosen, final.pkts_per_s,
+                         base_t.pkts_per_s)
+                chosen, final = dict(self.base), base_t
+        else:
+            final = base_t
+        return {
+            "policy": self.policy,
+            "strategy": strategy_used,
+            "space": [k.name for k in self.knobs],
+            "default": dict(self.base),
+            "knobs": dict(chosen),
+            "improved": chosen != self.base,
+            "score": {
+                "pkts_per_s": round(final.pkts_per_s, 1),
+                "baseline_pkts_per_s": round(base_t.pkts_per_s, 1),
+                "speedup": round(
+                    final.pkts_per_s / base_t.pkts_per_s, 3)
+                if base_t.pkts_per_s else None,
+                "window_ns": self.window,
+                "trials": self.trials_run,
+            },
+            "trials": [t.ledger() for t in self.ledger],
+        }

@@ -1,0 +1,178 @@
+"""Direct unit tests for the hybrid DeviceJudge (device/judge.py).
+
+The batched device judge is the hybrid policy's hot path, and its
+batching THRESHOLD (`hybrid_judge_min_batch`) is the first concrete
+target of the strategy autotuner (the 0.96-1.52x regression rungs in
+BENCH_tpu.json) — yet until now the module had no isolated coverage:
+its correctness rode indirectly on the end-to-end hybrid suites.
+These tests pin the unit contracts the tuner leans on:
+
+* power-of-two bucket padding (a handful of compiled shapes, padding
+  never leaks into verdicts);
+* bit-identity with the CPU NetworkModel's per-packet judgment (same
+  threefry chain, same latency matrices) — the property that makes
+  the threshold a pure wall-time knob;
+* the bootstrap window (no drops before bootstrap_end);
+* the batch/packet counters each path maintains;
+* threshold ROUTING in a real hybrid run: min_batch 0 sends every
+  round to the device, a huge min_batch keeps every round on the
+  CPU, and the two traces are bit-identical.
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.netmodel import NetworkModel
+from shadow_tpu.device.judge import DeviceJudge, _MIN_BUCKET, _bucket
+from shadow_tpu.topology.graph import Topology
+
+GML_LOSSY = """graph [ directed 0
+  node [ id 0 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+  node [ id 1 bandwidth_down "100 Mbit" bandwidth_up "100 Mbit" ]
+  edge [ source 0 target 0 latency "10 ms" packet_loss 0.3 ]
+  edge [ source 0 target 1 latency "25 ms" packet_loss 0.3 ]
+  edge [ source 1 target 1 latency "10 ms" packet_loss 0.3 ]
+]"""
+
+
+def _judge_pair(seed: int = 7, bootstrap_end: int = 0):
+    topo = Topology.from_gml(GML_LOSSY)
+    hv = np.array([0, 0, 1, 1], dtype=np.int64)
+    nm = NetworkModel(topology=topo, host_vertex=hv, seed=seed,
+                      bootstrap_end=bootstrap_end)
+    dj = DeviceJudge(topo, hv, seed, bootstrap_end=bootstrap_end)
+    return nm, dj
+
+
+def _traffic(n: int, rng_seed: int = 0):
+    rng = np.random.default_rng(rng_seed)
+    now = rng.integers(1, 10_000_000_000, n).astype(np.int64)
+    src = rng.integers(0, 4, n).astype(np.int32)
+    dst = rng.integers(0, 4, n).astype(np.int32)
+    pseq = rng.integers(0, 1 << 20, n).astype(np.int32)
+    return now, src, dst, pseq
+
+
+def test_bucket_sizes_are_powers_of_two():
+    assert _bucket(1) == _MIN_BUCKET
+    assert _bucket(_MIN_BUCKET) == _MIN_BUCKET
+    assert _bucket(_MIN_BUCKET + 1) == 2 * _MIN_BUCKET
+    assert _bucket(1000) == 1024
+    assert _bucket(1025) == 2048
+    # a sweep of batch sizes maps to a handful of compiled shapes
+    shapes = {_bucket(n) for n in range(1, 5000, 37)}
+    assert all(b & (b - 1) == 0 for b in shapes)
+    assert len(shapes) <= 6
+
+
+@pytest.mark.parametrize("n", [1, 3, 255, 256, 257, 700])
+def test_batch_verdicts_bit_match_cpu_netmodel(n):
+    """The device batch must reproduce the CPU model's per-packet
+    verdicts exactly — drop roll AND deliver time — at every batch
+    size, including the pad boundaries (padding must never leak)."""
+    nm, dj = _judge_pair()
+    now, src, dst, pseq = _traffic(n, rng_seed=n)
+    delivered, deliver_time = dj.judge_batch(now, src, dst, pseq)
+    assert len(delivered) == len(deliver_time) == n
+    dropped_some = False
+    for i in range(n):
+        v = nm.judge(int(now[i]), int(src[i]), int(dst[i]),
+                     int(pseq[i]))
+        assert bool(delivered[i]) == v.delivered, i
+        assert int(deliver_time[i]) == v.deliver_time, i
+        dropped_some |= not v.delivered
+    if n >= 255:
+        # 30% loss: a lossless sample would mean the roll is dead
+        assert dropped_some
+
+
+def test_bootstrap_window_never_drops():
+    """Packets sent before bootstrap_end bypass the drop roll (the
+    reference's unlimited-bandwidth bootstrap), on both paths."""
+    boot = 5_000_000_000
+    nm, dj = _judge_pair(bootstrap_end=boot)
+    now, src, dst, pseq = _traffic(400, rng_seed=3)
+    now = now % boot            # everything inside the window
+    delivered, _ = dj.judge_batch(now, src, dst, pseq)
+    assert delivered.all()
+    v = nm.judge(int(now[0]), int(src[0]), int(dst[0]), int(pseq[0]))
+    assert v.delivered
+
+
+def test_batch_counters():
+    """judge_batch maintains the device-side counters only; the CPU
+    fallback counters belong to the manager's threshold branch."""
+    _, dj = _judge_pair()
+    for n in (10, 300):
+        dj.judge_batch(*_traffic(n))
+    assert dj.batches == 2
+    assert dj.packets == 310
+    assert dj.cpu_batches == 0 and dj.cpu_packets == 0
+
+
+def test_min_batch_constructor_plumbing():
+    topo = Topology.from_gml(GML_LOSSY)
+    hv = np.array([0, 1], dtype=np.int64)
+    dj = DeviceJudge(topo, hv, 1, min_batch=777)
+    assert dj.min_batch == 777
+
+
+PHOLD_HYBRID = """
+general:
+  stop_time: 1s
+  seed: 7
+network:
+  graph:
+    type: gml
+    inline: |
+{gml}
+experimental:
+  scheduler_policy: hybrid
+  hybrid_judge_min_batch: {min_batch}
+hosts:
+  left:
+    quantity: 6
+    network_node_id: 0
+    processes:
+    - path: model:phold
+      args: msgload=3 size=64
+      start_time: 10ms
+  right:
+    quantity: 6
+    network_node_id: 1
+    processes:
+    - path: model:phold
+      args: msgload=3 size=64
+      start_time: 10ms
+"""
+
+
+def _hybrid_run(min_batch: int):
+    gml = "\n".join("      " + ln for ln in GML_LOSSY.splitlines())
+    cfg = load_config_str(PHOLD_HYBRID.format(gml=gml,
+                                              min_batch=min_batch))
+    c = Controller(cfg)
+    stats = c.run()
+    assert stats.ok
+    sig = [(h.name, h.trace_checksum, h.events_executed,
+            h.packets_sent, h.packets_dropped) for h in c.sim.hosts]
+    return sig, c.manager.net_judge
+
+
+def test_threshold_routes_rounds_and_never_changes_traces():
+    """The tuner's contract for hybrid_judge_min_batch: 0 sends every
+    round to the device, a threshold above any round size keeps every
+    round on the CPU, and the two runs are bit-identical — the knob
+    moves WALL time only."""
+    sig_dev, j_dev = _hybrid_run(0)
+    assert j_dev.batches > 0
+    assert j_dev.cpu_batches == 0
+    assert j_dev.packets > 0
+
+    sig_cpu, j_cpu = _hybrid_run(10**9)
+    assert j_cpu.batches == 0
+    assert j_cpu.cpu_batches > 0
+    assert j_cpu.cpu_packets == j_dev.packets
+    assert sig_cpu == sig_dev
