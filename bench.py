@@ -316,7 +316,7 @@ def load(config_path: str, policy: str, stop_s: float):
                 "a path to a saved PLAN_*.json strategy record",
                 json_record=True)
         except ValueError as e:
-            raise SystemExit(f"BENCH_STRATEGY_PLAN: {e}")
+            raise SystemExit(f"BENCH_STRATEGY_PLAN: {e}") from e
     if policy == "tpu" and _tuned:
         cfg.experimental.pop_strategy = _tuned["pop_strategy"]
         cfg.experimental.burst_pops = _tuned["burst_pops"]

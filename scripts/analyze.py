@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""shadowlint driver — run the static determinism & cache-soundness
+passes (shadow_tpu/analyze) and enforce the suppression baseline.
+
+    python scripts/analyze.py                 # all three passes
+    python scripts/analyze.py --pass jaxpr    # one pass
+    python scripts/analyze.py --json out.json # machine-readable record
+    python scripts/analyze.py --fix-hints     # name the repair per finding
+    python scripts/analyze.py --write-baseline --reason "PR NN staging"
+
+Exit codes: 0 = clean (no non-baselined error findings),
+1 = new error findings (or stale suppressions under --strict-baseline),
+2 = analyzer crash.
+
+The jaxpr audit only TRACES programs (no compile, no dispatch), so
+the driver is safe to run anywhere; it forces a 4-device CPU mesh by
+default so cross-shard collectives actually lower (set XLA_FLAGS
+yourself to override). docs/static_analysis.md documents the pass
+taxonomy and the baseline workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# env before ANY jax import: the collective audit needs a multi-device
+# mesh, and this tool must never dial a real TPU just to trace
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from shadow_tpu import analyze
+    from shadow_tpu.analyze import findings as F
+
+    ap = argparse.ArgumentParser(
+        description="shadowlint: static determinism analysis")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=list(analyze.PASS_NAMES),
+                    help="run only this pass (repeatable); default "
+                         "all three")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the machine-readable findings record "
+                         "(the CI workflow artifact)")
+    ap.add_argument("--baseline", default=F.DEFAULT_BASELINE,
+                    help="suppression baseline file (default: the "
+                         "checked-in shadow_tpu/analyze/baseline.json)")
+    ap.add_argument("--fix-hints", action="store_true",
+                    help="print the named repair under each finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather the current findings into "
+                         "--baseline instead of failing on them")
+    ap.add_argument("--reason", default="",
+                    help="reason recorded with --write-baseline "
+                         "suppressions (required with it)")
+    ap.add_argument("--strict-baseline", action="store_true",
+                    help="also fail on stale suppressions (baseline "
+                         "hygiene for CI)")
+    args = ap.parse_args()
+    passes = args.passes or list(analyze.PASS_NAMES)
+
+    findings, walls = [], {}
+    for name in passes:
+        t0 = time.perf_counter()
+        found = analyze.run_pass(name)
+        walls[name] = time.perf_counter() - t0
+        print(f"pass {name}: {len(found)} finding(s) in "
+              f"{walls[name]:.1f}s")
+        findings.extend(found)
+
+    if args.write_baseline:
+        if not args.reason:
+            print("FAIL: --write-baseline requires --reason")
+            return 1
+        F.write_baseline(args.baseline, findings, args.reason)
+        print(f"baseline written: {args.baseline} "
+              f"({len(findings)} suppression(s))")
+        return 0
+
+    baseline = F.load_baseline(args.baseline)
+    new, suppressed, stale = F.apply_baseline(findings, baseline)
+    # a --pass subset run cannot judge the other passes' suppressions
+    # stale — their findings were never computed
+    ran = tuple(analyze.PASS_CODE_PREFIX[p] for p in passes)
+    stale = [s for s in stale if s["key"].startswith(ran)]
+    rec = F.record(findings, new, suppressed, stale, passes, walls)
+    if args.json:
+        d = os.path.dirname(os.path.abspath(args.json))
+        os.makedirs(d, exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(rec, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"findings record: {args.json}")
+
+    for f_ in new:
+        print(f_.format(fix_hints=args.fix_hints))
+    for s in suppressed:
+        print(f"suppressed: {s['key']} (reason: {s['reason']})")
+    for s in stale:
+        print(f"stale suppression: {s['key']} — the finding is gone; "
+              "remove it from the baseline")
+
+    errors = [f_ for f_ in new if f_.severity == F.SEV_ERROR]
+    rc = 0
+    if errors:
+        print(f"shadowlint: FAIL — {len(errors)} new error "
+              f"finding(s) ({len(new) - len(errors)} warning(s), "
+              f"{len(suppressed)} suppressed)")
+        rc = 1
+    elif stale and args.strict_baseline:
+        print(f"shadowlint: FAIL — {len(stale)} stale "
+              "suppression(s) under --strict-baseline")
+        rc = 1
+    else:
+        print(f"shadowlint: OK — {len(findings)} finding(s), "
+              f"{len(new)} new (warnings only), "
+              f"{len(suppressed)} suppressed, {len(stale)} stale")
+    return rc
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:              # noqa: BLE001 — CLI boundary
+        print(f"shadowlint: analyzer crash: {e}")
+        raise SystemExit(2) from e

@@ -563,6 +563,102 @@ def run_telemetry_gate(config: str) -> int:
         return rc
 
 
+def run_analyze_consistency_gate(config: str) -> int:
+    """Static-analysis consistency gate (shadow_tpu/analyze): the
+    collective registry Pass 1 audits against must match what the
+    RUNTIME engine reports, so the static allowlist can never
+    silently drift from the real program. Three cheap checks on the
+    config's device engine:
+
+    1. registry-vs-effective: ``engine.collective_registry()`` must
+       pin exactly the exchange variant and capacities
+       ``engine.effective{}`` resolved (mover primitive per variant,
+       CAP/CAP2 buffer dims);
+    2. the Pass-1 jaxpr audit of the built engine must come up clean
+       (and, on a multi-shard mesh, must SEE the registered mover in
+       the lowered program — registry says ppermute, program must
+       contain ppermute);
+    3. analyzer-perturbs-nothing: the config runs once, the audit
+       traces every program in-process, the config runs again — both
+       runs' per-host signatures must be bit-identical (the
+       --telemetry-style spot check; the audit only lowers, never
+       executes).
+    """
+    from shadow_tpu.analyze import jaxpr_audit
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+
+    with tempfile.TemporaryDirectory() as tmp:
+        os.environ.setdefault("SHADOW_TPU_OCC_DIR",
+                              os.path.join(tmp, "occ"))
+        cfg = load_config(config)
+        cfg.experimental.scheduler_policy = "tpu"
+        cfg.general.data_directory = os.path.join(
+            tmp, "probe", "shadow.data")
+        c = Controller(cfg)
+        if c.runner is None or c.runner.engine is None:
+            print("FAIL: config did not build a device engine "
+                  "(--analyze-consistency needs a tpu-policy device "
+                  "config)")
+            return 1
+        engine = c.runner.engine
+        eff = engine.effective
+        reg = engine.collective_registry()
+        rc = 0
+
+        # 1. registry <-> effective{}
+        mover = jaxpr_audit.EXCHANGE_MOVER.get(eff["exchange"])
+        if mover is None:
+            print(f"FAIL: effective exchange {eff['exchange']!r} has "
+                  "no registered mover mapping")
+            rc = 1
+        elif engine.n_shards > 1 and mover not in reg:
+            print(f"FAIL: effective exchange {eff['exchange']!r} "
+                  f"needs mover {mover!r} but the collective "
+                  f"registry only pins {sorted(reg)}")
+            rc = 1
+        caps_want = {"all_to_all": (eff["CAP"],),
+                     "two_phase": (eff["CAP"], eff["CAP2"])}
+        want = caps_want.get(eff["exchange"])
+        if engine.n_shards > 1 and want is not None:
+            got = tuple(reg.get(mover, {}).get("caps") or ())
+            if got != tuple(int(x) for x in want):
+                print(f"FAIL: registry pins {mover} caps {got}, "
+                      f"effective says {want}")
+                rc = 1
+
+        # 2. the static audit of the real engine (traces only)
+        found = jaxpr_audit.audit_engine(engine, "gate")
+        errors = [f for f in found if f.severity == "error"]
+        for f in errors:
+            print(f"FAIL: {f.format()}")
+        rc = rc or (1 if errors else 0)
+
+        # 3. bit-identity across an in-process audit: run, audit,
+        # run again — the analyzer must perturb nothing
+        d1 = os.path.join(tmp, "run1", "shadow.data")
+        d2 = os.path.join(tmp, "run2", "shadow.data")
+        sig1, stats1 = run_once(config, "tpu", d1)
+        jaxpr_audit.audit_engine(engine, "gate-again")
+        sig2, _ = run_once(config, "tpu", d2)
+        if sig1 != sig2:
+            rc = 1
+            print("FAIL: per-host signatures differ across an "
+                  "in-process jaxpr audit — the analyzer perturbed "
+                  "the run")
+            for a, b in zip(sig1, sig2):
+                if a != b:
+                    print(f"  {a[0]}: {a[1:]} != {b[1:]}")
+
+        if rc == 0:
+            print(f"analyze-consistency OK: {config} (exchange "
+                  f"{eff['exchange']}, registry caps match "
+                  f"CAP={eff['CAP']}/CAP2={eff['CAP2']}, engine "
+                  f"audit clean, {stats1.events_executed} events "
+                  "bit-identical across an in-process audit)")
+        return rc
+
+
 def run_tuned_gate(config: str) -> int:
     """Strategy-autotuner gate (shadow_tpu/tune/): a tuned plan must
     change WALL time only. Three legs against one config (tpu
@@ -739,12 +835,30 @@ def main() -> int:
                          "record and a composed adversarial plan "
                          "must both bit-match the default-knob run "
                          "(a tuned plan changes wall time only)")
+    ap.add_argument("--analyze-consistency", action="store_true",
+                    help="static-analysis consistency gate: the "
+                         "collective registry shadowlint audits "
+                         "against must match engine.effective{} at "
+                         "runtime, the engine's jaxpr audit must be "
+                         "clean, and an in-process audit must leave "
+                         "run signatures bit-identical")
     args = ap.parse_args()
 
     default_policy = "serial,tpu" if args.ensemble else "serial"
     policies = [p.strip()
                 for p in (args.policy or default_policy).split(",")
                 if p.strip()]
+
+    if args.analyze_consistency:
+        if args.ensemble or args.preempt or args.policy or \
+                args.compile_cache or args.telemetry or args.tuned:
+            # this gate runs the standalone tpu policy around an
+            # in-process audit by construction
+            print("FAIL: --analyze-consistency does not combine "
+                  "with --ensemble/--preempt/--policy/"
+                  "--compile-cache/--telemetry/--tuned")
+            return 1
+        return run_analyze_consistency_gate(args.config)
 
     if args.tuned:
         if args.ensemble or args.preempt or args.policy or \

@@ -178,7 +178,7 @@ def print_compare(a: dict, b: dict, name_a: str, name_b: str) -> None:
     keys = [f"{p}_s" for p in PHASES if f"{p}_s" in pa
             or f"{p}_s" in pb]
     keys += sorted((set(pa) | set(pb)) - set(keys))
-    print(f"flight-recorder comparison")
+    print("flight-recorder comparison")
     print(f"  A: {name_a}")
     print(f"  B: {name_b}")
     print()

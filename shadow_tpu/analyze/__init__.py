@@ -1,0 +1,69 @@
+"""shadowlint — static determinism & cache-soundness analysis.
+
+Shadow's bit-identity contract (serial == thread == hybrid == tpu,
+cached == fresh, replica i == standalone i) is enforced at runtime by
+the determinism gate; this package is the STATIC half of that
+enforcement — three passes that prove the properties the gates can
+only spot-check, without executing a single simulated event:
+
+* **Pass 1 — jaxpr audit** (:mod:`.jaxpr_audit`): trace every
+  dispatchable device program (``engine.lowerable_programs()``) and
+  walk the ClosedJaxprs for (a) non-scalar closure constants not
+  threaded through the traced ``wrld`` tuple — a leaked world value
+  is a stale-cache and broken-ensemble hazard, (b) primitives outside
+  a pinned allowlist of known-deterministic ops, and (c) cross-shard
+  collectives whose axis or buffer capacity is not in the engine's
+  ``collective_registry()``.
+* **Pass 2 — fingerprint completeness** (:mod:`.imports_audit`): an
+  import-graph walk from the engine's trace roots computes the set of
+  modules whose source can shape a compiled program and requires it
+  to be a subset of the AOT cache's code-digest list
+  (``aotcache.CODE_DIGEST_MODULES``) — the digest list stops being
+  hand-maintained and becomes machine-checked.
+* **Pass 3 — concurrency lint** (:mod:`.concurrency`): an AST pass
+  over the host-side layers that flags writes to registered
+  shared-mutable state outside ``with <lock>`` regions, seeded from a
+  declared lock registry — the ``_streams`` bug class.
+
+All passes share one findings format (:mod:`.findings`) with
+severities, a checked-in baseline for grandfathered findings (new
+findings fail, suppressed ones are listed with reasons), and a
+``--fix-hints`` mode that names the repair. Driver:
+``scripts/analyze.py``; docs: ``docs/static_analysis.md``.
+"""
+
+from shadow_tpu.analyze.findings import (          # noqa: F401
+    Finding,
+    SEV_ERROR,
+    SEV_WARNING,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+PASS_NAMES = ("jaxpr", "digest", "concurrency")
+
+# finding-code prefix per pass (findings.CODES blocks): stale-
+# suppression detection must only consider codes whose pass actually
+# ran — a --pass subset run cannot know whether the other passes'
+# suppressed findings still exist
+PASS_CODE_PREFIX = {"jaxpr": "SL1", "digest": "SL2",
+                    "concurrency": "SL3"}
+
+
+def run_pass(name: str) -> list:
+    """Run one named pass and return its findings list."""
+    if name == "jaxpr":
+        from shadow_tpu.analyze import jaxpr_audit
+
+        return jaxpr_audit.run()
+    if name == "digest":
+        from shadow_tpu.analyze import imports_audit
+
+        return imports_audit.run()
+    if name == "concurrency":
+        from shadow_tpu.analyze import concurrency
+
+        return concurrency.run()
+    raise ValueError(
+        f"unknown pass {name!r} (choose from {PASS_NAMES})")

@@ -1,0 +1,168 @@
+"""Findings model, JSON record, and suppression baseline.
+
+Every pass emits :class:`Finding` rows. A finding's identity for
+baselining is ``code:path:obj`` — deliberately WITHOUT the line
+number, so an unrelated edit shifting lines never invalidates a
+suppression, while the finding moving to a different symbol (a new
+instance of the same bug class) correctly reads as NEW.
+
+The baseline file grandfathers known findings: each suppression
+carries a human reason, new findings fail the run, and suppressions
+that no longer match anything are reported stale (warning) so the
+baseline cannot quietly rot. The shipped baseline
+(``shadow_tpu/analyze/baseline.json``) is EMPTY — the tree passes all
+three passes clean; the mechanism exists for downstream forks and for
+staging multi-PR cleanups.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+# finding codes, one block of ten per pass:
+#   SL10x  jaxpr audit       (leaked const / primitive / collective)
+#   SL20x  fingerprint completeness (digest-list subset walk)
+#   SL30x  concurrency lint  (unlocked shared-state writes)
+CODES = {
+    "SL101": "non-scalar closure constant not threaded through wrld",
+    "SL102": "primitive outside the pinned deterministic allowlist",
+    "SL103": "cross-shard collective outside the engine's registry",
+    "SL104": "expected exchange collective missing from the program",
+    "SL105": "allowed constant lacks its const-ok suppression comment",
+    "SL201": "trace-shaping module missing from the code-digest list",
+    "SL202": "digested module not reachable from the trace roots",
+    "SL203": "module is both digested and declared a value boundary",
+    "SL301": "write to registered shared state outside its lock",
+    "SL302": "module-level mutable written without any lock",
+}
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+
+@dataclass
+class Finding:
+    code: str                  # SLxxx (CODES above)
+    severity: str              # SEV_ERROR | SEV_WARNING
+    path: str                  # repo-relative file, or a program id
+    obj: str                   # symbol / program / module concerned
+    message: str
+    hint: str = ""             # the named repair (--fix-hints)
+    line: int = 0              # 0 = not a source-line finding
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.code}:{self.path}:{self.obj}"
+
+    def format(self, fix_hints: bool = False) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        s = f"{loc}: {self.code} [{self.severity}] {self.message}"
+        if fix_hints and self.hint:
+            s += f"\n    fix: {self.hint}"
+        return s
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["key"] = self.key
+        return d
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> dict:
+    """Read the suppression baseline; a missing file is an empty
+    baseline (the shipped default is empty anyway). A malformed file
+    is a hard error — silently ignoring a corrupt baseline would turn
+    every grandfathered finding into a fresh CI failure (or worse,
+    vice versa)."""
+    if not os.path.exists(path):
+        return {"version": BASELINE_VERSION, "suppressions": []}
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or \
+            data.get("version") != BASELINE_VERSION or \
+            not isinstance(data.get("suppressions"), list):
+        raise ValueError(
+            f"baseline {path}: expected "
+            f'{{"version": {BASELINE_VERSION}, "suppressions": '
+            f'[...]}}, got {str(data)[:120]!r}')
+    for s in data["suppressions"]:
+        if not isinstance(s, dict) or "key" not in s or \
+                not s.get("reason"):
+            raise ValueError(
+                f"baseline {path}: every suppression needs a key AND "
+                f"a non-empty reason; bad entry {s!r}")
+    return data
+
+
+def write_baseline(path: str, findings: list[Finding],
+                   reason: str) -> dict:
+    """Grandfather `findings` into a fresh baseline at `path` (the
+    --write-baseline flow). One shared reason per batch: a baseline
+    refresh is a deliberate, reviewed act, and the reason should say
+    which PR staged the cleanup."""
+    from shadow_tpu.utils.artifacts import atomic_write_json
+
+    data = {
+        "version": BASELINE_VERSION,
+        "suppressions": [
+            {"key": f.key, "reason": reason,
+             "message": f.message}
+            for f in sorted(findings, key=lambda f: f.key)
+        ],
+    }
+    atomic_write_json(data, path)
+    return data
+
+
+def apply_baseline(findings: list[Finding], baseline: dict
+                   ) -> tuple[list[Finding], list[dict], list[dict]]:
+    """Split `findings` against the baseline: returns
+    (new_findings, suppressed, stale_suppressions) where suppressed
+    pairs each matched finding with its recorded reason and stale
+    lists suppressions that matched nothing (the baseline should
+    shrink when the underlying finding is fixed)."""
+    sup = {s["key"]: s for s in baseline.get("suppressions", [])}
+    new, suppressed = [], []
+    hit = set()
+    for f in findings:
+        if f.key in sup:
+            hit.add(f.key)
+            suppressed.append({"key": f.key,
+                               "reason": sup[f.key]["reason"],
+                               "message": f.message})
+        else:
+            new.append(f)
+    stale = [s for k, s in sorted(sup.items()) if k not in hit]
+    return new, suppressed, stale
+
+
+def record(findings: list[Finding], new: list[Finding],
+           suppressed: list[dict], stale: list[dict],
+           passes: list[str], walls: dict) -> dict:
+    """The machine-readable run record (scripts/analyze.py --json;
+    uploaded as the CI workflow artifact)."""
+    errors = [f for f in new if f.severity == SEV_ERROR]
+    return {
+        "version": 1,
+        "tool": "shadowlint",
+        "passes": list(passes),
+        "pass_walls_s": {k: round(v, 3) for k, v in walls.items()},
+        "findings": [f.to_dict() for f in findings],
+        "new": [f.key for f in new],
+        "suppressed": suppressed,
+        "stale_suppressions": stale,
+        "counts": {
+            "total": len(findings),
+            "new_errors": len(errors),
+            "new_warnings": len(new) - len(errors),
+            "suppressed": len(suppressed),
+            "stale_suppressions": len(stale),
+        },
+        "ok": not errors,
+    }

@@ -1,0 +1,169 @@
+"""Pass 2 — fingerprint completeness of the AOT cache's code digest.
+
+The compile cache (device/aotcache.py) keys serialized executables on
+a digest of the engine-side source modules that shape the traced
+programs. PR 6's review rounds grew that list by hand three times as
+reviewers found modules it missed; this pass replaces the hand
+maintenance with a machine check:
+
+* start from ``aotcache.CODE_DIGEST_ROOTS`` (the engine's trace
+  path) and walk STATIC imports (every ``import`` / ``from``
+  statement anywhere in the module, function-level included — the
+  engine imports capacity helpers and model_nic constants inside
+  ``_build_program``), restricted to the repo's own package;
+* stop at ``aotcache.CODE_DIGEST_BOUNDARY`` modules — each declares
+  WHY its source need not be digested (its trace-relevant outputs are
+  fingerprinted BY VALUE elsewhere in the cache key: program_facts,
+  app_fingerprint, backend_signature) — and do not follow their
+  imports;
+* every reached non-boundary module must be in
+  ``aotcache.CODE_DIGEST_MODULES`` (SL201, error): adding a traced
+  helper module without digesting it fails CI loudly, and deleting a
+  digested module the walk still reaches fails the same way;
+* a digested module the walk cannot reach is reported stale (SL202,
+  warning), and a module both digested and boundary-declared is a
+  contradiction (SL203, error).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from shadow_tpu.analyze.findings import (
+    SEV_ERROR,
+    SEV_WARNING,
+    Finding,
+)
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("analyze")
+
+
+def default_pkg_roots() -> dict:
+    import shadow_tpu
+
+    return {"shadow_tpu":
+            os.path.dirname(os.path.abspath(shadow_tpu.__file__))}
+
+
+def module_file(name: str, pkg_roots: dict) -> str | None:
+    """Resolve a dotted module name to its source file under the
+    registered package roots (no imports executed)."""
+    parts = name.split(".")
+    root = pkg_roots.get(parts[0])
+    if root is None:
+        return None
+    base = os.path.join(root, *parts[1:])
+    for cand in (base + ".py", os.path.join(base, "__init__.py")):
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def static_imports(name: str, pkg_roots: dict) -> set[str]:
+    """Every in-package module `name` statically imports, at any
+    nesting level (module top, function bodies, method bodies)."""
+    path = module_file(name, pkg_roots)
+    if path is None:
+        return set()
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    prefixes = tuple(pkg_roots)
+    out: set[str] = set()
+
+    def _add(mod: str):
+        if mod.split(".")[0] in prefixes:
+            out.add(mod)
+
+    pkg_parts = name.split(".")
+    is_pkg = path.endswith("__init__.py")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                _add(a.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # relative import: resolve against this module's pkg
+                base = pkg_parts[:len(pkg_parts) - node.level
+                                 + (1 if is_pkg else 0)]
+                mod = ".".join(base + ([node.module]
+                                       if node.module else []))
+            else:
+                mod = node.module or ""
+            if mod:
+                _add(mod)
+            for a in node.names:
+                # "from X import Y" where X.Y is itself a module
+                cand = f"{mod}.{a.name}" if mod else a.name
+                if module_file(cand, pkg_roots):
+                    _add(cand)
+    return out
+
+
+def reachable(roots, boundary, pkg_roots) -> dict[str, str]:
+    """Transitive import closure from `roots`, pruned at `boundary`
+    modules (reached, recorded, but not followed). Returns
+    module -> importer (for the finding message)."""
+    via: dict[str, str] = {m: "<root>" for m in roots}
+    work = [m for m in roots if m not in boundary]
+    while work:
+        mod = work.pop()
+        for imp in sorted(static_imports(mod, pkg_roots)):
+            if imp in via:
+                continue
+            via[imp] = mod
+            if imp not in boundary:
+                work.append(imp)
+    return via
+
+
+def run(roots=None, boundary=None, digest=None,
+        pkg_roots=None, rel_prefix: str = "") -> list[Finding]:
+    """The digest-completeness check. All knobs are injectable so the
+    test fixtures can run the identical logic over a scratch
+    package tree."""
+    from shadow_tpu.device import aotcache
+
+    roots = tuple(roots if roots is not None
+                  else aotcache.CODE_DIGEST_ROOTS)
+    boundary = dict(boundary if boundary is not None
+                    else aotcache.CODE_DIGEST_BOUNDARY)
+    digest = set(digest if digest is not None
+                 else aotcache.CODE_DIGEST_MODULES)
+    pkg_roots = pkg_roots or default_pkg_roots()
+    path = "shadow_tpu/device/aotcache.py" if not rel_prefix \
+        else rel_prefix
+
+    via = reachable(roots, set(boundary), pkg_roots)
+    required = {m for m in via if m not in boundary}
+    out = []
+    for m in sorted(required - digest):
+        out.append(Finding(
+            code="SL201", severity=SEV_ERROR, path=path, obj=m,
+            message=(f"{m} is reachable from the engine trace path "
+                     f"(via {via[m]}) but absent from "
+                     "CODE_DIGEST_MODULES — an edit there would NOT "
+                     "invalidate cached executables"),
+            hint=("add it to _CODE_DIGEST_FILES "
+                  "(aotcache.CODE_DIGEST_MODULES), or declare it in "
+                  "CODE_DIGEST_BOUNDARY with the reason its values "
+                  "are fingerprinted elsewhere")))
+    for m in sorted(digest - set(via)):
+        out.append(Finding(
+            code="SL202", severity=SEV_WARNING, path=path, obj=m,
+            message=(f"{m} is in CODE_DIGEST_MODULES but the import "
+                     "walk never reaches it from the trace roots — "
+                     "stale entry, or a root is missing"),
+            hint=("drop the stale digest entry, or add the new "
+                  "trace root to CODE_DIGEST_ROOTS")))
+    for m in sorted(digest & set(boundary)):
+        out.append(Finding(
+            code="SL203", severity=SEV_ERROR, path=path, obj=m,
+            message=(f"{m} is both digested and declared a value-"
+                     "fingerprint boundary — pick one"),
+            hint="remove it from one of the two lists"))
+    log.info("digest walk: %d module(s) reached, %d required, "
+             "%d digested, %d finding(s)", len(via), len(required),
+             len(digest), len(out))
+    return out

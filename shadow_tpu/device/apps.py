@@ -78,7 +78,13 @@ class DeviceApp:
 
     def _client_args_at(self, gid):
         """(count, pause_ns, retry_ns) gathered per host; padded
-        (out-of-range) hosts clip to the last entry — they are inert."""
+        (out-of-range) hosts clip to the last entry — they are inert.
+
+        shadowlint: const-ok(deliberately baked, not threaded through
+        wrld: every ndarray attribute of the app is hashed into the
+        cache key's workload_fp by capacity.app_fingerprint, and
+        ensemble vary axes never change app parameters — see
+        engine.audit_consts)"""
         cg = jnp.clip(gid, 0, len(self._count) - 1)
         return (jnp.asarray(self._count)[cg],
                 jnp.asarray(self._pause)[cg],

@@ -36,7 +36,6 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from functools import partial
 from typing import Optional
 
 import numpy as np
@@ -606,7 +605,13 @@ class DeviceEngine:
             MAX_SER_BYTES as MAX_SER,
         )
         from shadow_tpu.core.event import KIND_PACKET_READY
+        # shadowlint: const-ok(LAW is a constant table from
+        # host/model_nic.py, a CODE_DIGEST_MODULES member — an edit
+        # invalidates every cached executable via the code digest)
         law_t = jnp.asarray(LAW)                       # [1024] i64
+        # shadowlint: const-ok(the per-host bandwidth vectors are
+        # deliberately baked, not threaded through wrld — aotcache
+        # keys entries on their bw_digest under model_bandwidth)
         bw_up_t = jnp.asarray(self.bw_up)              # [H_pad] i64
         bw_down_t = jnp.asarray(self.bw_down)
         NSx8 = np.int64(8) * np.int64(1_000_000_000)
@@ -2253,6 +2258,160 @@ class DeviceEngine:
                 jax.device_put(jnp.asarray(k2), repl),
                 jax.device_put(jnp.asarray(self.epoch_times), repl))
         return self._world_dev
+
+    # ------------------------------------------------------------------
+    # static-analysis surface (shadow_tpu/analyze, scripts/analyze.py)
+    # ------------------------------------------------------------------
+    # The jaxpr audit needs to TRACE every dispatchable program
+    # without touching a device: these methods export the lowerable-
+    # program registry (name -> (jit fn, abstract args)) plus the
+    # collective registry (which cross-shard collectives this build is
+    # ALLOWED to contain, with the capacities their buffers are pinned
+    # to). determinism_gate --analyze-consistency cross-checks the
+    # registry against effective{} at runtime so the static allowlist
+    # cannot drift from the real program.
+    def state_structs(self) -> dict:
+        """jax.ShapeDtypeStruct pytree mirroring init_state's output —
+        the abstract argument surface for .trace()/.lower() with zero
+        device work (the analyzer must perturb nothing)."""
+        import numpy as _np
+
+        H, E = self.H_pad, self.config.event_capacity
+        S = self.n_shards
+
+        def sds(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+        out = {k: sds((H, E), _np.int64)
+               for k in ("ht", "hk", "hm", "hv", "hw")}
+        for k in ("head", "event_seq", "packet_seq", "app_seq",
+                  "n_exec", "n_sent", "n_drop", "n_deliv",
+                  "overflow", "x_overflow",
+                  "occ_heap", "occ_ob", "occ_in"):
+            out[k] = sds((H,), _np.int32)
+        out["chk"] = sds((H,), _np.int64)
+        out["app"] = sds((H, int(self.app.n_state_words)), _np.int32)
+        out["occ_x"] = sds((S, S), _np.int32)
+        out["occ_trips"] = sds((S,), _np.int32)
+        out["occ_phases"] = sds((S,), _np.int32)
+        if self.config.audit:
+            out["aud"] = sds((H,), _np.int32)
+            out["aud_t"] = sds((H,), _np.int64)
+            out["aud_tx"] = sds((H,), _np.int64)
+        if self.config.count_paths:
+            out["path_cnt"] = sds((S, self.n_vertices ** 2),
+                                  _np.int64)
+        if self.config.model_bandwidth:
+            for k in NIC_KEYS:
+                out[k] = sds((H,), _np.int64)
+        return out
+
+    def world_structs(self, ensemble: bool = False) -> tuple:
+        """Abstract twin of world() / ensemble_worlds_device()."""
+        import numpy as _np
+
+        if ensemble:
+            ens = self.ensemble
+            parts = (_np.asarray(ens.latency, _np.int32),
+                     _np.asarray(ens.reliability, _np.float32),
+                     _np.asarray(ens.seed_k1, _np.uint32),
+                     _np.asarray(ens.seed_k2, _np.uint32),
+                     _np.asarray(ens.epoch_times, _np.int64))
+            return tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
+                         for p in parts)
+        k1, k2 = self.seed_pair
+        parts = (self.latency, self.reliability,
+                 _np.asarray(k1), _np.asarray(k2), self.epoch_times)
+        return tuple(jax.ShapeDtypeStruct(_np.asarray(p).shape,
+                                          _np.asarray(p).dtype)
+                     for p in parts)
+
+    def lowerable_programs(self) -> dict:
+        """name -> (jit fn, abstract args) for every program the
+        engine dispatches — the same names the AOT cache keys on
+        ("run", "run_ens", "pop", "flush"), so the audit surface and
+        the cached surface cannot drift apart."""
+        import numpy as _np
+
+        s = self.state_structs()
+        hv = jax.ShapeDtypeStruct((self.H_pad,), _np.int32)
+        t = jax.ShapeDtypeStruct((), _np.int64)
+        ob = {f: jax.ShapeDtypeStruct(self._ob_shape_global,
+                                      _np.int64)
+              for f in ("t", "k", "m", "s", "v")}
+        w = self.world_structs()
+        progs = {
+            "run": (self._run, (s, hv, w, t, t)),
+            "pop": (self._pop_phase, (s, ob, hv, w, t)),
+            "flush": (self._flush_phase, (s, ob, hv, w, t)),
+        }
+        if self.ensemble is not None:
+            R = int(self.ensemble.R)
+            es = {k: jax.ShapeDtypeStruct((R,) + v.shape, v.dtype)
+                  for k, v in s.items()}
+            progs["run_ens"] = (
+                self._run_ens,
+                (es, hv, self.world_structs(ensemble=True), t, t))
+        return progs
+
+    def collective_registry(self) -> dict:
+        """The cross-shard collectives this build is allowed to lower
+        to: primitive name -> {"axis", "caps"} where caps pins the
+        trailing buffer dimension of the capacity-carrying movers
+        (None = shape not capacity-pinned: scalar reductions and
+        whole-outbox replication). Derived from the SAME resolved
+        config effective{} reports, so the runtime cross-check
+        (determinism_gate --analyze-consistency) is exact."""
+        eff = self.effective
+        reg = {
+            # axis_index / scalar all_gather reductions (_axis_min,
+            # the audit's _axis_sum64) exist on every mesh size
+            "axis_index": {"axis": AXIS, "caps": None},
+            "all_gather": {"axis": AXIS, "caps": None},
+        }
+        if self.n_shards > 1:
+            if eff["exchange"] == "all_to_all":
+                reg["all_to_all"] = {"axis": AXIS,
+                                     "caps": (int(eff["CAP"]),)}
+            elif eff["exchange"] == "two_phase":
+                reg["ppermute"] = {"axis": AXIS,
+                                   "caps": (int(eff["CAP"]),
+                                            int(eff["CAP2"]))}
+                # phase-2 loss attribution psum: the histogram is
+                # [H_pad]; the loss predicate is a scalar
+                reg["psum"] = {"axis": AXIS,
+                               "caps": (1, int(self.H_pad))}
+            # exchange == all_gather reuses the all_gather entry
+        return reg
+
+    def audit_consts(self) -> dict:
+        """The closure constants the jaxpr audit ACCEPTS in this
+        engine's traced programs, by value. Every entry must carry a
+        `# shadowlint: const-ok(reason)` comment at its capture site
+        in this file (the audit cross-checks), and its bytes must be
+        covered by the AOT cache key — via the code digest for
+        module-level tables, via bw_digest for the bandwidth
+        vectors. Anything else non-scalar captured by a trace is a
+        leaked world value (stale-cache + broken-ensemble hazard)."""
+        import numpy as _np
+
+        from shadow_tpu.host.model_nic import LAW
+
+        out = {"model_nic.LAW": _np.asarray(LAW)}
+        if self.config.model_bandwidth:
+            out["bw_up"] = _np.asarray(self.bw_up)
+            out["bw_down"] = _np.asarray(self.bw_down)
+        # per-host parameter arrays the app bakes into its traced
+        # handle() (tgen client count/pause/retry vectors, tor relay
+        # tables): capacity.app_fingerprint hashes EXACTLY the
+        # ndarray attributes of the app into the cache key's
+        # workload_fp, so using the same selection rule here makes
+        # the allowance fingerprint-covered by construction (a test
+        # pins that each array flips the fingerprint).
+        for k, v in sorted(vars(self.app).items()):
+            if isinstance(v, _np.ndarray):
+                out[f"app:{k}"] = v
+        return out
 
     def run(self, state: dict, stop: Optional[int] = None,
             final_stop: Optional[int] = None):

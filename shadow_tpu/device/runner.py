@@ -109,7 +109,7 @@ def device_twin(sim) -> DeviceApp:
                 except KeyError:
                     raise ValueError(
                         f"tgen client on {h.name}: unknown server "
-                        f"{h.app.server_name!r}")
+                        f"{h.app.server_name!r}") from None
         return TgenDevice(roles=roles, server_gid=server_gid,
                           size=first.size, count=count,
                           pause_ns=pause, retry_ns=retry)

@@ -136,10 +136,10 @@ def _edge_indices(top: Topology, ev: FaultEvent) -> list[int]:
     try:
         s = top.vertex_index_for_id(ev.source)
         d = top.vertex_index_for_id(ev.target)
-    except Exception:
+    except Exception as e:
         raise ValueError(
             f"network.faults: {ev.kind} at {ev.time} ns references "
-            f"unknown vertex id(s) {ev.source}->{ev.target}")
+            f"unknown vertex id(s) {ev.source}->{ev.target}") from e
     hit = [k for k in range(len(top.edge_src))
            if (top.edge_src[k] == s and top.edge_dst[k] == d)
            or (not top.directed

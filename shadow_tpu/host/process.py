@@ -78,7 +78,9 @@ def _disable_aslr_inheritable() -> None:
     cur = libc.personality(0xFFFFFFFF)
     if cur != -1:
         libc.personality(cur | ADDR_NO_RANDOMIZE)
-    _ASLR_OFF[0] = True
+    # monotonic once-latch: a racing double-set is idempotent and the
+    # personality() call it guards is too
+    _ASLR_OFF[0] = True  # shadowlint: unlocked-ok(idempotent latch)
 
 
 def elf_is_static(path: str) -> bool:

@@ -70,7 +70,7 @@ from shadow_tpu.host.process import (
     _NO_RESTART,
 )
 from shadow_tpu.host.memory import ProcessMemory
-from shadow_tpu.host.syscalls import (APPLIED, NATIVE, NR, NR_NAME,
+from shadow_tpu.host.syscalls import (APPLIED, NATIVE, NR_NAME,
                                       Blocked, FatalDivergence)
 from shadow_tpu.utils.slog import get_logger
 
@@ -860,7 +860,8 @@ class PtraceProcess(ManagedProcess):
             reply = self.tracer.replies.get(
                 timeout=RECV_TIMEOUT_MS / 1000)
         except queue.Empty:
-            raise RuntimeError("tracer unresponsive during clone")
+            raise RuntimeError(
+                "tracer unresponsive during clone") from None
         if reply[0] == "clone_fail":
             return reply[1]
         if reply[0] == "dead":
@@ -915,7 +916,8 @@ class PtraceProcess(ManagedProcess):
             reply = self.tracer.replies.get(
                 timeout=RECV_TIMEOUT_MS / 1000)
         except queue.Empty:
-            raise RuntimeError("tracer unresponsive during fork")
+            raise RuntimeError(
+                "tracer unresponsive during fork") from None
         if reply[0] == "clone_fail":
             return reply[1]
         if reply[0] == "dead":

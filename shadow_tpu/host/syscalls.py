@@ -1503,7 +1503,7 @@ class SyscallHandler:
                         getattr(desc, "is_fifo", False) and \
                         not desc.nonblock:
                     raise Blocked(deadline=ctx.now
-                                  + self._FIFO_POLL_NS)
+                                  + self._FIFO_POLL_NS) from None
                 return -e.errno
             if data:
                 self.mem.write(buf, data)
@@ -1542,7 +1542,7 @@ class SyscallHandler:
                         getattr(desc, "is_fifo", False) and \
                         not desc.nonblock:
                     raise Blocked(deadline=ctx.now
-                                  + self._FIFO_POLL_NS)
+                                  + self._FIFO_POLL_NS) from None
                 return -e.errno
         return -EINVAL
 
@@ -3801,17 +3801,18 @@ class SyscallHandler:
                 if got > 0 and st["deadline"] is None:
                     # no timeout: keep blocking for the next message
                     st["mm_got"] = got
-                    raise Blocked(b.descs)
+                    raise Blocked(b.descs) from None
                 if got > 0:
                     st["mm_got"] = got
-                    raise Blocked(b.descs, deadline=st["deadline"])
+                    raise Blocked(
+                        b.descs, deadline=st["deadline"]) from None
                 # first message: wait with no deadline even when the
                 # timeout already expired (kernel quirk — the timeout
                 # is only consulted after a datagram; a blocking empty
                 # socket waits regardless, nonblocking ones surfaced
                 # -EAGAIN from recvmsg above)
                 st["mm_got"] = 0
-                raise Blocked(b.descs)
+                raise Blocked(b.descs) from None
             if isinstance(r, int) and r < 0:
                 return r if got == 0 else got
             self.mem.write(mm + 56, struct.pack("<I", r))

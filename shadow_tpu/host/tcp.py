@@ -541,7 +541,7 @@ class TcpSocket(BaseSocket):
 
     # -- teardown ------------------------------------------------------
     def _handle_fin(self, hdr: TcpHeader, now: int) -> None:
-        fin_seq = hdr.seq + 0    # FIN occupies seq after any data
+        # the FIN occupies the seq slot after any data
         if hdr.seq > self.rcv_nxt:
             return               # out of order FIN; wait for data
         self.rcv_nxt = max(self.rcv_nxt, hdr.seq + 1)

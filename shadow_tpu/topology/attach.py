@@ -14,7 +14,6 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-import numpy as np
 
 from shadow_tpu.routing.address import ip_to_int
 from shadow_tpu.topology.graph import Topology

@@ -333,7 +333,9 @@ class Topology:
                 esrc[k] = id_to_idx[int(e.get("source"))]
                 edst[k] = id_to_idx[int(e.get("target"))]
             except KeyError as bad:
-                raise GmlError(f"edge references unknown vertex id {bad}")
+                raise GmlError(
+                    f"edge references unknown vertex id "
+                    f"{bad}") from bad
             lat = e.get("latency")
             if lat is None:
                 raise GmlError("edge missing required attribute 'latency'")

@@ -259,7 +259,8 @@ def apply_assignment(cfg, assignment: dict) -> dict:
             value = knob.coerce(raw)
         except (TypeError, ValueError) as e:
             raise ValueError(
-                f"strategy plan: invalid value for {name}: {e}")
+                f"strategy plan: invalid value for {name}: "
+                f"{e}") from e
         section = cfg.experimental if knob.section == "experimental" \
             else cfg.general
         setattr(section, knob.name, value)

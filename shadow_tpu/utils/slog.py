@@ -12,7 +12,7 @@ import logging
 import sys
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from shadow_tpu import simtime
 

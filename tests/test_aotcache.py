@@ -15,7 +15,6 @@ The subsystem's contract, pinned:
   (atomic tmp+rename — the loser's replace just lands second).
 """
 
-import json
 import os
 import pickle
 import subprocess

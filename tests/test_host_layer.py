@@ -1,7 +1,6 @@
 """DNS, CPU model, tracker heartbeats, pcap capture."""
 
 import logging
-import os
 import struct
 
 import pytest

@@ -86,7 +86,7 @@ def test_model_nic_codel_drops_standing_queue():
     # 1000-byte packets arriving every 1 ms but taking 10 ms to drain
     drops = 0
     t = 0
-    for i in range(400):
+    for _ in range(400):
         t += 1_000_000
         if nic.rx_deliver(t, 1000) < 0:
             drops += 1

@@ -56,7 +56,7 @@ def test_codel_drops_under_standing_queue():
     for i in range(500):
         q.enqueue(_pkt(i), 0)
     got, now = 0, 0
-    for i in range(500):
+    for _ in range(500):
         now += 2 * MS                   # sojourn grows far past target
         if q.dequeue(now) is not None:
             got += 1

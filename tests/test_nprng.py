@@ -9,7 +9,6 @@ from shadow_tpu.utils import nprng
 from shadow_tpu.utils.rng import (
     PURPOSE_PACKET_DROP,
     base_key,
-    packet_key,
     uniform01,
 )
 
@@ -33,8 +32,6 @@ def test_threefry_core_matches_jax():
 def test_seed_key_matches_prngkey():
     for seed in [0, 1, 42, 2**31 - 1, 2**32 + 17, 2**62 + 5]:
         jk = jax.random.PRNGKey(seed)
-        raw = jax.random.key_data(jax.random.wrap_key_data(
-            jnp.asarray(jk))) if hasattr(jax.random, "key_data") else jk
         ours = nprng.seed_key(seed)
         assert int(jk[0]) == int(ours[0]), seed
         assert int(jk[1]) == int(ours[1]), seed

@@ -11,7 +11,6 @@ import time
 
 import pytest
 
-from shadow_tpu.obs import perfetto
 from shadow_tpu.obs.trace import (
     NullTracer,
     PHASES,

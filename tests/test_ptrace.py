@@ -5,8 +5,6 @@ PTRACE_SYSEMU instead of the preload shim (the reference runs its
 shadow tests once per METHOD — src/test/CMakeLists.txt:36-60 — and so
 do we). Plus TSC emulation checks, which only exist on this backend."""
 
-import os
-
 import pytest
 
 from test_managed import (  # noqa: F401  (fixture re-export)
@@ -25,8 +23,6 @@ def ptrace_cfg(data_dir: str, stop: str = "30s") -> str:
 
 def _ptrace_works() -> bool:
     """PTRACE_TRACEME may be blocked in hardened sandboxes."""
-    import ctypes
-    import signal
     import subprocess
     try:
         p = subprocess.run(

@@ -20,7 +20,7 @@ import pytest
 from shadow_tpu.config import load_config_str
 from shadow_tpu.core.controller import Controller
 from shadow_tpu.host.tcp import TcpFlags, TcpSocket, TcpState
-from shadow_tpu.routing.packet import Packet, Protocol
+from shadow_tpu.routing.packet import Packet
 
 PLUGIN_DIR = os.path.join(os.path.dirname(__file__), "plugins")
 

@@ -5,9 +5,6 @@ circuit of REAL C processes forwarding through the emulated TCP stack
 judgments) and bit-compared against the pure-CPU oracle.
 """
 
-import os
-
-import pytest
 
 from shadow_tpu.config import load_config_str
 from shadow_tpu.core.controller import Controller

@@ -1,6 +1,5 @@
 """End-to-end simulations on the serial (oracle) policy."""
 
-from shadow_tpu import simtime
 from shadow_tpu.config import load_config_str
 from shadow_tpu.core.controller import Controller
 
@@ -44,7 +43,6 @@ def test_phold_runs_and_conserves_messages():
 
 
 def test_phold_deterministic():
-    cfg = load_config_str(PHOLD_YAML)
     t1, t2 = [], []
     Controller(load_config_str(PHOLD_YAML), trace=t1).run()
     Controller(load_config_str(PHOLD_YAML), trace=t2).run()
