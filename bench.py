@@ -435,6 +435,11 @@ def run_device(config_path: str, stop_s: float,
         stamp["phase_walls"] = stats.telemetry.get("phases")
         stamp["dominant_phase"] = stats.telemetry.get(
             "dominant_phase")
+    # segment-pipeline telemetry (supervise.advance): depth,
+    # issue/drain counts, sync wall, overlap efficiency — rides
+    # every device rung record so sync-bound vs device-bound wall
+    # is attributable from the BENCH record alone
+    stamp["pipeline"] = stats.pipeline
     # strategy-plan provenance (or its loud refusal) rides every
     # device rung record
     stamp.update(_plan_stamp(c, stats))
@@ -665,6 +670,144 @@ def run_ensemble_rung() -> dict:
     if not out["replica0_matches_single"]:
         out["error"] = "campaign replica 0 diverged from the " \
                        "standalone run with its seed"
+    return out
+
+
+PIPELINE_DEPTHS = (1, 2, 4)
+
+
+def run_pipelined_rung(name: str, config_path: str, stop_s: float
+                       ) -> dict:
+    """Pipelined-dispatch rung (device/supervise.py segment
+    pipeline): the headline workload in the SUPERVISED production
+    posture — rotating validated checkpoints, heartbeats, and the
+    state-audit word — at pipeline_depth 1/2/4 on one identical
+    config. Depth 1 is the serial issue-then-sync loop; deeper
+    windows overlap the drain's host-side boundary work (checkpoint
+    fetch+compress+write, heartbeat syncs, audit reads) with device
+    execution of the in-flight segments. Every depth must route
+    identical traffic (bit-identity is the gate's job; the rung
+    re-checks the cheap packet counters so a broken window can never
+    publish a number).
+
+    Honesty rules: all depths run WARM (one engine, compile excluded
+    from every timed window — the serial leg must not pay the audit
+    program's cold compile), and the record stamps host_cores:
+    overlap converts host-side wall into device-shadowed wall only
+    when the host and the device are separate hardware, so on a
+    single-core cpu-fallback box the depths measure flat and the
+    rung's real-TPU number is the one the ROADMAP campaign item
+    collects."""
+    import tempfile
+
+    from shadow_tpu import simtime
+    from shadow_tpu.core.controller import Controller
+
+    out: dict = {
+        "workload": name,
+        "slice_sim_s": stop_s,
+        "depths_swept": list(PIPELINE_DEPTHS),
+        "host_cores": len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity") else os.cpu_count(),
+        # the supervised posture (sim-seconds): segment/checkpoint/
+        # heartbeat cadences scale with the slice so the smoke rung
+        # and the full rung exercise the same boundary density
+        "dispatch_segment_s": round(stop_s / 20, 3),
+        "checkpoint_every_s": round(stop_s / 40, 3),
+        "heartbeat_s": round(stop_s / 10, 3),
+    }
+    engine = None
+    depths: dict = {}
+    pkts0 = None
+    with tempfile.TemporaryDirectory() as tmp:
+        for depth in PIPELINE_DEPTHS:
+            cfg = load(config_path, "tpu", stop_s)
+            # this rung measures PIPELINING, not planning: a
+            # BENCH_CAPACITY_PLAN=auto run would re-plan and rebuild
+            # the engine inside depth 1's timed window (and hand the
+            # stale engine to depths 2/4), breaking the one-warm-
+            # engine rule the depth comparison depends on — pin the
+            # static capacities for every depth instead
+            cfg.experimental.capacity_plan = "static"
+            cfg.experimental.capacity_warmup = 0
+            cfg.general.heartbeat_interval = simtime.from_seconds(
+                out["heartbeat_s"])
+            ddir = os.path.join(tmp, f"d{depth}")
+            os.makedirs(ddir, exist_ok=True)
+            cfg.general.data_directory = os.path.join(ddir,
+                                                      "shadow.data")
+            cfg.experimental.dispatch_segment = simtime.from_seconds(
+                out["dispatch_segment_s"])
+            cfg.experimental.checkpoint_save = os.path.join(ddir,
+                                                            "ck.npz")
+            cfg.experimental.checkpoint_every = simtime.from_seconds(
+                out["checkpoint_every_s"])
+            cfg.experimental.state_audit = True
+            cfg.experimental.pipeline_depth = depth
+            c = Controller(cfg)
+            if engine is None:
+                # compile once (the audit word changes the program,
+                # so the ladder's engine cache does not apply) and a
+                # boot-length warm dispatch, both outside every
+                # depth's timed window
+                from shadow_tpu._jax import jax
+                st = c.runner.engine.init_state(c.sim.starts)
+                t0 = time.perf_counter()
+                # run() is a pure async enqueue since PR 11: block
+                # explicitly, or the warm segment's device work
+                # would still be executing when depth 1's timed
+                # window opens (and be charged to the serial leg)
+                jax.block_until_ready(c.runner.engine.run(
+                    st, stop=simtime.from_seconds(0.001)))
+                out["compile_warm_s"] = round(
+                    time.perf_counter() - t0, 2)
+                engine = c.runner.engine
+            else:
+                c.runner.engine = engine
+                if getattr(engine, "aot_cache", None) is not None:
+                    c.runner.aot_cache = engine.aot_cache
+            t0 = time.perf_counter()
+            stats = c.run()
+            wall = time.perf_counter() - t0
+            if not stats.ok:
+                return {**out, "error":
+                        f"depth-{depth} run reported not-ok"}
+            if pkts0 is None:
+                pkts0 = stats.packets_sent
+            elif stats.packets_sent != pkts0:
+                # same config+seed at every depth must route the
+                # same traffic; a divergent window is a determinism
+                # bug, not a number worth publishing
+                return {**out, "error":
+                        f"depth {depth} routed {stats.packets_sent} "
+                        f"packets but depth 1 routed {pkts0} on the "
+                        "identical config"}
+            rec = {
+                "wall_s": round(wall, 2),
+                "pkts_per_s": round(stats.packets_sent / wall, 1),
+                "pipeline": dict(stats.pipeline or {}),
+            }
+            if stats.telemetry is not None:
+                rec["phase_walls"] = stats.telemetry.get("phases")
+                rec["dominant_phase"] = stats.telemetry.get(
+                    "dominant_phase")
+            depths[str(depth)] = rec
+            log(f"  depth {depth}: {wall:.2f}s wall, overlap "
+                f"{rec['pipeline'].get('overlap_efficiency', 0.0):.0%}"
+                f" ({rec['pipeline'].get('issued')} issued, sync "
+                f"{rec['pipeline'].get('sync_wall_s')}s)")
+    out["depths"] = depths
+    out["pkts"] = pkts0
+    w1 = depths[str(PIPELINE_DEPTHS[0])]["wall_s"]
+    wn = depths[str(PIPELINE_DEPTHS[-1])]["wall_s"]
+    out["wall_delta_vs_serial_pct"] = round(100.0 * (w1 - wn) / w1, 1)
+    if out["host_cores"] == 1:
+        out["note"] = (
+            "single-core host: the cpu-fallback 'device' and the "
+            "host share one core, so overlapped work cannot reduce "
+            "wall here — the flat depths are expected; the real-TPU "
+            "window (ROADMAP proof campaign) is where this rung's "
+            "overlap converts to wall")
     return out
 
 
@@ -964,6 +1107,7 @@ def main() -> int:
         # retry/compile/plan walls + the dominant phase
         result["phase_walls"] = f_stamp.get("phase_walls")
         result["dominant_phase"] = f_stamp.get("dominant_phase")
+        result["pipeline"] = f_stamp.get("pipeline")
         result["ladder"] = ladder
 
         if headline_path in _occ_records:
@@ -994,6 +1138,19 @@ def main() -> int:
         except Exception as e:          # noqa: BLE001
             result["multichip"] = {"error": str(e)}
             log(f"  multichip rung failed: {e}")
+            rc = 1
+
+        log(f"pipelined rung: {headline} at pipeline_depth "
+            f"{PIPELINE_DEPTHS} (supervised posture, warm)")
+        try:
+            result["pipelined"] = run_pipelined_rung(
+                headline, headline_path, full_stop)
+            log(f"  pipelined: {result['pipelined']}")
+            if "error" in result["pipelined"]:
+                rc = 1
+        except Exception as e:          # noqa: BLE001
+            result["pipelined"] = {"error": str(e)}
+            log(f"  pipelined rung failed: {e}")
             rc = 1
 
         log(f"ensemble rung: {ENSEMBLE_REPLICAS}-replica seed sweep "

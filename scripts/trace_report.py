@@ -39,6 +39,15 @@ from shadow_tpu.obs.trace import PHASES          # noqa: E402
 LEVERS = {
     "dispatch": "per-round dispatch latency dominates - the "
                 "pipelined/MPMD-overlap dispatch lever (ROADMAP)",
+    "dispatch.sync": "blocking waits for device results dominate - "
+                     "the run is device-bound; raise pipeline_depth "
+                     "so host-side boundary work overlaps device "
+                     "rounds (docs/operations.md#pipelining), or "
+                     "attack the round program itself",
+    "dispatch.issue": "host-side dispatch enqueue dominates - raise "
+                      "dispatch_segment (fewer, longer segments) or "
+                      "device_batch_rounds to batch more work per "
+                      "dispatch",
     "host": "host-side Python dominates - batch more work per "
             "dispatch (dispatch_segment), or move the workload to "
             "the device twin",
@@ -126,15 +135,15 @@ def print_report(m: dict, top: int = 0) -> None:
     print(f"total wall: {m['total_wall_s']:.3f}s over "
           f"{m.get('spans', '?')} span(s)")
     print()
-    print(f"  {'phase':<12} {'wall_s':>10} {'share':>7} {'spans':>7}")
-    print(f"  {'-' * 12} {'-' * 10} {'-' * 7} {'-' * 7}")
+    print(f"  {'phase':<14} {'wall_s':>10} {'share':>7} {'spans':>7}")
+    print(f"  {'-' * 14} {'-' * 10} {'-' * 7} {'-' * 7}")
     rows = sorted(phases.items(), key=lambda kv: -kv[1])
     for key, wall in rows:
         phase = key[:-2]
-        print(f"  {phase:<12} {wall:>10.3f} {wall / total:>6.1%} "
+        print(f"  {phase:<14} {wall:>10.3f} {wall / total:>6.1%} "
               f"{counts.get(phase, '-'):>7}")
-    print(f"  {'-' * 12} {'-' * 10} {'-' * 7} {'-' * 7}")
-    print(f"  {'sum':<12} {sum(phases.values()):>10.3f} "
+    print(f"  {'-' * 14} {'-' * 10} {'-' * 7} {'-' * 7}")
+    print(f"  {'sum':<14} {sum(phases.values()):>10.3f} "
           f"{sum(phases.values()) / total:>6.1%}")
     dom = m.get("dominant_phase") or rows[0][0][:-2]
     print()
@@ -144,6 +153,19 @@ def print_report(m: dict, top: int = 0) -> None:
     lever = LEVERS.get(dom)
     if lever:
         print(f"  -> {lever}")
+    pipe = (m.get("counters") or {}).get("pipeline")
+    if pipe:
+        # the pipelined-dispatch summary: how deep the window ran
+        # and how much host wall the in-flight segments overlapped
+        print(f"pipeline: depth {pipe.get('depth')}, "
+              f"{pipe.get('issued', '?')} issued / "
+              f"{pipe.get('drained', '?')} drained"
+              + (f" / {pipe['discarded']} discarded"
+                 if pipe.get("discarded") else "")
+              + f"; sync {pipe.get('sync_wall_s', 0.0):.3f}s, "
+              f"overlapped host {pipe.get('overlapped_host_s', 0.0):.3f}s "
+              f"-> overlap efficiency "
+              f"{pipe.get('overlap_efficiency', 0.0):.0%}")
     if m.get("dropped_spans"):
         print(f"note: {m['dropped_spans']} span(s) dropped from the "
               "in-memory list (JSONL log is complete)")
@@ -182,22 +204,22 @@ def print_compare(a: dict, b: dict, name_a: str, name_b: str) -> None:
     print(f"  A: {name_a}")
     print(f"  B: {name_b}")
     print()
-    print(f"  {'phase':<12} {'A_s':>10} {'B_s':>10} {'delta_s':>10} "
+    print(f"  {'phase':<14} {'A_s':>10} {'B_s':>10} {'delta_s':>10} "
           f"{'delta':>8}")
-    print(f"  {'-' * 12} {'-' * 10} {'-' * 10} {'-' * 10} {'-' * 8}")
+    print(f"  {'-' * 14} {'-' * 10} {'-' * 10} {'-' * 10} {'-' * 8}")
     rows = sorted(keys, key=lambda k: -(pa.get(k, 0.0)
                                         + pb.get(k, 0.0)))
     for key in rows:
         wa, wb = pa.get(key, 0.0), pb.get(key, 0.0)
         d = wb - wa
         rel = f"{d / wa:+.1%}" if wa > 0 else ("new" if wb else "-")
-        print(f"  {key[:-2]:<12} {wa:>10.3f} {wb:>10.3f} {d:>+10.3f} "
+        print(f"  {key[:-2]:<14} {wa:>10.3f} {wb:>10.3f} {d:>+10.3f} "
               f"{rel:>8}")
     ta = a.get("total_wall_s", 0.0)
     tb = b.get("total_wall_s", 0.0)
-    print(f"  {'-' * 12} {'-' * 10} {'-' * 10} {'-' * 10} {'-' * 8}")
+    print(f"  {'-' * 14} {'-' * 10} {'-' * 10} {'-' * 10} {'-' * 8}")
     rel = f"{(tb - ta) / ta:+.1%}" if ta > 0 else "-"
-    print(f"  {'total':<12} {ta:>10.3f} {tb:>10.3f} "
+    print(f"  {'total':<14} {ta:>10.3f} {tb:>10.3f} "
           f"{tb - ta:>+10.3f} {rel:>8}")
     ra, rb = _pkts_per_s(a), _pkts_per_s(b)
     print()
@@ -218,6 +240,14 @@ def print_compare(a: dict, b: dict, name_a: str, name_b: str) -> None:
     if dom_a and dom_b:
         print(f"dominant phase: A {dom_a} -> B {dom_b}"
               + ("" if dom_a == dom_b else "  <- shifted"))
+    pipe_a = (a.get("counters") or {}).get("pipeline")
+    pipe_b = (b.get("counters") or {}).get("pipeline")
+    if pipe_a or pipe_b:
+        def _pfmt(p):
+            return (f"depth {p.get('depth')} overlap "
+                    f"{p.get('overlap_efficiency', 0.0):.0%}"
+                    if p else "n/a")
+        print(f"pipeline: A {_pfmt(pipe_a)} -> B {_pfmt(pipe_b)}")
 
 
 def main() -> int:

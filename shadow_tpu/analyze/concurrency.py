@@ -50,6 +50,15 @@ LOCK_REGISTRY = {
     "shadow_tpu/core/netmodel.py": {
         "self.path_packets": "self._lock",
     },
+    # the segment pipeline's in-flight ring (PipelineWindow): the
+    # advance loop's issue/drain halves share it today from one
+    # thread (the lock is uncontended), but it is exactly the
+    # structure a future async drain worker would contend on —
+    # every mutation goes through the lock now so that refactor
+    # inherits a linted discipline instead of retrofitting one
+    "shadow_tpu/device/supervise.py": {
+        "self._ring": "self._lock",
+    },
 }
 
 # files the pass scans (the generic module-level rule applies to all
@@ -58,6 +67,7 @@ SCAN_GLOBS = (
     "shadow_tpu/core/manager.py",
     "shadow_tpu/core/controller.py",
     "shadow_tpu/core/netmodel.py",
+    "shadow_tpu/device/supervise.py",
     "shadow_tpu/host/*.py",
 )
 

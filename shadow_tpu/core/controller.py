@@ -423,6 +423,11 @@ class Controller:
                             "rounds": stats.rounds,
                             "retries": stats.retries,
                             "replans": stats.replans}
+                if stats.pipeline:
+                    # the METRICS record's overlap-efficiency line:
+                    # depth, issue/drain counts, sync wall, and the
+                    # host wall hidden behind in-flight device work
+                    counters["pipeline"] = dict(stats.pipeline)
             summary = self.tracer.finalize(
                 run_info={
                     "policy": self.cfg.experimental.scheduler_policy,

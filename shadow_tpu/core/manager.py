@@ -111,6 +111,12 @@ class SimStats:
     # change wall time only, so provenance is what keeps tuned and
     # default records honestly comparable.
     strategy_plan: Optional[dict] = None
+    # pipelined segment dispatch telemetry (device/supervise.py
+    # advance): depth, issued/drained/discarded segment counts, the
+    # wall blocked in dispatch.sync, the host wall overlapped with
+    # in-flight device work, and the overlap-efficiency share.
+    # None on CPU policies (no segment pipeline to report).
+    pipeline: Optional[dict] = None
 
     def merge(self, other: "SimStats") -> None:
         self.events_executed += other.events_executed

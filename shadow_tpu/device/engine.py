@@ -2413,6 +2413,18 @@ class DeviceEngine:
                 out[f"app:{k}"] = v
         return out
 
+    def host_vertex_device(self):
+        """The host->vertex table on device, replicated over the
+        mesh — cached like world(): run()/run_ensemble() dispatch
+        once per pipeline segment, and re-uploading the table on
+        every issue would tax each enqueue with a device_put for
+        nothing. The table is fixed at construction."""
+        if getattr(self, "_hv_dev", None) is None:
+            repl = NamedSharding(self.mesh, self._repl_spec)
+            self._hv_dev = jax.device_put(
+                jnp.asarray(self.host_vertex), repl)
+        return self._hv_dev
+
     def run(self, state: dict, stop: Optional[int] = None,
             final_stop: Optional[int] = None):
         """Run to `stop` (default config.stop_time); returns
@@ -2421,9 +2433,13 @@ class DeviceEngine:
         `final_stop` (default = stop) is the window-clamping horizon:
         pass the simulation end when pausing at intermediate
         boundaries (heartbeats) so the window sequence — and thus the
-        trace — is identical to an unsegmented run."""
-        repl = NamedSharding(self.mesh, self._repl_spec)
-        hv = jax.device_put(jnp.asarray(self.host_vertex), repl)
+        trace — is identical to an unsegmented run.
+
+        This call never synchronizes: it enqueues the compiled
+        program and returns asynchronous device arrays, so the
+        segment pipeline (supervise.advance) can keep several
+        segments in flight while the host drains earlier ones."""
+        hv = self.host_vertex_device()
         stop_v = jnp.int64(self.config.stop_time if stop is None
                            else stop)
         final_v = stop_v if final_stop is None else jnp.int64(final_stop)
@@ -2489,9 +2505,9 @@ class DeviceEngine:
         vmapped program; returns ([R, ...] states, [R] rounds).
         Window clamping stays on `final_stop` exactly as in `run`, so
         segmented campaigns (heartbeats, dispatch_segment) replay the
-        unsegmented window sequence per replica."""
-        repl = NamedSharding(self.mesh, self._repl_spec)
-        hv = jax.device_put(jnp.asarray(self.host_vertex), repl)
+        unsegmented window sequence per replica. Like `run`, this is
+        a pure asynchronous enqueue — campaigns pipeline too."""
+        hv = self.host_vertex_device()
         stop_v = jnp.int64(self.config.stop_time if stop is None
                            else stop)
         final_v = stop_v if final_stop is None else jnp.int64(final_stop)

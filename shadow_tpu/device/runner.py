@@ -759,6 +759,11 @@ class DeviceRunner:
         stats.retries = self.retries
         stats.preempted = adv.preempted
         stats.resume_path = adv.resume_path
+        # segment-pipeline telemetry (supervise.advance): depth,
+        # issue/drain counts, sync wall, and the overlap the depth
+        # bought — bench stamps it and trace_report prints the
+        # overlap-efficiency line from it
+        stats.pipeline = adv.pipeline or None
         stats.events_executed = n_exec_total
         stats.packets_sent = int(final["n_sent"][:H].sum())
         stats.packets_dropped = int(final["n_drop"][:H].sum())

@@ -525,6 +525,9 @@ class EnsembleRunner:
         stats.retries = self.retries
         stats.preempted = adv.preempted
         stats.resume_path = adv.resume_path
+        # campaigns ride the same segment pipeline as standalone runs
+        # (supervise.advance is shared) — report its telemetry too
+        stats.pipeline = adv.pipeline or None
         stats.ensemble = self.record
         # campaign totals (all replicas) — the aggregate view; the
         # per-replica breakdown lives in the record

@@ -21,8 +21,9 @@ Three output surfaces (docs/observability.md):
 * a Chrome-trace-event / Perfetto-loadable export
   (``TRACE_<label>.trace.json``, obs/perfetto.py);
 * a ``METRICS_<label>.json`` summary with per-phase wall attribution
-  (host_s / judge_s / dispatch_s / exchange_s / checkpoint_s /
-  retry_s, plus compile_s / plan_s) that bench.py and
+  (host_s / judge_s / dispatch.issue_s / dispatch.sync_s /
+  exchange_s / checkpoint_s / retry_s, plus compile_s / plan_s) that
+  bench.py and
   scripts/trace_report.py consume. ``host_s`` is the RESIDUAL — total
   tracer-lifetime wall minus every non-host measured bucket — i.e.
   exactly the host-side Python time no span claims, so the buckets
@@ -61,7 +62,13 @@ MODES = ("off", "summary", "trace")
 # phase buckets for the METRICS wall attribution. "host" is the
 # residual bucket (never directly attributed); spans may also carry
 # free-form categories, which fold into "host" residual time.
-PHASES = ("host", "judge", "dispatch", "exchange", "checkpoint",
+# "dispatch.issue" (asynchronous enqueue cost) and "dispatch.sync"
+# (blocking waits for device results) split the old conflated
+# "dispatch" bucket so device-bound and sync-bound wall are finally
+# distinguishable; "dispatch" itself remains for engine.profile()'s
+# fenced phase splits.
+PHASES = ("host", "judge", "dispatch", "dispatch.issue",
+          "dispatch.sync", "exchange", "checkpoint",
           "retry", "compile", "plan")
 
 # recent-span ring size: what a watchdog stall dump embeds so a hang

@@ -350,11 +350,16 @@ def test_e2e_modes_bit_identical_and_trace_artifacts(tmp_path):
     m = json.loads(mfiles[0].read_text())
     assert sum(m["phases"].values()) == pytest.approx(
         m["total_wall_s"], rel=0.1)
-    # the dispatch spans carry sim windows covering the run
+    # the dispatch spans carry sim windows covering the run — split
+    # since PR 11 into the asynchronous issue and the blocking sync,
+    # which must pair up over identical windows
     recs = [json.loads(ln) for ln in
             jfiles[0].read_text().strip().splitlines()]
-    disp = [r for r in recs if r["name"] == "dispatch"]
-    assert disp and disp[-1]["sim_t1"] == 2 * 10**9
+    issue = [r for r in recs if r["name"] == "dispatch.issue"]
+    sync = [r for r in recs if r["name"] == "dispatch.sync"]
+    assert issue and sync and sync[-1]["sim_t1"] == 2 * 10**9
+    assert [(r["sim_t0"], r["sim_t1"]) for r in issue] == \
+        [(r["sim_t0"], r["sim_t1"]) for r in sync]
     # and SimStats carries the same summary the file holds
     assert s_tr.telemetry["phases"] == m["phases"]
 
