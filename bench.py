@@ -440,6 +440,12 @@ def run_device(config_path: str, stop_s: float,
     # every device rung record so sync-bound vs device-bound wall
     # is attributable from the BENCH record alone
     stamp["pipeline"] = stats.pipeline
+    if stats.reshards:
+        # a bench run that survived device loss is NOT a clean perf
+        # record: stamp the shrink count + the shrunken mesh so the
+        # number is never compared against full-mesh runs unnoticed
+        stamp["reshards"] = stats.reshards
+        stamp["mesh_shards_final"] = c.runner.engine.n_shards
     # strategy-plan provenance (or its loud refusal) rides every
     # device rung record
     stamp.update(_plan_stamp(c, stats))

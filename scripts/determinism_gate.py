@@ -74,6 +74,19 @@ window and exit rc 75), and its checkpoint must resume at depth 1
 bit-identically (cross-depth resume: depth is host orchestration,
 never part of the checkpoint contract).
 
+`--chaos` switches to the ELASTIC MESH-SHRINK gate (device/chaos.py
++ failover: shrink): on a forced >= 4-device mesh, a scripted device
+loss (deterministic chaos injector) kills mesh device 1 at the 2nd
+dispatch issue; retries exhaust, the run re-shards the last
+validated state onto the 3 survivors and continues on-device under
+the state-audit word. The shrunk run must bit-match BOTH the serial
+oracle and an uninterrupted 3-shard run, for a standalone run AND
+an ensemble campaign (`--chaos-ensemble` names the campaign
+config); a post-shrink rotating checkpoint must stamp the shrunken
+geometry and resume bit-identically on the full pool; and a
+scripted corrupted-rotation-entry schedule must engage the
+newest-readable fallback.
+
 `--ensemble` switches to the CAMPAIGN gate (shadow_tpu/ensemble/):
 the config must carry an `ensemble:` block. The gate runs the
 campaign twice (run-to-run bit-identity over every replica), then
@@ -817,6 +830,238 @@ def run_tuned_gate(config: str) -> int:
         return rc
 
 
+def run_chaos_gate(config: str, ensemble_config: str) -> int:
+    """Elastic mesh-shrink failover gate (device/chaos.py +
+    failover: shrink): device loss must cost throughput, never the
+    run — or the trace. Driven end to end by the deterministic chaos
+    injector on a forced >= 4-device CPU mesh. Legs:
+
+    1. oracle + uninterrupted M-shard: the serial oracle, then the
+       tpu policy pinned to 3 shards (experimental.mesh_shards) —
+       bit-identical, the baseline pair every shrink compares to;
+    2. scripted device loss: a 4-shard run whose mesh device 1 dies
+       at the 2nd dispatch issue (chaos device_loss), retries
+       exhaust, the mesh shrinks 4 -> 3 and continues on-device
+       under the state-audit word — the final signature must
+       bit-match BOTH the serial oracle and the uninterrupted
+       3-shard run, with >= 1 reshard reported and the engine left
+       on 3 shards;
+    3. post-shrink checkpoint resume: the shrink run writes rotating
+       checkpoints; the newest entry must stamp the SHRUNKEN
+       geometry (meta["geometry"].n_shards == 3), and resuming it on
+       the full device pool must auto-adopt that geometry and
+       bit-match the oracle;
+    4. corrupted-rotation chaos: a supervised run whose LAST rotation
+       entry is corrupted on disk by the schedule
+       (chaos checkpoint_corrupt) — resolve_checkpoint must skip the
+       decoy (newest-READABLE fallback) and the resume must
+       bit-match;
+    5. ensemble campaign shrink: the same 4 -> 3 device loss against
+       `ensemble_config`'s campaign — every replica's counters and
+       checksums must bit-match the uninterrupted 3-shard campaign
+       (shrink keeps the vmapped replica axis intact; it is the one
+       failover campaigns have).
+    """
+    import numpy as np
+
+    from shadow_tpu._jax import jax
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.device import checkpoint, supervise
+    from shadow_tpu.device.chaos import ChaosEvent
+
+    ndev = len(jax.devices())
+    if ndev < 4:
+        print(f"FAIL: --chaos needs >= 4 devices for the 4 -> 3 "
+              f"shrink (run under XLA_FLAGS=--xla_force_host_"
+              f"platform_device_count=4); found {ndev}")
+        return 1
+    cfg0 = load_config(config)
+    stop = cfg0.general.stop_time
+    seg_ns = max(1, stop // 8)
+
+    def run_tpu(tag: str, tmp: str, shards: int, mutate=None,
+                ensemble: bool = False, want_ok: bool = True):
+        cfg = load_config(ensemble_config if ensemble else config)
+        cfg.experimental.scheduler_policy = "tpu"
+        cfg.experimental.mesh_shards = shards
+        cfg.experimental.state_audit = True
+        cfg.experimental.dispatch_segment = seg_ns
+        cfg.general.data_directory = os.path.join(
+            tmp, tag, "shadow.data")
+        if ensemble:
+            cfg.ensemble.record_path = os.path.join(
+                tmp, tag, "ENSEMBLE.json")
+            # the campaign config's own stop drives its segments
+            cfg.experimental.dispatch_segment = max(
+                1, cfg.general.stop_time // 8)
+        if mutate:
+            mutate(cfg)
+        c = Controller(cfg)
+        stats = c.run()
+        if want_ok and not stats.ok:
+            print(f"FAIL: {tag} run reported not-ok")
+            sys.exit(1)
+        if ensemble:
+            f = c.runner.final_state
+            sig = {k: np.asarray(f[k])
+                   for k in ("chk", "n_exec", "n_sent", "n_drop",
+                             "n_deliv")}
+        else:
+            sig = [(h.name, h.trace_checksum, h.events_executed,
+                    h.packets_sent, h.packets_dropped,
+                    h.packets_delivered) for h in c.sim.hosts]
+        return sig, stats, c
+
+    def loss_schedule(cfg):
+        cfg.experimental.failover = "shrink"
+        cfg.experimental.dispatch_retries = 1
+        cfg.experimental.dispatch_retry_backoff = 0.0
+        cfg.experimental.chaos = [
+            ChaosEvent(kind="device_loss", segment=1, shard=1)]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        os.environ.setdefault("SHADOW_TPU_OCC_DIR",
+                              os.path.join(tmp, "occ"))
+        rc = 0
+        # leg 1: the baseline pair
+        sig_oracle, stats_oracle = run_once(
+            config, "serial", os.path.join(tmp, "oracle",
+                                           "shadow.data"))
+        sig_m, _, _ = run_tpu("alone3", tmp, shards=3)
+        if sig_m != sig_oracle:
+            print("DETERMINISM FAILURE: the uninterrupted 3-shard "
+                  "run diverges from the serial oracle")
+            return 1
+
+        # leg 2 + 3: scripted device loss with rotating checkpoints
+        base = os.path.join(tmp, "ck.npz")
+
+        def shrink_mutate(cfg):
+            loss_schedule(cfg)
+            cfg.experimental.checkpoint_save = base
+            cfg.experimental.checkpoint_every = seg_ns
+            cfg.experimental.checkpoint_keep = 8
+
+        sig_s, stats_s, c_s = run_tpu("shrink", tmp, shards=4,
+                                      mutate=shrink_mutate)
+        if sig_s != sig_oracle:
+            rc = 1
+            print("DETERMINISM FAILURE: the 4 -> 3 shrunk run "
+                  "diverges from the serial oracle")
+            for a, b in zip(sig_oracle, sig_s):
+                if a != b:
+                    print(f"  {a[0]}: oracle {a[1:]} != shrunk "
+                          f"{b[1:]}")
+        if stats_s.reshards < 1:
+            rc = 1
+            print(f"FAIL: the shrink run reported "
+                  f"{stats_s.reshards} reshards — the scripted "
+                  "device loss did not trigger a mesh shrink")
+        if c_s.runner.engine.n_shards != 3:
+            rc = 1
+            print(f"FAIL: the shrink run finished on "
+                  f"{c_s.runner.engine.n_shards} shard(s), "
+                  "expected 3")
+
+        entries = supervise.rotation_entries(base)
+        post = [(t, p) for t, p in entries if t < stop]
+        if not post:
+            print("FAIL: the shrink run left no rotation entry "
+                  "before stop — nothing to resume")
+            return 1
+        last_t, last_p = post[-1]
+        geom = checkpoint.peek_geometry(checkpoint.peek_meta(last_p))
+        if geom.get("n_shards") != 3:
+            rc = 1
+            print(f"FAIL: the post-shrink rotation entry {last_p} "
+                  f"stamps geometry {geom}, expected n_shards=3")
+
+        def resume_mutate(cfg):
+            cfg.experimental.checkpoint_load = last_p
+
+        # shards=0: the full pool — the runner must ADOPT the saved
+        # shrunken geometry from the stamp
+        sig_r, _, c_r = run_tpu("resume", tmp, shards=0,
+                                mutate=resume_mutate)
+        if sig_r != sig_oracle:
+            rc = 1
+            print("DETERMINISM FAILURE: the post-shrink checkpoint "
+                  "resumed on the full pool diverges from the "
+                  "oracle")
+        if c_r.runner.engine.n_shards != 3:
+            rc = 1
+            print(f"FAIL: the resume rebuilt "
+                  f"{c_r.runner.engine.n_shards} shard(s) — the "
+                  "saved shrunken geometry was not adopted")
+
+        # leg 4: corrupted-rotation chaos -> newest-readable fallback
+        base2 = os.path.join(tmp, "ck2.npz")
+        n_saves = (stop - 1) // seg_ns     # rotation saves at t<stop
+
+        def corrupt_mutate(cfg):
+            cfg.experimental.checkpoint_save = base2
+            cfg.experimental.checkpoint_every = seg_ns
+            cfg.experimental.checkpoint_keep = 8
+            cfg.experimental.chaos = [
+                ChaosEvent(kind="checkpoint_corrupt",
+                           entry=n_saves - 1)]
+
+        run_tpu("corrupt", tmp, shards=4, mutate=corrupt_mutate)
+        # drop the end-of-run base save (simulating the crash the
+        # rotation exists for) so resolution exercises the rotation
+        os.unlink(base2)
+        newest = supervise.rotation_entries(base2)[-1][1]
+        resolved = supervise.resolve_checkpoint(base2)
+        if resolved == newest:
+            rc = 1
+            print(f"FAIL: resolve_checkpoint returned the corrupted "
+                  f"newest entry {newest} — the newest-readable "
+                  "fallback did not engage")
+
+        def resume2_mutate(cfg):
+            cfg.experimental.checkpoint_load = base2
+
+        sig_r2, _, _ = run_tpu("resume2", tmp, shards=4,
+                               mutate=resume2_mutate)
+        if sig_r2 != sig_oracle:
+            rc = 1
+            print("DETERMINISM FAILURE: the resume past the "
+                  "corrupted rotation entry diverges from the "
+                  "oracle")
+
+        # leg 5: the ensemble campaign survives the same device loss
+        ens_ref, _, _ = run_tpu("ens3", tmp, shards=3, ensemble=True)
+        ens_s, ens_stats, ens_c = run_tpu(
+            "ens_shrink", tmp, shards=4, mutate=loss_schedule,
+            ensemble=True)
+        bad = [k for k in ens_ref
+               if not np.array_equal(ens_ref[k], ens_s[k])]
+        if bad:
+            rc = 1
+            print(f"DETERMINISM FAILURE: the shrunk campaign's {bad} "
+                  "diverge from the uninterrupted 3-shard campaign")
+        if ens_stats.reshards < 1 or \
+                ens_c.runner.engine.n_shards != 3:
+            rc = 1
+            print(f"FAIL: campaign shrink reported "
+                  f"{ens_stats.reshards} reshards on "
+                  f"{ens_c.runner.engine.n_shards} final shard(s) — "
+                  "expected >= 1 on 3")
+
+        if rc == 0:
+            print(f"chaos OK: {config} (scripted 4 -> 3 device loss "
+                  f"bit-matches the serial oracle "
+                  f"[{stats_oracle.events_executed} events] and the "
+                  "uninterrupted 3-shard run, standalone AND "
+                  f"ensemble [{ensemble_config}]; post-shrink "
+                  "checkpoint stamps n_shards=3 and resumes "
+                  "bit-identically on the full pool; the corrupted "
+                  "rotation entry fell back to the newest readable "
+                  "one; audit word clean throughout)")
+        return rc
+
+
 def run_pipelined_gate(config: str) -> int:
     """Pipelined-dispatch gate (device/supervise.py segment
     pipeline): overlap must never change the simulation. Three legs
@@ -970,6 +1215,21 @@ def main() -> int:
                          "SIGTERM with a depth-4 window in flight "
                          "must drain to a resume checkpoint that a "
                          "depth-1 run resumes bit-identically")
+    ap.add_argument("--chaos", action="store_true",
+                    help="elastic mesh-shrink gate: scripted 4 -> 3 "
+                         "device loss (deterministic chaos injector) "
+                         "must bit-match the serial oracle and the "
+                         "uninterrupted 3-shard run, standalone and "
+                         "ensemble; post-shrink checkpoints stamp "
+                         "the shrunken geometry and resume; a "
+                         "corrupted rotation entry falls back to "
+                         "the newest readable one (needs >= 4 "
+                         "devices)")
+    ap.add_argument("--chaos-ensemble",
+                    default="examples/ensemble_seed_sweep.yaml",
+                    help="campaign config for the --chaos ensemble "
+                         "leg (default "
+                         "examples/ensemble_seed_sweep.yaml)")
     ap.add_argument("--analyze-consistency", action="store_true",
                     help="static-analysis consistency gate: the "
                          "collective registry shadowlint audits "
@@ -983,6 +1243,19 @@ def main() -> int:
     policies = [p.strip()
                 for p in (args.policy or default_policy).split(",")
                 if p.strip()]
+
+    if args.chaos:
+        if args.ensemble or args.preempt or args.policy or \
+                args.compile_cache or args.telemetry or args.tuned \
+                or args.analyze_consistency or args.pipelined:
+            # the chaos gate runs the serial oracle, the M-shard
+            # comparison, the shrink/resume legs, and its own
+            # ensemble leg by construction
+            print("FAIL: --chaos does not combine with other gate "
+                  "flags (it runs serial + tpu mesh_shards 3/4 plus "
+                  "its own checkpoint/ensemble legs)")
+            return 1
+        return run_chaos_gate(args.config, args.chaos_ensemble)
 
     if args.pipelined:
         if args.ensemble or args.preempt or args.policy or \

@@ -5,8 +5,9 @@ run (shadow_tpu/obs, docs/observability.md).
 Reads a ``METRICS_*.json`` summary (or a ``TRACE_*.jsonl`` span log,
 aggregated on the fly) and prints the per-phase wall attribution —
 host / judge / dispatch / exchange / checkpoint / retry / compile /
-plan — with span counts, flags the dominant phase, and names the
-lever it implicates. This is the concrete evidence the pipelining
+plan / reshard / chaos / failover — with span counts, flags the
+dominant phase, and names the lever it implicates. This is the
+concrete evidence the pipelining
 and auto-tuning work cite: e.g. a dispatch-dominant tgen_100 run is
 the per-round-dispatch-latency bottleneck MPMD overlap attacks.
 
@@ -63,6 +64,16 @@ LEVERS = {
                "(docs/compile_cache.md); repeat runs should hit",
     "plan": "capacity warm-up/re-plan dominates - save and reuse the "
             "OCC record (capacity_plan: <path>)",
+    "reshard": "mesh-shrink failover cost dominates - devices died "
+               "mid-run (drain + re-shard + recompile per shrink); "
+               "fix the pool, or warm the AOT cache so the rebuilt "
+               "program loads instead of recompiling",
+    "chaos": "scripted fault injections (experimental.chaos) - this "
+             "is a failover drill, not a production run",
+    "failover": "hybrid-failover rerun overhead dominates - the "
+                "device run died and replayed on CPU from t=0; "
+                "failover: shrink keeps the survivors on-device "
+                "(docs/operations.md#failover)",
 }
 
 
@@ -166,6 +177,14 @@ def print_report(m: dict, top: int = 0) -> None:
               f"overlapped host {pipe.get('overlapped_host_s', 0.0):.3f}s "
               f"-> overlap efficiency "
               f"{pipe.get('overlap_efficiency', 0.0):.0%}")
+    reshards = (m.get("counters") or {}).get("reshards")
+    if reshards or phases.get("reshard_s"):
+        # the shrink's degradation cost as a first-class line: wall
+        # lost to the drain + re-shard + re-place (the rebuilt
+        # program's compile wall lands in compile_s)
+        print(f"mesh shrinks: {reshards or '?'} absorbed; reshard "
+              f"wall {phases.get('reshard_s', 0.0):.3f}s "
+              "(+ rebuild compile in compile_s)")
     if m.get("dropped_spans"):
         print(f"note: {m['dropped_spans']} span(s) dropped from the "
               "in-memory list (JSONL log is complete)")
@@ -248,6 +267,16 @@ def print_compare(a: dict, b: dict, name_a: str, name_b: str) -> None:
                     f"{p.get('overlap_efficiency', 0.0):.0%}"
                     if p else "n/a")
         print(f"pipeline: A {_pfmt(pipe_a)} -> B {_pfmt(pipe_b)}")
+    rsh_a = (a.get("counters") or {}).get("reshards", 0)
+    rsh_b = (b.get("counters") or {}).get("reshards", 0)
+    if rsh_a or rsh_b or pa.get("reshard_s") or pb.get("reshard_s"):
+        # the one-line answer to "what did the shrink cost": wall
+        # lost to drain + re-shard + recompile, side by side
+        wa = pa.get("reshard_s", 0.0)
+        wb = pb.get("reshard_s", 0.0)
+        print(f"shrink cost: A {rsh_a} shrink(s) / {wa:.3f}s -> "
+              f"B {rsh_b} shrink(s) / {wb:.3f}s (drain + reshard + "
+              "recompile; rebuild compile rides compile_s)")
 
 
 def main() -> int:

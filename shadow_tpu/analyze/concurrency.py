@@ -59,6 +59,18 @@ LOCK_REGISTRY = {
     "shadow_tpu/device/supervise.py": {
         "self._ring": "self._lock",
     },
+    # the chaos injector's schedule counters + dead-device set: the
+    # dispatch seam runs on the advance loop's thread, but the
+    # checkpoint and cache seams are exactly the calls a future
+    # async drain worker would issue — every mutation takes the
+    # lock now (the PipelineWindow rationale)
+    "shadow_tpu/device/chaos.py": {
+        "self._dead": "self._lock",
+        "self._issues": "self._lock",
+        "self._ck_saves": "self._lock",
+        "self._stores": "self._lock",
+        "self.fired": "self._lock",
+    },
 }
 
 # files the pass scans (the generic module-level rule applies to all
@@ -67,6 +79,7 @@ SCAN_GLOBS = (
     "shadow_tpu/core/manager.py",
     "shadow_tpu/core/controller.py",
     "shadow_tpu/core/netmodel.py",
+    "shadow_tpu/device/chaos.py",
     "shadow_tpu/device/supervise.py",
     "shadow_tpu/host/*.py",
 )

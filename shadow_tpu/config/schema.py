@@ -500,14 +500,40 @@ class ExperimentalOptions:
     # (`dispatch_retry_backoff` seconds base, doubling, 30 s cap).
     dispatch_retries: int = 0
     dispatch_retry_backoff: float = 0.5
-    # after exhausting retries: "abort" fails the run (the old
-    # behavior); "hybrid" saves the last validated state to
-    # <checkpoint_save>.failover (kept for a device-side resume) and
-    # re-runs on the hybrid backend with a loud diagnostic instead of
-    # aborting — CPU host state is rebuilt from t=0 (device arrays
+    # after exhausting retries — the failover LADDER
+    # (docs/operations.md#failover): "abort" fails the run; "shrink"
+    # probes the mesh for dead devices, re-shards the last validated
+    # state onto the M survivors, re-plans exchange capacities for
+    # the new geometry, and continues ON-DEVICE at M/N throughput
+    # (bit-identical to the uninterrupted run — the mesh-shape
+    # determinism contract), escalating to the hybrid rung only when
+    # no shrink is possible (no dead device found, no survivor, or
+    # the state is unrecoverable); "hybrid" saves the last validated
+    # state to <checkpoint_save>.failover (kept for a device-side
+    # resume) and re-runs on the hybrid backend with a loud
+    # diagnostic — CPU host state is rebuilt from t=0 (device arrays
     # are not importable into CPU hosts), so the run finishes at the
-    # cost of replaying the lost prefix.
+    # cost of replaying the lost prefix. Ensemble campaigns may use
+    # "shrink" (the replica axis vmaps outside the mesh axis and
+    # survives intact); "hybrid" stays rejected for them (CPU host
+    # emulation cannot vmap replicas).
     failover: str = "abort"
+    # deterministic chaos injection (device/chaos.py,
+    # docs/operations.md#chaos): a list of scripted fault points —
+    # device_loss / dispatch_error at the k-th dispatch issue,
+    # checkpoint_corrupt after the k-th rotation save,
+    # cache_store_fail at the k-th cache store — fired at
+    # deterministic seam counters so the same schedule reproduces
+    # the identical run, failures included. This is how the failover
+    # ladder is drilled in CI (determinism_gate --chaos) without
+    # real hardware dying on cue.
+    chaos: list = field(default_factory=list)
+    # pin the device mesh to the first N available devices (0 = all):
+    # the chaos gate's uninterrupted M-shard comparison runs, and any
+    # workload that wants a submesh (a shrunken-geometry resume on a
+    # healthy pool, capacity experiments), build their mesh here
+    # instead of via XLA_FLAGS process-global forcing.
+    mesh_shards: int = 0
     mesh_axis: str = "hosts"
     device_batch_rounds: int = 64   # rounds fused into one device while_loop
     # hybrid mode: which CPU policy drives host emulation while the
@@ -696,7 +722,24 @@ class ExperimentalOptions:
                 "is not checkpointable — the reference has the same "
                 "limitation, i.e. no checkpoint at all)")
         _check_choice("experimental", "failover", out.failover,
-                      ("abort", "hybrid"))
+                      ("abort", "shrink", "hybrid"))
+        if out.chaos:
+            # the injector owns its schedule format — validate every
+            # entry at load (the network.faults rule: a typo'd
+            # schedule fails in milliseconds, never as a run that
+            # silently injects nothing)
+            from shadow_tpu.device.chaos import events_from_config
+            out.chaos = events_from_config(out.chaos)
+            if out.scheduler_policy != "tpu":
+                raise ValueError(
+                    "experimental.chaos injects faults at the DEVICE "
+                    "supervise/engine seams and requires "
+                    "scheduler_policy: tpu")
+        if out.mesh_shards and out.scheduler_policy != "tpu":
+            raise ValueError(
+                "experimental.mesh_shards pins the DEVICE mesh and "
+                "requires scheduler_policy: tpu (CPU policies have "
+                "no mesh to pin)")
         if out.checkpoint_every:
             if not out.checkpoint_save:
                 raise ValueError(
@@ -746,6 +789,7 @@ class ExperimentalOptions:
                               ("checkpoint_every", 0),
                               ("checkpoint_keep", 1),
                               ("dispatch_retries", 0),
+                              ("mesh_shards", 0),
                               ("outbox_capacity", 1),
                               ("exchange_capacity", 0),
                               ("exchange_capacity2", 0),
@@ -926,9 +970,11 @@ class ConfigOptions:
             raise ValueError(
                 "ensemble: experimental.failover: hybrid is not "
                 "available for campaigns (CPU host emulation cannot "
-                "vmap replicas) — campaigns retry transient dispatch "
-                "errors and otherwise fail loudly with the last "
-                "validated checkpoint on disk")
+                "vmap replicas) — use failover: shrink (campaigns "
+                "survive device loss on-device; the replica axis "
+                "vmaps outside the mesh axis), or let exhausted "
+                "retries fail loudly with the last validated "
+                "checkpoint on disk")
         return out
 
     def total_hosts(self) -> int:

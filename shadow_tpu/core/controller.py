@@ -237,7 +237,8 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
 
 
 class Controller:
-    def __init__(self, cfg: ConfigOptions, trace: Optional[list] = None):
+    def __init__(self, cfg: ConfigOptions, trace: Optional[list] = None,
+                 tracer=None):
         self.cfg = cfg
         self.sim = build(cfg)
         policy_name = cfg.experimental.scheduler_policy
@@ -247,10 +248,16 @@ class Controller:
         # flight recorder (shadow_tpu/obs): ONE per run, attached to
         # whichever executor this config resolves to and published as
         # the module-global current() for call sites with no plumbing
-        # path (aotcache.ensure, capacity record I/O, engine.profile)
+        # path (aotcache.ensure, capacity record I/O, engine.profile).
+        # A nested run (the hybrid failover rerun) receives its
+        # parent's tracer instead, so the rerun's spans land in the
+        # SAME trace under the parent's `failover` span — the parent
+        # finalizes, the child must not.
         from shadow_tpu.obs import trace as obstrace
-        self.tracer = obstrace.resolve_tracer(cfg,
-                                              len(self.sim.hosts))
+        self._owns_tracer = tracer is None
+        self.tracer = (tracer if tracer is not None
+                       else obstrace.resolve_tracer(cfg,
+                                                    len(self.sim.hosts)))
         obstrace.set_current(self.tracer)
         if cfg.ensemble is not None:
             # R-replica campaign in one vmapped device program
@@ -288,6 +295,22 @@ class Controller:
                         "fallback's CPU host emulation has no static "
                         "capacities to plan",
                         cfg.experimental.capacity_plan)
+                if cfg.experimental.chaos:
+                    # the schema's fail-fast rule for fault schedules
+                    # must survive the fallback too: a chaos drill
+                    # that silently injects nothing would read as a
+                    # green failover test that drilled nothing
+                    log.warning(
+                        "experimental.chaos ignored — the hybrid "
+                        "fallback has no device dispatch/checkpoint/"
+                        "cache seams to inject at; this run drills "
+                        "NOTHING")
+                if cfg.experimental.mesh_shards:
+                    log.warning(
+                        "experimental.mesh_shards=%d ignored — the "
+                        "hybrid fallback's CPU host emulation has "
+                        "no device mesh to pin",
+                        cfg.experimental.mesh_shards)
                 policy_name = "hybrid"
         self.strategy_plan = None
         if policy_name == "hybrid":
@@ -358,29 +381,44 @@ class Controller:
         )
 
     def _failover_run(self, exc) -> SimStats:
-        """Dispatch retries exhausted under failover: hybrid — finish
-        the run on the hybrid backend (CPU host emulation + device
-        network judge) instead of aborting. CPU host state cannot be
-        rebuilt from device arrays, so the hybrid run replays from
-        t=0; the last validated device checkpoint stays on disk to pin
-        a device-side resume once the accelerator returns. Determinism
+        """The failover ladder's hybrid rung (failover: hybrid, or
+        shrink when no shrink was possible) — finish the run on the
+        hybrid backend (CPU host emulation + device network judge)
+        instead of aborting. CPU host state cannot be rebuilt from
+        device arrays, so the hybrid run replays from t=0; the last
+        validated device checkpoint stays on disk to pin a
+        device-side resume once the accelerator returns. Determinism
         makes the replayed results bit-identical to what the device
-        run would have produced."""
+        run would have produced. The rerun shares THIS run's flight
+        recorder under a `failover` span, so the whole incident —
+        device prefix, escalation, hybrid replay — reads off one
+        timeline."""
         import copy
 
-        log.error(
-            "DEVICE FAILOVER: %s — re-running on the hybrid backend "
-            "from t=0 (device state is not importable into CPU "
-            "hosts; the prefix up to t=%d ns is replayed). The "
-            "validated device checkpoint %s remains for a "
-            "device-side resume.", exc, exc.sim_time,
-            exc.checkpoint_path or "<none>")
+        if exc.checkpoint_path is None:
+            # the ONE diagnostic for the persist failure: the
+            # escalation could save no state at all, so the hybrid
+            # rerun has no device-side resume point — previously this
+            # path silently dropped the failover and re-raised
+            log.error(
+                "DEVICE FAILOVER: %s — no device checkpoint could be "
+                "persisted (%s); re-running on the hybrid backend "
+                "from t=0 with NO device-side resume point.", exc,
+                exc.persist_error or "unknown persist error")
+        else:
+            log.error(
+                "DEVICE FAILOVER: %s — re-running on the hybrid "
+                "backend from t=0 (device state is not importable "
+                "into CPU hosts; the prefix up to t=%d ns is "
+                "replayed). The validated device checkpoint %s "
+                "remains for a device-side resume.", exc,
+                exc.sim_time, exc.checkpoint_path or "<none>")
         cfg2 = copy.deepcopy(self.cfg)
         xp = cfg2.experimental
         xp.scheduler_policy = "hybrid"
-        # supervision/planning knobs are device-only; the schema would
-        # reject them on a CPU policy, and the hybrid replay must not
-        # try to checkpoint or re-plan
+        # supervision/planning/chaos knobs are device-only; the schema
+        # would reject them on a CPU policy, and the hybrid replay
+        # must not try to checkpoint, re-plan, or re-inject
         xp.checkpoint_save = ""
         xp.checkpoint_save_time = 0
         xp.checkpoint_load = ""
@@ -390,9 +428,15 @@ class Controller:
         xp.state_audit = False
         xp.dispatch_retries = 0
         xp.failover = "abort"
-        inner = Controller(cfg2)
-        stats = inner.run()
-        stats.failover_checkpoint = exc.checkpoint_path
+        xp.chaos = []
+        xp.mesh_shards = 0
+        with self.tracer.span("failover.hybrid_rerun", "failover",
+                              sim_t0=exc.sim_time,
+                              checkpoint=exc.checkpoint_path or "",
+                              error=str(exc)[:200]):
+            inner = Controller(cfg2, tracer=self.tracer)
+            stats = inner.run()
+        stats.failover_checkpoint = exc.checkpoint_path or ""
         # reflect the replayed per-host results onto THIS sim's hosts:
         # anything reading c.sim.hosts after the run (the determinism
         # gate's signature path, summary tooling) must see the real
@@ -423,24 +467,32 @@ class Controller:
                             "rounds": stats.rounds,
                             "retries": stats.retries,
                             "replans": stats.replans}
+                if stats.reshards:
+                    # the shrink's degradation cost is a first-class
+                    # observable: the count rides the METRICS
+                    # counters, the wall rides the reshard phase
+                    counters["reshards"] = stats.reshards
                 if stats.pipeline:
                     # the METRICS record's overlap-efficiency line:
                     # depth, issue/drain counts, sync wall, and the
                     # host wall hidden behind in-flight device work
                     counters["pipeline"] = dict(stats.pipeline)
-            summary = self.tracer.finalize(
-                run_info={
-                    "policy": self.cfg.experimental.scheduler_policy,
-                    "n_hosts": len(self.sim.hosts),
-                    "stop_time": int(self.cfg.general.stop_time),
-                    "seed": int(self.cfg.general.seed)},
-                counters=counters)
-            if stats is not None and summary is not None and \
-                    stats.telemetry is None:
-                # already-set means a nested run (hybrid failover)
-                # published its own breakdown — keep it; the inner
-                # run is the one that produced these stats
-                stats.telemetry = summary
+            # a nested run (the hybrid failover rerun shares its
+            # parent's tracer) must NOT finalize: the parent closes
+            # the recorder once for the whole incident timeline and
+            # publishes the combined summary onto these stats
+            if self._owns_tracer:
+                summary = self.tracer.finalize(
+                    run_info={
+                        "policy": self.cfg.experimental
+                        .scheduler_policy,
+                        "n_hosts": len(self.sim.hosts),
+                        "stop_time": int(self.cfg.general.stop_time),
+                        "seed": int(self.cfg.general.seed)},
+                    counters=counters)
+                if stats is not None and summary is not None and \
+                        stats.telemetry is None:
+                    stats.telemetry = summary
 
     def _run_inner(self) -> SimStats:
         cfg = self.cfg
@@ -461,6 +513,13 @@ class Controller:
                 log.warning("run absorbed %d transient device "
                             "dispatch retr%s", stats.retries,
                             "y" if stats.retries == 1 else "ies")
+            if stats.reshards:
+                log.warning(
+                    "run absorbed %d mesh shrink(s): device loss "
+                    "survived on-device — the mesh now runs %d "
+                    "shard(s), results bit-identical, throughput "
+                    "degraded by the lost share", stats.reshards,
+                    self.runner.engine.n_shards)
             if stats.ensemble is not None:
                 rec = stats.ensemble
                 log.info(

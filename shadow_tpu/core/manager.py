@@ -84,6 +84,11 @@ class SimStats:
     retries: int = 0
     preempted: bool = False
     resume_path: str = ""
+    # mesh shrinks absorbed (failover: shrink, device/supervise.py):
+    # the run lost device(s) mid-flight and continued on-device on
+    # the surviving mesh — throughput degraded by the lost share,
+    # results bit-identical
+    reshards: int = 0
     # set when the tpu policy failed over to the hybrid backend
     # mid-run (the device checkpoint named here pins a device-side
     # resume; the hybrid results replayed from t=0)

@@ -478,6 +478,20 @@ class AotCache:
         then evict LRU entries past the size cap."""
         from shadow_tpu.utils.artifacts import atomic_write
 
+        from shadow_tpu.device import chaos as chaosmod
+
+        inj = chaosmod.current()
+        if inj is not None and inj.on_cache_store(key):
+            # chaos seam (full-disk drill): this store is refused —
+            # the run continues on the unpersisted fresh compile,
+            # exactly the degradation contract a real write failure
+            # gets below (store_disabled stays off: the scripted
+            # failure is one store, not the directory)
+            log.warning("compile cache: store of %s refused by the "
+                        "chaos schedule — running on the unpersisted "
+                        "fresh compile", key)
+            return False
+
         try:
             from jax.experimental import serialize_executable as se
 

@@ -429,6 +429,120 @@ def grow_heaps(host_state: dict, new_e: int) -> dict:
     return out
 
 
+# reshard_state's leaf classification: every key the engine may put
+# in state must fall in exactly one class — an unregistered key fails
+# loudly, so a new state leaf cannot be silently mis-resharded.
+# (Per-host vector leaves — counters, seq/chk, occ_heap/ob/in, aud*,
+# NIC scalars — are the residual class, shape-checked against the
+# padded width.)
+RESHARD_HOST_ROWS = ("ht", "hk", "hm", "hv", "hw", "app")
+RESHARD_SHARD_ZERO = ("occ_x", "occ_trips", "occ_phases")
+RESHARD_SHARD_SUM = ("path_cnt",)
+
+
+def reshard_state(host_state: dict, n_hosts: int,
+                  template_host: dict) -> dict:
+    """Carry a host-side state snapshot across a mesh-geometry change
+    (the elastic shrink failover's core transform): because
+    ``H_pad = ceil(H / n_shards) * n_shards``, a different shard
+    count means a different padded width, so every per-host leaf is
+    re-padded row-for-row rather than transferred whole.
+
+    ``template_host`` is a host-side copy of the TARGET engine's
+    freshly initialized state (``device_get`` of ``init_state`` /
+    ``init_ensemble_state``): its shapes define the new padded layout
+    and its values supply the padding rows' contents (app init rows,
+    INF/IMAX heap fills, zeroed counters) — exactly what an
+    uninterrupted run on the target mesh holds for hosts that never
+    execute. The first ``n_hosts`` rows along the host axis carry
+    over verbatim, so per-host counters, event heaps, and trace
+    checksums — the determinism surface — are untouched; combined
+    with the engine's mesh-shape determinism contract, the resharded
+    continuation is bit-identical to an uninterrupted run on the
+    target mesh. Per-shard telemetry resets (high-water marks
+    measured on the old geometry describe buffers that no longer
+    exist) and the per-shard path histogram's partial sums
+    re-aggregate onto shard 0 (row totals are the reported surface).
+    Works on standalone ``[H, ...]`` states and ensemble
+    ``[R, H, ...]`` stacks alike — the host axis position per leaf
+    is fixed, only leading axes broadcast."""
+    extra = set(host_state) - set(template_host)
+    if any(not _aux_leaf(k) for k in extra):
+        raise ValueError(
+            "reshard_state: snapshot carries leaves the target "
+            f"engine lacks: {sorted(extra)}")
+    old_pad = np.asarray(host_state["ht"]).shape[-2]
+    new_pad = np.asarray(template_host["ht"]).shape[-2]
+    H = int(n_hosts)
+    if not (0 < H <= old_pad and H <= new_pad):
+        raise ValueError(
+            f"reshard_state: n_hosts {H} does not fit the padded "
+            f"widths (old {old_pad}, new {new_pad})")
+    out = {}
+    for k, tmpl in template_host.items():
+        new = np.array(tmpl)        # the target padding, host-side
+        if k not in host_state:
+            if k == "aud_tx":
+                # the snapshot predates the audit (a rotation entry
+                # written with state_audit off): reseed the
+                # conservation ledger from the saved counters, the
+                # checkpoint.load_state rule — per-host, so the
+                # global balance holds exactly at the resume point
+                ht = np.asarray(host_state["ht"])
+                head = np.asarray(host_state["head"])
+                E = ht.shape[-1]
+                live = ((np.arange(E) >= head[..., None]) &
+                        (ht < (np.int64(1) << np.int64(62)))).sum(-1)
+                recon = (np.asarray(host_state["n_exec"])
+                         .astype(np.int64) + live
+                         + np.asarray(host_state["overflow"])
+                         .astype(np.int64)
+                         + np.asarray(host_state["x_overflow"])
+                         .astype(np.int64))
+                new[..., :H] = recon[..., :H]
+            elif not _aux_leaf(k):
+                raise ValueError(
+                    f"reshard_state: snapshot is missing leaf {k!r}")
+            out[k] = new
+            continue
+        old = np.asarray(host_state[k])
+        if k in RESHARD_HOST_ROWS:
+            if old.shape[-1] != new.shape[-1] or \
+                    old.shape[:-2] != new.shape[:-2] or \
+                    old.shape[-2] != old_pad or \
+                    new.shape[-2] != new_pad:
+                raise ValueError(
+                    f"reshard_state: leaf {k} is {old.shape}, target "
+                    f"expects {new.shape} — reshard carries geometry "
+                    "only, never capacity or replica changes")
+            new[..., :H, :] = old[..., :H, :]
+        elif k in RESHARD_SHARD_ZERO:
+            new[...] = 0
+        elif k in RESHARD_SHARD_SUM:
+            new[...] = 0
+            new[..., 0, :] = old.sum(axis=-2)
+        elif old.shape[:-1] == new.shape[:-1] and \
+                old.shape[-1] == old_pad and \
+                new.shape[-1] == new_pad:
+            new[..., :H] = old[..., :H]
+        else:
+            raise ValueError(
+                f"reshard_state: leaf {k!r} ({old.shape} -> "
+                f"{new.shape}) is not registered in any reshard "
+                "class — classify it in capacity.RESHARD_* before "
+                "adding state leaves")
+        out[k] = new
+    return out
+
+
+def _aux_leaf(k: str) -> bool:
+    """Auxiliary leaves that may differ between the saving and
+    resuming engines without perturbing the trace (the
+    checkpoint.load_state rule): occupancy telemetry and the
+    invariant-audit word."""
+    return k.startswith("occ_") or k.startswith("aud")
+
+
 def transfer(engine, starts, host_state: dict,
              template: dict = None) -> dict:
     """Place a host-side state snapshot onto a (re-planned) engine:

@@ -115,9 +115,14 @@ def _fingerprint(engine) -> dict:
     from shadow_tpu.device.capacity import app_scalars
     h.update(json.dumps(app_scalars(engine.app),
                         sort_keys=True).encode())
+    # NB: the shard geometry (n_shards, H_pad, H_loc) deliberately
+    # stays OUT of the fingerprint: it lives in the readable
+    # meta["geometry"] keys instead, so a mismatch names the shard
+    # counts ("saved on 4 shards, loading on 3") rather than hiding
+    # inside an opaque fingerprint diff — and so the mesh-shrink
+    # failover's resume path can validate/adopt it directly.
     fp = {
         "n_hosts": int(cfg.n_hosts),
-        "h_pad": int(engine.H_pad),
         "event_capacity": int(cfg.event_capacity),
         "outbox_capacity": int(cfg.outbox_capacity),
         "seed": int(cfg.seed),
@@ -159,6 +164,15 @@ def save_state(engine, state, path: str, sim_time: int,
         "sim_time": int(sim_time),
         "final_stop": int(final_stop),
         "fingerprint": _fingerprint(engine),
+        # the shard geometry the state is laid out for, as READABLE
+        # keys (not folded into the fingerprint): H_pad depends on
+        # n_shards, so a checkpoint written after a mesh-shrink
+        # failover stamps the shrunken geometry here and the runners
+        # adopt it on resume (rebuild the mesh to match) instead of
+        # failing on an opaque fingerprint diff
+        "geometry": {"n_shards": int(engine.n_shards),
+                     "h_pad": int(engine.H_pad),
+                     "h_loc": int(engine.H_loc)},
         # ALL capacity knobs of the saving engine, not just the
         # layout-determining two in the fingerprint: a resume under
         # capacity_plan adopts these, so a plan/widen that grew
@@ -209,6 +223,66 @@ def peek_fingerprint(path: str) -> dict:
     return peek_meta(path)["fingerprint"]
 
 
+def peek_geometry(meta: dict) -> dict:
+    """The shard-geometry stamp of a checkpoint's meta dict.
+    Pre-geometry checkpoints carried only h_pad, inside the
+    fingerprint — surface what exists so callers get one shape."""
+    geom = meta.get("geometry")
+    if geom is not None:
+        return dict(geom)
+    fp = meta.get("fingerprint") or {}
+    return ({"h_pad": int(fp["h_pad"])} if "h_pad" in fp else {})
+
+
+def validate_geometry(path: str, meta: dict, engine) -> None:
+    """Reject a geometry mismatch with a READABLE message naming the
+    shard counts and padded widths — the runners normally adopt the
+    saved geometry before loading (DeviceRunner.
+    _adopt_checkpoint_geometry), so reaching this error means the
+    adoption was impossible or the caller loaded directly."""
+    geom = peek_geometry(meta)
+    if not geom:
+        return
+    saved_n = geom.get("n_shards")
+    saved_pad = geom.get("h_pad")
+    if (saved_n is not None and int(saved_n) != engine.n_shards) or \
+            (saved_pad is not None and int(saved_pad) != engine.H_pad):
+        raise ValueError(
+            f"checkpoint {path}: saved on "
+            f"{saved_n if saved_n is not None else '?'} shard(s) "
+            f"(H_pad {saved_pad}), loading on {engine.n_shards} "
+            f"(H_pad {engine.H_pad}) — resume on a mesh of the saved "
+            "shard count (the tpu runner adopts it automatically "
+            "from this stamp; experimental.mesh_shards pins it by "
+            "hand), or re-run from scratch")
+
+
+def load_host_state(path: str):
+    """Raw host-side leaves + meta, with NO engine/template
+    validation: the mesh-shrink failover re-shards the saved pytree
+    onto a DIFFERENT geometry (capacity.reshard_state), so the usual
+    shape/sharding checks cannot apply here. Keys come back plain
+    (``"['ht']"`` -> ``"ht"``). Returns (state, meta)."""
+    import re
+
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        if meta.get("format") != FORMAT:
+            raise ValueError(
+                f"checkpoint {path}: format {meta.get('format')} "
+                f"(this build reads format {FORMAT})")
+        saved = {k: z[f"leaf_{i}"]
+                 for i, k in enumerate(meta["keys"])}
+    state = {}
+    for k, v in saved.items():
+        m = re.fullmatch(r"\['(\w+)'\]", k)
+        if not m:
+            raise ValueError(
+                f"checkpoint {path}: unexpected state key {k!r}")
+        state[m.group(1)] = v
+    return state, meta
+
+
 def load_state(engine, starts, path: str, final_stop: int = 0,
                template: dict = None):
     """Load a checkpoint into a fresh engine: builds a template state
@@ -255,7 +329,14 @@ def load_state(engine, starts, path: str, final_stop: int = 0,
             f"({meta['ensemble']}); a standalone run cannot resume "
             "it — load it under the same ensemble config")
 
-    fp, want = meta["fingerprint"], _fingerprint(engine)
+    # shard geometry first, by its readable keys: "saved on 4 shards,
+    # loading on 2" beats an opaque fingerprint diff, and the reshard
+    # path validates exactly these
+    validate_geometry(path, meta, engine)
+    fp, want = dict(meta["fingerprint"]), _fingerprint(engine)
+    # pre-geometry checkpoints carried the padded width inside the
+    # fingerprint; validate_geometry covered it above
+    fp.pop("h_pad", None)
     if fp != want:
         diffs = {k: (fp.get(k), want[k]) for k in want
                  if fp.get(k) != want[k]}

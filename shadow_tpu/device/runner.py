@@ -30,7 +30,7 @@ from shadow_tpu.device.apps import (
     TgenDevice,
     TorDevice,
 )
-from shadow_tpu.device.engine import DeviceEngine, EngineConfig
+from shadow_tpu.device.engine import AXIS, DeviceEngine, EngineConfig
 from shadow_tpu.models.phold import PholdApp
 from shadow_tpu.models.tgen import TgenClientApp, TgenServerApp
 from shadow_tpu.models.tor import TorClientApp, TorRelayApp
@@ -179,6 +179,22 @@ class DeviceRunner:
             log.warning("tpu policy: pcap capture requires a CPU "
                         "scheduler policy (packets are device-resident "
                         "metadata here)")
+        if mesh is None and cfg.experimental.mesh_shards:
+            # experimental.mesh_shards: pin the mesh to the first N
+            # devices (the chaos gate's uninterrupted M-shard
+            # comparison runs; shrunken-geometry resumes on a
+            # healthy pool) without XLA_FLAGS process-global
+            # forcing. Resolved before plan adoption below — the
+            # plan's applicability gates must see the mesh that
+            # actually runs.
+            from jax.sharding import Mesh
+            n = cfg.experimental.mesh_shards
+            devs = jax.devices()
+            if n > len(devs):
+                raise ValueError(
+                    f"experimental.mesh_shards={n} but only "
+                    f"{len(devs)} device(s) are available")
+            mesh = Mesh(np.array(devs[:n]), (AXIS,))
         # strategy-plan adoption (shadow_tpu/tune/plan.py,
         # docs/autotune.md): under experimental.strategy_plan a
         # stored PLAN record for this workload fingerprint re-tunes
@@ -214,6 +230,12 @@ class DeviceRunner:
             self.app.burst_pops = bp
         self._burst = max(1, getattr(self.app, "burst_pops", 1))
         self._mesh = mesh
+        # deterministic chaos injection (device/chaos.py): installed
+        # process-global for the run's lifetime — None without a
+        # schedule, so schedules never leak across in-process runs
+        from shadow_tpu.device import chaos as chaosmod
+        self.chaos = chaosmod.from_config(cfg.experimental)
+        chaosmod.set_current(self.chaos)
         # capacity overrides on top of the config's static knobs:
         # filled by the occupancy planner (capacity_plan: auto|path)
         # and widened by the overflow re-plan/retry loop
@@ -244,6 +266,7 @@ class DeviceRunner:
         self.checkpointer = None
         self.guard = None
         self.retries = 0
+        self.reshards = 0
         # flight recorder (shadow_tpu/obs): the Controller attaches
         # its run-wide tracer; None (direct construction in tests)
         # falls through to the module-global current() in advance
@@ -500,6 +523,158 @@ class DeviceRunner:
             self._exchange_choice = meta.get("exchange",
                                              "all_to_all")
 
+    def _adopt_checkpoint_geometry(self, load_path: str) -> bool:
+        """A checkpoint written after a mesh-shrink failover stamps
+        the shrunken geometry (checkpoint meta["geometry"]); loading
+        it onto the full mesh would be a hard layout mismatch. Adopt
+        instead: rebuild the mesh on the first ``n_shards`` available
+        devices so the resume lands on the saved geometry — traces
+        are mesh-placement-invariant, so WHICH devices is free.
+        Returns whether the mesh changed (the EnsembleRunner rebuilds
+        its campaign engine then). ONE adopt path for both runners,
+        like _adopt_checkpoint_caps."""
+        from shadow_tpu.device import checkpoint
+
+        geom = checkpoint.peek_geometry(
+            checkpoint.peek_meta(load_path))
+        n = geom.get("n_shards")
+        if n is None:
+            return False
+        n = int(n)
+        cur = (self._mesh.devices.size if self._mesh is not None
+               else len(jax.devices()))
+        if n == cur:
+            return False
+        devs = (list(self._mesh.devices.flat)
+                if self._mesh is not None else jax.devices())
+        if n > len(devs):
+            raise ValueError(
+                f"checkpoint {load_path} was saved on {n} shard(s) "
+                f"but only {len(devs)} device(s) are available — "
+                "resume on a pool of at least the saved shard count")
+        from jax.sharding import Mesh
+        log.warning(
+            "checkpoint %s was saved on %d shard(s) (this pool has "
+            "%d) — rebuilding the mesh to the saved geometry for "
+            "the resume", load_path, n, len(devs))
+        self._mesh = Mesh(np.array(devs[:n]), (AXIS,))
+        if self.engine is not None:
+            self.engine = self._build_engine()
+        return True
+
+    def _replan_for_shrink(self, n_shards: int, record: dict = None,
+                           per_iter: int = 0) -> None:
+        """The exchange-geometry capacities were planned/auto-sized
+        for the OLD shard count — fewer shards mean more hosts (and
+        rows) per shard pair, so carrying them over would guarantee
+        overflow re-plans. Drop them, re-resolve the exchange
+        schedule for the new width (``exchange: auto``), and re-plan
+        the caps from the measured occupancy record when one exists
+        (capacity.pair_matrix degrades a mismatched-shape pair
+        matrix to a safe scalar bound). Per-host capacities
+        (event/outbox/IN/compact) are shard-independent and stay."""
+        from shadow_tpu.device import capacity
+        from shadow_tpu.tune import plan as planmod
+
+        xp = self.sim.cfg.experimental
+        for k in ("exchange_capacity", "exchange_capacity2"):
+            # 0, not pop: a hand-set static knob was sized for the
+            # dead geometry too — the override restores the engine's
+            # own auto-sizing until the record-based plan below (if
+            # any) supplies measured caps for the new width
+            self._capacity_overrides[k] = 0
+        record = record if record is not None else self.occ_record
+        floor_iters = 4 if self._burst > 1 else 8
+        # the EnsembleRunner passes its campaign engine's lane width
+        # (the base runner's engine is deferred there)
+        per_iter = per_iter or self.engine.effective["M_out"]
+        exchange = xp.exchange
+        if xp.exchange == "auto":
+            if record is not None:
+                choice, info = capacity.choose_exchange(
+                    record, n_shards, per_iter=per_iter,
+                    floor_iters=floor_iters,
+                    headroom=self._headroom())
+                record["exchange_auto"] = info
+                exchange = self._exchange_choice = choice
+                log.info("shrink re-plan: exchange auto -> %s at %d "
+                         "shard(s)", choice, n_shards)
+            else:
+                exchange = self._exchange_choice = "all_to_all"
+        if record is not None:
+            planned = capacity.plan(
+                record, per_iter=per_iter, floor_iters=floor_iters,
+                n_shards=n_shards, headroom=self._headroom(),
+                exchange=exchange)
+            for k in ("exchange_capacity", "exchange_capacity2"):
+                if planned[k]:
+                    self._capacity_overrides[k] = planned[k]
+            log.info("shrink re-plan at %d shard(s): %s", n_shards,
+                     {k: v for k, v in self._capacity_overrides
+                      .items() if k.startswith("exchange")})
+        # the adopted strategy plan was validated against the old run
+        # shape: re-run its applicability gates under the new shard
+        # count and surface the knobs that no longer apply
+        self.strategy_plan = planmod.revalidate_after_reshard(
+            self.sim.cfg, self.strategy_plan, n_shards)
+
+    def _shrink_to(self, alive, host_state: dict,
+                   ensemble: bool = False):
+        """Re-shard a host-side validated snapshot onto the surviving
+        devices: new mesh, re-planned exchange capacities, rebuilt
+        engine (warm through the shared AOT cache), and the snapshot
+        re-padded to the new geometry (capacity.reshard_state) and
+        re-placed with the new template's shardings. Returns the
+        on-device state the advance loop continues from. The
+        EnsembleRunner overrides this to rebuild its campaign
+        engine; the mesh/override mutations stay here — one owner.
+
+        Transactional: a failure anywhere rolls the mesh, engine,
+        overrides, and plan provenance back to the pre-shrink
+        state before re-raising — the escalation that follows
+        persists the (old-geometry) snapshot through
+        ``runner.engine``, so a half-committed shrink would stamp
+        the NEW geometry over old-layout leaves and poison the
+        failover checkpoint."""
+        from jax.sharding import Mesh
+
+        from shadow_tpu.device import supervise
+
+        rollback = (self._mesh, self.engine,
+                    dict(self._capacity_overrides),
+                    self._exchange_choice, self.strategy_plan)
+        try:
+            self._mesh = Mesh(np.array(list(alive)), (AXIS,))
+            self._replan_for_shrink(len(alive))
+            self.engine = self._build_engine()
+            supervise.prefetch_programs(self, ensemble=ensemble)
+            return self._place_resharded(self, host_state, ensemble)
+        except Exception:
+            (self._mesh, self.engine, self._capacity_overrides,
+             self._exchange_choice, self.strategy_plan) = rollback
+            raise
+
+    @staticmethod
+    def _place_resharded(runner, host_state: dict, ensemble: bool):
+        """Shared tail of the shrink: build the new engine's template
+        (shapes + shardings + padding-row values), re-pad the
+        snapshot onto it, and device_put. The template round-trips
+        through the host once — the padding rows' contents (app init
+        rows, heap fills) must be exactly what an uninterrupted run
+        on the new mesh would hold, and init_state is their one
+        source of truth."""
+        from shadow_tpu.device import capacity
+
+        engine = runner.engine
+        template = (engine.init_ensemble_state(runner.sim.starts)
+                    if ensemble else
+                    engine.init_state(runner.sim.starts))
+        new_host = capacity.reshard_state(
+            host_state, len(runner.sim.hosts),
+            jax.device_get(template))
+        return capacity.transfer(engine, runner.sim.starts, new_host,
+                                 template=template)
+
     def _resolve_exchange(self, record: dict, engine=None) -> str:
         """The exchange variant the planned engine will compile:
         the config's explicit choice, or — under `exchange: auto` —
@@ -560,9 +735,10 @@ class DeviceRunner:
         self._hb_mark, (rate,) = heartbeat_rates(self._hb_mark,
                                                  [sent_total])
         log.info("[supervise-heartbeat] t=%s events=%d sent=%d "
-                 "pkts/s=%s retries=%d replans=%d",
+                 "pkts/s=%s retries=%d replans=%d reshards=%d",
                  simtime.format_time(now), int(n_exec[:H].sum()),
-                 sent_total, rate, self.retries, self.replans)
+                 sent_total, rate, self.retries, self.replans,
+                 self.reshards)
 
     def run(self, stop: int) -> SimStats:
         import time as _time
@@ -573,6 +749,7 @@ class DeviceRunner:
         tracer = self.tracer or obstrace.current()
         self.replans = 0
         self.retries = 0
+        self.reshards = 0
         self._hb_mark = None
         if xp.capacity_plan == "static":
             # a re-used runner must not merge this run's measurements
@@ -597,6 +774,11 @@ class DeviceRunner:
                 load_path, stop,
                 save_path=xp.checkpoint_save,
                 save_time=xp.checkpoint_save_time)
+            # a post-shrink checkpoint stamps the shrunken geometry:
+            # adopt it (rebuild the mesh + engine to match) BEFORE
+            # planning/loading, so the resume lands on the saved
+            # padded width instead of a loud layout mismatch
+            self._adopt_checkpoint_geometry(load_path)
         if xp.capacity_plan != "static" and not self._planned:
             with tracer.span("capacity.plan", "plan",
                              mode=xp.capacity_plan):
@@ -757,6 +939,7 @@ class DeviceRunner:
             self.aot_cache.publish(stats)
         stats.replans = self.replans
         stats.retries = self.retries
+        stats.reshards = adv.reshards
         stats.preempted = adv.preempted
         stats.resume_path = adv.resume_path
         # segment-pipeline telemetry (supervise.advance): depth,

@@ -26,15 +26,25 @@ ensemble campaigns need the same three guarantees:
    bit-identical to the uninterrupted one (the checkpoint contract).
    A second signal aborts hard (handlers restored, KeyboardInterrupt).
 
-3. **Dispatch retry + failover** — a transient device error
-   (RESOURCE_EXHAUSTED, device unavailable, ...) retries the failed
-   segment from the last validated state with capped exponential
-   backoff (``dispatch_retries`` / ``dispatch_retry_backoff``). After
-   exhausting retries, ``failover: hybrid`` saves the last validated
-   state to disk and raises :class:`DeviceFailover`, which the
-   Controller answers by re-running on the hybrid backend with a loud
-   diagnostic instead of aborting — the device checkpoint remains on
-   disk for a device-side resume.
+3. **Dispatch retry + the failover ladder** — a transient device
+   error (RESOURCE_EXHAUSTED, device unavailable, ...) retries the
+   failed segment from the last validated state with capped
+   exponential backoff (``dispatch_retries`` /
+   ``dispatch_retry_backoff``). After exhausting retries the ladder
+   engages (``failover:``): ``shrink`` probes the mesh, re-shards
+   the last validated state onto the surviving M devices
+   (:func:`_shrink_recover` + capacity.reshard_state) and continues
+   ON-DEVICE — losing 1 of N chips costs 1/N of throughput, not the
+   run, and the continuation is bit-identical to an uninterrupted
+   M-shard run (the mesh-shape determinism contract); when no
+   shrink is possible it escalates to the hybrid rung. ``hybrid``
+   saves the last validated state to disk and raises
+   :class:`DeviceFailover`, which the Controller answers by
+   re-running on the hybrid backend with a loud diagnostic instead
+   of aborting — the device checkpoint remains on disk for a
+   device-side resume. The ladder is drilled in CI by the
+   deterministic chaos injector (device/chaos.py,
+   ``experimental.chaos``; determinism_gate --chaos).
 
 :func:`advance` is the single segmented-advance loop both
 ``DeviceRunner`` and ``EnsembleRunner`` now share: it generalizes the
@@ -117,16 +127,23 @@ class AuditFailure(RuntimeError):
 
 
 class DeviceFailover(RuntimeError):
-    """Dispatch retries exhausted under ``failover: hybrid``: carries
-    the last validated checkpoint (for a later device-side resume) and
-    the sim time it pins. The Controller catches this and re-runs the
-    config on the hybrid backend."""
+    """Dispatch retries (and, under ``failover: shrink``, the mesh
+    shrink) exhausted: carries the last validated checkpoint (for a
+    later device-side resume) and the sim time it pins. The
+    Controller catches this and re-runs the config on the hybrid
+    backend. ``checkpoint_path`` is explicitly ``None`` when no
+    state could be persisted at all (the save failed AND no rotating
+    checkpoint exists) — ``persist_error`` then names the save
+    failure, and the Controller's single diagnostic surfaces it: the
+    hybrid rerun restarts from t=0 with no device-side resume
+    point."""
 
-    def __init__(self, message: str, checkpoint_path: str = "",
-                 sim_time: int = 0):
+    def __init__(self, message: str, checkpoint_path=None,
+                 sim_time: int = 0, persist_error: str = ""):
         super().__init__(message)
         self.checkpoint_path = checkpoint_path
         self.sim_time = int(sim_time)
+        self.persist_error = persist_error
 
 
 def is_transient(exc: BaseException) -> bool:
@@ -273,6 +290,128 @@ def prefetch_programs(runner, ensemble: bool = False) -> None:
     cache.prefetch(key, program=program)
 
 
+def surviving_devices(mesh) -> list:
+    """Probe every device of a mesh for liveness (a trivial placement
+    + sync per device) and return the survivors, in mesh order. The
+    chaos injector's dead set is consulted first, so a scripted
+    device loss (device/chaos.py) fails the probe exactly the way a
+    real dead chip does — the shrink failover cannot tell them
+    apart, which is the point."""
+    from shadow_tpu._jax import jax
+    from shadow_tpu.device import chaos as chaosmod
+
+    inj = chaosmod.current()
+    alive = []
+    for d in mesh.devices.flat:
+        if inj is not None and inj.is_dead(d.id):
+            log.warning("device %s failed the liveness probe "
+                        "(scripted device loss)", d)
+            continue
+        try:
+            jax.block_until_ready(
+                jax.device_put(np.zeros(1, np.int32), d))
+        except Exception as e:      # noqa: BLE001 — any probe failure = dead
+            log.warning("device %s failed the liveness probe: %s",
+                        d, e)
+            continue
+        alive.append(d)
+    return alive
+
+
+def _shrink_recover(runner, exc, good_state, good_t, ensemble, ck,
+                    tracer):
+    """``failover: shrink`` — retries exhausted on a device error:
+    probe the mesh, and if dead devices are found with at least one
+    survivor, re-shard the last validated state onto the M-device
+    mesh and hand back a state the advance loop continues from
+    ON-DEVICE (losing 1 of N chips costs 1/N of throughput, not the
+    run). Returns ``(new_state, validated_t)`` or None when no
+    shrink is possible (nothing dead, nothing alive, or the state is
+    unrecoverable) — the caller then escalates down the failover
+    ladder.
+
+    Determinism: the engine's traces are bit-identical across mesh
+    shapes, the re-shard (capacity.reshard_state) carries every
+    per-host leaf verbatim, and segment boundaries are a pure
+    function of sim time — so the N-shard prefix + M-shard
+    continuation equals both the uninterrupted M-shard run and the
+    serial oracle (determinism_gate --chaos pins all three)."""
+    from shadow_tpu._jax import jax
+    from shadow_tpu.device import checkpoint
+
+    engine = runner.engine
+    old_n = engine.n_shards
+    with tracer.span("reshard.probe", "reshard", sim_t0=good_t,
+                     shards=old_n):
+        alive = surviving_devices(engine.mesh)
+    n_dead = len(list(engine.mesh.devices.flat)) - len(alive)
+    if n_dead == 0:
+        log.error("shrink failover: every mesh device passed the "
+                  "liveness probe — the dispatch failure (%s) cannot "
+                  "be attributed to a dead device; escalating", exc)
+        return None
+    if not alive:
+        log.error("shrink failover: no mesh device survived the "
+                  "liveness probe; escalating")
+        return None
+    # recover the last validated state host-side; a dead device owns
+    # shards of the in-memory snapshot, so the fetch may fail — the
+    # newest rotating checkpoint on disk is the fallback, and the
+    # replay rewinds to ITS sim time (older than good_t is fine:
+    # deterministic segments recompute bit-identically)
+    t_good = good_t
+    try:
+        host_state = jax.device_get(good_state)
+    except Exception as fetch_err:      # noqa: BLE001 — dead-device fetch
+        if ck is None or not ck.last_path:
+            log.error("shrink failover: the last validated state is "
+                      "unrecoverable (%s) and no rotating checkpoint "
+                      "exists; escalating", fetch_err)
+            return None
+        log.warning("shrink failover: could not fetch the in-memory "
+                    "state (%s); re-sharding the newest readable "
+                    "rotating checkpoint instead", fetch_err)
+        # newest-READABLE walk (the resolve_checkpoint rule): the
+        # newest entry may be the torn artifact a crash leaves —
+        # forfeiting the shrink over it when an older readable entry
+        # exists would be exactly the failure mode the rotation is
+        # for. Replaying from an older boundary is fine:
+        # deterministic segments recompute bit-identically.
+        host_state = None
+        for _, p_e in reversed(rotation_entries(ck.base)):
+            try:
+                host_state, meta = checkpoint.load_host_state(p_e)
+                break
+            except Exception as load_err:   # noqa: BLE001 — torn entry
+                log.warning("shrink failover: rotation entry %s is "
+                            "unreadable (%s); trying the previous "
+                            "one", p_e, load_err)
+        if host_state is None:
+            log.error("shrink failover: no readable rotation entry "
+                      "under %s; escalating", ck.base)
+            return None
+        t_good = int(meta["sim_time"])
+    try:
+        with tracer.span("reshard.shrink", "reshard", sim_t0=t_good,
+                         from_shards=old_n, to_shards=len(alive),
+                         error=str(exc)[:200]) as sp:
+            state = runner._shrink_to(alive, host_state,
+                                      ensemble=ensemble)
+            sp.add(h_pad=runner.engine.H_pad)
+    except Exception as re_err:         # noqa: BLE001 — escalate, not crash
+        log.error("shrink failover: re-sharding onto the %d "
+                  "surviving device(s) failed (%s); escalating",
+                  len(alive), re_err)
+        return None
+    log.warning(
+        "MESH SHRINK: %d device(s) dead (%s) — re-sharded the last "
+        "validated state (t=%d ns) onto the %d surviving device(s) "
+        "and continuing on-device at %d/%d of mesh throughput; "
+        "checkpoints from here stamp the shrunken geometry",
+        n_dead, exc, t_good, len(alive), len(alive), old_n)
+    return state, t_good
+
+
 def drain_possible(cfg) -> bool:
     """Whether a run under this config ever reaches a segment
     boundary before its pause — the only points a preemption drain
@@ -379,6 +518,14 @@ class Checkpointer:
             audit_meta={"enabled": self.audit_enabled,
                         "violations": 0})
         self.last_path, self.last_t = path, t
+        from shadow_tpu.device import chaos as chaosmod
+        inj = chaosmod.current()
+        if inj is not None:
+            # chaos seam: a scripted checkpoint_corrupt truncates the
+            # entry just landed (the decoy a SIGKILL leaves) — the
+            # run continues; resume must hit the newest-readable
+            # rotation fallback
+            inj.on_checkpoint_saved(path)
         self._prune()
         log.info("rotating checkpoint at t=%d ns -> %s "
                  "(keep %d; resume with checkpoint_load: %s)",
@@ -464,6 +611,10 @@ class AdvanceResult:
     preempted: bool = False
     resume_path: str = ""
     retries: int = 0
+    # mesh shrinks absorbed (failover: shrink): each one cost a
+    # drain + re-shard + engine rebuild and dropped the mesh to the
+    # surviving devices
+    reshards: int = 0
     # pipeline telemetry (always populated): depth, issued/drained
     # segment counts, discarded speculative segments, the wall spent
     # blocked in dispatch.sync, and the host wall that ran with >= 1
@@ -569,6 +720,7 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
         return path
 
     depth = max(1, int(getattr(xp, "pipeline_depth", 0) or 0))
+    chaos_inj = getattr(runner, "chaos", None)
     res = AdvanceResult()
     window = PipelineWindow(depth)
     good_state, good_t = (state if keep_good else None), t_start
@@ -605,16 +757,20 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
             nxt = min(nxt, ck.next_after(ti))
         return nxt
 
-    def rewind_to_good(new_state):
+    def rewind_to_good(new_state, new_t=None):
         """Recovery epilogue shared by every replay path: install
         the re-placed state as both the validated snapshot and the
         issue head, and rewind both clocks to the last validated
         boundary. The replay then proceeds through the normal
         issue/drain loop — deterministic segments recompute
         bit-identically, so a replayed prefix never changes the
-        trace."""
+        trace. ``new_t`` overrides the boundary the state pins (the
+        shrink failover may fall back to an on-disk checkpoint older
+        than the in-memory snapshot)."""
         nonlocal cur_state, t, t_issue, next_hb, next_ck
-        nonlocal good_state, pending_error, last_sync_end
+        nonlocal good_state, good_t, pending_error, last_sync_end
+        if new_t is not None:
+            good_t = int(new_t)
         cur_state = new_state
         good_state = new_state
         t = t_issue = good_t
@@ -647,6 +803,18 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
         # reports it mid-run, not just the end-of-run SimStats
         runner.retries = res.retries
         if failures > xp.dispatch_retries:
+            if xp.failover == "shrink":
+                shrunk = _shrink_recover(runner, e, good_state,
+                                         good_t, ensemble, ck,
+                                         tracer)
+                if shrunk is not None:
+                    new_state, t_shrunk = shrunk
+                    failures = 0        # the new mesh earns a fresh
+                    # budget: a second device death on the shrunken
+                    # mesh walks the same retry -> shrink ladder
+                    res.reshards += 1
+                    runner.reshards = res.reshards
+                    return rewind_to_good(new_state, t_shrunk)
             _escalate(runner, e, good_state, good_t, stop,
                       ensemble, ck)
         delay = min(
@@ -685,6 +853,14 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
                 with tracer.span("dispatch.issue", "dispatch.issue",
                                  sim_t0=t_issue, sim_t1=nxt,
                                  in_flight=len(window)):
+                    if chaos_inj is not None:
+                        # the deterministic chaos seam: counts this
+                        # issue and raises the scripted error when a
+                        # fault (or a previously killed device on
+                        # this mesh) is scheduled here — routed
+                        # through pending_error like any real
+                        # asynchronous dispatch failure
+                        chaos_inj.on_dispatch_issue(runner.engine)
                     cur_state, seg_rounds = run_segment(cur_state,
                                                         nxt)
             except AuditFailure:
@@ -909,14 +1085,26 @@ def _recover_state(runner, good_state, replace_state, ck, stop,
 
 
 def _escalate(runner, exc, good_state, good_t, stop, ensemble, ck):
-    """Retries exhausted: under ``failover: hybrid`` persist the last
-    validated state and raise DeviceFailover for the Controller;
-    otherwise re-raise the dispatch error."""
+    """Retries exhausted and no shrink absorbed the loss: the
+    failover ladder's last rung. ``abort`` re-raises; ``hybrid`` —
+    and ``shrink``, whose hybrid rung this is when no shrink was
+    possible — persists the last validated state and raises
+    DeviceFailover for the Controller's hybrid rerun. Campaigns
+    never reach the hybrid rung (CPU host emulation cannot vmap
+    replicas): they re-raise with the last validated checkpoint on
+    disk.
+
+    When the persist fails AND no rotating checkpoint exists, the
+    failover still runs: the raised DeviceFailover carries
+    ``checkpoint_path=None`` and the persist error, and the
+    Controller surfaces ONE loud diagnostic naming it — previously
+    this path silently degraded to a bare re-raise with no state on
+    disk and no failover at all."""
     from shadow_tpu._jax import jax
     from shadow_tpu.device import checkpoint
 
     xp = runner.sim.cfg.experimental
-    if xp.failover != "hybrid" or ensemble:
+    if xp.failover == "abort" or ensemble:
         raise exc
     path, t_pin = "", good_t
     if ck is not None and ck.last_path:
@@ -935,11 +1123,16 @@ def _escalate(runner, exc, good_state, good_t, stop, ensemble, ck):
         path, t_pin = fo_path, good_t
     except Exception as save_err:       # noqa: BLE001
         if not path:
-            log.error("failover: could not persist the last "
-                      "validated state (%s) and no rotating "
-                      "checkpoint exists — re-raising the dispatch "
-                      "error", save_err)
-            raise exc from None
+            # no state anywhere: the Controller's diagnostic is THE
+            # loud surface (one message naming the persist error) —
+            # no second error log here
+            raise DeviceFailover(
+                f"device dispatch failed permanently after "
+                f"{xp.dispatch_retries} retries ({exc}); the last "
+                f"validated state at t={good_t} ns could NOT be "
+                f"persisted ({save_err})",
+                checkpoint_path=None, sim_time=good_t,
+                persist_error=str(save_err)) from exc
         log.warning("failover: could not persist the in-memory state "
                     "(%s); the last rotating checkpoint %s (t=%d ns) "
                     "pins the device-side resume", save_err, path,

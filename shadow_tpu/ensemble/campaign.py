@@ -107,7 +107,12 @@ class EnsembleRunner:
         self.engine = self._build_engine()
         self.replans = 0
         self.retries = 0
+        self.reshards = 0
         self._planned = False
+        # chaos injection + shrink failover ride the base runner's
+        # plumbing (one injector, one mesh owner); the shared advance
+        # loop reads runner.chaos
+        self.chaos = self._base.chaos
         self.occ_record: Optional[dict] = None
         self.record: Optional[dict] = None
         self.final_state: Optional[dict] = None
@@ -156,6 +161,41 @@ class EnsembleRunner:
     @_capacity_overrides.setter
     def _capacity_overrides(self, value: dict) -> None:
         self._base._capacity_overrides = value
+
+    def _shrink_to(self, alive, host_state: dict,
+                   ensemble: bool = True):
+        """The shrink failover's campaign path: mesh + capacity
+        re-plan route through the base runner (the one owner of
+        both), then the CAMPAIGN engine — vmapped replica axis
+        outside the new, smaller mesh axis — rebuilds and the
+        [R, ...] snapshot re-shards leaf-for-leaf. The replica axis
+        survives intact: shrink is the one failover campaigns have
+        (hybrid cannot vmap replicas). Transactional like the base
+        runner's: a failed reshard rolls everything back so the
+        escalation still sees the old-geometry engine."""
+        from jax.sharding import Mesh
+
+        from shadow_tpu.device import supervise
+        from shadow_tpu.device.engine import AXIS
+        from shadow_tpu.device.runner import DeviceRunner
+
+        base = self._base
+        rollback = (base._mesh, self.engine,
+                    dict(base._capacity_overrides),
+                    base._exchange_choice, base.strategy_plan)
+        try:
+            base._mesh = Mesh(np.array(list(alive)), (AXIS,))
+            base._replan_for_shrink(
+                len(alive), record=self.occ_record,
+                per_iter=self.engine.effective["M_out"])
+            self.engine = self._build_engine()
+            supervise.prefetch_programs(self, ensemble=True)
+            return DeviceRunner._place_resharded(self, host_state,
+                                                 ensemble=True)
+        except Exception:
+            (base._mesh, self.engine, base._capacity_overrides,
+             base._exchange_choice, base.strategy_plan) = rollback
+            raise
 
     # ------------------------------------------------------------------
     def _worst_case_view(self, states) -> dict:
@@ -371,6 +411,7 @@ class EnsembleRunner:
         tracer = self.tracer or obstrace.current()
         self.replans = 0
         self.retries = 0
+        self.reshards = 0
         self._hb_mark = None
         w = self.worlds
         if xp.checkpoint_save:
@@ -396,6 +437,11 @@ class EnsembleRunner:
                 load_path, stop,
                 save_path=xp.checkpoint_save,
                 save_time=xp.checkpoint_save_time)
+            # a post-shrink campaign checkpoint stamps the shrunken
+            # geometry: the base runner adopts the mesh (one adopt
+            # path), then the CAMPAIGN engine rebuilds on it
+            if self._base._adopt_checkpoint_geometry(load_path):
+                self.engine = self._build_engine()
         if xp.capacity_plan != "static" and not self._planned:
             with tracer.span("capacity.plan", "plan",
                              mode=xp.capacity_plan, ensemble=True):
@@ -523,6 +569,7 @@ class EnsembleRunner:
             self.aot_cache.publish(stats)
         stats.replans = self.replans
         stats.retries = self.retries
+        stats.reshards = adv.reshards
         stats.preempted = adv.preempted
         stats.resume_path = adv.resume_path
         # campaigns ride the same segment pipeline as standalone runs

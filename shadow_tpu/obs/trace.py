@@ -66,10 +66,16 @@ MODES = ("off", "summary", "trace")
 # (blocking waits for device results) split the old conflated
 # "dispatch" bucket so device-bound and sync-bound wall are finally
 # distinguishable; "dispatch" itself remains for engine.profile()'s
-# fenced phase splits.
+# fenced phase splits. "reshard" is the mesh-shrink failover's
+# degradation cost (liveness probe + re-shard + re-place; the
+# rebuild's compile wall lands in "compile" as ever), "chaos" marks
+# scripted fault injections (instants — the faults themselves cost
+# nothing), and "failover" is the hybrid-rerun rung's own overhead
+# (the rerun's inner spans keep their phases).
 PHASES = ("host", "judge", "dispatch", "dispatch.issue",
           "dispatch.sync", "exchange", "checkpoint",
-          "retry", "compile", "plan")
+          "retry", "compile", "plan", "reshard", "chaos",
+          "failover")
 
 # recent-span ring size: what a watchdog stall dump embeds so a hang
 # report shows what the run WAS doing, not just where it stopped

@@ -193,3 +193,44 @@ def adopt(cfg, app, n_hosts: int, n_shards: int = 0,
         log.info("strategy plan %s matched but every knob was "
                  "skipped (%s)", path, skipped or "empty plan")
     return prov
+
+
+def revalidate_after_reshard(cfg, provenance, n_shards: int):
+    """A mesh-shrink failover changed the run shape the adopted plan
+    was tuned and gate-validated against (plans are fingerprinted
+    per shard count). Every plan-space knob is individually
+    bit-identity-pinned, so nothing already applied can corrupt the
+    trace — but knobs whose applicability gate fails under the NEW
+    shard count (an exchange schedule tuned for a wider mesh, a
+    pipeline depth sized to the old segment cost) are now merely
+    inherited, not tuned. Re-run each applied knob's gate under the
+    new geometry and stamp the survivors/stale ones into the
+    provenance (``SimStats.strategy_plan``), so post-shrink records
+    never read as 'tuned for this mesh'. The exchange geometry
+    itself is re-planned for real by the runner
+    (DeviceRunner._replan_for_shrink) — this is the audit trail."""
+    if not provenance:
+        return provenance
+    ctx = space.context(cfg, n_shards=n_shards)
+    ctx["policy"] = "tpu"
+    stale = {}
+    for name in (provenance.get("knobs") or {}):
+        knob = space.KNOB_BY_NAME.get(name)
+        if knob is not None and not knob.applies(cfg, ctx):
+            stale[name] = (f"tuned for the pre-shrink mesh; gate "
+                           f"fails at n_shards={n_shards}")
+    out = dict(provenance)
+    out["resharded_to"] = int(n_shards)
+    if stale:
+        out["stale_after_reshard"] = stale
+        log.warning(
+            "strategy plan: knob(s) %s were tuned for the pre-shrink "
+            "mesh and no longer pass their applicability gate at %d "
+            "shard(s) — values stay (each is bit-identity-pinned) "
+            "but the plan should be re-tuned for the new geometry "
+            "(scripts/tune.py)", sorted(stale), n_shards)
+    else:
+        log.info("strategy plan re-validated after the mesh shrink: "
+                 "every adopted knob still applies at %d shard(s)",
+                 n_shards)
+    return out

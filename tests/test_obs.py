@@ -364,6 +364,48 @@ def test_e2e_modes_bit_identical_and_trace_artifacts(tmp_path):
     assert s_tr.telemetry["phases"] == m["phases"]
 
 
+def test_failover_rerun_lands_in_same_trace(tmp_path, monkeypatch):
+    """Satellite: the hybrid failover rerun shares its parent's
+    flight recorder — its spans land in the SAME trace under a
+    `failover` phase, and the METRICS walls still sum to total (the
+    host bucket is the residual by construction, so the failover
+    span's self-time must not double-count the inner run's spans)."""
+    import shadow_tpu.device.engine as eng
+    from shadow_tpu.config import load_config_str
+    from shadow_tpu.core.controller import Controller
+
+    def dead(self, state, stop=None, final_stop=None):
+        raise RuntimeError("UNAVAILABLE: device went away")
+
+    monkeypatch.setattr(eng.DeviceEngine, "run", dead)
+    tel = tmp_path / "tel_failover"
+    cfg = load_config_str(E2E_YAML.format(
+        mode="trace", tel=tel, data=tmp_path / "fo" / "shadow.data"))
+    cfg.experimental.failover = "hybrid"
+    cfg.experimental.dispatch_segment = 500_000_000
+    stats = Controller(cfg).run()
+    assert stats.ok
+    summary = stats.telemetry
+    assert summary is not None
+    # ONE finalized recorder for the whole incident: the rerun did
+    # not write its own METRICS/TRACE set
+    mfiles = list(tel.glob("METRICS_*.json"))
+    jfiles = list(tel.glob("TRACE_*.jsonl"))
+    assert len(mfiles) == 1 and len(jfiles) == 1
+    recs = [json.loads(ln) for ln in
+            jfiles[0].read_text().strip().splitlines()]
+    fo = [r for r in recs if r["phase"] == "failover"]
+    assert fo and fo[0]["name"] == "failover.hybrid_rerun"
+    # the hybrid rerun's own spans (judge flushes, at minimum) are in
+    # the SAME stream, after the device prefix's dispatch spans
+    assert any(r["phase"] == "judge" for r in recs)
+    assert any(r["name"] == "dispatch.issue" for r in recs)
+    # walls still sum to total (host is the residual)
+    assert sum(summary["phases"].values()) == pytest.approx(
+        summary["total_wall_s"], rel=0.1)
+    assert summary["span_counts"].get("failover", 0) >= 1
+
+
 def test_ensemble_heartbeat_rate_columns(caplog):
     # satellite: per-replica [ensemble-heartbeat] lines carry a
     # pkts/s-since-last-heartbeat rate and cumulative retry/replan
