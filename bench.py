@@ -240,6 +240,32 @@ def _plan_stamp(c, stats) -> dict:
                      "score": prov.get("score")}}
 
 
+def _admission_stamp(stats) -> dict:
+    """Preflight admission provenance for a rung record
+    (device/capacity.py admission_verdict): the verdict, the modeled
+    per-device footprint, the budget it was compared against, any
+    static overrides (lowered pipeline depth, replica-batch split),
+    and the runtime degradation-ladder rung count — a benched wall
+    that ran degraded must never be compared against full-footprint
+    runs unnoticed."""
+    adm = getattr(stats, "admission", None)
+    out = {}
+    if adm is not None:
+        est = adm.get("estimate") or {}
+        entry = {"mode": adm.get("mode"),
+                 "action": adm.get("action"),
+                 "budget": adm.get("budget"),
+                 "budget_source": adm.get("budget_source"),
+                 "footprint_per_device": est.get("per_device"),
+                 "overrides": adm.get("overrides") or {}}
+        if adm.get("replica_batch"):
+            entry["replica_batch"] = adm["replica_batch"]
+        out["admission"] = entry
+    if getattr(stats, "degrades", 0):
+        out["degrades"] = stats.degrades
+    return out
+
+
 def load_tuned_knobs() -> dict:
     """Best (pop_strategy, burst_pops, outbox_compact) combo measured
     ON CHIP by scripts/tune_10k.py, if a committed sweep artifact
@@ -440,6 +466,9 @@ def run_device(config_path: str, stop_s: float,
     # every device rung record so sync-bound vs device-bound wall
     # is attributable from the BENCH record alone
     stamp["pipeline"] = stats.pipeline
+    # preflight admission verdict + modeled footprint (and any
+    # degradation the run absorbed) ride every device rung record
+    stamp.update(_admission_stamp(stats))
     if stats.reshards:
         # a bench run that survived device loss is NOT a clean perf
         # record: stamp the shrink count + the shrunken mesh so the
@@ -557,6 +586,7 @@ def run_multichip_rung(n_chips: int, fell_back: bool,
     if not stats.ok:
         return {**out, "error": "multichip run overflowed"}
     out.update(_plan_stamp(c, stats))
+    out.update(_admission_stamp(stats))
     eng = c.runner.engine
     eff = eng.effective
     occ = stats.occupancy or {}
@@ -654,6 +684,7 @@ def run_ensemble_rung() -> dict:
     if not s2.ok:
         return {**out, "error": "campaign overflowed"}
     out["campaign_wall_s"] = round(ens_wall, 2)
+    out.update(_admission_stamp(s2))
     s2_stamp = _cache_stamp(c2)
     out["campaign_compile_s"] = s2_stamp.get("compile_s")
     out["campaign_cache_hit"] = s2_stamp.get("cache_hit")
@@ -793,6 +824,7 @@ def run_pipelined_rung(name: str, config_path: str, stop_s: float
                 "pkts_per_s": round(stats.packets_sent / wall, 1),
                 "pipeline": dict(stats.pipeline or {}),
             }
+            rec.update(_admission_stamp(stats))
             if stats.telemetry is not None:
                 rec["phase_walls"] = stats.telemetry.get("phases")
                 rec["dominant_phase"] = stats.telemetry.get(
@@ -1070,7 +1102,7 @@ def main() -> int:
                 # strategy-plan provenance (None = default knobs)
                 **{k: d_stamp.get(k) for k in
                    ("compile_s", "first_dispatch_s", "cache_hit",
-                    "plan")},
+                    "plan", "admission", "degrades")},
             }
             last_rung_wall = d_wall + c_wall
             log(f"  speedup vs thread policy: {ratio:.2f}x")
@@ -1114,6 +1146,12 @@ def main() -> int:
         result["phase_walls"] = f_stamp.get("phase_walls")
         result["dominant_phase"] = f_stamp.get("dominant_phase")
         result["pipeline"] = f_stamp.get("pipeline")
+        # preflight admission verdict + modeled footprint for the
+        # headline run (and the degrade-rung count if it absorbed a
+        # runtime OOM) — same comparability rule as the plan stamp
+        result["admission"] = f_stamp.get("admission")
+        if f_stamp.get("degrades"):
+            result["degrades"] = f_stamp["degrades"]
         result["ladder"] = ladder
 
         if headline_path in _occ_records:

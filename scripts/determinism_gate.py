@@ -87,6 +87,20 @@ geometry and resume bit-identically on the full pool; and a
 scripted corrupted-rotation-entry schedule must engage the
 newest-readable fallback.
 
+`--degrade` switches to the ADMISSION + DEGRADATION-LADDER gate
+(device/capacity.py admission + device/supervise.py oom ladder): a
+run must never OOM blind. `admission: strict` under a deliberately
+tiny `device_memory_budget` must refuse with the readable "needs X,
+budget Y on N devices" diagnostic before ANY compile; a scripted
+RESOURCE_EXHAUSTED (chaos oom) at the 0th program compile (cold AOT
+cache, so the compile really runs) and at the 2nd dispatch issue of
+a depth-4 pipelined run must each walk the degradation ladder —
+degrade >= 1, the retry budget NOT exhausted — and finish
+bit-identical to the serial oracle; and `--chaos-ensemble`'s
+campaign run in sequential replica batches
+(ensemble.replica_batch=2) must bit-match the full-vmap campaign
+and a standalone run of replica 0 (needs >= 4 devices).
+
 `--ensemble` switches to the CAMPAIGN gate (shadow_tpu/ensemble/):
 the config must carry an `ensemble:` block. The gate runs the
 campaign twice (run-to-run bit-identity over every replica), then
@@ -1062,6 +1076,265 @@ def run_chaos_gate(config: str, ensemble_config: str) -> int:
         return rc
 
 
+def run_degrade_gate(config: str, ensemble_config: str) -> int:
+    """Preflight-admission + degradation-ladder gate
+    (device/capacity.py admission + device/supervise.py recover_oom):
+    a run must never OOM blind — over-budget estimates are refused or
+    degraded BEFORE any compile, and real allocator failures walk a
+    bit-identical degradation ladder instead of burning the retry
+    budget. Driven end to end by the deterministic chaos injector's
+    oom seam on a forced >= 4-device CPU mesh. Legs:
+
+    1. oracle: the serial run every degraded run compares to;
+    2. strict refusal: ``admission: strict`` under a deliberately
+       tiny ``device_memory_budget`` must raise the readable
+       "needs X, budget Y on N devices" diagnostic before ANY
+       compile — the leg's private cold AOT cache directory must
+       stay empty;
+    3. compile-seam oom: a scripted RESOURCE_EXHAUSTED at the 0th
+       program compile (chaos oom against a COLD cache, so the
+       compile actually runs — a warm hit compiles nothing and the
+       seam never fires) repeats until the ladder engages a rung;
+       the finished run must bit-match the oracle with degrade >= 1
+       and the retry budget unexhausted;
+    4. dispatch-seam oom: the same scripted oom at the 2nd dispatch
+       issue of a depth-4 pipelined run — the FIRST failure charges
+       one normal retry, the second consecutive identical one routes
+       to the ladder (deterministic OOMs must never exhaust
+       dispatch_retries), and the run bit-matches the oracle;
+    5. replica batches: `ensemble_config`'s campaign run with
+       ``ensemble.replica_batch: 2`` (sequential halves of the
+       replica axis, each its own engine) must bit-match the
+       full-vmap campaign over every replica's counters and
+       checksums, stamp the admission verdict + batch split, and
+       replica 0 must still bit-match a standalone serial run with
+       its parameters (the batch never weakens the replica-i ==
+       standalone-i contract).
+    """
+    import numpy as np
+
+    from shadow_tpu._jax import jax
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.device.chaos import OOM_ERROR, ChaosEvent
+
+    ndev = len(jax.devices())
+    if ndev < 4:
+        print(f"FAIL: --degrade needs >= 4 devices for the forced "
+              f"CPU mesh (run under XLA_FLAGS=--xla_force_host_"
+              f"platform_device_count=4); found {ndev}")
+        return 1
+    cfg0 = load_config(config)
+    stop = cfg0.general.stop_time
+    seg_ns = max(1, stop // 8)
+
+    def run_tpu(tag: str, tmp: str, mutate=None):
+        cfg = load_config(config)
+        cfg.experimental.scheduler_policy = "tpu"
+        cfg.experimental.state_audit = True
+        cfg.experimental.dispatch_segment = seg_ns
+        cfg.experimental.compile_cache = os.path.join(tmp, "aot")
+        cfg.general.data_directory = os.path.join(
+            tmp, tag, "shadow.data")
+        if mutate:
+            mutate(cfg)
+        c = Controller(cfg)
+        stats = c.run()
+        if not stats.ok:
+            print(f"FAIL: {tag} run reported not-ok")
+            sys.exit(1)
+        sig = [(h.name, h.trace_checksum, h.events_executed,
+                h.packets_sent, h.packets_dropped,
+                h.packets_delivered) for h in c.sim.hosts]
+        return sig, stats
+
+    with tempfile.TemporaryDirectory() as tmp:
+        os.environ.setdefault("SHADOW_TPU_OCC_DIR",
+                              os.path.join(tmp, "occ"))
+        rc = 0
+        # leg 1: the serial oracle
+        sig_oracle, stats_oracle = run_once(
+            config, "serial", os.path.join(tmp, "oracle",
+                                           "shadow.data"))
+
+        # leg 2: strict refusal, before any compile
+        strict_aot = os.path.join(tmp, "aot_strict")
+        cfg = load_config(config)
+        cfg.experimental.scheduler_policy = "tpu"
+        cfg.experimental.admission = "strict"
+        cfg.experimental.device_memory_budget = 4096   # 4 KiB: absurd
+        cfg.experimental.compile_cache = strict_aot
+        cfg.general.data_directory = os.path.join(
+            tmp, "strict", "shadow.data")
+        try:
+            Controller(cfg).run()
+        except ValueError as e:
+            msg = str(e)
+            for frag in ("admission", "needs", "budget", "device"):
+                if frag not in msg:
+                    rc = 1
+                    print(f"FAIL: strict refusal diagnostic lacks "
+                          f"{frag!r}: {msg}")
+        else:
+            rc = 1
+            print("FAIL: admission: strict ADMITTED a run whose "
+                  "footprint dwarfs a 4 KiB device budget")
+        if os.path.isdir(strict_aot) and os.listdir(strict_aot):
+            rc = 1
+            print("FAIL: the strict refusal leg left entries in its "
+                  "cold AOT cache — something compiled BEFORE the "
+                  "admission decision")
+
+        # leg 3: scripted oom at the 0th program compile (cold cache)
+        def oom_compile(cfg):
+            cfg.experimental.pipeline_depth = 2
+            cfg.experimental.dispatch_retries = 3
+            cfg.experimental.dispatch_retry_backoff = 0.0
+            cfg.experimental.compile_cache = os.path.join(
+                tmp, "aot_cold")
+            cfg.experimental.chaos = [
+                ChaosEvent(kind="oom", compile=0, error=OOM_ERROR)]
+
+        sig_c, stats_c = run_tpu("oom_compile", tmp,
+                                 mutate=oom_compile)
+        if sig_c != sig_oracle:
+            rc = 1
+            print("DETERMINISM FAILURE: the compile-seam oom run "
+                  "diverges from the serial oracle")
+        if stats_c.degrades < 1:
+            rc = 1
+            print(f"FAIL: the scripted compile oom reported "
+                  f"{stats_c.degrades} degrades — the ladder never "
+                  "engaged")
+        if stats_c.retries >= 3:
+            rc = 1
+            print(f"FAIL: the compile-seam oom burned "
+                  f"{stats_c.retries} retries — the ladder must "
+                  "engage before the budget of 3 exhausts")
+
+        # leg 4: scripted oom at the 2nd dispatch issue, depth 4
+        def oom_dispatch(cfg):
+            cfg.experimental.pipeline_depth = 4
+            cfg.experimental.dispatch_retries = 3
+            cfg.experimental.dispatch_retry_backoff = 0.0
+            cfg.experimental.chaos = [
+                ChaosEvent(kind="oom", segment=2, error=OOM_ERROR)]
+
+        sig_d, stats_d = run_tpu("oom_dispatch", tmp,
+                                 mutate=oom_dispatch)
+        if sig_d != sig_oracle:
+            rc = 1
+            print("DETERMINISM FAILURE: the dispatch-seam oom run "
+                  "diverges from the serial oracle")
+            for a, b in zip(sig_oracle, sig_d):
+                if a != b:
+                    print(f"  {a[0]}: oracle {a[1:]} != degraded "
+                          f"{b[1:]}")
+        if stats_d.degrades < 1:
+            rc = 1
+            print(f"FAIL: the scripted dispatch oom reported "
+                  f"{stats_d.degrades} degrades — the ladder never "
+                  "engaged")
+        if stats_d.retries > 1:
+            rc = 1
+            print(f"FAIL: the deterministic dispatch oom charged "
+                  f"{stats_d.retries} retries — the second "
+                  "consecutive identical failure must route to the "
+                  "ladder after ONE charged retry, not drain "
+                  "dispatch_retries")
+
+        # leg 5: replica batches bit-match the full-vmap campaign
+        def run_campaign(tag: str, batch: int = 0):
+            cfg = load_config(ensemble_config)
+            cfg.experimental.scheduler_policy = "tpu"
+            cfg.experimental.state_audit = True
+            cfg.experimental.dispatch_segment = max(
+                1, cfg.general.stop_time // 8)
+            cfg.experimental.compile_cache = os.path.join(
+                tmp, "aot_ens")
+            cfg.general.data_directory = os.path.join(
+                tmp, tag, "shadow.data")
+            cfg.ensemble.record_path = os.path.join(
+                tmp, tag, "ENSEMBLE.json")
+            if batch:
+                cfg.ensemble.replica_batch = batch
+            c = Controller(cfg)
+            stats = c.run()
+            if not stats.ok:
+                print(f"FAIL: {tag} campaign reported not-ok")
+                sys.exit(1)
+            f = c.runner.final_state
+            sig = {k: np.asarray(f[k])
+                   for k in ("chk", "n_exec", "n_sent", "n_drop",
+                             "n_deliv")}
+            return sig, stats, c
+
+        ens_full, _, _ = run_campaign("ens_full")
+        ens_b, stats_b, c_b = run_campaign("ens_batch", batch=2)
+        bad = [k for k in ens_full
+               if not np.array_equal(ens_full[k], ens_b[k])]
+        if bad:
+            rc = 1
+            print(f"DETERMINISM FAILURE: the replica-batched "
+                  f"campaign's {bad} diverge from the full-vmap "
+                  "campaign")
+        pipe = stats_b.pipeline or {}
+        if pipe.get("replica_batches") != 2 or \
+                pipe.get("replica_batch") != 2:
+            rc = 1
+            print(f"FAIL: the batched campaign stamped pipeline "
+                  f"{pipe} — expected replica_batch=2 over "
+                  "replica_batches=2")
+        adm = stats_b.admission
+        if not isinstance(adm, dict) or \
+                adm.get("replica_batch") != 2:
+            rc = 1
+            print(f"FAIL: the batched campaign's admission verdict "
+                  f"{adm} does not stamp replica_batch=2")
+
+        # ... and replica 0 still bit-matches a standalone serial run
+        desc = c_b.runner.worlds.descriptors[0]
+        names = [h.name for h in c_b.sim.hosts]
+        sig_e = [(names[i], int(ens_b["chk"][0, i]),
+                  int(ens_b["n_exec"][0, i]),
+                  int(ens_b["n_sent"][0, i]),
+                  int(ens_b["n_drop"][0, i]),
+                  int(ens_b["n_deliv"][0, i]))
+                 for i in range(len(names))]
+        cfg = load_config(ensemble_config)
+        cfg.ensemble = None
+        cfg.experimental.scheduler_policy = "serial"
+        cfg.experimental.runahead = c_b.runner.lookahead
+        cfg.general.seed = desc["seed"]
+        cfg.general.data_directory = os.path.join(
+            tmp, "alone", "shadow.data")
+        c_a = Controller(cfg)
+        stats_a = c_a.run()
+        if not stats_a.ok:
+            print("FAIL: standalone replica-0 run reported not-ok")
+            return 1
+        sig_a = [(h.name, h.trace_checksum, h.events_executed,
+                  h.packets_sent, h.packets_dropped,
+                  h.packets_delivered) for h in c_a.sim.hosts]
+        if sig_a != sig_e:
+            rc = 1
+            print(f"DETERMINISM FAILURE: replica 0 of the batched "
+                  f"campaign diverges from the standalone serial "
+                  f"run with its parameters ({desc})")
+
+        if rc == 0:
+            print(f"degrade OK: {config} (strict admission refused "
+                  f"a 4 KiB budget before any compile; scripted "
+                  f"RESOURCE_EXHAUSTED at compile 0 and dispatch 2 "
+                  f"walked the ladder bit-identical to the serial "
+                  f"oracle [{stats_oracle.events_executed} events, "
+                  f"{stats_c.degrades}+{stats_d.degrades} degrades, "
+                  f"retry budget intact]; {ensemble_config} in "
+                  "replica batches of 2 bit-matches the full-vmap "
+                  "campaign and standalone replica 0)")
+        return rc
+
+
 def run_pipelined_gate(config: str) -> int:
     """Pipelined-dispatch gate (device/supervise.py segment
     pipeline): overlap must never change the simulation. Three legs
@@ -1227,9 +1500,22 @@ def main() -> int:
                          "devices)")
     ap.add_argument("--chaos-ensemble",
                     default="examples/ensemble_seed_sweep.yaml",
-                    help="campaign config for the --chaos ensemble "
-                         "leg (default "
+                    help="campaign config for the --chaos / "
+                         "--degrade ensemble legs (default "
                          "examples/ensemble_seed_sweep.yaml)")
+    ap.add_argument("--degrade", action="store_true",
+                    help="admission + degradation-ladder gate: "
+                         "admission: strict must refuse a tiny "
+                         "device_memory_budget with a readable "
+                         "diagnostic before any compile; scripted "
+                         "RESOURCE_EXHAUSTED at the 0th compile and "
+                         "the 2nd dispatch issue must walk the "
+                         "degradation ladder bit-identical to the "
+                         "serial oracle without exhausting "
+                         "dispatch_retries; the --chaos-ensemble "
+                         "campaign in replica batches of 2 must "
+                         "bit-match the full-vmap campaign and "
+                         "standalone replica 0 (needs >= 4 devices)")
     ap.add_argument("--analyze-consistency", action="store_true",
                     help="static-analysis consistency gate: the "
                          "collective registry shadowlint audits "
@@ -1243,6 +1529,20 @@ def main() -> int:
     policies = [p.strip()
                 for p in (args.policy or default_policy).split(",")
                 if p.strip()]
+
+    if args.degrade:
+        if args.ensemble or args.preempt or args.policy or \
+                args.compile_cache or args.telemetry or args.tuned \
+                or args.analyze_consistency or args.pipelined or \
+                args.chaos:
+            # the degrade gate runs the serial oracle, both oom
+            # seams, the strict refusal, and its own replica-batch
+            # ensemble leg by construction
+            print("FAIL: --degrade does not combine with other gate "
+                  "flags (it runs serial + tpu oom/strict legs plus "
+                  "its own replica-batch ensemble leg)")
+            return 1
+        return run_degrade_gate(args.config, args.chaos_ensemble)
 
     if args.chaos:
         if args.ensemble or args.preempt or args.policy or \

@@ -593,6 +593,20 @@ class ExperimentalOptions:
     # wider sorts and more ICI padding. Requires capacity_plan
     # auto/<path> (there is nothing to pad on a static run).
     capacity_headroom: float = 0.0
+    # preflight resource admission (device/capacity.py footprint +
+    # admission_verdict; docs/operations.md#admission): before any
+    # compile, both runners estimate the per-device byte footprint
+    # and compare it to the per-device budget. "auto" (default)
+    # admits, statically degrades (pipeline_depth shrink, ensemble
+    # replica batching), or admits loudly over budget — the runtime
+    # degradation ladder is the backstop; "strict" refuses an
+    # over-budget config with a readable diagnostic; "off" skips.
+    admission: str = "auto"
+    # per-device memory budget in bytes (size suffixes accepted:
+    # "7.5 GiB") for backends that report none (cpu meshes, some
+    # tunneled relays). A backend-reported bytes_limit wins when
+    # present. 0 = no budget: admission auto skips, strict refuses.
+    device_memory_budget: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "ExperimentalOptions":
@@ -608,7 +622,8 @@ class ExperimentalOptions:
                               "capacity_warmup"):
                     v = parse_time_ns(v)
                 elif f.name in ("interface_buffer", "socket_recv_buffer",
-                                "socket_send_buffer"):
+                                "socket_send_buffer",
+                                "device_memory_budget"):
                     v = parse_size_bytes(v)
                 elif f.type == "int":
                     v = int(v)
@@ -723,6 +738,24 @@ class ExperimentalOptions:
                 "limitation, i.e. no checkpoint at all)")
         _check_choice("experimental", "failover", out.failover,
                       ("abort", "shrink", "hybrid"))
+        if isinstance(out.admission, bool):
+            # YAML 1.1 reads bare `off`/`on` as booleans — map them
+            # back to the knob's keywords (the telemetry rule); `on`
+            # means the default-on mode, auto
+            out.admission = "auto" if out.admission else "off"
+        _check_choice("experimental", "admission", out.admission,
+                      ("auto", "off", "strict"))
+        if out.admission == "strict" and \
+                out.scheduler_policy != "tpu":
+            raise ValueError(
+                "experimental.admission: strict gates DEVICE engine "
+                "footprints and requires scheduler_policy: tpu (CPU "
+                "policies have no device budget to admit against)")
+        if out.device_memory_budget and out.scheduler_policy != "tpu":
+            raise ValueError(
+                "experimental.device_memory_budget bounds the DEVICE "
+                "engine's footprint and requires scheduler_policy: "
+                "tpu")
         if out.chaos:
             # the injector owns its schedule format — validate every
             # entry at load (the network.faults rule: a typo'd
@@ -799,7 +832,8 @@ class ExperimentalOptions:
                               ("device_batch_rounds", 1),
                               ("hybrid_judge_min_batch", 0),
                               ("round_watchdog", 0),
-                              ("preload_spin_max", 0)):
+                              ("preload_spin_max", 0),
+                              ("device_memory_budget", 0)):
             if getattr(out, name) < minimum:
                 raise ValueError(
                     f"experimental.{name} must be >= {minimum}")
@@ -842,6 +876,16 @@ class EnsembleOptions:
     fault_schedules: dict = field(default_factory=dict)
     aggregate: tuple = ENSEMBLE_AGGREGATES
     record_path: str = ""        # "" = artifacts/ENSEMBLE_*.json
+    # sequential replica batching (the ensembles' out-of-memory
+    # story, and the degradation ladder's rung 2): 0 = the full
+    # R-replica vmap in one program; k = run ceil(R/k) sequential
+    # batches of <= k replicas each and merge the results — pinned
+    # bit-identical to the full vmap (each replica's trace is the
+    # standalone program's regardless of which batch carries it,
+    # determinism_gate --degrade). Incompatible with campaign
+    # checkpointing (a checkpoint stamps the full-R stacked state,
+    # which a batched campaign never materializes).
+    replica_batch: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "EnsembleOptions":
@@ -849,7 +893,7 @@ class EnsembleOptions:
 
         _check_keys("ensemble", d, {"replicas", "vary",
                                     "fault_schedules", "aggregate",
-                                    "record_path"})
+                                    "record_path", "replica_batch"})
         if "replicas" not in d:
             raise ValueError("ensemble: missing required key "
                              "'replicas'")
@@ -928,9 +972,16 @@ class EnsembleOptions:
                 _check_choice("ensemble", "aggregate", a,
                               ENSEMBLE_AGGREGATES)
             aggregate = tuple(agg)
+        replica_batch = int(d.get("replica_batch", 0) or 0)
+        if replica_batch < 0 or replica_batch > replicas:
+            raise ValueError(
+                f"ensemble.replica_batch must be in [0, replicas="
+                f"{replicas}] (0 = full vmap; k = sequential batches "
+                "of <= k replicas)")
         return cls(replicas=replicas, vary=vary,
                    fault_schedules=schedules, aggregate=aggregate,
-                   record_path=str(d.get("record_path", "") or ""))
+                   record_path=str(d.get("record_path", "") or ""),
+                   replica_batch=replica_batch)
 
 
 @dataclass
@@ -975,6 +1026,16 @@ class ConfigOptions:
                 "vmaps outside the mesh axis), or let exhausted "
                 "retries fail loudly with the last validated "
                 "checkpoint on disk")
+        if ensemble is not None and ensemble.replica_batch and \
+                (out.experimental.checkpoint_save or
+                 out.experimental.checkpoint_load or
+                 out.experimental.checkpoint_every):
+            raise ValueError(
+                "ensemble.replica_batch cannot combine with "
+                "checkpoint_save/checkpoint_load/checkpoint_every: a "
+                "campaign checkpoint stamps the full-R stacked state, "
+                "which a batched campaign never materializes — drop "
+                "replica_batch or the checkpoint knobs")
         return out
 
     def total_hosts(self) -> int:

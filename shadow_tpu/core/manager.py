@@ -122,6 +122,22 @@ class SimStats:
     # in-flight device work, and the overlap-efficiency share.
     # None on CPU policies (no segment pipeline to report).
     pipeline: Optional[dict] = None
+    # OOM degradation-ladder rungs engaged (device/supervise.py): a
+    # deterministic RESOURCE_EXHAUSTED walked the ladder (pipeline
+    # depth / replica batching / dispatch segment) this many times —
+    # each rung shrank the footprint and replayed bit-identically
+    degrades: int = 0
+    # preflight admission verdict (device/capacity.py
+    # admission_verdict): mode, budget + source, modeled footprint,
+    # action taken (admit/degrade/over/off/no-budget), and any
+    # static overrides applied. None on CPU policies.
+    admission: Optional[dict] = None
+    # live device allocator stats at the end of the run, when the
+    # backend exposes them (TPU/GPU memory_stats); -1 = unavailable
+    # (CPU backends) — the heartbeat lines print "n/a" for the same
+    # reason
+    mem_bytes_in_use: int = -1
+    mem_budget: int = -1
 
     def merge(self, other: "SimStats") -> None:
         self.events_executed += other.events_executed

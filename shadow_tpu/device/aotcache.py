@@ -628,6 +628,17 @@ class AotCache:
                          "compile skipped)", program,
                          self.entry_path(key), ev["load_s"])
                 return loaded
+        # chaos seam (device/chaos.py `oom`): a scripted compile-time
+        # RESOURCE_EXHAUSTED fires HERE — after the cache-hit return
+        # (a hit compiles nothing), before lower/compile, and OUTSIDE
+        # the lazy-jit fallback below (the fallback absorbs backend
+        # quirks, not allocator failures) — so it surfaces out of the
+        # dispatch that forced the compile, exactly like a real one
+        from shadow_tpu.device import chaos as chaosmod
+
+        inj = chaosmod.current()
+        if inj is not None and hasattr(inj, "on_compile"):
+            inj.on_compile(program)
         # a blob destined for the cache must come from a FRESH
         # compile (see _fresh_compile); when nothing will be stored
         # (unsupported backend, unwritable directory) keep JAX's

@@ -38,6 +38,10 @@ import os
 
 import numpy as np
 
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("capacity")
+
 FORMAT = 1
 # planned = ceil(measured * HEADROOM) + SLACK: the warm-up slice is a
 # lower bound on steady-state occupancy, and the retry loop makes an
@@ -609,3 +613,195 @@ def load_record(path: str) -> dict:
             raise ValueError(f"occupancy record {path}: missing {key!r}")
     obstrace.current().instant("occ.load", "plan", path=path)
     return record
+
+
+# ----------------------------------------------------------------------
+# preflight admission: footprint estimate vs per-device budget
+# ----------------------------------------------------------------------
+# The byte model counts exactly what the engine pins on device: the
+# sharded state pytree (state_structs), in-flight copies of it (the
+# segment pipeline keeps up to `depth` issued segments plus the last
+# validated snapshot alive), the replica axis R, the per-flush outbox
+# and exchange buffers at their effective capacities, and the
+# replicated world tables. XLA's transient workspace (sort scratch,
+# fusion temporaries) is deliberately NOT modeled — the estimate is a
+# floor on steady-state live bytes, and the honesty tests pin it to
+# measured live bytes within FOOTPRINT_TOLERANCE.
+FOOTPRINT_TOLERANCE = 4.0
+
+
+def _nbytes(struct) -> int:
+    """Bytes of one ShapeDtypeStruct (shape may be empty)."""
+    n = 1
+    for d in struct.shape:
+        n *= int(d)
+    return n * np.dtype(struct.dtype).itemsize
+
+
+def footprint(engine, pipeline_depth: int = 0,
+              replicas: int = None) -> dict:
+    """Static per-device byte model of an engine's resident state —
+    from the same resolved inputs program_facts reports, with zero
+    device work (admission must run BEFORE any compile).
+
+    ``replicas`` overrides the engine's ensemble width (the
+    replica-batch rungs of the degradation ladder estimate a k-replica
+    batch against the full-R engine before building it)."""
+    eff = engine.effective
+    S = max(1, int(eff["n_shards"]))
+    ens = getattr(engine, "ensemble", None)
+    R_full = int(ens.R) if ens is not None else 1
+    R = max(1, int(replicas if replicas is not None else R_full))
+    # one copy of one replica's sharded state, per device
+    structs = engine.state_structs()
+    state_total = sum(_nbytes(v) for v in structs.values())
+    state_dev = -(-state_total // S)
+    # the segment pipeline holds `depth` issued segment outputs plus
+    # the last validated snapshot (rewind source) concurrently
+    copies = max(1, int(pipeline_depth)) + 1
+    # per-flush scratch: the 5 int64 outbox field arrays plus the
+    # exchange send+receive buffers at the effective capacities
+    H_pad, OB = engine._ob_shape_global
+    outbox_dev = 5 * (-(-int(H_pad) // S)) * int(OB) * 8
+    h_loc = -(-int(H_pad) // S)
+    g, ng = (int(x) for x in eff["tp_groups"])
+    if S <= 1:
+        rows = 0
+    elif eff["exchange"] == "two_phase":
+        rows = g * int(eff["CAP"]) + ng * int(eff["CAP2"])
+    elif eff["exchange"] == "all_gather":
+        rows = S * h_loc * int(eff["CX"])
+    else:
+        rows = S * int(eff["CAP"])
+    exchange_dev = 2 * rows * 6 * 8          # send + recv, ~6 fields
+    scratch = (outbox_dev + exchange_dev) * R
+    # world tables replicate on every device; ensemble stacks them [R]
+    ws = engine.world_structs(ensemble=ens is not None)
+    world_total = sum(_nbytes(s) for s in ws)
+    if ens is not None and R_full:
+        world_total = (world_total * R) // R_full
+    per_device = state_dev * copies * R + scratch + world_total
+    return {
+        "per_device": int(per_device),
+        "total": int(per_device * S),
+        "state_bytes": int(state_dev),
+        "scratch_bytes": int(scratch),
+        "world_bytes": int(world_total),
+        "copies": int(copies),
+        "replicas": int(R),
+        "pipeline_depth": int(pipeline_depth),
+        "n_devices": int(S),
+    }
+
+
+def fmt_bytes(n) -> str:
+    """Human-readable byte count for admission diagnostics."""
+    n = float(int(n))
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0:
+            return (f"{int(n)} B" if unit == "B"
+                    else f"{n:.1f} {unit}")
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def device_budget(engine, xp) -> tuple:
+    """(per-device budget bytes, source). The backend's reported
+    bytes_limit wins when it exposes one (TPU/GPU); else the
+    operator's experimental.device_memory_budget; else (0, "") —
+    no budget, admission: auto skips and strict refuses."""
+    try:
+        dev = list(engine.mesh.devices.flat)[0]
+        ms = dev.memory_stats()
+        if ms and int(ms.get("bytes_limit", 0) or 0) > 0:
+            return int(ms["bytes_limit"]), "backend"
+    except Exception:
+        pass
+    b = int(getattr(xp, "device_memory_budget", 0) or 0)
+    if b > 0:
+        return b, "config"
+    return 0, ""
+
+
+def admission_diagnostic(est: dict, budget: int, source: str) -> str:
+    return (
+        f"admission: needs {fmt_bytes(est['per_device'])} per device, "
+        f"budget {fmt_bytes(budget)} ({source}) on "
+        f"{est['n_devices']} device(s) — state "
+        f"{fmt_bytes(est['state_bytes'])} x {est['copies']} copies x "
+        f"R={est['replicas']}, scratch "
+        f"{fmt_bytes(est['scratch_bytes'])}, world "
+        f"{fmt_bytes(est['world_bytes'])}; raise the budget or lower "
+        "pipeline_depth / ensemble.replicas / capacities")
+
+
+def admission_verdict(engine, xp, pipeline_depth: int = 0,
+                      batchable: bool = False) -> dict:
+    """The preflight admission gate, shared by both runners.
+
+    * ``strict``  — refuse an over-budget estimate outright (raises
+      ValueError with the readable diagnostic) before any compile.
+    * ``auto``    — degrade statically along the same ladder the
+      runtime walks (shrink pipeline_depth, then split the ensemble
+      into replica batches); if the estimate still exceeds the
+      budget, admit LOUDLY — the runtime degradation ladder in
+      supervise.advance is the backstop for what the static model
+      cannot shed (dispatch_segment halving, failover).
+    * ``off``     — skip entirely.
+
+    Returns the verdict dict the runners stash on ``runner.admission``
+    (bench stamps it; supervise reads the imposed overrides)."""
+    mode = str(getattr(xp, "admission", "auto"))
+    ens = getattr(engine, "ensemble", None)
+    R_full = int(ens.R) if ens is not None else 1
+    est = footprint(engine, pipeline_depth=pipeline_depth)
+    budget, source = device_budget(engine, xp)
+    out = {"mode": mode, "budget": int(budget),
+           "budget_source": source, "estimate": est,
+           "action": "admit", "fits": True, "overrides": {}}
+    if mode == "off":
+        out["action"] = "off"
+        return out
+    if budget <= 0:
+        if mode == "strict":
+            raise ValueError(
+                "experimental.admission: strict needs a per-device "
+                "budget, but the backend reports none and "
+                "experimental.device_memory_budget is unset")
+        out["action"] = "no-budget"
+        return out
+    if est["per_device"] <= budget:
+        log.info("admission: fits — %s per device of %s (%s)",
+                 fmt_bytes(est["per_device"]), fmt_bytes(budget),
+                 source)
+        return out
+    diag = admission_diagnostic(est, budget, source)
+    if mode == "strict":
+        raise ValueError(diag)
+    # auto: statically walk the ladder's estimable rungs
+    overrides = {}
+    depth = max(1, int(pipeline_depth))
+    while est["per_device"] > budget and depth > 1:
+        depth //= 2
+        overrides["pipeline_depth"] = depth
+        est = footprint(engine, pipeline_depth=depth)
+    batch = R_full
+    while est["per_device"] > budget and batchable and batch > 1:
+        batch = (batch + 1) // 2
+        overrides["replica_batch"] = batch
+        est = footprint(engine, pipeline_depth=depth,
+                        replicas=batch)
+    out["estimate"] = est
+    out["overrides"] = overrides
+    out["fits"] = est["per_device"] <= int(budget)
+    if out["fits"]:
+        out["action"] = "degrade"
+        log.warning("%s — degraded preflight to %s (now %s per "
+                    "device)", diag, overrides,
+                    fmt_bytes(est["per_device"]))
+    else:
+        out["action"] = "over"
+        log.warning("%s — admitting anyway (admission: auto); the "
+                    "runtime degradation ladder is the backstop",
+                    diag)
+    return out

@@ -32,6 +32,15 @@ Fault kinds (:data:`KINDS`):
 * ``cache_store_fail`` — the ``store``-th AOT compile-cache store
   is refused (full-disk drill); the cache must degrade loudly to an
   unpersisted fresh compile, never abort the run.
+* ``oom`` — a scripted ``RESOURCE_EXHAUSTED`` at the ``segment``-th
+  dispatch issue OR the ``compile``-th program compile (exactly one).
+  Unlike ``dispatch_error`` the fault REPEATS at every later count —
+  a real out-of-memory is deterministic: the same too-big program
+  fails every time — until the degradation ladder engages a rung
+  (:meth:`ChaosInjector.on_degrade_rung`), after which it clears the
+  way a real OOM clears once the footprint shrinks. This is the CI
+  drill for supervise.advance's degrade ladder
+  (``determinism_gate --degrade``).
 
 Counters are seam-local and monotonic: dispatch issues count every
 ``dispatch.issue`` of supervise.advance (replays after a recovery
@@ -57,11 +66,14 @@ from shadow_tpu.utils.slog import get_logger
 log = get_logger("chaos")
 
 KINDS = ("device_loss", "dispatch_error", "checkpoint_corrupt",
-         "cache_store_fail")
+         "cache_store_fail", "oom")
 
 # transient by default: UNAVAILABLE matches supervise.TRANSIENT_MARKERS
 # so the scripted loss walks the real retry -> escalate ladder
 DEFAULT_ERROR = "UNAVAILABLE"
+# oom events default to the class a real allocator failure raises, so
+# supervise.is_oom routes them onto the degradation ladder
+OOM_ERROR = "RESOURCE_EXHAUSTED"
 
 
 class ChaosError(RuntimeError):
@@ -75,11 +87,12 @@ class ChaosEvent:
     """One validated ``experimental.chaos`` entry."""
 
     kind: str
-    segment: int = -1      # device_loss/dispatch_error: dispatch issue #
+    segment: int = -1      # device_loss/dispatch_error/oom: dispatch #
     shard: int = -1        # device_loss: mesh position of the dying chip
     error: str = DEFAULT_ERROR
     entry: int = -1        # checkpoint_corrupt: rotation save #
     store: int = -1        # cache_store_fail: cache store #
+    compile: int = -1      # oom: program compile #
 
 
 def event_from_dict(i: int, d: dict) -> ChaosEvent:
@@ -90,7 +103,8 @@ def event_from_dict(i: int, d: dict) -> ChaosEvent:
     section = f"experimental.chaos[{i}]"
     if not isinstance(d, dict):
         raise ValueError(f"{section} must be a mapping")
-    allowed = {"kind", "segment", "shard", "error", "entry", "store"}
+    allowed = {"kind", "segment", "shard", "error", "entry", "store",
+               "compile"}
     unknown = set(d) - allowed
     if unknown:
         raise ValueError(f"unknown key(s) in {section}: "
@@ -103,17 +117,30 @@ def event_from_dict(i: int, d: dict) -> ChaosEvent:
     need = {"device_loss": ("segment", "shard"),
             "dispatch_error": ("segment",),
             "checkpoint_corrupt": ("entry",),
-            "cache_store_fail": ("store",)}[kind]
+            "cache_store_fail": ("store",),
+            "oom": ()}[kind]
     for key in need:
         if d.get(key) is None or int(d[key]) < 0:
             raise ValueError(
                 f"{section}: {kind} needs {key!r} >= 0 (the "
                 "deterministic seam counter the fault fires at)")
+    if kind == "oom":
+        has_seg = d.get("segment") is not None and \
+            int(d["segment"]) >= 0
+        has_cmp = d.get("compile") is not None and \
+            int(d["compile"]) >= 0
+        if has_seg == has_cmp:
+            raise ValueError(
+                f"{section}: oom needs exactly one of 'segment' "
+                "(dispatch issue #) or 'compile' (program compile #) "
+                ">= 0")
     scope = {"device_loss": ("segment", "shard", "error"),
              "dispatch_error": ("segment", "error"),
              "checkpoint_corrupt": ("entry",),
-             "cache_store_fail": ("store",)}[kind]
-    for key in ("segment", "shard", "entry", "store", "error"):
+             "cache_store_fail": ("store",),
+             "oom": ("segment", "compile", "error")}[kind]
+    for key in ("segment", "shard", "entry", "store", "compile",
+                "error"):
         if key not in scope and d.get(key) is not None:
             raise ValueError(
                 f"{section}: {key!r} is not valid for {kind}")
@@ -121,9 +148,11 @@ def event_from_dict(i: int, d: dict) -> ChaosEvent:
         kind=kind,
         segment=int(d.get("segment", -1)),
         shard=int(d.get("shard", -1)),
-        error=str(d.get("error", DEFAULT_ERROR)),
+        error=str(d.get("error",
+                        OOM_ERROR if kind == "oom" else DEFAULT_ERROR)),
         entry=int(d.get("entry", -1)),
         store=int(d.get("store", -1)),
+        compile=int(d.get("compile", -1)),
     )
 
 
@@ -160,6 +189,11 @@ class ChaosInjector:
         self._issues = 0
         self._ck_saves = 0
         self._stores = 0
+        self._compiles = 0
+        # scripted ooms repeat until the degradation ladder engages a
+        # rung — then they clear, the way a real OOM clears once the
+        # footprint shrinks (on_degrade_rung)
+        self._oom_cleared = False
         self.fired: list = []          # ledger of fired events
 
     # -- dispatch seam (supervise.advance issue half) ------------------
@@ -196,8 +230,24 @@ class ChaosInjector:
                     self.fired.append(
                         {"kind": "dispatch_error", "segment": k,
                          "error": ev.error})
+            oom = None
+            if not self._oom_cleared:
+                for ev in self._events:
+                    if ev.kind == "oom" and ev.segment >= 0 and \
+                            k >= ev.segment:
+                        oom = ev
+                        self.fired.append(
+                            {"kind": "oom", "seam": "dispatch",
+                             "segment": k, "error": ev.error})
+                        break
             down = sorted((d.id, self._dead[d.id]) for d in devices
                           if d.id in self._dead)
+        if oom is not None:
+            obstrace.current().instant(
+                "chaos.oom", "chaos", segment=k, error=oom.error)
+            raise ChaosError(
+                f"{oom.error}: chaos: scripted oom at dispatch issue "
+                f"{k} (repeats until a degrade rung engages)")
         if oneshot is not None:
             obstrace.current().instant(
                 "chaos.dispatch_error", "chaos", segment=k,
@@ -219,6 +269,51 @@ class ChaosInjector:
         probe exactly like a real one."""
         with self._lock:
             return device_id in self._dead
+
+    # -- compile seam (aotcache.AotCache.ensure) -----------------------
+    def on_compile(self, name: str) -> None:
+        """Count one program compile (before lower/compile); raise a
+        scripted oom scheduled at this count. A compile-time
+        RESOURCE_EXHAUSTED surfaces out of the dispatch that forced
+        the compile, so the same supervise recovery path catches it."""
+        from shadow_tpu.obs import trace as obstrace
+
+        with self._lock:
+            n = self._compiles
+            self._compiles += 1
+            hit = None
+            if not self._oom_cleared:
+                for ev in self._events:
+                    if ev.kind == "oom" and ev.compile >= 0 and \
+                            n >= ev.compile:
+                        hit = ev
+                        self.fired.append(
+                            {"kind": "oom", "seam": "compile",
+                             "compile": n, "program": name,
+                             "error": ev.error})
+                        break
+        if hit is not None:
+            obstrace.current().instant(
+                "chaos.oom", "chaos", compile=n, program=name,
+                error=hit.error)
+            log.warning("chaos: scripted oom at compile %d (%s)", n,
+                        name)
+            raise ChaosError(
+                f"{hit.error}: chaos: scripted oom at compile {n} "
+                f"({name}; repeats until a degrade rung engages)")
+
+    def on_degrade_rung(self, rung: str) -> None:
+        """The degradation ladder engaged a rung: scripted ooms stop
+        firing. Without this clear the drill could never converge —
+        a real OOM clears because the rung genuinely shrank the
+        footprint; the scripted one must honor the same contract."""
+        with self._lock:
+            if self._oom_cleared:
+                return
+            self._oom_cleared = True
+            self.fired.append({"kind": "oom_cleared", "rung": rung})
+        log.warning("chaos: scripted oom cleared by degrade rung %s",
+                    rung)
 
     # -- checkpoint seam (supervise.Checkpointer.save) -----------------
     def on_checkpoint_saved(self, path: str) -> None:

@@ -2425,6 +2425,47 @@ class DeviceEngine:
                 jnp.asarray(self.host_vertex), repl)
         return self._hv_dev
 
+    def live_bytes(self) -> int:
+        """Measured live device bytes across this engine's mesh,
+        attributed per buffer by its sharding (a buffer spanning k
+        devices contributes nbytes/k per device; the return is the
+        MAX per-device total — what admission compares to a
+        per-device budget). Uses jax.live_arrays(), which works on
+        every backend including cpu — the estimator honesty tests
+        run on the forced-multi-device cpu mesh."""
+        mesh_ids = {d.id for d in self.mesh.devices.flat}
+        per_dev: dict = {}
+        for arr in jax.live_arrays():
+            try:
+                devs = [d for d in arr.sharding.device_set
+                        if d.id in mesh_ids]
+                if not devs:
+                    continue
+                share = arr.nbytes // max(1, len(arr.sharding
+                                                 .device_set))
+            except Exception:       # deleted/donated buffers race
+                continue
+            for d in devs:
+                per_dev[d.id] = per_dev.get(d.id, 0) + share
+        return max(per_dev.values(), default=0)
+
+    def device_memory_stats(self):
+        """(bytes_in_use, bytes_limit) from the backend's allocator
+        when it exposes them (TPU/GPU memory_stats), else None — the
+        heartbeat lines print `n/a` then."""
+        try:
+            dev = list(self.mesh.devices.flat)[0]
+            ms = dev.memory_stats()
+            if not ms:
+                return None
+            in_use = int(ms.get("bytes_in_use", 0) or 0)
+            limit = int(ms.get("bytes_limit", 0) or 0)
+            if in_use <= 0 and limit <= 0:
+                return None
+            return in_use, limit
+        except Exception:
+            return None
+
     def run(self, state: dict, stop: Optional[int] = None,
             final_stop: Optional[int] = None):
         """Run to `stop` (default config.stop_time); returns

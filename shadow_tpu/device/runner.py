@@ -267,6 +267,13 @@ class DeviceRunner:
         self.guard = None
         self.retries = 0
         self.reshards = 0
+        # OOM degradation-ladder rungs engaged (supervise.advance
+        # walks the ladder; the heartbeat and SimStats report it)
+        self.degrades = 0
+        # preflight admission verdict (capacity.admission_verdict),
+        # set per run(); the advance loop honors its overrides and
+        # SimStats/bench stamp it
+        self.admission = None
         # flight recorder (shadow_tpu/obs): the Controller attaches
         # its run-wide tracer; None (direct construction in tests)
         # falls through to the module-global current() in advance
@@ -734,11 +741,20 @@ class DeviceRunner:
         sent_total = int(n_sent[:H].sum())
         self._hb_mark, (rate,) = heartbeat_rates(self._hb_mark,
                                                  [sent_total])
+        # live device memory, when the backend exposes allocator
+        # stats (TPU/GPU); "n/a" on CPU — an approaching OOM is
+        # visible from the log stream alone
+        from shadow_tpu.device import capacity as capmod
+        mem = self.engine.device_memory_stats()
+        mem_s = (f"{capmod.fmt_bytes(mem[0])}/"
+                 f"{capmod.fmt_bytes(mem[1])}"
+                 if mem is not None else "n/a")
         log.info("[supervise-heartbeat] t=%s events=%d sent=%d "
-                 "pkts/s=%s retries=%d replans=%d reshards=%d",
+                 "pkts/s=%s retries=%d replans=%d reshards=%d "
+                 "mem=%s",
                  simtime.format_time(now), int(n_exec[:H].sum()),
                  sent_total, rate, self.retries, self.replans,
-                 self.reshards)
+                 self.reshards, mem_s)
 
     def run(self, stop: int) -> SimStats:
         import time as _time
@@ -750,6 +766,7 @@ class DeviceRunner:
         self.replans = 0
         self.retries = 0
         self.reshards = 0
+        self.degrades = 0
         self._hb_mark = None
         if xp.capacity_plan == "static":
             # a re-used runner must not merge this run's measurements
@@ -779,6 +796,17 @@ class DeviceRunner:
             # planning/loading, so the resume lands on the saved
             # padded width instead of a loud layout mismatch
             self._adopt_checkpoint_geometry(load_path)
+        # preflight admission (capacity.py): the modeled footprint —
+        # state copies x pipeline depth, exchange scratch, world
+        # tables — against the per-device budget, BEFORE any compile
+        # (the first compile happens lazily at the first dispatch,
+        # which the capacity warm-up below would trigger). strict
+        # refuses over-budget with a readable diagnostic; auto may
+        # statically lower the pipeline depth, and the runtime
+        # degradation ladder backstops what the model cannot see.
+        self.admission = capacity.admission_verdict(
+            self.engine, xp,
+            pipeline_depth=getattr(xp, "pipeline_depth", 0))
         if xp.capacity_plan != "static" and not self._planned:
             with tracer.span("capacity.plan", "plan",
                              mode=xp.capacity_plan):
@@ -940,6 +968,11 @@ class DeviceRunner:
         stats.replans = self.replans
         stats.retries = self.retries
         stats.reshards = adv.reshards
+        stats.degrades = adv.degrades
+        stats.admission = self.admission
+        mem = self.engine.device_memory_stats()
+        if mem is not None:
+            stats.mem_bytes_in_use, stats.mem_budget = mem
         stats.preempted = adv.preempted
         stats.resume_path = adv.resume_path
         # segment-pipeline telemetry (supervise.advance): depth,

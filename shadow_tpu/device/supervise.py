@@ -5,7 +5,7 @@ PR 2 made the *simulated* world fault-tolerant (link outages, host
 crashes); this module makes the simulator process itself survivable.
 Production training/inference stacks treat preemption and
 checkpoint-restart as first-class, and multi-hour 10k-host or
-ensemble campaigns need the same three guarantees:
+ensemble campaigns need the same guarantees:
 
 1. **Periodic validated checkpointing** — every ``checkpoint_every``
    sim ns the run writes a rotating checkpoint
@@ -45,6 +45,21 @@ ensemble campaigns need the same three guarantees:
    device-side resume. The ladder is drilled in CI by the
    deterministic chaos injector (device/chaos.py,
    ``experimental.chaos``; determinism_gate --chaos).
+
+4. **The OOM degradation ladder** — a *deterministic* memory
+   exhaustion (the same RESOURCE_EXHAUSTED twice in a row at the
+   same validated boundary, or one that survives the retry budget)
+   is a capacity fact, not a transient: each recurrence walks one
+   rung that actually shrinks the footprint — halve the pipeline
+   window depth, split the ensemble into sequential replica batches
+   (:class:`DegradeToReplicaBatch`, caught by the campaign), halve
+   the dispatch segment — and replays bit-identically from the last
+   validated state without charging ``dispatch_retries``. Out of
+   rungs, the existing ``failover:`` escalation applies. The ladder
+   is the runtime backstop of the preflight admission gate
+   (capacity.footprint / ``experimental.admission``) and is drilled
+   by the chaos injector's ``oom`` seam (determinism_gate
+   --degrade).
 
 :func:`advance` is the single segmented-advance loop both
 ``DeviceRunner`` and ``EnsembleRunner`` now share: it generalizes the
@@ -97,6 +112,12 @@ EXIT_PREEMPTED = 75
 # exponential backoff cap between dispatch retries (wall seconds)
 BACKOFF_CAP_S = 30.0
 
+# degradation-ladder floor: how many times the dispatch-segment rung
+# may halve the segment before the ladder gives up and escalates —
+# shorter segments shrink the transient working set with diminishing
+# returns, and an OOM that survives 4 halvings is not segment-bound
+MAX_SEG_HALVINGS = 4
+
 # substrings marking a device error as transient (worth retrying from
 # the last validated state). Matched against str(exc) — XLA surfaces
 # these as XlaRuntimeError messages whose class identity varies by
@@ -109,6 +130,15 @@ TRANSIENT_MARKERS = (
     "device unavailable",
     "failed to connect",
     "Socket closed",
+    "out of memory",
+)
+
+# the subset of TRANSIENT_MARKERS that names memory exhaustion. A
+# matching error that RECURS at the same validated boundary is a
+# capacity fact (the footprint does not fit), not flakiness — the
+# degradation ladder, not the retry budget, is the answer.
+OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
     "out of memory",
 )
 
@@ -146,11 +176,35 @@ class DeviceFailover(RuntimeError):
         self.persist_error = persist_error
 
 
+class DegradeToReplicaBatch(RuntimeError):
+    """OOM degradation ladder, replica-batch rung (ensembles only):
+    the full-R vmap does not fit on the mesh. Carries the suggested
+    per-batch replica count; the campaign catches this and re-runs
+    the sweep in sequential replica batches (vmap over R/k replicas
+    per batch, finals merged), which is bit-identical to the full
+    vmap — each replica's trace is a pure function of its own world
+    row, so stacking order and batch boundaries cannot change it."""
+
+    def __init__(self, message: str, batch: int = 1):
+        super().__init__(message)
+        self.batch = max(1, int(batch))
+
+
 def is_transient(exc: BaseException) -> bool:
     """Whether a dispatch error is worth retrying from the last
     validated state (vs a programming error that would just recur)."""
     text = str(exc)
     return any(m in text for m in TRANSIENT_MARKERS)
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Whether a dispatch/compile error names memory exhaustion. OOM
+    stays transient-retryable ONCE (an allocator can lose a race with
+    another process and win the rerun); the second consecutive hit at
+    the same validated boundary is deterministic and routes to the
+    degradation ladder instead of burning the retry budget."""
+    text = str(exc)
+    return any(m in text for m in OOM_MARKERS)
 
 
 def decode_audit(word: int) -> list[str]:
@@ -615,6 +669,11 @@ class AdvanceResult:
     # drain + re-shard + engine rebuild and dropped the mesh to the
     # surviving devices
     reshards: int = 0
+    # OOM degradation-ladder rungs engaged (deterministic
+    # RESOURCE_EXHAUSTED): each one shrank the footprint (pipeline
+    # depth, replica batching, or dispatch segment) and replayed
+    # bit-identically from the last validated state
+    degrades: int = 0
     # pipeline telemetry (always populated): depth, issued/drained
     # segment counts, discarded speculative segments, the wall spent
     # blocked in dispatch.sync, and the host wall that ran with >= 1
@@ -720,11 +779,23 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
         return path
 
     depth = max(1, int(getattr(xp, "pipeline_depth", 0) or 0))
+    adm = getattr(runner, "admission", None)
+    if isinstance(adm, dict):
+        # the preflight admission gate (capacity.admission_verdict)
+        # may have statically degraded the pipeline depth to fit the
+        # per-device budget — honor it before the first issue
+        ov_depth = (adm.get("overrides") or {}).get("pipeline_depth")
+        if ov_depth:
+            depth = max(1, min(depth, int(ov_depth)))
     chaos_inj = getattr(runner, "chaos", None)
     res = AdvanceResult()
     window = PipelineWindow(depth)
     good_state, good_t = (state if keep_good else None), t_start
     failures = 0
+    oom_streak = 0              # consecutive memory-exhaustion errors
+    # at the current validated boundary; 2 = deterministic, walk the
+    # degradation ladder instead of the retry budget
+    seg_halvings = 0
     t = t_start                 # drained/validated sim time
     t_issue = t_start           # where the next issued segment starts
     cur_state = state           # issue-side head (newest issued state)
@@ -792,9 +863,19 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
         segment: unrelated transient incidents hours apart must not
         pool into one exhausted budget — a genuinely dead device
         still exhausts it, because no segment ever drains clean."""
-        nonlocal failures
+        nonlocal failures, oom_streak
         if not is_transient(e) or good_state is None:
             raise e
+        # a deterministic OOM — the SAME memory-exhaustion error
+        # twice in a row at the same validated boundary — cannot be
+        # retried away: it routes to the degradation ladder WITHOUT
+        # charging the retry budget (pre-ladder it burned every
+        # retry replaying a segment that could never fit, then
+        # escalated off-device). A single OOM still retries
+        # normally: allocators do lose races and win the rerun.
+        oom_streak = oom_streak + 1 if is_oom(e) else 0
+        if oom_streak >= 2:
+            return recover_oom(e)
         discarded = window.discard()
         pstats["discarded"] += discarded
         failures += 1
@@ -803,6 +884,11 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
         # reports it mid-run, not just the end-of-run SimStats
         runner.retries = res.retries
         if failures > xp.dispatch_retries:
+            if is_oom(e):
+                # the retry budget ran out on a memory error:
+                # shrink the FOOTPRINT (the ladder), not the mesh —
+                # a smaller mesh has less memory, not more
+                return recover_oom(e)
             if xp.failover == "shrink":
                 shrunk = _shrink_recover(runner, e, good_state,
                                          good_t, ensemble, ck,
@@ -833,6 +919,108 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
                 time.sleep(delay)
         with tracer.span("retry.recover", "retry", sim_t0=good_t,
                          attempt=failures):
+            new_state = _recover_state(runner, good_state,
+                                       replace_state, ck, stop,
+                                       ensemble)
+        return rewind_to_good(new_state)
+
+    def recover_oom(e):
+        """The graceful-degradation ladder (the runtime backstop of
+        the preflight admission gate): a deterministic OOM is a
+        capacity fact, so each invocation walks ONE rung that
+        actually shrinks the footprint, replays bit-identically from
+        the last validated state, and leaves the retry budget
+        untouched. Rungs, in order:
+
+        1. halve the pipeline window depth — each unit of depth pins
+           a full extra segment state on-device;
+        2. ensembles only: raise :class:`DegradeToReplicaBatch` —
+           the campaign re-runs the sweep in sequential replica
+           batches (bit-identical to the full vmap);
+        3. halve the dispatch segment — shorter segments bound the
+           transient exchange/working-set peak (segmentation never
+           changes traces: the engine clamps on the global stop);
+        4. out of rungs: the existing ``failover:`` escalation.
+
+        Every rung logs a ``degrade`` span, notifies the chaos
+        injector (so a scripted repeating OOM stops firing exactly
+        when a real one would — the footprint shrank), and logs the
+        re-admission estimate against the budget."""
+        nonlocal seg, seg_halvings, oom_streak
+        pstats["discarded"] += window.discard()
+        # a recurrence AFTER a rung is a fresh deterministic-OOM
+        # incident at streak 2 immediately — walking the next rung
+        # must not charge the retry budget either
+        oom_streak = 1
+        res.degrades += 1
+        runner.degrades = res.degrades
+        rung, span_kw = "", {}
+        if window.depth > 1:
+            new_depth = max(1, window.depth // 2)
+            rung = f"pipeline_depth {window.depth}->{new_depth}"
+            span_kw = {"depth": new_depth}
+            window.depth = new_depth
+            pstats["depth"] = new_depth
+        elif ensemble and \
+                int(getattr(runner, "_replica_batchable", 0) or 0):
+            batch = int(runner._replica_batchable)
+            rung = f"replica_batch {batch}"
+            tracer.instant("degrade.replica_batch", "degrade",
+                           sim_t0=good_t, batch=batch,
+                           error=str(e)[:200])
+            if chaos_inj is not None and \
+                    hasattr(chaos_inj, "on_degrade_rung"):
+                chaos_inj.on_degrade_rung(rung)
+            log.warning(
+                "OOM ladder: deterministic memory exhaustion past "
+                "t=%d ns (%s) — the full-replica vmap does not fit; "
+                "re-running the sweep in sequential batches of %d "
+                "replica(s) (bit-identical to the full vmap)",
+                good_t, e, batch)
+            raise DegradeToReplicaBatch(
+                f"ensemble footprint exhausted device memory ({e}); "
+                f"degrade to replica batches of {batch}",
+                batch=batch) from e
+        else:
+            cur_seg = seg if seg else max(1, int(pause) - int(good_t))
+            if cur_seg > 1 and seg_halvings < MAX_SEG_HALVINGS:
+                seg = max(1, cur_seg // 2)
+                seg_halvings += 1
+                rung = f"dispatch_segment {cur_seg}->{seg}"
+                span_kw = {"segment": seg}
+        if not rung:
+            log.error(
+                "OOM ladder exhausted: deterministic memory "
+                "exhaustion past t=%d ns (%s) with no rung left "
+                "(depth=1, segment floor reached); escalating via "
+                "failover: %s", good_t, e, xp.failover)
+            _escalate(runner, e, good_state, good_t, stop, ensemble,
+                      ck)
+        tracer.instant("degrade." + rung.split()[0], "degrade",
+                       sim_t0=good_t, error=str(e)[:200], **span_kw)
+        if chaos_inj is not None and \
+                hasattr(chaos_inj, "on_degrade_rung"):
+            chaos_inj.on_degrade_rung(rung)
+        try:
+            est = capacity.footprint(runner.engine,
+                                     pipeline_depth=window.depth)
+            b, src = capacity.device_budget(runner.engine, xp)
+            log.warning(
+                "OOM ladder rung %d (%s): deterministic memory "
+                "exhaustion past t=%d ns (%s); re-admission "
+                "estimate ~%s per device%s — replaying from the "
+                "last validated state (bit-identical: segmentation "
+                "and pipelining are pure host orchestration)",
+                res.degrades, rung, good_t, e,
+                capacity.fmt_bytes(est["per_device"]),
+                (f" vs budget {capacity.fmt_bytes(b)} ({src})"
+                 if b else ""))
+        except Exception:       # noqa: BLE001 — telemetry only
+            log.warning("OOM ladder rung %d (%s): replaying from "
+                        "the last validated state", res.degrades,
+                        rung)
+        with tracer.span("degrade.recover", "degrade", sim_t0=good_t,
+                         rung=rung):
             new_state = _recover_state(runner, good_state,
                                        replace_state, ck, stop,
                                        ensemble)
@@ -964,6 +1152,7 @@ def advance(runner, state, t_start: int, pause: int, stop: int,
             res.rounds = res.rounds + seg_rounds
             t = fl.t1
             failures = 0        # the segment drained clean; see above
+            oom_streak = 0      # ... and so did any OOM streak
             if int(np.max(res.rounds)) >= budget:
                 # enforced cumulatively (per-invocation caps would
                 # reset each segment); speculative segments past the

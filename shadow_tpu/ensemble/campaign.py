@@ -108,7 +108,19 @@ class EnsembleRunner:
         self.replans = 0
         self.retries = 0
         self.reshards = 0
+        self.degrades = 0
         self._planned = False
+        # preflight admission verdict (capacity.admission_verdict),
+        # set per run(); the shared advance loop reads its overrides
+        # and the ENSEMBLE/bench records stamp it
+        self.admission = None
+        # nonzero = the OOM ladder may degrade this campaign to
+        # sequential replica batches of this size (set per run();
+        # zero while batching is impossible or already engaged)
+        self._replica_batchable = 0
+        # replica-index offset of the batch currently running, so
+        # batched heartbeat lines keep campaign-global replica labels
+        self._replica_offset = 0
         # chaos injection + shrink failover ride the base runner's
         # plumbing (one injector, one mesh owner); the shared advance
         # loop reads runner.chaos
@@ -327,14 +339,24 @@ class EnsembleRunner:
         n_deliv = np.asarray(jax.device_get(states["n_deliv"]))[:, :H]
         self._hb_mark, rates = heartbeat_rates(self._hb_mark,
                                                n_sent.sum(1))
+        # live device memory, when the backend exposes allocator
+        # stats (TPU/GPU); "n/a" on CPU or before the engine exists —
+        # the operator can tell an approaching OOM from the log
+        # stream alone
+        eng = getattr(self, "engine", None)
+        mem = eng.device_memory_stats() if eng is not None else None
+        mem_s = (f"{capacity.fmt_bytes(mem[0])}/"
+                 f"{capacity.fmt_bytes(mem[1])}"
+                 if mem is not None else "n/a")
         for r in range(self.worlds.R):
             log.info("[ensemble-heartbeat] t=%s replica=%d events=%d "
                      "sent=%d dropped=%d delivered=%d pkts/s=%s "
-                     "retries=%d replans=%d",
-                     simtime.format_time(now), r,
+                     "retries=%d replans=%d mem=%s",
+                     simtime.format_time(now),
+                     r + getattr(self, "_replica_offset", 0),
                      int(n_exec[r].sum()), int(n_sent[r].sum()),
                      int(n_drop[r].sum()), int(n_deliv[r].sum()),
-                     rates[r], self.retries, self.replans)
+                     rates[r], self.retries, self.replans, mem_s)
 
     # ------------------------------------------------------------------
     def record_path(self) -> str:
@@ -402,6 +424,87 @@ class EnsembleRunner:
         }
 
     # ------------------------------------------------------------------
+    def _run_batched(self, t_start: int, pause: int, stop: int,
+                     batch: int, tracer):
+        """Sequential replica batches: vmap over <= ``batch`` replicas
+        at a time, then merge the per-batch host-side finals over the
+        replica axis. Bit-identical to the full-R vmap — each
+        replica's trace is a pure function of its own world row
+        (spec.py's contract), and every batch keeps the FULL
+        campaign's lookahead, so batch boundaries cannot move round
+        boundaries. Engaged by ``ensemble.replica_batch``, a
+        preflight admission override, or the OOM ladder's
+        :class:`supervise.DegradeToReplicaBatch` rung. Returns
+        ``(merged_final, combined AdvanceResult, per-replica
+        rounds)``; the merged final is host-side (the point is never
+        holding all R replicas of device state at once), which the
+        downstream record/stats path consumes unchanged."""
+        from shadow_tpu.device import supervise
+        from shadow_tpu.ensemble import spec
+
+        w_full = self.worlds
+        R = int(w_full.R)
+        batch = max(1, min(int(batch), R))
+        n_batches = -(-R // batch)
+        log.warning(
+            "replica batching: running %d replica(s) as %d "
+            "sequential batch(es) of <= %d (one vmapped program per "
+            "batch, finals merged — bit-identical to the full vmap)",
+            R, n_batches, batch)
+        # already batched: the ladder's replica-batch rung must not
+        # re-trigger (an OOM inside a batch walks the next rung)
+        self._replica_batchable = 0
+        heaps = ("ht", "hk", "hm", "hv", "hw")
+        engine_full, finals, rounds_parts = self.engine, [], []
+        combined = supervise.AdvanceResult()
+        try:
+            for b in range(n_batches):
+                lo, hi = b * batch, min(R, (b + 1) * batch)
+                part = spec.slice_worlds(w_full, lo, hi)
+                self.worlds = part
+                self._replica_offset = lo
+                # per-replica heartbeat rate vectors change length
+                # across batches — a stale mark would mis-zip
+                self._hb_mark = None
+                with tracer.span("replica_batch", "host",
+                                 sim_t0=t_start, lo=lo, hi=hi,
+                                 batch_index=b):
+                    self.engine = self._build_engine()
+                    supervise.prefetch_programs(self, ensemble=True)
+                    states = self.engine.init_ensemble_state(
+                        self.sim.starts)
+                    states, adv = supervise.advance(
+                        self, states, t_start, pause, stop,
+                        ensemble=True)
+                    finals.append(jax.device_get(
+                        {k: v for k, v in states.items()
+                         if k not in heaps}))
+                rounds_parts.append(np.broadcast_to(
+                    np.asarray(adv.rounds), (hi - lo,)).copy())
+                combined.t_end = adv.t_end
+                combined.retries += adv.retries
+                combined.reshards += adv.reshards
+                combined.degrades += adv.degrades
+                combined.budget_hit |= adv.budget_hit
+                combined.overflowed |= adv.overflowed
+                combined.pipeline = adv.pipeline
+        finally:
+            self.worlds = w_full
+            self._replica_offset = 0
+            self.engine = engine_full
+        merged = {k: np.concatenate([f[k] for f in finals], axis=0)
+                  for k in finals[0]}
+        rounds_r = np.concatenate(rounds_parts)
+        combined.rounds = np.int64(rounds_r.max())
+        pl = dict(combined.pipeline or {})
+        pl["replica_batches"] = int(n_batches)
+        pl["replica_batch"] = int(batch)
+        combined.pipeline = pl
+        if isinstance(self.admission, dict):
+            self.admission["replica_batch"] = int(batch)
+        return merged, combined, rounds_r
+
+    # ------------------------------------------------------------------
     def run(self, stop: int) -> SimStats:
         from shadow_tpu.device import checkpoint, supervise
 
@@ -412,7 +515,9 @@ class EnsembleRunner:
         self.replans = 0
         self.retries = 0
         self.reshards = 0
+        self.degrades = 0
         self._hb_mark = None
+        self._replica_offset = 0
         w = self.worlds
         if xp.checkpoint_save:
             checkpoint.probe_writable(xp.checkpoint_save)
@@ -442,6 +547,31 @@ class EnsembleRunner:
             # path), then the CAMPAIGN engine rebuilds on it
             if self._base._adopt_checkpoint_geometry(load_path):
                 self.engine = self._build_engine()
+        # preflight admission (capacity.py): the campaign footprint —
+        # per-replica state x R, exchange scratch, pipeline copies —
+        # against the per-device budget, BEFORE any compile (the
+        # first compile happens lazily at the first dispatch, which
+        # the capacity warm-up below would trigger). strict refuses
+        # over-budget here; auto may statically degrade the pipeline
+        # depth or pre-split the sweep into replica batches.
+        eopts = self.sim.cfg.ensemble
+        batch = int(getattr(eopts, "replica_batch", 0) or 0)
+        ck_on = bool(xp.checkpoint_save or xp.checkpoint_load
+                     or xp.checkpoint_every)
+        can_batch = w.R > 1 and not batch and not ck_on
+        self.admission = capacity.admission_verdict(
+            self.engine, xp,
+            pipeline_depth=getattr(xp, "pipeline_depth", 0),
+            batchable=can_batch)
+        adm_ov = self.admission.get("overrides") or {}
+        if not batch and adm_ov.get("replica_batch"):
+            batch = int(adm_ov["replica_batch"])
+        # the OOM ladder may still degrade an unbatched campaign at
+        # runtime (supervise.DegradeToReplicaBatch); checkpointed
+        # campaigns cannot batch — the checkpoint stamps the full-R
+        # stacked state (schema.py enforces the same for the knob)
+        self._replica_batchable = (max(1, w.R // 2)
+                                   if can_batch and not batch else 0)
         if xp.capacity_plan != "static" and not self._planned:
             with tracer.span("capacity.plan", "plan",
                              mode=xp.capacity_plan, ensemble=True):
@@ -477,13 +607,30 @@ class EnsembleRunner:
         self.guard = supervise.make_guard(self.sim.cfg)
         import contextlib
         t0 = time.perf_counter()
+        rounds_r = None
         with (self.guard if self.guard is not None
               else contextlib.nullcontext()):
-            states, adv = supervise.advance(self, states, t_start,
-                                            pause, stop,
-                                            ensemble=True)
-        rounds_r = np.broadcast_to(np.asarray(adv.rounds),
-                                   (self.worlds.R,))
+            if batch:
+                states, adv, rounds_r = self._run_batched(
+                    t_start, pause, stop, batch, tracer)
+            else:
+                try:
+                    states, adv = supervise.advance(
+                        self, states, t_start, pause, stop,
+                        ensemble=True)
+                except supervise.DegradeToReplicaBatch as dg:
+                    # the ladder's replica-batch rung: the full-R
+                    # vmap exhausted device memory deterministically
+                    # — re-run the sweep from t=0 in sequential
+                    # batches (bit-identical; no checkpointer exists
+                    # on this path, so nothing was saved to rewind)
+                    batch = dg.batch
+                    states, adv, rounds_r = self._run_batched(
+                        t_start, pause, stop, batch, tracer)
+                    adv.degrades += 1   # the rung that engaged it
+        if rounds_r is None:
+            rounds_r = np.broadcast_to(np.asarray(adv.rounds),
+                                       (self.worlds.R,))
         t_end = adv.t_end
         budget_hit, overflowed = adv.budget_hit, adv.overflowed
         self.retries = adv.retries
@@ -538,7 +685,17 @@ class EnsembleRunner:
         overflow = int(final["overflow"][:, :H].sum())
         x_overflow = int(final["x_overflow"][:, :H].sum())
         ok = overflow == 0 and x_overflow == 0 and not budget_hit
+        self.degrades = adv.degrades
         self.record = self._build_record(final, rounds_r, wall, ok)
+        if self.admission is not None:
+            # the preflight verdict (and any replica-batch split)
+            # rides the campaign record — bench.py stamps it into
+            # the ensemble BENCH records from here
+            self.record["admission"] = self.admission
+        if batch:
+            self.record["replica_batch"] = int(batch)
+        if adv.degrades:
+            self.record["degrades"] = int(adv.degrades)
         if adv.preempted:
             # a preempted campaign's counters cover only the executed
             # prefix — the resumed run writes the real record
@@ -570,6 +727,11 @@ class EnsembleRunner:
         stats.replans = self.replans
         stats.retries = self.retries
         stats.reshards = adv.reshards
+        stats.degrades = adv.degrades
+        stats.admission = self.admission
+        mem = self.engine.device_memory_stats()
+        if mem is not None:
+            stats.mem_bytes_in_use, stats.mem_budget = mem
         stats.preempted = adv.preempted
         stats.resume_path = adv.resume_path
         # campaigns ride the same segment pipeline as standalone runs

@@ -61,6 +61,35 @@ class EnsembleWorlds:
     campaign_fp: str = ""
 
 
+def slice_worlds(w: EnsembleWorlds, lo: int, hi: int) -> EnsembleWorlds:
+    """A replica-contiguous slice ``[lo, hi)`` of a stacked world, for
+    sequential replica batches (``ensemble.replica_batch`` / the OOM
+    degradation ladder's replica-batch rung in campaign.py). Every
+    ``[R, ...]``-leading array is sliced; the shared scalars are kept
+    VERBATIM — in particular the FULL campaign's lookahead (the min
+    over ALL replicas: a batch-local min could differ and change
+    round boundaries, breaking the batch == full-vmap bit-identity)
+    and the full campaign fingerprint (records must name the
+    campaign, not the batch)."""
+    lo, hi = int(lo), int(hi)
+    if not (0 <= lo < hi <= w.R):
+        raise ValueError(
+            f"slice_worlds: replica window [{lo}, {hi}) is outside "
+            f"[0, {w.R})")
+    return EnsembleWorlds(
+        R=hi - lo,
+        latency=w.latency[lo:hi],
+        reliability=w.reliability[lo:hi],
+        epoch_times=w.epoch_times[lo:hi],
+        seed_k1=w.seed_k1[lo:hi],
+        seed_k2=w.seed_k2[lo:hi],
+        seeds=w.seeds[lo:hi],
+        lookahead=w.lookahead,
+        descriptors=list(w.descriptors[lo:hi]),
+        campaign_fp=w.campaign_fp,
+    )
+
+
 def seed_key_np(seed: int) -> tuple[np.uint32, np.uint32]:
     """numpy twin of device/prng.seed_key — the same 64-bit mask and
     split, so the traced per-replica keys are bit-identical to the

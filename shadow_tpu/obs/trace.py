@@ -70,12 +70,14 @@ MODES = ("off", "summary", "trace")
 # degradation cost (liveness probe + re-shard + re-place; the
 # rebuild's compile wall lands in "compile" as ever), "chaos" marks
 # scripted fault injections (instants — the faults themselves cost
-# nothing), and "failover" is the hybrid-rerun rung's own overhead
-# (the rerun's inner spans keep their phases).
+# nothing), "failover" is the hybrid-rerun rung's own overhead
+# (the rerun's inner spans keep their phases), and "degrade" marks
+# the OOM degradation ladder's rung engagements (admission refusals
+# and runtime rungs both land here).
 PHASES = ("host", "judge", "dispatch", "dispatch.issue",
           "dispatch.sync", "exchange", "checkpoint",
           "retry", "compile", "plan", "reshard", "chaos",
-          "failover")
+          "failover", "degrade")
 
 # recent-span ring size: what a watchdog stall dump embeds so a hang
 # report shows what the run WAS doing, not just where it stopped
