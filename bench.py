@@ -710,6 +710,91 @@ def run_ensemble_rung() -> dict:
     return out
 
 
+# topology-representation rung ladder: (label, clusters, spokes/hub).
+# The 1M point runs only outside BENCH_SMOKE (sub-second build, but
+# the smoke ladder stays tiny on principle).
+TOPOLOGY_RUNG_SIZES = [("1k", 20, 49), ("100k", 100, 999)]
+TOPOLOGY_RUNG_1M = "examples/tgen_1000000.yaml"
+
+
+def run_topology_rung() -> dict:
+    """Topology-representation rung (docs/topology.md): build
+    hierarchical star_clusters tables at 1k/100k vertices — and the
+    million-host example config outside BENCH_SMOKE — stamping build
+    wall, actual table bytes, and the dense-equivalent bytes
+    (12 bytes/pair: int64 latency + float32 reliability). At the 1k
+    point the dense pipeline also runs for a wall/byte comparison and
+    the factored tables are checked bit-identical to it (the build
+    already verifies at V <= 2048; a silent skip would make this rung
+    meaningless). Pure host-side numpy — no device work, so the rung
+    is identical on every backend."""
+    import numpy as np
+
+    from shadow_tpu.device.capacity import fmt_bytes
+    from shadow_tpu.topology.generate import generate_star_clusters
+
+    out = {"points": []}
+    for label, C, S in TOPOLOGY_RUNG_SIZES:
+        params = {"clusters": C, "spokes_per_cluster": S,
+                  "hub_latency": "10 ms", "access_latency": "1 ms"}
+        t0 = time.perf_counter()
+        th = generate_star_clusters(params,
+                                    representation="hierarchical")
+        h_wall = time.perf_counter() - t0
+        V = th.n_vertices
+        dense_bytes = 12 * V * V
+        pt = {"label": label, "n_vertices": V,
+              "n_clusters": th.hier.n_clusters,
+              "hier_build_s": round(h_wall, 3),
+              "hier_table_bytes": th.table_nbytes(),
+              "dense_table_bytes": dense_bytes,
+              "reduction": round(dense_bytes / th.table_nbytes(), 1)}
+        if V <= 2048:
+            t0 = time.perf_counter()
+            td = generate_star_clusters(params,
+                                        representation="dense")
+            pt["dense_build_s"] = round(time.perf_counter() - t0, 3)
+            hlat, hrel = th.hier.dense()
+            if not (np.array_equal(hlat, td.latency_ns)
+                    and np.array_equal(hrel, td.reliability)):
+                return {**out, "error": f"{label}: factored tables "
+                        "diverged from the dense pipeline"}
+        log(f"  topology {label}: V={V} hier "
+            f"{fmt_bytes(pt['hier_table_bytes'])} in "
+            f"{pt['hier_build_s']}s (dense "
+            f"{fmt_bytes(dense_bytes)}, {pt['reduction']}x)")
+        out["points"].append(pt)
+    if not os.environ.get("BENCH_SMOKE"):
+        # the million-host example, through the REAL config path
+        # (schema -> load_topology -> generator -> representation)
+        from shadow_tpu.config import load_config
+        from shadow_tpu.core.controller import load_topology
+        cfg = load_config(TOPOLOGY_RUNG_1M)
+        t0 = time.perf_counter()
+        top = load_topology(cfg)
+        wall = time.perf_counter() - t0
+        V = top.n_vertices
+        budget = int(cfg.experimental.device_memory_budget)
+        tb = top.table_nbytes()
+        pt = {"label": "1M", "config": TOPOLOGY_RUNG_1M,
+              "n_vertices": V, "n_clusters": top.hier.n_clusters,
+              "hier_build_s": round(wall, 3),
+              "hier_table_bytes": tb,
+              "dense_table_bytes": 12 * V * V,
+              "reduction": round(12 * V * V / tb, 1),
+              "budget_bytes": budget,
+              "tables_fit_budget": tb <= budget}
+        log(f"  topology 1M: V={V} tables {fmt_bytes(tb)} in "
+            f"{pt['hier_build_s']}s — "
+            f"{'fit' if pt['tables_fit_budget'] else 'EXCEED'} the "
+            f"{fmt_bytes(budget)} example budget (dense would be "
+            f"{fmt_bytes(12 * V * V)})")
+        out["points"].append(pt)
+        if not pt["tables_fit_budget"]:
+            out["error"] = "1M tables exceed the example's budget"
+    return out
+
+
 PIPELINE_DEPTHS = (1, 2, 4)
 
 
@@ -1208,6 +1293,18 @@ def main() -> int:
         except Exception as e:          # noqa: BLE001
             result["ensemble"] = {"error": str(e)}
             log(f"  ensemble rung failed: {e}")
+            rc = 1
+
+        log("topology rung: hierarchical vs dense table build "
+            "(host-side, docs/topology.md)")
+        try:
+            result["topology"] = run_topology_rung()
+            if "error" in result["topology"]:
+                log(f"  topology rung: {result['topology']['error']}")
+                rc = 1
+        except Exception as e:          # noqa: BLE001
+            result["topology"] = {"error": str(e)}
+            log(f"  topology rung failed: {e}")
             rc = 1
 
         if not os.environ.get("BENCH_SMOKE"):

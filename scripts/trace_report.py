@@ -164,6 +164,15 @@ def print_report(m: dict, top: int = 0) -> None:
     lever = LEVERS.get(dom)
     if lever:
         print(f"  -> {lever}")
+    if (dom == "plan" and run.get("representation") == "dense"
+            and int(run.get("n_hosts") or 0) >= 100_000):
+        # a plan-dominant dense run at >=100k hosts is almost always
+        # paying the [V,V] table build/upload — the factored tables
+        # are the lever (docs/topology.md)
+        print(f"  -> dense path tables at {run['n_hosts']} hosts: "
+              "if the topology is hub-and-spoke, set "
+              "network.topology.representation: hierarchical "
+              "(docs/topology.md)")
     pipe = (m.get("counters") or {}).get("pipeline")
     if pipe:
         # the pipelined-dispatch summary: how deep the window ran

@@ -130,6 +130,10 @@ class HostOptions:
     bandwidth_down: Optional[int] = None   # bits/s; default from topology vertex
     bandwidth_up: Optional[int] = None
     network_node_id: Optional[int] = None  # pin to a topology vertex id
+    # with network_node_id: host i of the group attaches at vertex
+    # network_node_id + i * stride — O(1) placement for generated
+    # million-vertex topologies (no per-host vertex scan)
+    network_node_stride: int = 0
     ip_address_hint: Optional[str] = None
     country_code_hint: Optional[str] = None
     city_code_hint: Optional[str] = None
@@ -142,16 +146,26 @@ class HostOptions:
     def from_dict(cls, name: str, d: dict) -> "HostOptions":
         _check_keys(f"hosts.{name}", d, {
             "quantity", "bandwidth_down", "bandwidth_up", "network_node_id",
+            "network_node_stride",
             "ip_address_hint", "ip_addr", "country_code_hint",
             "city_code_hint", "log_level", "pcap_directory", "options",
             "processes",
         })
+        stride = int(d.get("network_node_stride", 0))
+        if stride < 0:
+            raise ValueError(
+                f"hosts.{name}: network_node_stride must be >= 0")
+        if stride > 0 and d.get("network_node_id") is None:
+            raise ValueError(
+                f"hosts.{name}: network_node_stride needs "
+                "network_node_id (the stride's base vertex)")
         return cls(
             name=name,
             quantity=int(d.get("quantity", 1)),
             network_node_id=(int(d["network_node_id"])
                              if d.get("network_node_id") is not None
                              else None),
+            network_node_stride=stride,
             bandwidth_down=(parse_bandwidth_bits(d["bandwidth_down"])
                             if d.get("bandwidth_down") is not None else None),
             bandwidth_up=(parse_bandwidth_bits(d["bandwidth_up"])
@@ -285,21 +299,51 @@ class NetworkOptions:
     graph_type: str = "1_gbit_switch"
     graph_file: Optional[str] = None
     graph_inline: Optional[str] = None
+    # generator knobs (graph.type: star_clusters — topology/generate.py)
+    graph_params: dict = field(default_factory=dict)
     use_shortest_path: bool = True
+    # network.topology.representation: dense | hierarchical | auto —
+    # how the all-pairs tables are stored (topology/graph.py; see
+    # docs/topology.md). dense is the exact [V,V] baseline;
+    # hierarchical factors through clusters (O(C^2 + V), required
+    # beyond ~100k hosts) and REFUSES non-factorable graphs; auto
+    # tries hierarchical and falls back to dense with a log line.
+    representation: str = "dense"
     faults: list = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict) -> "NetworkOptions":
         _check_keys("network", d, {"graph", "use_shortest_path",
-                                   "faults"})
+                                   "topology", "faults"})
         graph = d.get("graph", {}) or {}
-        _check_keys("network.graph", graph, {"type", "file", "inline"})
+        _check_keys("network.graph", graph, {
+            "type", "file", "inline",
+            # star_clusters generator surface
+            "clusters", "spokes_per_cluster", "hub_latency",
+            "access_latency", "hub_packet_loss", "access_packet_loss",
+            "bandwidth_down", "bandwidth_up"})
         gtype = graph.get("type", "1_gbit_switch")
         gfile = None
         if isinstance(graph.get("file"), dict):
             gfile = graph["file"].get("path")
         elif isinstance(graph.get("file"), str):
             gfile = graph["file"]
+        params = {k: graph[k] for k in (
+            "clusters", "spokes_per_cluster", "hub_latency",
+            "access_latency", "hub_packet_loss", "access_packet_loss",
+            "bandwidth_down", "bandwidth_up") if k in graph}
+        if params and gtype != "star_clusters":
+            raise ValueError(
+                "network.graph: generator keys "
+                f"{sorted(params)} are only valid with "
+                "type: star_clusters")
+        topo = d.get("topology", {}) or {}
+        _check_keys("network.topology", topo, {"representation"})
+        rep = str(topo.get("representation", "dense"))
+        if rep not in ("dense", "hierarchical", "auto"):
+            raise ValueError(
+                "network.topology.representation must be dense, "
+                f"hierarchical or auto (got {rep!r})")
         raw_faults = d.get("faults") or []
         if not isinstance(raw_faults, list):
             raise ValueError("network.faults must be a list of fault "
@@ -308,7 +352,9 @@ class NetworkOptions:
             graph_type=gtype,
             graph_file=gfile,
             graph_inline=graph.get("inline"),
+            graph_params=params,
             use_shortest_path=bool(d.get("use_shortest_path", True)),
+            representation=rep,
             faults=[_fault_from_dict(i, f)
                     for i, f in enumerate(raw_faults)],
         )

@@ -23,7 +23,7 @@ from shadow_tpu.core.netmodel import NetworkModel
 from shadow_tpu.core.scheduler import make_policy
 from shadow_tpu.host.host import Host
 from shadow_tpu.models import is_model_path, make_app
-from shadow_tpu.topology.attach import Attacher
+from shadow_tpu.topology.attach import Attacher, HostAttachment
 from shadow_tpu.topology.graph import Topology
 from shadow_tpu.utils.rng import SeededRandom
 from shadow_tpu.utils.slog import get_logger
@@ -33,16 +33,24 @@ log = get_logger("controller")
 
 def load_topology(cfg: ConfigOptions) -> Topology:
     net = cfg.network
+    rep = net.representation
     if net.graph_type == "1_gbit_switch":
-        return Topology.builtin_1_gbit_switch()
+        return Topology.builtin_1_gbit_switch(representation=rep)
     if net.graph_type == "gml":
         if net.graph_inline:
             return Topology.from_gml(net.graph_inline,
-                                     net.use_shortest_path)
+                                     net.use_shortest_path,
+                                     representation=rep)
         if net.graph_file:
             with open(net.graph_file) as f:
-                return Topology.from_gml(f.read(), net.use_shortest_path)
+                return Topology.from_gml(f.read(), net.use_shortest_path,
+                                         representation=rep)
         raise ValueError("network.graph.type=gml needs file.path or inline")
+    if net.graph_type == "star_clusters":
+        from shadow_tpu.topology.generate import generate_star_clusters
+        return generate_star_clusters(net.graph_params,
+                                      net.use_shortest_path,
+                                      representation=rep)
     raise ValueError(f"unknown graph type {net.graph_type!r}")
 
 
@@ -86,18 +94,45 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
     runtime = None
     n_total = cfg.total_hosts()
     for group in cfg.hosts:
+        # network_node_stride: host i of the group attaches at vertex
+        # index base + i*stride — resolved ONCE per group (the id
+        # lookup is an O(V) scan; a million strided hosts must not
+        # pay it a million times)
+        stride_base = None
+        if group.network_node_stride > 0:
+            stride_base = topology.vertex_index_for_id(
+                group.network_node_id)
+            last = stride_base + \
+                (group.quantity - 1) * group.network_node_stride
+            if last >= topology.n_vertices:
+                raise ValueError(
+                    f"hosts.{group.name}: network_node_stride walks "
+                    f"past the topology (host {group.quantity - 1} "
+                    f"would attach at vertex {last}, the graph has "
+                    f"{topology.n_vertices})")
         for i in range(group.quantity):
             name = group.name if group.quantity == 1 else f"{group.name}{i}"
             host_id = len(hosts)
             groups.setdefault(group.name, []).append(host_id)
-            att = attacher.attach(
-                network_node_id=group.network_node_id,
-                ip_hint=group.ip_address_hint,
-                city_hint=group.city_code_hint,
-                country_hint=group.country_code_hint,
-                bw_down_override=group.bandwidth_down,
-                bw_up_override=group.bandwidth_up,
-            )
+            if stride_base is not None:
+                v = stride_base + i * group.network_node_stride
+                att = HostAttachment(
+                    vertex=v,
+                    bw_down_bits=(group.bandwidth_down
+                                  if group.bandwidth_down is not None
+                                  else int(topology.bw_down_bits[v])),
+                    bw_up_bits=(group.bandwidth_up
+                                if group.bandwidth_up is not None
+                                else int(topology.bw_up_bits[v])))
+            else:
+                att = attacher.attach(
+                    network_node_id=group.network_node_id,
+                    ip_hint=group.ip_address_hint,
+                    city_hint=group.city_code_hint,
+                    country_hint=group.country_code_hint,
+                    bw_down_override=group.bandwidth_down,
+                    bw_up_override=group.bandwidth_up,
+                )
             host = Host(host_id=host_id, name=name, vertex=att.vertex,
                         bw_down_bits=att.bw_down_bits,
                         bw_up_bits=att.bw_up_bits,
@@ -488,7 +523,9 @@ class Controller:
                         .scheduler_policy,
                         "n_hosts": len(self.sim.hosts),
                         "stop_time": int(self.cfg.general.stop_time),
-                        "seed": int(self.cfg.general.seed)},
+                        "seed": int(self.cfg.general.seed),
+                        "representation": self.sim.topology
+                        .representation},
                     counters=counters)
                 if stats is not None and summary is not None and \
                         stats.telemetry is None:

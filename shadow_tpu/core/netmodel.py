@@ -69,6 +69,9 @@ class NetworkModel:
         under a fault schedule."""
         if self.faults is not None:
             return self.faults.lookup(now, sv, dv)
+        if self.topology.hier is not None:
+            # hierarchical representation: two-level factored lookup
+            return self.topology.hier.lookup(sv, dv)
         return (int(self.topology.latency_ns[sv, dv]),
                 float(self.topology.reliability[sv, dv]))
 

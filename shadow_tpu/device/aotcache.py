@@ -75,6 +75,9 @@ CODE_DIGEST_MODULES = (
     "shadow_tpu.device.apps",
     "shadow_tpu.device.netsem",
     "shadow_tpu.device.prng",
+    # the two-level factored gather the traced program calls under
+    # representation: hierarchical (compose order is trace semantics)
+    "shadow_tpu.topology.hierarchy",
     "shadow_tpu.host.model_nic",
     # constant providers the trace bakes in: checksum fold constants
     # (CHK_*/MASK63), event kind ids (KIND_*), RNG purpose ids
@@ -96,6 +99,11 @@ CODE_DIGEST_ROOTS = ("shadow_tpu.device.engine",)
 CODE_DIGEST_BOUNDARY = {
     "shadow_tpu": "package namespace only (version/__init__ exports)",
     "shadow_tpu.device": "package namespace only",
+    "shadow_tpu.topology":
+        "package namespace only; builders never enter a traced "
+        "program — the tables they produce join the cache key by "
+        "value (world fingerprint + program_facts representation), "
+        "and the traced gather itself is topology.hierarchy, digested",
     "shadow_tpu._jax":
         "import shim; jax/jaxlib versions join backend_signature",
     "shadow_tpu.simtime":

@@ -675,13 +675,23 @@ def footprint(engine, pipeline_depth: int = 0,
         rows = S * int(eff["CAP"])
     exchange_dev = 2 * rows * 6 * 8          # send + recv, ~6 fields
     scratch = (outbox_dev + exchange_dev) * R
-    # world tables replicate on every device; ensemble stacks them [R]
+    # world tables replicate on every device; ensemble stacks them
+    # [R]. Under the hierarchical representation the latency /
+    # reliability slots are TUPLES of factored leaves ([C,C] + [V]
+    # vectors), so flatten the pytree and price the actual uploaded
+    # arrays — the whole point of the representation is that this sum
+    # is MBs where the dense [V,V] pair would be GBs.
+    from shadow_tpu._jax import jax
+
     ws = engine.world_structs(ensemble=ens is not None)
-    world_total = sum(_nbytes(s) for s in ws)
+    world_total = sum(_nbytes(s)
+                     for s in jax.tree_util.tree_leaves(ws))
     if ens is not None and R_full:
         world_total = (world_total * R) // R_full
+    hier = isinstance(getattr(engine, "latency", None), tuple)
     per_device = state_dev * copies * R + scratch + world_total
     return {
+        "representation": "hierarchical" if hier else "dense",
         "per_device": int(per_device),
         "total": int(per_device * S),
         "state_bytes": int(state_dev),
@@ -731,8 +741,10 @@ def admission_diagnostic(est: dict, budget: int, source: str) -> str:
         f"{fmt_bytes(est['state_bytes'])} x {est['copies']} copies x "
         f"R={est['replicas']}, scratch "
         f"{fmt_bytes(est['scratch_bytes'])}, world "
-        f"{fmt_bytes(est['world_bytes'])}; raise the budget or lower "
-        "pipeline_depth / ensemble.replicas / capacities")
+        f"{fmt_bytes(est['world_bytes'])} "
+        f"({est.get('representation', 'dense')} tables); raise the "
+        "budget or lower pipeline_depth / ensemble.replicas / "
+        "capacities")
 
 
 def admission_verdict(engine, xp, pipeline_depth: int = 0,

@@ -102,9 +102,16 @@ def _fingerprint(engine) -> dict:
     # surface, so existing fault-free checkpoints keep loading.
     faulted = len(engine.epoch_times) > 1
     h = hashlib.sha256()
-    for arr in (engine.host_vertex, engine.latency,
-                engine.reliability, engine.bw_up, engine.bw_down) + \
-            ((engine.epoch_times,) if faulted else ()):
+    # hierarchical world tables are tuples of factored leaves —
+    # hash each leaf in order (dense engines hash the exact
+    # pre-hierarchy byte sequence)
+    arrs: list = [engine.host_vertex]
+    for t in (engine.latency, engine.reliability):
+        arrs.extend(t if isinstance(t, tuple) else (t,))
+    arrs += [engine.bw_up, engine.bw_down]
+    if faulted:
+        arrs.append(engine.epoch_times)
+    for arr in arrs:
         a = np.ascontiguousarray(np.asarray(arr))
         h.update(str(a.shape).encode())
         h.update(a.tobytes())

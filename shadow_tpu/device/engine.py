@@ -59,6 +59,7 @@ from shadow_tpu.core.event import (
 from shadow_tpu.device import prng
 from shadow_tpu.device.apps import DeviceApp
 from shadow_tpu.device.netsem import packet_drop_mask
+from shadow_tpu.topology import hierarchy
 from shadow_tpu.utils.rng import PURPOSE_APP, PURPOSE_PACKET_DROP
 
 from shadow_tpu.utils.checksum import (
@@ -229,9 +230,17 @@ class DeviceEngine:
         if ensemble is not None:
             # the stacked tables arrive i32/f32 — build_worlds
             # (ensemble/spec.py) enforces the i32 latency bound over
-            # every replica before the cast, so no re-check here
-            latency_ns = np.asarray(ensemble.latency[0])
-            reliability = np.asarray(ensemble.reliability[0])
+            # every replica before the cast, so no re-check here.
+            # Hierarchical worlds stack each factored leaf [R,...]
+            # instead of one [R,(T,)V,V] matrix.
+            if isinstance(ensemble.latency, tuple):
+                latency_ns = tuple(np.asarray(p[0])
+                                   for p in ensemble.latency)
+                reliability = tuple(np.asarray(p[0])
+                                    for p in ensemble.reliability)
+            else:
+                latency_ns = np.asarray(ensemble.latency[0])
+                reliability = np.asarray(ensemble.reliability[0])
             epoch_times = np.asarray(ensemble.epoch_times[0])
         # d2 survivor bitmasks are one uint32 word: a larger train
         # would silently lose packets (ADVICE r3 #2 — fail loudly)
@@ -251,10 +260,24 @@ class DeviceEngine:
         # [T,V,V] (shadow_tpu/faults.py epoch table) when a fault
         # schedule exists; the fault-free single epoch keeps the
         # plain [V,V] matrices so the compiled program (and its
-        # gathers) is byte-identical to the pre-fault engine
-        latency_ns = np.asarray(latency_ns)
-        reliability = np.asarray(reliability)
-        n_epochs = latency_ns.shape[0] if latency_ns.ndim == 3 else 1
+        # gathers) is byte-identical to the pre-fault engine.
+        # Under `network.topology.representation: hierarchical` the
+        # matrices are replaced by factored leaf TUPLES
+        # (cluster [C,C], cluster-of [V], access [V], self [V]) —
+        # hierarchy.HierTables lat_parts()/rel_parts() — with the
+        # epoch stack as a leading [T] axis on every leaf; the
+        # per-packet lookup becomes hierarchy.gather_parts.
+        hier = isinstance(latency_ns, tuple)
+        if hier:
+            latency_ns = tuple(np.asarray(p) for p in latency_ns)
+            reliability = tuple(np.asarray(p) for p in reliability)
+            n_epochs = latency_ns[0].shape[0] \
+                if latency_ns[0].ndim == 3 else 1
+        else:
+            latency_ns = np.asarray(latency_ns)
+            reliability = np.asarray(reliability)
+            n_epochs = latency_ns.shape[0] if latency_ns.ndim == 3 \
+                else 1
         if epoch_times is None:
             epoch_times = np.zeros(n_epochs, dtype=np.int64)
         self.epoch_times = np.asarray(epoch_times, dtype=np.int64)
@@ -262,21 +285,48 @@ class DeviceEngine:
             raise ValueError(
                 f"epoch_times has {len(self.epoch_times)} entries but "
                 f"the latency table has {n_epochs} epochs")
-        if latency_ns.ndim == 3 and n_epochs == 1:
-            latency_ns = latency_ns[0]
-            reliability = reliability[0]
-        if (latency_ns > np.iinfo(np.int32).max).any():
+        if n_epochs == 1:
+            if hier and latency_ns[0].ndim == 3:
+                latency_ns = tuple(p[0] for p in latency_ns)
+                reliability = tuple(p[0] for p in reliability)
+            elif not hier and latency_ns.ndim == 3:
+                latency_ns = latency_ns[0]
+                reliability = reliability[0]
+        if hier:
+            if latency_ns[0].ndim == 3:
+                over = max(hierarchy.max_composed_latency(
+                    tuple(p[e] for p in latency_ns))
+                    for e in range(n_epochs))
+            else:
+                over = hierarchy.max_composed_latency(latency_ns)
+            if over > np.iinfo(np.int32).max:
+                raise ValueError(
+                    "path latencies above ~2.1 s don't fit the "
+                    "i32 device latency matrix")
+        elif (latency_ns > np.iinfo(np.int32).max).any():
             raise ValueError("path latencies above ~2.1 s don't fit the "
                              "i32 device latency matrix")
         self.host_vertex = np.zeros(self.H_pad, dtype=np.int32)
         self.host_vertex[:H] = host_vertex
-        self.latency = latency_ns.astype(np.int32)
-        self.n_vertices = int(latency_ns.shape[-1])
+        if hier:
+            # int leaves (cluster/access/self latency + the i32
+            # cluster-of vector) ride i32; reliability leaves f32
+            # except the shared cluster-of index vector
+            self.latency = tuple(np.asarray(p).astype(np.int32)
+                                 for p in latency_ns)
+            self.reliability = tuple(
+                np.asarray(p).astype(
+                    np.int32 if i == 1 else np.float32)
+                for i, p in enumerate(reliability))
+            self.n_vertices = int(self.latency[1].shape[-1])
+        else:
+            self.latency = latency_ns.astype(np.int32)
+            self.n_vertices = int(latency_ns.shape[-1])
+            self.reliability = reliability.astype(np.float32)
         if config.count_paths and self.n_vertices ** 2 > 65536:
             raise ValueError(
                 "count_paths needs V*V <= 65536 (histogram boundaries "
                 f"scale with V^2; this graph has V={self.n_vertices})")
-        self.reliability = reliability.astype(np.float32)
         self.seed_pair = prng.seed_key(config.seed)
         # model-NIC bandwidths (bits/s), padded; 1 Gbit default keeps
         # the padded hosts' arithmetic harmless
@@ -569,20 +619,33 @@ class DeviceEngine:
         def _ep_of(t, ept):
             return (t[..., None] >= ept).sum(-1).astype(jnp.int32) - 1
 
+        # hierarchical representation: world tables are factored leaf
+        # tuples; every lookup goes through the shared two-level
+        # gather (topology/hierarchy.py gather_parts)
+        HIER = isinstance(self.latency, tuple)
+
         def _tbl(tab, t, sv, dv, ept):
             """Topology-table gather at send time t; tab is [V,V]
-            (single epoch) or [T,V,V] (fault schedule)."""
+            (single epoch) or [T,V,V] (fault schedule) — or, under
+            the hierarchical representation, the factored leaf tuple
+            with an optional leading [T] axis on every leaf."""
+            if HIER:
+                e = None if T_EP == 1 else _ep_of(t, ept)
+                return hierarchy.gather_parts(tab, sv, dv, e=e)
             if T_EP == 1:
                 return tab[sv, dv]
             return tab[_ep_of(t, ept), sv, dv]
 
         # one-hot topology-table lookups (see EngineConfig.table_onehot)
         TAB_ONEHOT = bool(cfg.table_onehot) and V * V <= 128 \
-            and T_EP == 1
+            and T_EP == 1 and not HIER
         if cfg.table_onehot and not TAB_ONEHOT:
             if T_EP > 1:
                 log.info("table_onehot disabled: fault epoch table "
                          "(T=%d) uses the indexed gather", T_EP)
+            elif HIER:
+                log.info("table_onehot disabled: hierarchical "
+                         "representation uses the factored gather")
             else:
                 log.info("table_onehot disabled: V*V = %d > 128",
                          V * V)
@@ -591,9 +654,15 @@ class DeviceEngine:
         # the roll, so the threefry batch is skipped outright. Under
         # an ensemble the check spans every replica's table — one
         # lossy replica keeps the rolls for all.
-        ALL_REL1 = bool((np.asarray(
-            self.ensemble.reliability if self.ensemble is not None
-            else self.reliability) >= 1.0).all())
+        if HIER:
+            _rel_tab = (self.ensemble.reliability
+                        if self.ensemble is not None
+                        else self.reliability)
+            ALL_REL1 = hierarchy.all_rel1(_rel_tab)
+        else:
+            ALL_REL1 = bool((np.asarray(
+                self.ensemble.reliability if self.ensemble is not None
+                else self.reliability) >= 1.0).all())
 
         # model-NIC constants (host/model_nic.py twins; keep in
         # lockstep with its arithmetic — trace equality depends on it)
@@ -1190,6 +1259,12 @@ class DeviceEngine:
                       "D": int(D), "C": int(C), "M_out": int(M_out),
                       "B": int(B)},
             "n_vertices": int(V),
+            # the factored-vs-dense world layout shapes the gather
+            # trace, so two representations of the SAME topology must
+            # never share a cached executable
+            "representation": ("hierarchical" if HIER else "dense"),
+            "n_clusters": (int(self.latency[0].shape[-1])
+                           if HIER else 0),
             "ensemble_replicas": (int(self.ensemble.R)
                                   if self.ensemble is not None else 0),
         }
@@ -2251,12 +2326,14 @@ class DeviceEngine:
         if getattr(self, "_world_dev", None) is None:
             repl = NamedSharding(self.mesh, self._repl_spec)
             k1, k2 = self.seed_pair
+
+            def put(a):
+                return jax.device_put(jnp.asarray(a), repl)
+
             self._world_dev = (
-                jax.device_put(jnp.asarray(self.latency), repl),
-                jax.device_put(jnp.asarray(self.reliability), repl),
-                jax.device_put(jnp.asarray(k1), repl),
-                jax.device_put(jnp.asarray(k2), repl),
-                jax.device_put(jnp.asarray(self.epoch_times), repl))
+                jax.tree_util.tree_map(put, self.latency),
+                jax.tree_util.tree_map(put, self.reliability),
+                put(k1), put(k2), put(self.epoch_times))
         return self._world_dev
 
     # ------------------------------------------------------------------
@@ -2310,21 +2387,29 @@ class DeviceEngine:
         """Abstract twin of world() / ensemble_worlds_device()."""
         import numpy as _np
 
+        def sds(p):
+            p = _np.asarray(p)
+            return jax.ShapeDtypeStruct(p.shape, p.dtype)
+
         if ensemble:
             ens = self.ensemble
-            parts = (_np.asarray(ens.latency, _np.int32),
-                     _np.asarray(ens.reliability, _np.float32),
-                     _np.asarray(ens.seed_k1, _np.uint32),
-                     _np.asarray(ens.seed_k2, _np.uint32),
-                     _np.asarray(ens.epoch_times, _np.int64))
-            return tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
-                         for p in parts)
+            if isinstance(ens.latency, tuple):
+                # hierarchical leaves arrive final-dtyped from
+                # build_worlds (i32 int leaves / f32 reliability)
+                lat = jax.tree_util.tree_map(sds, ens.latency)
+                rel = jax.tree_util.tree_map(sds, ens.reliability)
+            else:
+                lat = sds(_np.asarray(ens.latency, _np.int32))
+                rel = sds(_np.asarray(ens.reliability, _np.float32))
+            parts = (lat, rel,
+                     sds(_np.asarray(ens.seed_k1, _np.uint32)),
+                     sds(_np.asarray(ens.seed_k2, _np.uint32)),
+                     sds(_np.asarray(ens.epoch_times, _np.int64)))
+            return parts
         k1, k2 = self.seed_pair
-        parts = (self.latency, self.reliability,
-                 _np.asarray(k1), _np.asarray(k2), self.epoch_times)
-        return tuple(jax.ShapeDtypeStruct(_np.asarray(p).shape,
-                                          _np.asarray(p).dtype)
-                     for p in parts)
+        return (jax.tree_util.tree_map(sds, self.latency),
+                jax.tree_util.tree_map(sds, self.reliability),
+                sds(k1), sds(k2), sds(self.epoch_times))
 
     def lowerable_programs(self) -> dict:
         """name -> (jit fn, abstract args) for every program the
@@ -2524,19 +2609,23 @@ class DeviceEngine:
         if getattr(self, "_ens_world_dev", None) is None:
             ens = self.ensemble
             repl = NamedSharding(self.mesh, self._repl_spec)
+
+            def put(a):
+                return jax.device_put(jnp.asarray(a), repl)
+
+            if isinstance(ens.latency, tuple):
+                # hierarchical leaves are final-dtyped by build_worlds
+                lat = jax.tree_util.tree_map(put, ens.latency)
+                rel = jax.tree_util.tree_map(put, ens.reliability)
+            else:
+                lat = put(np.asarray(ens.latency, dtype=np.int32))
+                rel = put(np.asarray(ens.reliability,
+                                     dtype=np.float32))
             self._ens_world_dev = (
-                jax.device_put(jnp.asarray(
-                    np.asarray(ens.latency, dtype=np.int32)), repl),
-                jax.device_put(jnp.asarray(
-                    np.asarray(ens.reliability,
-                               dtype=np.float32)), repl),
-                jax.device_put(jnp.asarray(
-                    np.asarray(ens.seed_k1, dtype=np.uint32)), repl),
-                jax.device_put(jnp.asarray(
-                    np.asarray(ens.seed_k2, dtype=np.uint32)), repl),
-                jax.device_put(jnp.asarray(
-                    np.asarray(ens.epoch_times,
-                               dtype=np.int64)), repl),
+                lat, rel,
+                put(np.asarray(ens.seed_k1, dtype=np.uint32)),
+                put(np.asarray(ens.seed_k2, dtype=np.uint32)),
+                put(np.asarray(ens.epoch_times, dtype=np.int64)),
             )
         return self._ens_world_dev
 

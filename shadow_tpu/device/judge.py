@@ -28,6 +28,7 @@ import numpy as np
 from shadow_tpu._jax import jax, jnp
 from shadow_tpu.device import prng
 from shadow_tpu.device.netsem import packet_drop_mask
+from shadow_tpu.topology import hierarchy
 
 _MIN_BUCKET = 256
 
@@ -45,25 +46,33 @@ class DeviceJudge:
     def __init__(self, topology, host_vertex: np.ndarray, seed: int,
                  bootstrap_end: int = 0, min_batch: int = 192,
                  fault_table=None):
-        if (topology.latency_ns > np.iinfo(np.int64).max // 2).any():
+        if topology.hier is not None:
+            if hierarchy.max_composed_latency(topology.hier.lat_parts()) \
+                    > np.iinfo(np.int64).max // 2:
+                raise ValueError("latency overflow")
+        elif (topology.latency_ns > np.iinfo(np.int64).max // 2).any():
             raise ValueError("latency overflow")
         # fault epochs ride as stacked [T,V,V] matrices + the [T]
         # epoch start times; the fault-free case keeps the plain
         # [V,V] matrices and the original program — identical XLA to
-        # before the fault layer
-        if fault_table is not None:
-            ep_times = np.asarray(fault_table.times, dtype=np.int64)
-            lat = np.asarray(fault_table.latency_ns, dtype=np.int64)
-            rel = np.asarray(fault_table.reliability, dtype=np.float32)
-        else:
+        # before the fault layer. Under the hierarchical
+        # representation the matrices are replaced by the factored
+        # leaf tuples ([T,C,C] + [T,V] vectors when epoch-stacked)
+        # and the gather goes through hierarchy.gather_parts.
+        lat, rel, ep_times = hierarchy.world_tables(topology,
+                                                    fault_table)
+        hier = isinstance(lat, tuple)
+        if ep_times is None:
             ep_times = np.zeros(1, dtype=np.int64)
-            lat = topology.latency_ns.astype(np.int64)
-            rel = topology.reliability.astype(np.float32)
         n_epochs = len(ep_times)
         ep_times_t = jnp.asarray(ep_times)
         self._hv = jnp.asarray(host_vertex.astype(np.int32))
-        self._lat = jnp.asarray(lat)
-        self._rel = jnp.asarray(rel)
+        if hier:
+            self._lat = tuple(jnp.asarray(p) for p in lat)
+            self._rel = tuple(jnp.asarray(p) for p in rel)
+        else:
+            self._lat = jnp.asarray(lat)
+            self._rel = jnp.asarray(rel)
         self._seed_pair = prng.seed_key(seed)
         boot_end = np.int64(bootstrap_end)
         seed_pair = self._seed_pair
@@ -72,14 +81,22 @@ class DeviceJudge:
             sv = hv[src]
             dv = hv[dst]
             if n_epochs == 1:
-                latv, relv = lat[sv, dv], rel[sv, dv]
+                if hier:
+                    latv = hierarchy.gather_parts(lat, sv, dv)
+                    relv = hierarchy.gather_parts(rel, sv, dv)
+                else:
+                    latv, relv = lat[sv, dv], rel[sv, dv]
             else:
                 # active epoch at SEND time: count of epoch starts <=
                 # now, minus one — the vectorized twin of the CPU
                 # model's binary search (faults.FaultTable.epoch_of)
                 ep = (now[:, None] >= ep_times_t[None, :]) \
                     .sum(-1).astype(jnp.int32) - 1
-                latv, relv = lat[ep, sv, dv], rel[ep, sv, dv]
+                if hier:
+                    latv = hierarchy.gather_parts(lat, sv, dv, e=ep)
+                    relv = hierarchy.gather_parts(rel, sv, dv, e=ep)
+                else:
+                    latv, relv = lat[ep, sv, dv], rel[ep, sv, dv]
             dropped = packet_drop_mask(seed_pair, boot_end, now, src,
                                        pseq, relv)
             return ~dropped, now + latv

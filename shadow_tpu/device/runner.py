@@ -34,6 +34,7 @@ from shadow_tpu.device.engine import AXIS, DeviceEngine, EngineConfig
 from shadow_tpu.models.phold import PholdApp
 from shadow_tpu.models.tgen import TgenClientApp, TgenServerApp
 from shadow_tpu.models.tor import TorClientApp, TorRelayApp
+from shadow_tpu.topology import hierarchy
 from shadow_tpu.utils.slog import get_logger
 
 log = get_logger("device")
@@ -337,13 +338,8 @@ class DeviceRunner:
         # epoch inside the jitted program; without faults it gets the
         # single base epoch and compiles identically to before
         ft = getattr(sim, "fault_table", None)
-        if ft is not None:
-            latency_ns, reliability = ft.latency_ns, ft.reliability
-            epoch_times = ft.times
-        else:
-            latency_ns = sim.topology.latency_ns
-            reliability = sim.topology.reliability
-            epoch_times = None
+        latency_ns, reliability, epoch_times = hierarchy.world_tables(
+            sim.topology, ft)
         engine = DeviceEngine(
             EngineConfig(
                 n_hosts=len(sim.hosts),

@@ -43,15 +43,19 @@ latency changes apply immediately.
 from __future__ import annotations
 
 import hashlib
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
 
+from shadow_tpu.topology import hierarchy
 from shadow_tpu.topology.graph import (
+    _MIN_PATH_LATENCY_NS,
+    _all_pairs_shortest,
     Topology,
     compute_path_matrices,
     dense_adjacency,
+    sparse_min_adjacency,
 )
 
 LINK_KINDS = ("link_down", "link_up", "degrade")
@@ -73,28 +77,62 @@ class FaultEvent:
     host: str = ""                 # host kinds: configured host name
 
 
-@dataclass
 class FaultTable:
     """The compiled link-fault schedule: epoch start times plus one
     [V,V] latency/reliability override pair per epoch. ``times[0]`` is
     always 0 (the healthy base matrices), so every send time maps to
-    exactly one epoch."""
+    exactly one epoch.
 
-    times: np.ndarray              # [T] int64, ascending, times[0]==0
-    latency_ns: np.ndarray         # [T,V,V] int64
-    reliability: np.ndarray        # [T,V,V] float32
-    events: list = field(default_factory=list)
+    Epochs are held as a LIST of per-epoch [V,V] views; unchanged
+    epochs (including the epoch-0 healthy base) are *references to the
+    topology's own matrices*, never copies, so a schedule with k
+    changed epochs allocates k extra [V,V] pairs instead of T. The
+    stacked ``latency_ns`` / ``reliability`` [T,V,V] arrays the device
+    backends upload materialize lazily on first access; the CPU twin
+    never pays for them."""
+
+    is_hierarchical = False
+
+    def __init__(self, times, latency_ns=None, reliability=None,
+                 events=None, lat_epochs=None, rel_epochs=None):
+        self.times = np.asarray(times, np.int64)
+        self.events = list(events) if events else []
+        self._lat_stack = None
+        self._rel_stack = None
+        if lat_epochs is None:
+            # back-compat constructor from pre-stacked [T,V,V] arrays
+            self._lat_stack = np.asarray(latency_ns, np.int64)
+            self._rel_stack = np.asarray(reliability, np.float32)
+            lat_epochs = list(self._lat_stack)
+            rel_epochs = list(self._rel_stack)
+        self._lat_epochs = [np.asarray(a, np.int64) for a in lat_epochs]
+        self._rel_epochs = [np.asarray(a, np.float32)
+                            for a in rel_epochs]
 
     @property
     def n_epochs(self) -> int:
         return len(self.times)
 
     @property
+    def latency_ns(self) -> np.ndarray:
+        """Stacked [T,V,V] int64 (lazy; device upload path only)."""
+        if self._lat_stack is None:
+            self._lat_stack = np.stack(self._lat_epochs)
+        return self._lat_stack
+
+    @property
+    def reliability(self) -> np.ndarray:
+        """Stacked [T,V,V] float32 (lazy; device upload path only)."""
+        if self._rel_stack is None:
+            self._rel_stack = np.stack(self._rel_epochs)
+        return self._rel_stack
+
+    @property
     def min_latency_ns(self) -> int:
         """Conservative lookahead floor across every epoch — a degrade
         can only keep or raise the window, never shrink it under a
         backend's feet (all backends consume the same value)."""
-        return int(self.latency_ns.min())
+        return min(int(a.min()) for a in self._lat_epochs)
 
     def epoch_of(self, now: int) -> int:
         """Active epoch at send time `now`: the largest i with
@@ -105,18 +143,96 @@ class FaultTable:
     def lookup(self, now: int, src_vertex: int,
                dst_vertex: int) -> tuple[int, float]:
         e = self.epoch_of(now)
-        return (int(self.latency_ns[e, src_vertex, dst_vertex]),
-                float(self.reliability[e, src_vertex, dst_vertex]))
+        return (int(self._lat_epochs[e][src_vertex, dst_vertex]),
+                float(self._rel_epochs[e][src_vertex, dst_vertex]))
 
     def fingerprint(self) -> str:
         """Stable digest of the compiled schedule, for tools and logs.
+        Byte-identical to hashing the stacked arrays (an epoch list is
+        a representation detail, not a schedule difference).
         (Checkpoint resume-safety does not go through this method:
         device/checkpoint.py folds the engine's epoch_times and the
         stacked matrices into its world hash directly, so a saved
         state already refuses an edited fault schedule.)"""
         h = hashlib.sha256()
-        for a in (self.times, self.latency_ns, self.reliability):
-            a = np.ascontiguousarray(a)
+        t = np.ascontiguousarray(self.times)
+        h.update(str(t.shape).encode())
+        h.update(t.tobytes())
+        for eps in (self._lat_epochs, self._rel_epochs):
+            h.update(str((len(eps),) + eps[0].shape).encode())
+            for a in eps:
+                h.update(np.ascontiguousarray(a).tobytes())
+        return h.hexdigest()[:12]
+
+
+class HierFaultTable:
+    """The hierarchical twin of FaultTable: one factored table set
+    (hierarchy.HierTables) per epoch instead of [V,V] matrices, built
+    by _compile_hier in O(affected links + C^2 + V) per changed epoch.
+    Unchanged epochs share the topology's base table LEAVES by
+    reference; within a changed epoch, only the leaves a fault
+    actually touches are new arrays. The device backends consume
+    lat_parts_stacked()/rel_parts_stacked() — each factored leaf with
+    a leading [T] epoch axis — resolved through
+    hierarchy.world_tables."""
+
+    is_hierarchical = True
+
+    def __init__(self, times, epochs, events=None):
+        self.times = np.asarray(times, np.int64)
+        self.epochs = list(epochs)      # [T] of hierarchy.HierTables
+        self.events = list(events) if events else []
+        self._lat_stacked = None
+        self._rel_stacked = None
+
+    @property
+    def n_epochs(self) -> int:
+        return len(self.times)
+
+    @property
+    def min_latency_ns(self) -> int:
+        return min(ht.min_latency_ns() for ht in self.epochs)
+
+    def epoch_of(self, now: int) -> int:
+        return int(np.searchsorted(self.times, now, side="right") - 1)
+
+    def lookup(self, now: int, src_vertex: int,
+               dst_vertex: int) -> tuple[int, float]:
+        return self.epochs[self.epoch_of(now)].lookup(src_vertex,
+                                                      dst_vertex)
+
+    def lat_parts_stacked(self) -> tuple:
+        """(cluster_lat [T,C,C], cl [T,V], acc_lat [T,V],
+        self_lat [T,V]) — the device world leaves (lazy, cached)."""
+        if self._lat_stacked is None:
+            T = self.n_epochs
+            self._lat_stacked = (
+                np.stack([h.cluster_lat for h in self.epochs]),
+                np.repeat(self.epochs[0].cl[None], T, axis=0),
+                np.stack([h.acc_lat for h in self.epochs]),
+                np.stack([h.self_lat for h in self.epochs]))
+        return self._lat_stacked
+
+    def rel_parts_stacked(self) -> tuple:
+        if self._rel_stacked is None:
+            T = self.n_epochs
+            self._rel_stacked = (
+                np.stack([h.cluster_rel for h in self.epochs]),
+                np.repeat(self.epochs[0].cl[None], T, axis=0),
+                np.stack([h.acc_rel for h in self.epochs]),
+                np.stack([h.self_rel for h in self.epochs]))
+        return self._rel_stacked
+
+    def fingerprint(self) -> str:
+        """Stable digest over the stacked factored leaves (the
+        factored schedule is a different representation, so this is
+        intentionally NOT comparable to FaultTable.fingerprint())."""
+        h = hashlib.sha256()
+        t = np.ascontiguousarray(self.times)
+        h.update(str(t.shape).encode())
+        h.update(t.tobytes())
+        for leaf in self.lat_parts_stacked() + self.rel_parts_stacked():
+            a = np.ascontiguousarray(leaf)
             h.update(str(a.shape).encode())
             h.update(a.tobytes())
         return h.hexdigest()[:12]
@@ -150,6 +266,27 @@ def _edge_indices(top: Topology, ev: FaultEvent) -> list[int]:
             f"{ev.source}->{ev.target}, but the graph has no such "
             "edge")
     return hit
+
+
+def _epoch_edge_state(events: list, ordered: list,
+                      keyed: list, t: int) -> tuple[set, list]:
+    """(down_edges, active_degrades) at epoch start time `t` — the
+    edge state both the dense and hierarchical compilers replay."""
+    down_edges: set[int] = set()
+    for i in ordered:
+        ev = events[i]
+        if ev.time > t:
+            break
+        _, eids = keyed[i]
+        if ev.kind == "link_down":
+            down_edges.update(eids)
+        elif ev.kind == "link_up":
+            down_edges.difference_update(eids)
+    degrades = [(events[i], keyed[i][1]) for i in ordered
+                if events[i].kind == "degrade"
+                and events[i].time <= t
+                < events[i].time + events[i].duration]
+    return down_edges, degrades
 
 
 def compile_link_faults(top: Topology,
@@ -233,26 +370,19 @@ def compile_link_faults(top: Topology,
             bounds.add(ev.time + ev.duration)
     times = np.array(sorted(bounds), dtype=np.int64)
 
+    if top.hier is not None:
+        return _compile_hier(top, events, times, ordered, keyed)
+
     V = top.n_vertices
     base_lat, base_rel = top.latency_ns, top.reliability
     lat_epochs, rel_epochs = [], []
     for t in times:
-        # edge state active at time t
-        down_edges: set[int] = set()
-        for i in ordered:
-            ev = events[i]
-            if ev.time > t:
-                break
-            _, eids = keyed[i]
-            if ev.kind == "link_down":
-                down_edges.update(eids)
-            elif ev.kind == "link_up":
-                down_edges.difference_update(eids)
-        degrades = [(events[i], keyed[i][1]) for i in ordered
-                    if events[i].kind == "degrade"
-                    and events[i].time <= t
-                    < events[i].time + events[i].duration]
+        down_edges, degrades = _epoch_edge_state(events, ordered,
+                                                 keyed, t)
         if not down_edges and not degrades:
+            # share the healthy base matrices by reference — the
+            # stacked arrays only materialize lazily for the device
+            # backends, so unchanged epochs never copy a [V,V] pair
             lat_epochs.append(base_lat)
             rel_epochs.append(base_rel)
             continue
@@ -275,11 +405,223 @@ def compile_link_faults(top: Topology,
         lat_epochs.append(lat)
         rel_epochs.append(rel)
 
-    return FaultTable(times=times,
-                      latency_ns=np.stack(lat_epochs).astype(np.int64),
-                      reliability=np.stack(rel_epochs)
-                      .astype(np.float32),
-                      events=list(events))
+    return FaultTable(times=times, events=list(events),
+                      lat_epochs=lat_epochs, rel_epochs=rel_epochs)
+
+
+def _hub_connected(n_clusters: int, rv: np.ndarray,
+                   ru: np.ndarray) -> bool:
+    """Is the (alive) hub subgraph connected? Plain BFS over the
+    reduced adjacency entries — C is small by construction."""
+    if n_clusters <= 1:
+        return True
+    nbrs: dict[int, list[int]] = {}
+    for a, b in zip(rv.tolist(), ru.tolist()):
+        if a != b:
+            nbrs.setdefault(a, []).append(b)
+            nbrs.setdefault(b, []).append(a)
+    seen = {0}
+    stack = [0]
+    while stack:
+        for b in nbrs.get(stack.pop(), ()):
+            if b not in seen:
+                seen.add(b)
+                stack.append(b)
+    return len(seen) == n_clusters
+
+
+def _compile_hier(top: Topology, events: list, times: np.ndarray,
+                  ordered: list, keyed: list) -> HierFaultTable:
+    """Hierarchical epoch compilation: instead of re-running the
+    all-pairs pipeline over [V,V], rebuild only the factored pieces a
+    fault touches — the [C,C] cluster pair when a hub-hub link
+    changes, the access/self entries of the vertices incident to an
+    affected edge otherwise. O(affected links + C^2 + V) per changed
+    epoch; unchanged epochs share the base table leaves by reference.
+
+    Exactness vs the dense oracle follows the same composition
+    contract as the base builder (topology/hierarchy.py), with one
+    extra corner: the dense pipeline gives an *unreachable* pair its
+    healthy base latency, which the factored form can only reproduce
+    while the latency factors it would compose still equal the base.
+    An epoch that combines unreachability with latency-factor changes
+    is therefore rejected loudly (the dense representation handles
+    it). Every epoch is additionally verified elementwise against the
+    dense pipeline when V <= HIER_VERIFY_MAX_V."""
+    ht = top.hier
+    V = top.n_vertices
+    C = ht.n_clusters
+    is_hub = np.zeros(V, dtype=bool)
+    is_hub[ht.hub_vertex] = True
+    hub_rank = np.full(V, -1, dtype=np.int64)
+    hub_rank[ht.hub_vertex] = np.arange(C, dtype=np.int64)
+    esrc = np.asarray(top.edge_src, np.int64)
+    edst = np.asarray(top.edge_dst, np.int64)
+
+    # vertices any event's edge touches, and the slice of edges
+    # incident to them: a touched vertex's FULL candidate edge set
+    # rides in the slice, so its access/self entries re-reduce with
+    # dense_adjacency's exact tie rule (slice order preserves
+    # original edge order)
+    ev_edges = sorted({k for _, eids in keyed for k in eids})
+    touched = np.zeros(V, dtype=bool)
+    touched[esrc[ev_edges]] = True
+    touched[edst[ev_edges]] = True
+    inc = np.nonzero(touched[esrc] | touched[edst])[0]
+    hub_pair = is_hub[esrc] & is_hub[edst] & (esrc != edst)
+    hub_sel = np.nonzero(is_hub[esrc] & is_hub[edst])[0]
+    aff_spokes = np.nonzero(touched & ~is_hub)[0]
+    aff_vs = np.nonzero(touched)[0]
+
+    base_dense = ht.dense() if V <= hierarchy.HIER_VERIFY_MAX_V \
+        else None
+
+    epochs = []
+    for t in times:
+        down_edges, degrades = _epoch_edge_state(events, ordered,
+                                                 keyed, t)
+        if not down_edges and not degrades:
+            epochs.append(ht)
+            continue
+        elat = top.edge_latency_ns.copy()
+        erel = top.edge_reliability.astype(np.float64)
+        alive = np.ones(len(elat), dtype=bool)
+        changed = set(down_edges)
+        for k in down_edges:
+            alive[k] = False
+        for ev, eids in degrades:
+            for k in eids:
+                elat[k] = max(1, int(round(
+                    int(elat[k]) * ev.latency_multiplier)))
+                erel[k] = erel[k] * (1.0 - ev.extra_packet_loss)
+                changed.add(k)
+        changed_idx = np.fromiter(changed, dtype=np.int64)
+
+        # [C,C] rebuild — only when a hub-hub link changed; the hub
+        # subgraph re-reduces and re-runs shortest paths exactly like
+        # the base builder, with unreachable hub pairs taking the
+        # healthy base cluster latency (the dense unreachable rule)
+        hub_unreach = False
+        if changed_idx.size and hub_pair[changed_idx].any():
+            rv, ru, rl, rr = sparse_min_adjacency(
+                C, False, hub_rank[esrc[hub_sel]],
+                hub_rank[edst[hub_sel]], elat[hub_sel],
+                erel[hub_sel].astype(np.float32),
+                edge_alive=alive[hub_sel])
+            dlat = np.zeros((C, C), dtype=np.int64)
+            drel = np.zeros((C, C), dtype=np.float32)
+            dlat[rv, ru] = rl
+            drel[rv, ru] = rr
+            hub_unreach = not _hub_connected(C, rv, ru)
+            cc_lat, cc_rel = _all_pairs_shortest(dlat, drel,
+                                                 ht.cluster_lat)
+            np.fill_diagonal(cc_lat, 0)
+            np.fill_diagonal(cc_rel, 1.0)
+            cc_lat = cc_lat.astype(np.int64)
+            cc_rel = cc_rel.astype(np.float32)
+        else:
+            cc_lat, cc_rel = ht.cluster_lat, ht.cluster_rel
+
+        # re-reduce the incident slice once; update access entries of
+        # touched spokes and self entries of every touched vertex
+        rv2, ru2, rl2, rr2 = sparse_min_adjacency(
+            V, False, esrc[inc], edst[inc], elat[inc],
+            erel[inc].astype(np.float32), edge_alive=alive[inc])
+        acc_lat, acc_rel = ht.acc_lat, ht.acc_rel
+        downed_spokes = []
+        acc_lat_changed = False
+        if aff_spokes.size:
+            acc_lat = acc_lat.copy()
+            acc_rel = acc_rel.copy()
+            off2 = rv2 != ru2
+            for v in aff_spokes.tolist():
+                sel = np.nonzero(off2 & (rv2 == v))[0]
+                if not sel.size:
+                    # the spoke's only link is down: the pair is
+                    # undeliverable (rel 0) at the healthy latency,
+                    # exactly the dense unreachable rule
+                    downed_spokes.append(v)
+                    acc_rel[v] = 0.0
+                else:
+                    j = sel[0]   # a spoke has exactly one neighbor
+                    if int(rl2[j]) != int(ht.acc_lat[v]):
+                        acc_lat_changed = True
+                    acc_lat[v] = rl2[j]
+                    acc_rel[v] = rr2[j]
+
+        self_lat = ht.self_lat.copy()
+        self_rel = ht.self_rel.copy()
+        cand_lat = np.where(rv2 == ru2, rl2, 2 * rl2)
+        cand_rel = np.where(rv2 == ru2, rr2,
+                            (rr2 * rr2).astype(np.float32))
+        order2 = np.lexsort((cand_rel.astype(np.float64), cand_lat,
+                             rv2))
+        sv_ = rv2[order2]
+        sl_, sr_ = cand_lat[order2], cand_rel[order2]
+        firstv = np.ones(len(sv_), dtype=bool)
+        firstv[1:] = sv_[1:] != sv_[:-1]
+        got = set()
+        for j in np.nonzero(firstv)[0]:
+            v = int(sv_[j])
+            # only touched vertices carry their full candidate set in
+            # the slice; everyone else keeps the base self entry
+            if touched[v]:
+                self_lat[v] = sl_[j]
+                self_rel[v] = sr_[j]
+                got.add(v)
+        for v in aff_vs.tolist():
+            if v not in got:      # no alive incident edge: the dense
+                self_lat[v] = _MIN_PATH_LATENCY_NS  # zero-lat clamp
+                self_rel[v] = 1.0
+
+        cc_lat_changed = cc_lat is not ht.cluster_lat and \
+            not np.array_equal(cc_lat, ht.cluster_lat)
+        if downed_spokes and (acc_lat_changed or cc_lat_changed):
+            raise ValueError(
+                f"network.faults: epoch at {int(t)} ns combines an "
+                "unreachable pair (downed access link) with latency "
+                "changes elsewhere; the dense pipeline pins "
+                "unreachable pairs to their HEALTHY base latency, "
+                "which the factored tables cannot reproduce while "
+                "their latency factors change — use "
+                "network.topology.representation: dense for this "
+                "schedule")
+        if hub_unreach and acc_lat_changed:
+            raise ValueError(
+                f"network.faults: epoch at {int(t)} ns combines an "
+                "unreachable hub pair with access-latency changes; "
+                "the dense pipeline pins unreachable pairs to their "
+                "HEALTHY base latency, which the factored tables "
+                "cannot reproduce while their latency factors change "
+                "— use network.topology.representation: dense for "
+                "this schedule")
+
+        eht = hierarchy.HierTables(
+            cluster_lat=cc_lat, cluster_rel=cc_rel,
+            cl=ht.cl, hub_vertex=ht.hub_vertex,
+            acc_lat=acc_lat, acc_rel=acc_rel,
+            self_lat=self_lat, self_rel=self_rel)
+
+        if base_dense is not None:
+            direct_lat, direct_rel = dense_adjacency(
+                V, top.directed, top.edge_src, top.edge_dst, elat,
+                erel.astype(np.float32), edge_alive=alive)
+            want_lat, want_rel = compute_path_matrices(
+                direct_lat, direct_rel, top.use_shortest_path,
+                unreachable_lat=base_dense[0])
+            have_lat, have_rel = eht.dense()
+            if not (np.array_equal(want_lat, have_lat)
+                    and np.array_equal(want_rel, have_rel)):
+                raise ValueError(
+                    f"network.faults: epoch at {int(t)} ns is not "
+                    "bit-exact against the dense fault pipeline "
+                    "under the hierarchical representation — use "
+                    "network.topology.representation: dense for "
+                    "this schedule")
+        epochs.append(eht)
+
+    return HierFaultTable(times=times, epochs=epochs,
+                          events=list(events))
 
 
 def resolve_host_faults(events: list,

@@ -1,0 +1,100 @@
+"""Programmatic topology generators (network.graph.type: star_clusters).
+
+A million-host topology cannot arrive as a GML file: parsing a million
+node stanzas costs minutes, and `Topology.from_parsed` runs two O(V^2)
+scans (the completeness detector materializes the dense adjacency, the
+connectivity check walks a Python adjacency list). Generators build the
+edge arrays directly with numpy and skip both scans — the generated
+structure is connected and non-complete *by construction* — then hand
+off to the shared `_compute_paths` dispatch, so representation
+semantics (dense / hierarchical / auto, verification, fallback) are
+identical to a parsed graph's.
+
+`star_clusters` is the canonical hierarchical shape: `clusters` hub
+vertices forming a complete inter-hub graph, each with
+`spokes_per_cluster` spoke vertices hanging off it. Vertex ids are the
+indices: hubs 0..C-1, then the spokes of hub h at
+C + h*S .. C + (h+1)*S - 1 — so a host group with `network_node_id: C`
+and `network_node_stride: 1` tiles hosts across the spokes with O(1)
+placement per host.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shadow_tpu.config.units import parse_bandwidth_bits, parse_time_ns
+from shadow_tpu.topology.graph import GmlError, Topology
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("topology.generate")
+
+
+def generate_star_clusters(params: dict,
+                           use_shortest_path: bool = True,
+                           representation: str = "dense") -> Topology:
+    """Build the hub-and-spoke cluster topology from the
+    `network.graph` generator keys (config/schema.py validates the key
+    set; this validates the values)."""
+    C = int(params.get("clusters", 1))
+    S = int(params.get("spokes_per_cluster", 0))
+    if C < 1:
+        raise GmlError("star_clusters: clusters must be >= 1")
+    if S < 0:
+        raise GmlError("star_clusters: spokes_per_cluster must "
+                       "be >= 0")
+    hub_lat = parse_time_ns(params.get("hub_latency", "10 ms"))
+    acc_lat = parse_time_ns(params.get("access_latency", "1 ms"))
+    if hub_lat <= 0 or acc_lat <= 0:
+        raise GmlError("star_clusters: latencies must be > 0")
+    hub_loss = float(params.get("hub_packet_loss", 0.0))
+    acc_loss = float(params.get("access_packet_loss", 0.0))
+    for name, loss in (("hub_packet_loss", hub_loss),
+                       ("access_packet_loss", acc_loss)):
+        if not (0.0 <= loss <= 1.0):
+            raise GmlError(f"star_clusters: {name} {loss} not in "
+                           "[0,1]")
+    bw_down = parse_bandwidth_bits(params.get("bandwidth_down",
+                                              "1 Gbit"))
+    bw_up = parse_bandwidth_bits(params.get("bandwidth_up", "1 Gbit"))
+
+    V = C + C * S
+    # complete inter-hub graph: one undirected edge per hub pair
+    hi, hj = np.triu_indices(C, k=1)
+    # spoke k of hub h sits at vertex C + h*S + k
+    sp = np.arange(C * S, dtype=np.int64) + C
+    sp_hub = (np.arange(C * S, dtype=np.int64) // max(1, S)) \
+        if S else np.empty(0, dtype=np.int64)
+    esrc = np.concatenate([hi.astype(np.int64), sp_hub])
+    edst = np.concatenate([hj.astype(np.int64), sp])
+    E_hub = len(hi)
+    elat = np.concatenate([
+        np.full(E_hub, hub_lat, dtype=np.int64),
+        np.full(C * S, acc_lat, dtype=np.int64)])
+    erel = np.concatenate([
+        np.full(E_hub, np.float32(1.0 - hub_loss), dtype=np.float32),
+        np.full(C * S, np.float32(1.0 - acc_loss), dtype=np.float32)])
+
+    top = Topology(
+        directed=False,
+        # a star is complete only in the degenerate 1-vertex case —
+        # set statically, never via the O(V^2) detector
+        complete=(V == 1),
+        use_shortest_path=use_shortest_path,
+        vertex_ids=np.arange(V, dtype=np.int64),
+        bw_down_bits=np.full(V, bw_down, dtype=np.int64),
+        bw_up_bits=np.full(V, bw_up, dtype=np.int64),
+        ip_strs=[None] * V, country_codes=[None] * V,
+        city_codes=[None] * V, labels=[None] * V,
+        edge_src=esrc, edge_dst=edst,
+        edge_latency_ns=elat, edge_reliability=erel,
+        latency_ns=None, reliability=None,
+    )
+    if not use_shortest_path and not top.complete:
+        raise GmlError("use_shortest_path=false requires a complete "
+                       "graph (every ordered vertex pair needs a "
+                       "direct edge)")
+    log.info("star_clusters: V=%d (C=%d hubs, %d spokes/hub), E=%d",
+             V, C, S, len(esrc))
+    top._compute_paths(representation)
+    return top

@@ -38,6 +38,15 @@ import numpy as np
 from shadow_tpu import simtime
 from shadow_tpu.config.units import parse_bandwidth_bits, parse_time_ns
 from shadow_tpu.topology.gml import GmlGraph, GmlError, parse_gml
+from shadow_tpu.topology.hierarchy import (
+    HIER_VERIFY_MAX_V,
+    HierTables,
+)
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("topology")
+
+REPRESENTATIONS = ("dense", "hierarchical", "auto")
 
 # Builtin graph, byte-identical semantics to the reference's
 # ONE_GBIT_SWITCH_GRAPH (configuration.rs:732-760).
@@ -90,6 +99,146 @@ def dense_adjacency(n_vertices: int, directed: bool,
         if not directed:
             _store(d, s, l, r)
     return lat, rel
+
+
+def sparse_min_adjacency(n_vertices: int, directed: bool,
+                         edge_src: np.ndarray, edge_dst: np.ndarray,
+                         edge_latency_ns: np.ndarray,
+                         edge_reliability: np.ndarray,
+                         edge_alive: Optional[np.ndarray] = None
+                         ) -> tuple[np.ndarray, np.ndarray,
+                                    np.ndarray, np.ndarray]:
+    """Sparse twin of dense_adjacency: the reduced (v, u, lat, rel)
+    entry arrays — one row per ordered vertex pair that has at least
+    one (alive) edge — with EXACTLY dense_adjacency's parallel-edge
+    tie rule (the first edge reaching the minimal latency wins,
+    in _store call order). O(E log E) and never materializes [V,V],
+    so the hierarchical builder and its fault epochs can reduce a
+    million-edge list."""
+    esrc = np.asarray(edge_src, np.int64)
+    edst = np.asarray(edge_dst, np.int64)
+    elat = np.asarray(edge_latency_ns, np.int64)
+    erel = np.asarray(edge_reliability, np.float32)
+    if edge_alive is not None:
+        keep = np.asarray(edge_alive, bool)
+        # the ORIGINAL edge index keeps the tie rule stable under
+        # fault-epoch masks (dense_adjacency skips dead edges without
+        # renumbering the survivors)
+        order = np.nonzero(keep)[0].astype(np.int64)
+        esrc, edst = esrc[keep], edst[keep]
+        elat, erel = elat[keep], erel[keep]
+    else:
+        order = np.arange(len(esrc), dtype=np.int64)
+    if directed:
+        v, u, l, r, o = esrc, edst, elat, erel, 2 * order
+    else:
+        # _store(s, d) runs before _store(d, s) within each edge
+        v = np.concatenate([esrc, edst])
+        u = np.concatenate([edst, esrc])
+        l = np.concatenate([elat, elat])
+        r = np.concatenate([erel, erel])
+        o = np.concatenate([2 * order, 2 * order + 1])
+    key = v * np.int64(n_vertices) + u
+    idx = np.lexsort((o, l, key))
+    key_s = key[idx]
+    first = np.ones(len(key_s), dtype=bool)
+    first[1:] = key_s[1:] != key_s[:-1]
+    sel = idx[first]
+    return v[sel], u[sel], l[sel], r[sel]
+
+
+def build_hier_tables(top: "Topology") -> HierTables:
+    """Factor a topology into cluster tables (hierarchy.HierTables).
+
+    Structural form: *spokes* are vertices with exactly one distinct
+    non-self neighbor (whose own degree exceeds one); everything else
+    is a *hub* and becomes its own cluster. Spokes are dead ends, so
+    every shortest path factors as access + inter-hub + access, and
+    hub-to-hub shortest paths never detour through a spoke — the
+    [C,C] cluster matrices are the dense pipeline run on the hub
+    subgraph alone. Raises GmlError when the graph does not fit the
+    factored form (directed, or direct-edge-only routing)."""
+    if top.directed:
+        raise GmlError("hierarchical representation requires an "
+                       "undirected graph")
+    if not top.use_shortest_path:
+        raise GmlError("hierarchical representation requires "
+                       "use_shortest_path: true (direct-edge-only "
+                       "routing does not factor)")
+    V = top.n_vertices
+    av, au, alat, arel = sparse_min_adjacency(
+        V, False, top.edge_src, top.edge_dst,
+        top.edge_latency_ns, top.edge_reliability)
+
+    off = av != au
+    ov, ou = av[off], au[off]
+    olat, orel = alat[off], arel[off]
+    deg = np.bincount(ov, minlength=V)        # distinct neighbors
+    nbr_of = np.full(V, 0, dtype=np.int64)
+    nbr_of[ov] = ou                           # exact for deg==1 rows
+    spoke = (deg == 1) & (deg[nbr_of] > 1)
+
+    hub_vertex = np.nonzero(~spoke)[0].astype(np.int64)
+    C = len(hub_vertex)
+    hub_rank = np.full(V, -1, dtype=np.int64)
+    hub_rank[hub_vertex] = np.arange(C, dtype=np.int64)
+    cl = hub_rank.copy()
+    cl[spoke] = hub_rank[nbr_of[spoke]]
+
+    # access factors: the reduced spoke->hub entry (dense adjacency
+    # semantics — cheapest parallel edge, first-minimal tie)
+    acc_lat = np.zeros(V, dtype=np.int64)
+    acc_rel = np.ones(V, dtype=np.float32)
+    m = spoke[ov]
+    acc_lat[ov[m]] = olat[m]
+    acc_rel[ov[m]] = orel[m]
+
+    # inter-cluster matrices: dense shortest paths over the hubs only
+    if C == 1:
+        cc_lat = np.zeros((1, 1), dtype=np.int64)
+        cc_rel = np.ones((1, 1), dtype=np.float32)
+    else:
+        hub_edge = (~spoke)[top.edge_src] & (~spoke)[top.edge_dst]
+        hsrc = hub_rank[np.asarray(top.edge_src)[hub_edge]]
+        hdst = hub_rank[np.asarray(top.edge_dst)[hub_edge]]
+        rv, ru, rl, rr = sparse_min_adjacency(
+            C, False, hsrc, hdst,
+            np.asarray(top.edge_latency_ns)[hub_edge],
+            np.asarray(top.edge_reliability)[hub_edge])
+        dlat = np.zeros((C, C), dtype=np.int64)
+        drel = np.zeros((C, C), dtype=np.float32)
+        dlat[rv, ru] = rl
+        drel[rv, ru] = rr
+        # a disconnected hub subgraph would contradict connectivity
+        # of the full graph (spokes are dead ends) — _all_pairs
+        # raises loudly if the structural argument is ever violated
+        cc_lat, cc_rel = _all_pairs_shortest(dlat, drel, None)
+    np.fill_diagonal(cc_lat, 0)               # transit identity —
+    np.fill_diagonal(cc_rel, 1.0)             # true self paths below
+
+    # self vectors: the dense self-path rule (self-loop as-is, else
+    # cheapest incident edge out-and-back), tuple-lexicographic min
+    cand_v = av
+    cand_lat = np.where(av == au, alat, 2 * alat)
+    cand_rel = np.where(av == au, arel,
+                        (arel * arel).astype(np.float32))
+    order = np.lexsort((cand_rel.astype(np.float64), cand_lat,
+                        cand_v))
+    sv_, sl_, sr_ = cand_v[order], cand_lat[order], cand_rel[order]
+    firstv = np.ones(len(sv_), dtype=bool)
+    firstv[1:] = sv_[1:] != sv_[:-1]
+    # no incident edge at all: the dense zero-latency clamp value
+    self_lat = np.full(V, _MIN_PATH_LATENCY_NS, dtype=np.int64)
+    self_rel = np.ones(V, dtype=np.float32)
+    self_lat[sv_[firstv]] = sl_[firstv]
+    self_rel[sv_[firstv]] = sr_[firstv]
+
+    return HierTables(
+        cluster_lat=cc_lat.astype(np.int64),
+        cluster_rel=cc_rel.astype(np.float32),
+        cl=cl.astype(np.int32), hub_vertex=hub_vertex,
+        acc_lat=acc_lat, acc_rel=acc_rel,
+        self_lat=self_lat, self_rel=self_rel)
 
 
 def compute_path_matrices(direct_lat: np.ndarray, direct_rel: np.ndarray,
@@ -261,8 +410,16 @@ class Topology:
     edge_dst: np.ndarray
     edge_latency_ns: np.ndarray     # [E] int64
     edge_reliability: np.ndarray    # [E] float32 (1 - packet_loss)
-    latency_ns: np.ndarray          # [V,V] int64 path latency
-    reliability: np.ndarray         # [V,V] float32 path reliability
+    # dense representation: [V,V] int64 / float32 path matrices.
+    # Under representation == "hierarchical" BOTH are None and the
+    # factored tables live in `hier` (hierarchy.HierTables) — every
+    # consumer goes through path()/min_latency_ns or branches on
+    # `hier`, so a stray dense read fails loudly instead of silently
+    # reading stale zeros.
+    latency_ns: Optional[np.ndarray]
+    reliability: Optional[np.ndarray]
+    representation: str = "dense"
+    hier: Optional[HierTables] = None
 
     @property
     def n_vertices(self) -> int:
@@ -272,13 +429,31 @@ class Topology:
     def min_latency_ns(self) -> int:
         """Minimum path latency — the conservative lookahead window
         ("min time jump", controller.c:125-153)."""
+        if self.hier is not None:
+            return self.hier.min_latency_ns()
         return int(self.latency_ns.min())
 
+    def path(self, src_vertex: int, dst_vertex: int
+             ) -> tuple[int, float]:
+        """(latency_ns, reliability) in whatever representation this
+        topology holds — the single fault-free lookup seam."""
+        if self.hier is not None:
+            return self.hier.lookup(src_vertex, dst_vertex)
+        return (int(self.latency_ns[src_vertex, dst_vertex]),
+                float(self.reliability[src_vertex, dst_vertex]))
+
     def get_latency_ns(self, src_vertex: int, dst_vertex: int) -> int:
-        return int(self.latency_ns[src_vertex, dst_vertex])
+        return self.path(src_vertex, dst_vertex)[0]
 
     def get_reliability(self, src_vertex: int, dst_vertex: int) -> float:
-        return float(self.reliability[src_vertex, dst_vertex])
+        return self.path(src_vertex, dst_vertex)[1]
+
+    def table_nbytes(self) -> int:
+        """Bytes of the path tables this representation holds — what
+        admission/bench report as the world table cost."""
+        if self.hier is not None:
+            return self.hier.nbytes()
+        return int(self.latency_ns.nbytes + self.reliability.nbytes)
 
     def vertex_index_for_id(self, gml_id: int) -> int:
         idx = np.nonzero(self.vertex_ids == gml_id)[0]
@@ -288,16 +463,23 @@ class Topology:
 
     # ------------------------------------------------------------------
     @classmethod
-    def from_gml(cls, text: str, use_shortest_path: bool = True) -> "Topology":
+    def from_gml(cls, text: str, use_shortest_path: bool = True,
+                 representation: str = "dense") -> "Topology":
         g = parse_gml(text)
-        return cls.from_parsed(g, use_shortest_path)
+        return cls.from_parsed(g, use_shortest_path,
+                               representation=representation)
 
     @classmethod
-    def builtin_1_gbit_switch(cls) -> "Topology":
-        return cls.from_gml(ONE_GBIT_SWITCH_GML, use_shortest_path=True)
+    def builtin_1_gbit_switch(cls,
+                              representation: str = "dense"
+                              ) -> "Topology":
+        return cls.from_gml(ONE_GBIT_SWITCH_GML,
+                            use_shortest_path=True,
+                            representation=representation)
 
     @classmethod
-    def from_parsed(cls, g: GmlGraph, use_shortest_path: bool) -> "Topology":
+    def from_parsed(cls, g: GmlGraph, use_shortest_path: bool,
+                    representation: str = "dense") -> "Topology":
         V = len(g.nodes)
         if V == 0:
             raise GmlError("graph has no vertices")
@@ -368,7 +550,7 @@ class Topology:
             raise GmlError("use_shortest_path=false requires a complete "
                            "graph (every ordered vertex pair needs a "
                            "direct edge)")
-        top._compute_paths()
+        top._compute_paths(representation)
         return top
 
     # ------------------------------------------------------------------
@@ -418,7 +600,81 @@ class Topology:
         return bool((lat[off_diag] > 0).all())
 
     # ------------------------------------------------------------------
-    def _compute_paths(self) -> None:
+    def _compute_dense(self) -> None:
         direct_lat, direct_rel = self._adjacency()
         self.latency_ns, self.reliability = compute_path_matrices(
             direct_lat, direct_rel, self.use_shortest_path)
+        self.representation = "dense"
+        self.hier = None
+
+    def _compute_paths(self, representation: str = "dense") -> None:
+        """Build the path tables in the requested representation.
+
+        ``dense``        — the original [V,V] matrices, byte for byte.
+        ``hierarchical`` — cluster-factored tables; a graph that does
+                           not fit the factored form (directed,
+                           direct-edge-only routing) or whose factored
+                           float32 reliabilities fail the bit-exact
+                           dense verification (V <= HIER_VERIFY_MAX_V)
+                           is a HARD error.
+        ``auto``         — hierarchical when it factors, verifies, and
+                           actually shrinks the tables (C < V); dense
+                           with a log line otherwise.
+        """
+        if representation not in REPRESENTATIONS:
+            raise GmlError(
+                f"network.topology.representation must be one of "
+                f"{REPRESENTATIONS}, got {representation!r}")
+        if representation == "dense":
+            self._compute_dense()
+            return
+        try:
+            ht = build_hier_tables(self)
+        except GmlError as why:
+            if representation == "hierarchical":
+                raise GmlError(
+                    "network.topology.representation: hierarchical, "
+                    f"but this graph does not factor: {why}") from why
+            log.info("topology representation auto: dense fallback "
+                     "(%s)", why)
+            self._compute_dense()
+            return
+        if representation == "auto" and \
+                ht.n_clusters >= self.n_vertices:
+            log.info("topology representation auto: dense (no spokes "
+                     "— factoring would not shrink the tables, "
+                     "C=%d == V=%d)", ht.n_clusters, self.n_vertices)
+            self._compute_dense()
+            return
+        if self.n_vertices <= HIER_VERIFY_MAX_V:
+            # bit-exact verification against the dense pipeline: the
+            # loud contract that hierarchical traces match the dense
+            # oracle on every backend
+            direct_lat, direct_rel = self._adjacency()
+            dlat, drel = compute_path_matrices(
+                direct_lat, direct_rel, self.use_shortest_path)
+            hlat, hrel = ht.dense()
+            if not (np.array_equal(dlat, hlat)
+                    and np.array_equal(drel, hrel)):
+                if representation == "hierarchical":
+                    raise GmlError(
+                        "hierarchical tables do not reproduce the "
+                        "dense path matrices bit for bit (equal-cost "
+                        "multipath tie-break or a float32 "
+                        "reliability product that does not factor) — "
+                        "use representation: dense or auto")
+                log.info("topology representation auto: dense "
+                         "fallback (factored tables failed the "
+                         "bit-exact verification)")
+                self.latency_ns, self.reliability = dlat, drel
+                self.representation = "dense"
+                self.hier = None
+                return
+        self.hier = ht
+        self.representation = "hierarchical"
+        self.latency_ns = None
+        self.reliability = None
+        log.info("topology representation hierarchical: V=%d C=%d "
+                 "table bytes %d (dense would be %d)",
+                 self.n_vertices, ht.n_clusters, ht.nbytes(),
+                 12 * self.n_vertices ** 2)

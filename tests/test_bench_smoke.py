@@ -47,6 +47,14 @@ def test_bench_smoke_emits_valid_json(tmp_path):
         assert mc["ici_rows_per_round"] > 0
         assert mc["exchange"] in ("all_to_all", "all_gather",
                                   "two_phase")
+    # the topology rung stamped the hierarchical-vs-dense table cost
+    # (1M point skipped under smoke) and met the reduction floor
+    topo = result["topology"]
+    assert "error" not in topo, topo
+    pts = {pt["label"]: pt for pt in topo["points"]}
+    assert pts["100k"]["reduction"] >= 100
+    assert pts["1k"]["hier_table_bytes"] < pts["1k"]["dense_table_bytes"]
+    assert "1M" not in pts
     # the run's measured occupancy landed for tune_10k.py to reuse
     occ_path = result["occupancy_record"]
     with open(occ_path) as f:

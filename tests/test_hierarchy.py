@@ -1,0 +1,457 @@
+"""Hierarchical (cluster-factored) topology representation.
+
+The contract under test (docs/topology.md): under
+`network.topology.representation: hierarchical` the path tables factor
+into a [C,C] cluster pair + per-vertex access/self vectors whose
+composed values are BIT-IDENTICAL to the dense [V,V] pipeline — at
+build time, per fault epoch, through the device judge, and across
+ensemble variations — or the build refuses loudly (`hierarchical` is a
+hard error, `auto` falls back to dense with a log line). Full-run
+trace identity across policies additionally runs in CI via
+`determinism_gate.py examples/tgen_faults_hier.yaml
+--policy serial,thread,tpu`.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from shadow_tpu import simtime
+from shadow_tpu.config import load_config_str
+from shadow_tpu.core.controller import Controller, build
+from shadow_tpu.faults import FaultEvent, FaultTable, compile_link_faults
+from shadow_tpu.topology import hierarchy
+from shadow_tpu.topology.generate import generate_star_clusters
+from shadow_tpu.topology.gml import GmlError
+from shadow_tpu.topology.graph import Topology
+
+MS = simtime.SIMTIME_ONE_MILLISECOND
+S = simtime.SIMTIME_ONE_SECOND
+
+
+def _clustered_gml(n_hubs=3, spokes=(2, 2, 2), hub_loss=0.01,
+                   rng=None):
+    """Hub clique + per-hub spokes; lossless access links (the
+    reliability-exactness condition), lossy hub links. Random
+    latencies when an rng is passed."""
+    def lat(lo, hi):
+        return int(rng.integers(lo, hi)) if rng is not None else lo
+    V = n_hubs + sum(spokes)
+    lines = ["graph [ directed 0"]
+    for i in range(V):
+        lines.append(f'  node [ id {i} bandwidth_down "1 Gbit" '
+                     f'bandwidth_up "1 Gbit" ]')
+    for a in range(n_hubs):
+        for b in range(a + 1, n_hubs):
+            lines.append(f'  edge [ source {a} target {b} latency '
+                         f'"{lat(20, 90)} ms" packet_loss {hub_loss} ]')
+    k = n_hubs
+    for h, n in enumerate(spokes):
+        for _ in range(n):
+            lines.append(f'  edge [ source {h} target {k} latency '
+                         f'"{lat(1, 9)} ms" packet_loss 0.0 ]')
+            k += 1
+    lines.append("]")
+    return "\n".join(lines)
+
+
+def _both(text):
+    return (Topology.from_gml(text, representation="dense"),
+            Topology.from_gml(text, representation="hierarchical"))
+
+
+# ------------------------------------------------- build + exactness
+def test_factored_matches_dense_bitwise():
+    td, th = _both(_clustered_gml())
+    assert th.representation == "hierarchical" and th.hier is not None
+    assert td.representation == "dense" and td.hier is None
+    # hierarchical drops the O(V^2) matrices entirely
+    assert th.latency_ns is None and th.reliability is None
+    hlat, hrel = th.hier.dense()
+    np.testing.assert_array_equal(hlat, td.latency_ns)
+    np.testing.assert_array_equal(hrel, td.reliability)
+    assert th.min_latency_ns == td.min_latency_ns
+    assert th.table_nbytes() < td.table_nbytes()
+    # the scalar CPU lookup is the same composition
+    V = td.n_vertices
+    for sv in range(V):
+        for dv in range(V):
+            assert th.path(sv, dv) == td.path(sv, dv)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_property_random_clustered_topologies(seed):
+    rng = np.random.default_rng(seed)
+    n_hubs = int(rng.integers(2, 6))
+    spokes = tuple(int(rng.integers(0, 4)) for _ in range(n_hubs))
+    text = _clustered_gml(n_hubs, spokes,
+                          hub_loss=float(rng.choice([0.0, 0.02, 0.1])),
+                          rng=rng)
+    td, th = _both(text)
+    hlat, hrel = th.hier.dense()
+    np.testing.assert_array_equal(hlat, td.latency_ns)
+    np.testing.assert_array_equal(hrel, td.reliability)
+    assert th.min_latency_ns == td.min_latency_ns
+    # ... and across random fault epochs on real edges of the graph
+    # (vertex ids == indices here, so edge arrays name GML ids)
+    events, t = [], 1 * S
+    for _ in range(int(rng.integers(1, 4))):
+        k = int(rng.integers(0, len(td.edge_src)))
+        events.append(FaultEvent(
+            kind="degrade", time=t, duration=1 * S,
+            source=int(td.edge_src[k]), target=int(td.edge_dst[k]),
+            latency_multiplier=float(rng.integers(2, 5))))
+        t += 2 * S
+    fd = compile_link_faults(td, events)
+    fh = compile_link_faults(th, events)
+    np.testing.assert_array_equal(fd.times, fh.times)
+    for e, ht in enumerate(fh.epochs):
+        dl, dr = ht.dense()
+        np.testing.assert_array_equal(dl, fd.latency_ns[e])
+        np.testing.assert_array_equal(dr, fd.reliability[e])
+
+
+# A 2-hub / 2-spoke graph whose all-lossy reliabilities do NOT factor
+# through float32 (found by search: the dense multi-hop product and
+# the factored (acc*core)*acc round differently by one ulp).
+NONFACTORABLE_LOSSY = """graph [ directed 0
+  node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  node [ id 2 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  node [ id 3 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+  edge [ source 0 target 1 latency "20 ms" packet_loss 0.249207 ]
+  edge [ source 0 target 2 latency "2 ms" packet_loss 0.034273 ]
+  edge [ source 1 target 3 latency "3 ms" packet_loss 0.429362 ]
+]"""
+
+
+def test_hierarchical_is_a_hard_error_when_it_cannot_reproduce_dense():
+    with pytest.raises(GmlError, match="bit for bit"):
+        Topology.from_gml(NONFACTORABLE_LOSSY,
+                          representation="hierarchical")
+    with pytest.raises(GmlError, match="does not factor"):
+        # direct-edge-only routing never factors
+        Topology.from_gml("""graph [ directed 0
+          node [ id 0 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+          node [ id 1 bandwidth_down "1 Gbit" bandwidth_up "1 Gbit" ]
+          edge [ source 0 target 1 latency "5 ms" packet_loss 0.0 ]
+          edge [ source 0 target 0 latency "2 ms" packet_loss 0.0 ]
+          edge [ source 1 target 1 latency "3 ms" packet_loss 0.0 ]
+        ]""", use_shortest_path=False,
+            representation="hierarchical")
+
+
+def test_auto_falls_back_to_dense_loudly(caplog):
+    with caplog.at_level(logging.INFO):
+        top = Topology.from_gml(NONFACTORABLE_LOSSY,
+                                representation="auto")
+    assert top.representation == "dense" and top.hier is None
+    assert top.latency_ns is not None
+    assert any("dense fallback" in r.message for r in caplog.records)
+    # ... but picks hierarchical when the graph factors and shrinks
+    top = Topology.from_gml(_clustered_gml(), representation="auto")
+    assert top.representation == "hierarchical"
+    # ... and dense when factoring would not shrink (no spokes)
+    hubs_only = _clustered_gml(3, (0, 0, 0))
+    top = Topology.from_gml(hubs_only, representation="auto")
+    assert top.representation == "dense"
+
+
+def test_unknown_representation_rejected():
+    with pytest.raises(GmlError, match="representation"):
+        Topology.from_gml(_clustered_gml(), representation="sparse")
+
+
+# ------------------------------------------------------ fault epochs
+FAULTS = [
+    FaultEvent(kind="link_down", time=1 * S, source=0, target=1),
+    FaultEvent(kind="degrade", time=2 * S, duration=1 * S, source=1,
+               target=2, latency_multiplier=3.0,
+               extra_packet_loss=0.25),
+    FaultEvent(kind="link_up", time=4 * S, source=0, target=1),
+    FaultEvent(kind="degrade", time=5 * S, duration=1 * S, source=0,
+               target=3, latency_multiplier=2.0),       # access link
+    FaultEvent(kind="link_down", time=7 * S, source=1, target=5),
+    FaultEvent(kind="link_up", time=8 * S, source=1, target=5),
+]
+
+
+@pytest.fixture(scope="module")
+def faulted():
+    td, th = _both(_clustered_gml())
+    return td, th, compile_link_faults(td, FAULTS), \
+        compile_link_faults(th, FAULTS)
+
+
+def test_fault_epochs_bit_identical_to_dense(faulted):
+    td, th, fd, fh = faulted
+    assert fh.is_hierarchical and not fd.is_hierarchical
+    np.testing.assert_array_equal(fd.times, fh.times)
+    V = td.n_vertices
+    for t in fd.times:
+        for sv in range(V):
+            for dv in range(V):
+                assert fd.lookup(int(t), sv, dv) == \
+                    fh.lookup(int(t), sv, dv)
+    # stacked device leaves materialize to the dense epoch stacks
+    latp, relp = fh.lat_parts_stacked(), fh.rel_parts_stacked()
+    for e in range(fh.n_epochs):
+        dl, dr = hierarchy.dense_from_parts(
+            tuple(p[e] for p in latp), tuple(p[e] for p in relp))
+        np.testing.assert_array_equal(dl, fd.latency_ns[e])
+        np.testing.assert_array_equal(dr, fd.reliability[e])
+    assert fh.min_latency_ns == fd.min_latency_ns
+
+
+def test_lazy_fault_table_shares_base_and_fingerprint(faulted):
+    td, th, fd, fh = faulted
+    # the healthy epochs REFERENCE the topology matrices — no copy
+    assert fd._lat_epochs[0] is td.latency_ns
+    assert fd._rel_epochs[0] is td.reliability
+    # the lazy table is indistinguishable from the eager stack
+    stacked = FaultTable(times=fd.times,
+                         latency_ns=np.stack(fd._lat_epochs),
+                         reliability=np.stack(fd._rel_epochs))
+    assert fd.fingerprint() == stacked.fingerprint()
+    np.testing.assert_array_equal(fd.latency_ns, stacked.latency_ns)
+
+
+def test_world_tables_single_resolver(faulted):
+    td, th, fd, fh = faulted
+    # fault-free: dense ndarrays vs factored part tuples
+    lat, rel, ept = hierarchy.world_tables(th, None)
+    assert isinstance(lat, tuple) and ept is None
+    dl, dr = hierarchy.dense_from_parts(lat, rel)
+    np.testing.assert_array_equal(dl, td.latency_ns)
+    np.testing.assert_array_equal(dr, td.reliability)
+    # faulted: both resolve to the same epoch grid
+    ld, rd, ed = hierarchy.world_tables(td, fd)
+    lh, rh, eh = hierarchy.world_tables(th, fh)
+    assert not isinstance(ld, tuple) and isinstance(lh, tuple)
+    np.testing.assert_array_equal(ed, eh)
+    for e in range(fh.n_epochs):
+        dl, dr = hierarchy.dense_from_parts(
+            tuple(p[e] for p in lh), tuple(p[e] for p in rh))
+        np.testing.assert_array_equal(dl, ld[e])
+        np.testing.assert_array_equal(dr, rd[e])
+
+
+def test_unreachable_plus_access_change_refused():
+    # downing a spoke's only edge while another access latency is
+    # degraded in the same window: the dense unreachable rule (healthy
+    # base latency) does not factor — the compiler must refuse with a
+    # pointer at representation: dense, not silently diverge
+    _, th = _both(_clustered_gml())
+    events = [
+        FaultEvent(kind="link_down", time=1 * S, source=1, target=5),
+        FaultEvent(kind="degrade", time=1 * S, duration=2 * S,
+                   source=0, target=3, latency_multiplier=2.0),
+        FaultEvent(kind="link_up", time=4 * S, source=1, target=5),
+    ]
+    with pytest.raises(ValueError, match="representation: dense"):
+        compile_link_faults(th, events)
+
+
+def test_device_judge_parity_dense_vs_hier(faulted):
+    from shadow_tpu.device.judge import DeviceJudge
+
+    td, th, fd, fh = faulted
+    V = td.n_vertices
+    hv = np.arange(V, dtype=np.int32)
+    rng = np.random.default_rng(0)
+    N = 300
+    now = rng.integers(0, 9 * S, N).astype(np.int64)
+    src = rng.integers(0, V, N).astype(np.int32)
+    dst = rng.integers(0, V, N).astype(np.int32)
+    seq = np.arange(N, dtype=np.int32)
+    for ft_d, ft_h in [(None, None), (fd, fh)]:
+        jd = DeviceJudge(td, hv, seed=42, fault_table=ft_d)
+        jh = DeviceJudge(th, hv, seed=42, fault_table=ft_h)
+        deld, timd = jd.judge_batch(now, src, dst, seq)
+        delh, timh = jh.judge_batch(now, src, dst, seq)
+        np.testing.assert_array_equal(deld, delh)
+        np.testing.assert_array_equal(timd, timh)
+
+
+# ------------------------------------------------ star_clusters + stride
+def test_star_clusters_layout_and_paths():
+    top = generate_star_clusters(
+        {"clusters": 3, "spokes_per_cluster": 2,
+         "hub_latency": "10 ms", "access_latency": "2 ms"},
+        representation="hierarchical")
+    assert top.n_vertices == 9 and top.hier.n_clusters == 3
+    # spoke k of hub h at C + h*S + k
+    assert top.path(3, 4) == (2 * MS + 0 + 2 * MS, 1.0)   # same hub
+    assert top.path(3, 5)[0] == 2 * MS + 10 * MS + 2 * MS  # cross hub
+    assert top.path(0, 1)[0] == 10 * MS                    # hub-hub
+    assert top.path(3, 0)[0] == 2 * MS                     # spoke-hub
+    # bit-identical to its own dense build
+    td = generate_star_clusters(
+        {"clusters": 3, "spokes_per_cluster": 2,
+         "hub_latency": "10 ms", "access_latency": "2 ms"})
+    hlat, hrel = top.hier.dense()
+    np.testing.assert_array_equal(hlat, td.latency_ns)
+    np.testing.assert_array_equal(hrel, td.reliability)
+
+
+def test_star_clusters_validation():
+    with pytest.raises(GmlError, match="clusters"):
+        generate_star_clusters({"clusters": 0})
+    with pytest.raises(GmlError, match="latencies"):
+        generate_star_clusters({"clusters": 2, "hub_latency": "0 ms"})
+    with pytest.raises(GmlError, match="hub_packet_loss"):
+        generate_star_clusters({"clusters": 2, "hub_packet_loss": 1.5})
+    with pytest.raises(GmlError, match="complete"):
+        generate_star_clusters({"clusters": 2},
+                               use_shortest_path=False)
+    # degenerate 1-vertex graph is complete and builds
+    top = generate_star_clusters({"clusters": 1})
+    assert top.n_vertices == 1 and top.complete
+
+
+STAR_CFG = """
+general: {{stop_time: 500ms, seed: 3}}
+network:
+  topology:
+    representation: hierarchical
+  graph:
+    type: star_clusters
+    clusters: 2
+    spokes_per_cluster: 3
+    hub_latency: 10 ms
+    access_latency: 1 ms
+experimental:
+  scheduler_policy: {policy}
+hosts:
+  server:
+    network_node_id: 2
+    processes: [{{path: "model:tgen_server", start_time: 10ms}}]
+  client:
+    quantity: {q}
+    network_node_id: 3
+    network_node_stride: {stride}
+    processes:
+    - path: model:tgen_client
+      args: server=server size=20KiB count=1 pause=50ms retry=200ms
+      start_time: 50ms
+"""
+
+
+def test_stride_places_hosts_on_consecutive_vertices():
+    sim = build(load_config_str(
+        STAR_CFG.format(policy="serial", q=3, stride=1)))
+    assert sim.topology.representation == "hierarchical"
+    vs = {h.name: h.vertex for h in sim.hosts}
+    # spokes of hub 0 are vertices 2,3,4 — server pinned at 2,
+    # clients tile 3,4,5 (5 = first spoke of hub 1)
+    assert vs["server"] == 2
+    assert [vs[f"client{i}"] for i in range(3)] == [3, 4, 5]
+
+
+def test_stride_schema_validation():
+    bad = STAR_CFG.format(policy="serial", q=3, stride=1).replace(
+        "    network_node_id: 3\n", "")
+    with pytest.raises(ValueError, match="network_node_id"):
+        load_config_str(bad)
+    with pytest.raises(ValueError, match="network_node_stride"):
+        load_config_str(
+            STAR_CFG.format(policy="serial", q=3, stride=-1))
+
+
+def test_stride_walking_past_topology_rejected():
+    with pytest.raises(ValueError, match="walks past"):
+        build(load_config_str(
+            STAR_CFG.format(policy="serial", q=3, stride=4)))
+
+
+# --------------------------------------------------------- ensemble
+ENS_SCALE = """
+ensemble:
+  replicas: 2
+  vary:
+    latency_scale: [1.0, 2.0]
+"""
+
+
+def _star_ens_cfg(ensemble, rep="hierarchical", acc_loss=0.0):
+    text = STAR_CFG.format(policy="tpu", q=3, stride=1)
+    text = text.replace("representation: hierarchical",
+                        f"representation: {rep}")
+    text = text.replace("access_latency: 1 ms",
+                        "access_latency: 1 ms\n"
+                        f"    access_packet_loss: {acc_loss}")
+    return load_config_str(text + ensemble)
+
+
+def test_ensemble_factored_worlds_match_dense():
+    from shadow_tpu.ensemble.spec import build_worlds
+
+    wh = build_worlds(build(_star_ens_cfg(ENS_SCALE)),
+                      _star_ens_cfg(ENS_SCALE).ensemble)
+    cd = _star_ens_cfg(ENS_SCALE, rep="dense")
+    wd = build_worlds(build(cd), cd.ensemble)
+    assert isinstance(wh.latency, tuple)
+    assert wh.lookahead == wd.lookahead
+    for r in range(2):
+        dl, dr = hierarchy.dense_from_parts(
+            tuple(np.asarray(p[r], np.int64) for p in wh.latency),
+            tuple(p[r] for p in wh.reliability))
+        np.testing.assert_array_equal(dl, wd.latency[r])
+        np.testing.assert_array_equal(dr, wd.reliability[r])
+
+
+def test_ensemble_loss_delta_refused_under_lossy_access():
+    from shadow_tpu.ensemble.spec import build_worlds
+
+    ens = ENS_SCALE.replace("latency_scale: [1.0, 2.0]",
+                            "packet_loss_delta: [0.0, 0.1]")
+    cfg = _star_ens_cfg(ens, acc_loss=0.05)
+    with pytest.raises(ValueError, match="lossless access"):
+        build_worlds(build(cfg), cfg.ensemble)
+
+
+# ------------------------------------- engine facts + admission bytes
+@pytest.mark.slow
+def test_program_facts_and_footprint_representation():
+    from shadow_tpu.device import capacity
+
+    c = Controller(_star_ens_cfg(""))
+    stats = c.run()
+    assert stats.ok
+    pf = c.runner.engine.program_facts
+    assert pf["representation"] == "hierarchical"
+    assert pf["n_clusters"] == 2
+    est = capacity.footprint(c.runner.engine)
+    assert est["representation"] == "hierarchical"
+    # the factored world prices what is actually uploaded: far below
+    # even this tiny topology's 8-host dense pair, and the stamp rides
+    # the admission diagnostic
+    line = capacity.admission_diagnostic(est, 2**30, "config")
+    assert "hierarchical tables" in line
+    # the dense twin of the same run disagrees on both stamps
+    cd = Controller(_star_ens_cfg("", rep="dense"))
+    stats_d = cd.run()
+    assert stats_d.ok
+    pf_d = cd.runner.engine.program_facts
+    assert pf_d["representation"] == "dense"
+    assert pf_d["n_clusters"] == 0
+    assert capacity.footprint(
+        cd.runner.engine)["representation"] == "dense"
+
+
+@pytest.mark.slow
+def test_million_host_example_builds_and_fits_budget():
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import load_topology
+
+    cfg = load_config("examples/tgen_1000000.yaml")
+    top = load_topology(cfg)
+    assert top.n_vertices == 1_000_200
+    assert top.representation == "hierarchical"
+    assert top.hier.n_clusters == 200
+    # the whole point: tables fit the config's device budget where the
+    # dense pair (12 bytes/vertex-pair) would be terabytes
+    assert top.table_nbytes() <= \
+        int(cfg.experimental.device_memory_budget)
+    assert top.min_latency_ns == 1 * MS
