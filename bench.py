@@ -795,6 +795,85 @@ def run_topology_rung() -> dict:
     return out
 
 
+# columnar-boot rung ladder: config per point. The 1M example runs
+# only outside BENCH_SMOKE (it boots in seconds now, but the smoke
+# ladder stays tiny on principle).
+BOOT_RUNG_POINTS = [("1k", "examples/tgen_1000.yaml"),
+                    ("100k", "examples/tgen_100000.yaml")]
+BOOT_RUNG_1M = ("1M", "examples/tgen_1000000.yaml")
+BOOT_RUNG_PATH = os.path.join("artifacts", "BOOT_r16.json")
+BOOT_1M_FLOOR_S = 60.0
+
+
+def run_boot_rung() -> dict:
+    """Columnar-boot rung (docs/host_plane.md): wall clock to stand up
+    a runnable simulation — controller.build() (columnar host plane) +
+    DeviceRunner construction + engine.init_state() — at 1k/100k
+    hosts, plus the million-host example outside BENCH_SMOKE. Stamps
+    per-stage walls and hosts/s into artifacts/BOOT_r16.json, and
+    records whether the columnar fast path actually ran: an object
+    build sneaking in would silently bench the wrong thing, so a
+    refused plane is an error here, not a fallback. The acceptance
+    floor rides along — the 1M point must boot in under 60 s."""
+    import gc
+
+    import jax as _jax
+
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import build as build_sim
+    from shadow_tpu.device.runner import DeviceRunner
+    from shadow_tpu.utils.artifacts import atomic_write_json
+
+    points = list(BOOT_RUNG_POINTS)
+    if not os.environ.get("BENCH_SMOKE"):
+        points.append(BOOT_RUNG_1M)
+    out = {"points": []}
+    for label, path in points:
+        cfg = load_config(path)
+        n = cfg.total_hosts()
+        t0 = time.perf_counter()
+        sim = build_sim(cfg)
+        build_s = time.perf_counter() - t0
+        columnar = sim.plane is not None
+        t0 = time.perf_counter()
+        runner = DeviceRunner(sim)
+        engine_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        state = runner.engine.init_state(sim.starts)
+        _jax.block_until_ready(state["ht"])
+        init_s = time.perf_counter() - t0
+        boot_s = build_s + engine_s + init_s
+        pt = {"label": label, "config": path, "n_hosts": n,
+              "columnar": columnar,
+              "build_s": round(build_s, 3),
+              "engine_s": round(engine_s, 3),
+              "init_state_s": round(init_s, 3),
+              "boot_s": round(boot_s, 3),
+              "hosts_per_s": round(n / boot_s, 1)}
+        log(f"  boot {label}: {n} hosts in {pt['boot_s']}s "
+            f"({pt['hosts_per_s']:,.0f} hosts/s; build "
+            f"{pt['build_s']}s, engine {pt['engine_s']}s, "
+            f"init_state {pt['init_state_s']}s, "
+            f"columnar={columnar})")
+        out["points"].append(pt)
+        if not columnar:
+            out["error"] = (f"{label}: the columnar fast path was "
+                            "refused — this rung benches the plane")
+        elif label == "1M" and boot_s >= BOOT_1M_FLOOR_S:
+            out["error"] = (f"1M boot took {boot_s:.1f}s — the "
+                            f"<{BOOT_1M_FLOOR_S:.0f}s floor failed")
+        # the 1M heaps are ~2.6 GB on the CPU platform: release them
+        # before the next point (or whatever rung follows)
+        del state, runner, sim
+        gc.collect()
+    try:
+        atomic_write_json(out, BOOT_RUNG_PATH)
+        log(f"  boot record -> {BOOT_RUNG_PATH}")
+    except OSError as e:
+        log(f"  could not write boot record: {e}")
+    return out
+
+
 PIPELINE_DEPTHS = (1, 2, 4)
 
 
@@ -1293,6 +1372,18 @@ def main() -> int:
         except Exception as e:          # noqa: BLE001
             result["ensemble"] = {"error": str(e)}
             log(f"  ensemble rung failed: {e}")
+            rc = 1
+
+        log("boot rung: columnar host-plane build + init_state "
+            "ladder (docs/host_plane.md)")
+        try:
+            result["boot"] = run_boot_rung()
+            if "error" in result["boot"]:
+                log(f"  boot rung: {result['boot']['error']}")
+                rc = 1
+        except Exception as e:          # noqa: BLE001
+            result["boot"] = {"error": str(e)}
+            log(f"  boot rung failed: {e}")
             rc = 1
 
         log("topology rung: hierarchical vs dense table build "

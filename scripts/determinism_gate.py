@@ -1450,6 +1450,86 @@ def run_pipelined_gate(config: str) -> int:
         return rc
 
 
+def run_host_plane_gate(config: str) -> int:
+    """Columnar host plane gate (host/plane.py, docs/host_plane.md):
+    on the forced multi-device mesh, the columnar build, the object-
+    path build (SHADOW_TPU_HOST_PLANE=0), and the serial CPU oracle
+    must produce bit-identical per-host signatures, and the two tpu
+    legs' engines must carry identical checkpoint fingerprints.
+    Vacuity-guarded: the columnar leg must actually have used the
+    plane, and the object leg must not have."""
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.device import checkpoint
+
+    def leg(policy: str, data_dir: str, columnar: bool):
+        old = os.environ.pop("SHADOW_TPU_HOST_PLANE", None)
+        try:
+            if not columnar:
+                os.environ["SHADOW_TPU_HOST_PLANE"] = "0"
+            cfg = load_config(config)
+            cfg.experimental.scheduler_policy = policy
+            cfg.general.data_directory = data_dir
+            c = Controller(cfg)
+            stats = c.run()
+        finally:
+            os.environ.pop("SHADOW_TPU_HOST_PLANE", None)
+            if old is not None:
+                os.environ["SHADOW_TPU_HOST_PLANE"] = old
+        if not stats.ok:
+            print(f"FAIL: {policy} leg reported not-ok")
+            sys.exit(1)
+        sig = [(h.name, h.trace_checksum, h.events_executed,
+                h.packets_sent, h.packets_dropped,
+                h.packets_delivered) for h in c.sim.hosts]
+        return c, sig
+
+    def diff(tag: str, a, b) -> None:
+        print(f"HOST-PLANE FAILURE: {tag} signatures diverge")
+        for x, y in zip(a, b):
+            if x != y:
+                print(f"  {x[0]}: {x[1:]} != {y[1:]}")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        col, sig_col = leg("tpu", os.path.join(tmp, "columnar"), True)
+        if col.sim.plane is None:
+            print("FAIL: the columnar leg did not use the host plane "
+                  "(eligibility refused this config, so the gate "
+                  "would compare object vs object — fix the config "
+                  "or the eligibility rule)")
+            return 1
+        obj, sig_obj = leg("tpu", os.path.join(tmp, "object"), False)
+        if obj.sim.plane is not None:
+            print("FAIL: SHADOW_TPU_HOST_PLANE=0 did not force the "
+                  "object build")
+            return 1
+        _, sig_ser = leg("serial", os.path.join(tmp, "serial"), True)
+
+        rc = 0
+        if sig_col != sig_obj:
+            rc = 1
+            diff("columnar vs object", sig_col, sig_obj)
+        if sig_col != sig_ser:
+            rc = 1
+            diff("columnar vs serial oracle", sig_col, sig_ser)
+        fp_col = checkpoint._fingerprint(col.runner.engine)
+        fp_obj = checkpoint._fingerprint(obj.runner.engine)
+        if fp_col != fp_obj:
+            rc = 1
+            print("HOST-PLANE FAILURE: checkpoint fingerprints "
+                  "diverge between the columnar and object engines")
+            for k in fp_col:
+                if fp_col.get(k) != fp_obj.get(k):
+                    print(f"  {k}: {fp_col.get(k)} != {fp_obj.get(k)}")
+        if rc == 0:
+            import jax
+            print(f"host-plane OK: {config} ({len(sig_col)} hosts, "
+                  f"{len(jax.devices())} devices) — columnar, "
+                  "object, and serial legs bit-identical; "
+                  "checkpoint fingerprints match")
+        return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("config", nargs="?", default="examples/minimal.yaml")
@@ -1516,6 +1596,14 @@ def main() -> int:
                          "campaign in replica batches of 2 must "
                          "bit-match the full-vmap campaign and "
                          "standalone replica 0 (needs >= 4 devices)")
+    ap.add_argument("--host-plane", action="store_true",
+                    help="columnar host-plane gate: the vectorized "
+                         "columnar build, the object-path build "
+                         "(SHADOW_TPU_HOST_PLANE=0), and the serial "
+                         "CPU oracle must be bit-identical on the "
+                         "forced multi-device mesh, with matching "
+                         "checkpoint fingerprints between the two "
+                         "tpu legs")
     ap.add_argument("--analyze-consistency", action="store_true",
                     help="static-analysis consistency gate: the "
                          "collective registry shadowlint audits "
@@ -1568,6 +1656,18 @@ def main() -> int:
                   "plus its own preemption/resume legs)")
             return 1
         return run_pipelined_gate(args.config)
+
+    if args.host_plane:
+        if args.ensemble or args.preempt or args.policy or \
+                args.compile_cache or args.telemetry or args.tuned \
+                or args.analyze_consistency:
+            # the host-plane gate runs its own three legs (columnar
+            # tpu, object tpu, serial oracle) by construction
+            print("FAIL: --host-plane does not combine with other "
+                  "gate flags (it runs columnar tpu + object tpu + "
+                  "serial legs by construction)")
+            return 1
+        return run_host_plane_gate(args.config)
 
     if args.analyze_consistency:
         if args.ensemble or args.preempt or args.policy or \

@@ -11,6 +11,7 @@ Manager(s) for the earliest next event between rounds.
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -71,28 +72,167 @@ class BuiltSimulation:
     # [(time, host_id, kind)] host crash/restart schedule
     fault_table: object = None
     host_faults: list = None
+    # columnar builds only (host/plane.py): the HostPlane whose columns
+    # DeviceRunner consumes directly; `hosts` is then a LazyHostList
+    # view over it
+    plane: object = None
+
+
+# log one [build-heartbeat] line per this many hosts (only for builds
+# big enough that silence reads as a hang)
+_HEARTBEAT_MIN_HOSTS = 50_000
+
+
+def _heartbeat(t_start: float, done: int, total: int) -> None:
+    elapsed = time.monotonic() - t_start
+    rate = done / elapsed if elapsed > 0 else 0.0
+    eta = (total - done) / rate if rate > 0 else 0.0
+    log.info("[build-heartbeat] %d/%d hosts in %.1fs "
+             "(%.0f hosts/s, ETA %.1fs)", done, total, elapsed,
+             rate, eta)
 
 
 def build(cfg: ConfigOptions) -> BuiltSimulation:
+    """Instantiate a config: columnar fast path (host/plane.py) for
+    pure model-app device-policy runs, the per-host object loop for
+    everything else. Both paths produce bit-identical simulations —
+    the plane is a representation change, not a semantic one."""
     from shadow_tpu import faults as faultmod
-    from shadow_tpu.host.cpu import Cpu
+    from shadow_tpu.host import plane as planemod
     from shadow_tpu.routing.dns import Dns
 
     topology = load_topology(cfg)
     # link faults compile into the epoch table HERE, at load time,
     # exactly like the base all-pairs matrices; host faults resolve
-    # against the built host list further down
+    # against the built host names further down
     link_events, host_events = faultmod.split_events(cfg.network.faults)
     fault_table = faultmod.compile_link_faults(topology, link_events)
+    dns = Dns()
+    reason = planemod.object_build_reason(cfg, topology)
+    if reason is None:
+        return _build_columnar(cfg, topology, dns, fault_table,
+                               host_events)
+    if cfg.ensemble is not None or \
+            cfg.experimental.scheduler_policy == "tpu":
+        # device policies WANT the fast path; a quiet fallback would
+        # read as "columnar is slow" instead of "columnar was refused"
+        log.warning("[host-plane] falling back to the object build: "
+                    "%s", reason)
+    return _build_objects(cfg, topology, dns, fault_table, host_events)
+
+
+def _lookahead(cfg: ConfigOptions, netmodel: NetworkModel) -> int:
+    # the lookahead window must be a static floor over every fault
+    # epoch (netmodel.min_latency_ns is fault-aware) — all backends
+    # consume this one value, so window sequences stay identical
+    return (cfg.experimental.runahead
+            if cfg.experimental.runahead is not None
+            else netmodel.min_latency_ns)
+
+
+def _build_columnar(cfg: ConfigOptions, topology: Topology, dns,
+                    fault_table, host_events) -> BuiltSimulation:
+    """O(groups) vectorized build: every per-host quantity is an array
+    fill (strided arange attachment, broadcast bandwidths, one DNS
+    block per group); Host objects materialize lazily off the plane."""
+    from shadow_tpu import faults as faultmod
+    from shadow_tpu.host import plane as planemod
+    from shadow_tpu.models import make_app
+
+    n_total = cfg.total_hosts()
+    t_start = time.monotonic()
+    records: list[planemod.PlaneGroup] = []
+    groups: dict[str, range] = {}
+    v_parts, d_parts, u_parts, ip_parts = [], [], [], []
+    t0_parts, t1_parts = [], []
+    base = 0
+    for group in cfg.hosts:
+        q = group.quantity
+        if group.network_node_stride > 0:
+            stride_base = topology.vertex_index_for_id(
+                group.network_node_id)
+            last = stride_base + (q - 1) * group.network_node_stride
+            if last >= topology.n_vertices:
+                raise ValueError(
+                    f"hosts.{group.name}: network_node_stride walks "
+                    f"past the topology (host {q - 1} "
+                    f"would attach at vertex {last}, the graph has "
+                    f"{topology.n_vertices})")
+            v = stride_base + np.arange(q, dtype=np.int64) * \
+                group.network_node_stride
+        elif group.network_node_id is not None:
+            v = np.full(q, topology.vertex_index_for_id(
+                group.network_node_id), dtype=np.int64)
+        else:
+            # eligibility guarantees a 1-vertex graph here
+            v = np.zeros(q, dtype=np.int64)
+        d_parts.append(np.full(q, group.bandwidth_down, dtype=np.int64)
+                       if group.bandwidth_down is not None
+                       else topology.bw_down_bits[v].astype(np.int64))
+        u_parts.append(np.full(q, group.bandwidth_up, dtype=np.int64)
+                       if group.bandwidth_up is not None
+                       else topology.bw_up_bits[v].astype(np.int64))
+        v_parts.append(v)
+        ip_parts.append(dns.register_block(base, group.name, q))
+        proc = group.processes[0]
+        stop = proc.stop_time if proc.stop_time is not None else -1
+        records.append(planemod.PlaneGroup(
+            name=group.name, base_id=base, count=q,
+            pcap_directory=group.pcap_directory,
+            path=proc.path, args=proc.args,
+            start_time=proc.start_time, stop_time=stop,
+            model=proc.path[len("model:"):],
+            prototype=make_app(proc.path, proc.args, base, n_total)))
+        groups[group.name] = range(base, base + q)
+        t0_parts.append(np.full(q, proc.start_time, dtype=np.int64))
+        t1_parts.append(np.full(q, stop, dtype=np.int64))
+        base += q
+        if n_total >= _HEARTBEAT_MIN_HOSTS:
+            _heartbeat(t_start, base, n_total)
+
+    def _cat(parts):
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    starts = planemod.StartColumns(_cat(t0_parts), _cat(t1_parts))
+    plane = planemod.HostPlane(cfg, records, _cat(v_parts),
+                               _cat(d_parts), _cat(u_parts),
+                               _cat(ip_parts), starts)
+    netmodel = NetworkModel(
+        topology=topology,
+        host_vertex=plane.vertex,
+        seed=cfg.general.seed,
+        bootstrap_end=cfg.general.bootstrap_end_time,
+        faults=fault_table,
+    )
+    host_faults = faultmod.resolve_host_faults(host_events, plane.names)
+    log.info("[host-plane] columnar build: %d hosts in %d groups, "
+             "%.2fs", n_total, len(records),
+             time.monotonic() - t_start)
+    return BuiltSimulation(cfg=cfg, topology=topology,
+                           hosts=planemod.LazyHostList(plane),
+                           netmodel=netmodel, starts=starts,
+                           lookahead=_lookahead(cfg, netmodel),
+                           dns=dns, runtime=None, groups=groups,
+                           fault_table=fault_table,
+                           host_faults=host_faults, plane=plane)
+
+
+def _build_objects(cfg: ConfigOptions, topology: Topology, dns,
+                   fault_table, host_events) -> BuiltSimulation:
+    from shadow_tpu import faults as faultmod
+    from shadow_tpu.host.cpu import Cpu
+    from shadow_tpu.routing.address import Address
+
     root_rng = SeededRandom(cfg.general.seed)
     attacher = Attacher(topology, root_rng.child("attach"))
-    dns = Dns()
 
     hosts: list[Host] = []
     starts: list[tuple[int, int, int]] = []
     groups: dict[str, list[int]] = {}
     runtime = None
     n_total = cfg.total_hosts()
+    t_start = time.monotonic()
+    beat_every = max(10_000, n_total // 20)
     for group in cfg.hosts:
         # network_node_stride: host i of the group attaches at vertex
         # index base + i*stride — resolved ONCE per group (the id
@@ -110,10 +250,20 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
                     f"past the topology (host {group.quantity - 1} "
                     f"would attach at vertex {last}, the graph has "
                     f"{topology.n_vertices})")
+        members = groups.setdefault(group.name, [])
+        # bulk DNS for model-only groups: one vectorized block
+        # allocation instead of `quantity` Address constructions and
+        # 3x that many dict inserts (hint-less groups only — a
+        # requested IP needs the scalar path's validity checks)
+        block_ips = None
+        if group.quantity > 1 and not group.ip_address_hint and \
+                all(is_model_path(p.path) for p in group.processes):
+            block_ips = dns.register_block(len(hosts), group.name,
+                                           group.quantity)
         for i in range(group.quantity):
             name = group.name if group.quantity == 1 else f"{group.name}{i}"
             host_id = len(hosts)
-            groups.setdefault(group.name, []).append(host_id)
+            members.append(host_id)
             if stride_base is not None:
                 v = stride_base + i * group.network_node_stride
                 att = HostAttachment(
@@ -143,8 +293,12 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
                 from shadow_tpu.host.model_nic import ModelNic
                 host.model_nic = ModelNic(att.bw_up_bits,
                                           att.bw_down_bits)
-            host.address = dns.register(host_id, name,
-                                        requested_ip=group.ip_address_hint)
+            if block_ips is not None:
+                host.address = Address(host_id=host_id, name=name,
+                                       ip=int(block_ips[i]))
+            else:
+                host.address = dns.register(
+                    host_id, name, requested_ip=group.ip_address_hint)
             host.ip = host.address.ip_str
             for proc in group.processes:
                 for _ in range(proc.quantity):
@@ -242,6 +396,9 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
                                    if proc.stop_time is not None else -1,
                                    proc_idx))
             hosts.append(host)
+            if n_total >= _HEARTBEAT_MIN_HOSTS and \
+                    len(hosts) % beat_every == 0:
+                _heartbeat(t_start, len(hosts), n_total)
 
     netmodel = NetworkModel(
         topology=topology,
@@ -252,12 +409,7 @@ def build(cfg: ConfigOptions) -> BuiltSimulation:
     )
     host_faults = faultmod.resolve_host_faults(
         host_events, {h.name: h.host_id for h in hosts})
-    # the lookahead window must be a static floor over every fault
-    # epoch (netmodel.min_latency_ns is fault-aware) — all backends
-    # consume this one value, so window sequences stay identical
-    lookahead = (cfg.experimental.runahead
-                 if cfg.experimental.runahead is not None
-                 else netmodel.min_latency_ns)
+    lookahead = _lookahead(cfg, netmodel)
     if runtime is not None:
         # managed processes resolve names against this file
         # (dns.c's /etc/hosts-style emission)
@@ -275,11 +427,6 @@ class Controller:
     def __init__(self, cfg: ConfigOptions, trace: Optional[list] = None,
                  tracer=None):
         self.cfg = cfg
-        self.sim = build(cfg)
-        policy_name = cfg.experimental.scheduler_policy
-        self.runner = None
-        self.manager = None
-        net_judge = None
         # flight recorder (shadow_tpu/obs): ONE per run, attached to
         # whichever executor this config resolves to and published as
         # the module-global current() for call sites with no plumbing
@@ -287,13 +434,21 @@ class Controller:
         # A nested run (the hybrid failover rerun) receives its
         # parent's tracer instead, so the rerun's spans land in the
         # SAME trace under the parent's `failover` span — the parent
-        # finalizes, the child must not.
+        # finalizes, the child must not. Resolved BEFORE build so the
+        # boot wall lands in the trace's `plan` phase.
         from shadow_tpu.obs import trace as obstrace
         self._owns_tracer = tracer is None
         self.tracer = (tracer if tracer is not None
                        else obstrace.resolve_tracer(cfg,
-                                                    len(self.sim.hosts)))
+                                                    cfg.total_hosts()))
         obstrace.set_current(self.tracer)
+        with self.tracer.span("build", "plan",
+                              n_hosts=cfg.total_hosts()):
+            self.sim = build(cfg)
+        policy_name = cfg.experimental.scheduler_policy
+        self.runner = None
+        self.manager = None
+        net_judge = None
         if cfg.ensemble is not None:
             # R-replica campaign in one vmapped device program
             # (shadow_tpu/ensemble/). No hybrid fallback: CPU host
@@ -384,6 +539,14 @@ class Controller:
                 min_batch=cfg.experimental.hybrid_judge_min_batch,
                 fault_table=self.sim.fault_table)
             policy_name = cfg.experimental.hybrid_cpu_policy
+        if self.sim.plane is not None:
+            # a CPU-policy backend reached a columnar sim (the
+            # NoDeviceTwin hybrid fallback): the Manager touches every
+            # host per event, so lazy materialization buys nothing —
+            # materialize the whole table once, up front
+            log.info("[host-plane] CPU backend %r: materializing all "
+                     "%d hosts", policy_name, len(self.sim.hosts))
+            self.sim.hosts = list(self.sim.hosts)
         from shadow_tpu.core.manager import NetOptions
         self.manager = Manager(
             tracer=self.tracer,

@@ -53,10 +53,86 @@ class NoDeviceTwin(ValueError):
     network judgment, core/manager.py flush_judgments)."""
 
 
+def _plane_twin(sim, plane) -> DeviceApp:
+    """Device twin straight from the columnar host plane — no host
+    materialization, no per-host iteration. Each group's ONE prototype
+    app carries the parsed args; the twin's per-host arrays fill from
+    group slices. Raises the exact errors the object path would, so
+    the fallback story reads the same either way."""
+    n_hosts = plane.n_hosts
+    models = {g.model for g in plane.group_records}
+
+    if models == {"phold"}:
+        first = plane.group_records[0].prototype
+        for g in plane.group_records[1:]:
+            a = g.prototype
+            if (a.msgload, a.size, a.selfloop) != (first.msgload,
+                                                   first.size,
+                                                   first.selfloop):
+                raise ValueError("tpu policy: phold args must match "
+                                 "across hosts")
+        return PholdDevice(n_hosts_total=n_hosts, msgload=first.msgload,
+                           size=first.size, selfloop=first.selfloop)
+
+    # eligibility (host/plane.py COLUMNAR_MODELS) admits only phold
+    # and tgen; a mixed phold+tgen set still lands here
+    if models <= {"tgen_server", "tgen_client"}:
+        client_groups = [g for g in plane.group_records
+                         if g.model == "tgen_client"]
+        if not client_groups:
+            raise ValueError("tpu policy: tgen config has no clients")
+        first = client_groups[0].prototype
+        for g in client_groups:
+            if g.prototype.size != first.size:
+                raise ValueError(
+                    "tpu policy: tgen client `size` must match across "
+                    "hosts (it shapes the shared servers' responses); "
+                    "count/pause/retry may vary")
+        roles = np.zeros(n_hosts, np.int32)
+        server_gid = np.zeros(n_hosts, np.int32)
+        count = np.zeros(n_hosts, np.int32)
+        pause = np.zeros(n_hosts, np.int64)
+        retry = np.zeros(n_hosts, np.int64)
+        for g in client_groups:
+            sl = slice(g.base_id, g.base_id + g.count)
+            a = g.prototype
+            roles[sl] = 1
+            count[sl] = a.count
+            pause[sl] = a.pause_ns
+            retry[sl] = a.retry_ns
+            # same name-or-group rule as resolve_host_ref: an exact
+            # host name pins every client in the group to one server;
+            # a group name fans out by asker_id % group size
+            sid = plane.names.get(a.server_name)
+            if sid is not None:
+                server_gid[sl] = sid
+                continue
+            members = (sim.groups or {}).get(a.server_name)
+            if not members:
+                raise ValueError(
+                    f"tgen client on {plane.name_of(g.base_id)}: "
+                    f"unknown server {a.server_name!r}")
+            ids = np.arange(g.base_id, g.base_id + g.count,
+                            dtype=np.int64)
+            server_gid[sl] = (members[0]
+                              + ids % len(members)).astype(np.int32)
+        return TgenDevice(roles=roles, server_gid=server_gid,
+                          size=first.size, count=count,
+                          pause_ns=pause, retry_ns=retry)
+
+    names = sorted(models)
+    raise NoDeviceTwin(f"no device twin registered for {names}; "
+                       "available: phold, tgen (server+client) — "
+                       "running hybrid (CPU hosts + device net model)")
+
+
 def device_twin(sim) -> DeviceApp:
     """Map the config's CPU model apps to their vectorized device twin.
     Supported: homogeneous phold; tgen server/client mixes (homogeneous
     client args)."""
+    plane = getattr(sim, "plane", None)
+    if plane is not None:
+        return _plane_twin(sim, plane)
     if any(len(h.apps) > 1 for h in sim.hosts):
         raise NoDeviceTwin("tpu policy: multi-process hosts run hybrid")
     apps = [h.app for h in sim.hosts]
@@ -176,7 +252,9 @@ class DeviceRunner:
                 "equivalence testing")
         self.sim = sim
         cfg = sim.cfg
-        if any(h.pcap_directory for h in sim.hosts):
+        plane = getattr(sim, "plane", None)
+        if (plane.any_pcap if plane is not None
+                else any(h.pcap_directory for h in sim.hosts)):
             log.warning("tpu policy: pcap capture requires a CPU "
                         "scheduler policy (packets are device-resident "
                         "metadata here)")
@@ -365,10 +443,16 @@ class DeviceRunner:
             epoch_times=epoch_times,
             ensemble=ensemble,
             mesh=self._mesh,
-            bw_up_bits=np.array([h.bw_up_bits for h in sim.hosts],
-                                dtype=np.int64),
-            bw_down_bits=np.array([h.bw_down_bits for h in sim.hosts],
-                                  dtype=np.int64),
+            bw_up_bits=(sim.plane.bw_up_bits
+                        if getattr(sim, "plane", None) is not None
+                        else np.array([h.bw_up_bits
+                                       for h in sim.hosts],
+                                      dtype=np.int64)),
+            bw_down_bits=(sim.plane.bw_down_bits
+                          if getattr(sim, "plane", None) is not None
+                          else np.array([h.bw_down_bits
+                                         for h in sim.hosts],
+                                        dtype=np.int64)),
         )
         # every engine this runner builds (static, warm-up, planned,
         # re-planned, resumed) shares the one AOT compile cache, so a
@@ -996,12 +1080,19 @@ class DeviceRunner:
                       "for hub-concentrated traffic, or "
                       "capacity_plan: auto)", x_overflow)
 
-        # reflect per-host results back onto the Host objects
-        for h in self.sim.hosts:
-            i = h.host_id
-            h.events_executed = int(final["n_exec"][i])
-            h.packets_sent = int(final["n_sent"][i])
-            h.packets_dropped = int(final["n_drop"][i])
-            h.packets_delivered = int(final["n_deliv"][i])
-            h.trace_checksum = int(final["chk"][i])
+        # reflect per-host results back onto the Host objects — or,
+        # for a columnar build, adopt them as plane columns: hosts
+        # materialized later still read the real counters, and nothing
+        # is materialized just to carry five ints
+        plane = getattr(self.sim, "plane", None)
+        if plane is not None:
+            plane.adopt_final(final)
+        else:
+            for h in self.sim.hosts:
+                i = h.host_id
+                h.events_executed = int(final["n_exec"][i])
+                h.packets_sent = int(final["n_sent"][i])
+                h.packets_dropped = int(final["n_drop"][i])
+                h.packets_delivered = int(final["n_deliv"][i])
+                h.trace_checksum = int(final["chk"][i])
         return stats

@@ -760,12 +760,18 @@ class EnsembleRunner:
         # replica 0's per-host results reflect onto the Host objects:
         # the determinism gate's signature path (and any tooling that
         # reads hosts) sees the base replica, which must bit-match a
-        # standalone run with replica 0's parameters
-        for h in self.sim.hosts:
-            i = h.host_id
-            h.events_executed = int(final["n_exec"][0, i])
-            h.packets_sent = int(final["n_sent"][0, i])
-            h.packets_dropped = int(final["n_drop"][0, i])
-            h.packets_delivered = int(final["n_deliv"][0, i])
-            h.trace_checksum = int(final["chk"][0, i])
+        # standalone run with replica 0's parameters. A columnar build
+        # adopts the row as plane columns instead — no host
+        # materialization just to carry counters.
+        plane = getattr(self.sim, "plane", None)
+        if plane is not None:
+            plane.adopt_final(final, replica=0)
+        else:
+            for h in self.sim.hosts:
+                i = h.host_id
+                h.events_executed = int(final["n_exec"][0, i])
+                h.packets_sent = int(final["n_sent"][0, i])
+                h.packets_dropped = int(final["n_drop"][0, i])
+                h.packets_delivered = int(final["n_deliv"][0, i])
+                h.trace_checksum = int(final["chk"][0, i])
         return stats

@@ -629,7 +629,12 @@ def resolve_host_faults(events: list,
     """Validate host_crash/host_restart events against the built host
     list: names must resolve (group-expanded names like ``client0``),
     and each host's schedule must alternate crash -> restart. Returns
-    [(time, host_id, kind)] sorted by time."""
+    [(time, host_id, kind)] sorted by time.
+
+    ``name_to_id`` is any mapping-like with ``.get`` — a plain dict
+    from the object build, or the columnar build's
+    ``host.plane.PlaneNameMap``, which parses generated names back to
+    ids WITHOUT materializing a million Host objects first."""
     out: list[tuple[int, int, str]] = []
     state: dict[int, str] = {}
     for ev in sorted(events, key=lambda e: e.time):

@@ -6,6 +6,13 @@ per-host id counters that make the event order reproducible — the
 event-sequence counter (host_getNewEventID) and packet-sequence counter
 (packet ids). The interfaces/router/TCP machinery attaches here as the
 host emulation layer grows.
+
+Columnar builds (host/plane.py) do not construct these objects up
+front: the plane holds the same fields as [H] numpy columns and
+``HostPlane.materialize`` builds a Host lazily — field for field
+identical to the object build, including the RNG seed — only when
+something actually touches it (a CPU backend, a tracker heartbeat,
+tooling reading ``sim.hosts``).
 """
 
 from __future__ import annotations

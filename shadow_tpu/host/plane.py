@@ -1,0 +1,323 @@
+"""Columnar host plane: the vectorized build/boot path.
+
+PR 15 made million-vertex path TABLES cheap; this module does the same
+lift-the-layer move one level up, at PAPER.md's layer-4 host emulation.
+A device-policy run never touches most of what a Python ``Host`` object
+carries — the per-host RNG is never drawn, the Cpu model never ticks,
+the net stack is device state — yet ``controller.build()`` used to
+construct a million of them one at a time (name f-string, blake2b seed
+derivation, ``Cpu()``, DNS dict inserts, closure allocation), and
+``device/runner.py`` immediately re-extracted numpy columns from them.
+
+The :class:`HostPlane` holds the whole host table AS the columns:
+vertex attachment, bandwidths, IPs, and process start/stop times are
+built O(groups) vectorized (strided arange, broadcast, one bulk DNS
+block per group), and the app-parameter columns the device twin needs
+come from ONE prototype app per group (every host in a group shares
+one args string, so the parsed fields broadcast). Full ``Host``
+objects materialize LAZILY — only for hosts something actually touches
+(a CPU-policy backend, tooling that reads ``sim.hosts``, a tracker
+heartbeat) — and :meth:`HostPlane.materialize` constructs them
+EXACTLY like the object path, including the per-host seed via the
+same ``SeededRandom.child`` blake2b derivation, so a materialized
+host is bit-identical to an object-built one by construction.
+
+Bit-identity contract (enforced by tests/test_host_plane.py and the
+``determinism_gate.py --host-plane`` CI rung): a columnar build
+produces identical run signatures, checkpoints, and OCC/PLAN
+fingerprints to the object-path build at every V where both run.
+
+Eligibility lives in :func:`object_build_reason`: the fast path covers
+pure model-app groups (tgen/phold — no managed processes, no
+tor/HTTP) with deterministic O(1) vertex placement; anything else
+returns a human-readable reason and ``controller.build()`` falls back
+loudly to the object loop. ``SHADOW_TPU_HOST_PLANE=0`` forces the
+object path (the gate's comparison leg).
+"""
+
+from __future__ import annotations
+
+import os
+from bisect import bisect_right
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from shadow_tpu.host.host import Host
+from shadow_tpu.models import COLUMNAR_MODELS, is_model_path, make_app
+from shadow_tpu.routing.address import Address
+from shadow_tpu.utils.rng import SeededRandom, _derive
+
+
+def object_build_reason(cfg, topology) -> Optional[str]:
+    """None when the columnar fast path applies; otherwise a readable
+    reason for the object-path fallback (logged loudly on device
+    policies — a silently slow million-host build is the failure mode
+    this module exists to kill)."""
+    if os.environ.get("SHADOW_TPU_HOST_PLANE", "") in ("0", "off"):
+        return "disabled by SHADOW_TPU_HOST_PLANE=0"
+    if not cfg.hosts:
+        return "config has no host groups"
+    if cfg.ensemble is None and \
+            cfg.experimental.scheduler_policy != "tpu":
+        return (f"scheduler_policy "
+                f"{cfg.experimental.scheduler_policy!r} is a "
+                "CPU-policy backend (it touches every host, so lazy "
+                "materialization buys nothing)")
+    for g in cfg.hosts:
+        for proc in g.processes:
+            if not is_model_path(proc.path):
+                return (f"hosts.{g.name} runs managed process "
+                        f"{proc.path!r} (real processes need full "
+                        "Host objects and the native runtime)")
+        n_procs = sum(p.quantity for p in g.processes)
+        if n_procs != 1:
+            return (f"hosts.{g.name} runs {n_procs} processes per "
+                    "host (the plane carries exactly one model app)")
+        model = g.processes[0].path[len("model:"):]
+        if model not in COLUMNAR_MODELS:
+            return (f"hosts.{g.name} model {model!r} has no columnar "
+                    f"twin (have: {sorted(COLUMNAR_MODELS)})")
+        if g.ip_address_hint or g.city_code_hint or \
+                g.country_code_hint:
+            return (f"hosts.{g.name} uses attachment/IP hints "
+                    "(hint resolution is per-host object work)")
+        if g.network_node_id is None and topology.n_vertices != 1:
+            return (f"hosts.{g.name} has no network_node_id on a "
+                    f"{topology.n_vertices}-vertex graph (attachment "
+                    "would draw from the build RNG)")
+    names = [g.name for g in cfg.hosts]
+    for a in names:
+        for b in names:
+            if a != b and b.startswith(a) and b[len(a):].isdigit():
+                # "web" x quantity 20 generates web1; a sibling group
+                # "web1" collides — the object path's DNS raises on
+                # the duplicate, so send ambiguous layouts there
+                return (f"group names {a!r} and {b!r} can collide in "
+                        "generated host names")
+    return None
+
+
+@dataclass
+class PlaneGroup:
+    """One config host group's columnar record: contiguous ids
+    [base_id, base_id + count), names ``{name}{i}`` (bare ``name``
+    when count == 1), one model process shared by every member, and
+    ONE prototype app carrying the parsed per-group arg fields."""
+
+    name: str
+    base_id: int
+    count: int
+    pcap_directory: Optional[str]
+    path: str                      # "model:<name>"
+    args: str
+    start_time: int
+    stop_time: int                 # -1 = no stop event
+    model: str                     # registry name after "model:"
+    prototype: object              # ModelApp built for host base_id
+
+    def ids(self) -> range:
+        return range(self.base_id, self.base_id + self.count)
+
+
+class PlaneNameMap:
+    """name -> host id WITHOUT materializing anything (the host-fault
+    resolver's seam: faults.resolve_host_faults only calls ``.get``).
+    Generated names parse back by group prefix + decimal suffix; the
+    eligibility check already refused prefix-ambiguous group sets, so
+    every name has at most one parse."""
+
+    def __init__(self, groups: list[PlaneGroup]):
+        self._groups = {g.name: g for g in groups}
+
+    def get(self, name: str, default=None):
+        g = self._groups.get(name)
+        if g is not None and g.count == 1:
+            return g.base_id
+        for prefix, g in self._groups.items():
+            if g.count > 1 and name.startswith(prefix):
+                suf = name[len(prefix):]
+                # generated names never carry leading zeros
+                if suf.isdigit() and str(int(suf)) == suf \
+                        and int(suf) < g.count:
+                    return g.base_id + int(suf)
+        return default
+
+    def __contains__(self, name: str) -> bool:
+        return self.get(name) is not None
+
+    def __getitem__(self, name: str) -> int:
+        hid = self.get(name)
+        if hid is None:
+            raise KeyError(name)
+        return hid
+
+
+class StartColumns:
+    """Per-host process (start, stop|-1) times as [H] int64 columns.
+    Iterates as the ``(host_id, start, stop, proc_idx)`` tuples
+    ``Manager.boot_hosts`` expects (host_id == index: the plane
+    carries exactly one process per host); the device engine's
+    ``init_state`` detects :meth:`as_arrays` and fills its boot/stop
+    vectors with array ops instead of a million-iteration loop."""
+
+    def __init__(self, t0, t1):
+        self.t0 = np.asarray(t0, dtype=np.int64)
+        self.t1 = np.asarray(t1, dtype=np.int64)
+
+    def as_arrays(self):
+        return self.t0, self.t1
+
+    def __len__(self) -> int:
+        return int(self.t0.shape[0])
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        if i < 0:
+            i += len(self)
+        if not 0 <= i < len(self):
+            raise IndexError(i)
+        return (i, int(self.t0[i]), int(self.t1[i]), 0)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield (i, int(self.t0[i]), int(self.t1[i]), 0)
+
+
+class HostPlane:
+    """The columnar host table. Columns are aligned [H] arrays indexed
+    by host id; ``materialize(i)`` builds (and caches) the full
+    ``Host`` object for one row, bit-identical to what the object-path
+    build constructs for the same config."""
+
+    def __init__(self, cfg, groups: list[PlaneGroup],
+                 vertex: np.ndarray, bw_down_bits: np.ndarray,
+                 bw_up_bits: np.ndarray, ips: np.ndarray,
+                 starts: StartColumns):
+        self.cfg = cfg
+        self.group_records = groups
+        self.n_hosts = int(vertex.shape[0])
+        self.vertex = vertex                  # [H] int64
+        self.bw_down_bits = bw_down_bits      # [H] int64
+        self.bw_up_bits = bw_up_bits          # [H] int64
+        self.ips = ips                        # [H] int64 (host order)
+        self.starts = starts
+        self.root_seed = int(cfg.general.seed)
+        self.names = PlaneNameMap(groups)
+        self._bases = [g.base_id for g in groups]
+        self._cache: dict[int, Host] = {}
+        # per-host final stats adopted from the device engine
+        # (adopt_final); None until a run completes
+        self._final: Optional[dict] = None
+
+    # -- identity ----------------------------------------------------
+    @property
+    def any_pcap(self) -> bool:
+        return any(g.pcap_directory for g in self.group_records)
+
+    @property
+    def materialized_count(self) -> int:
+        return len(self._cache)
+
+    def group_of(self, host_id: int) -> PlaneGroup:
+        return self.group_records[
+            bisect_right(self._bases, host_id) - 1]
+
+    def name_of(self, host_id: int) -> str:
+        g = self.group_of(host_id)
+        return g.name if g.count == 1 \
+            else f"{g.name}{host_id - g.base_id}"
+
+    # -- lazy materialization ---------------------------------------
+    def materialize(self, host_id: int) -> Host:
+        host = self._cache.get(host_id)
+        if host is not None:
+            return host
+        from shadow_tpu.host.cpu import Cpu
+
+        g = self.group_of(host_id)
+        name = self.name_of(host_id)
+        # the exact object-path construction, row by row: the seed is
+        # the same root.child(f"host:{name}") blake2b derivation, so
+        # any consumer that DOES draw from the host RNG (CPU-policy
+        # backends after a hybrid fallback) sees identical streams
+        host = Host(host_id=host_id, name=name,
+                    vertex=int(self.vertex[host_id]),
+                    bw_down_bits=int(self.bw_down_bits[host_id]),
+                    bw_up_bits=int(self.bw_up_bits[host_id]),
+                    rng=SeededRandom(_derive(self.root_seed,
+                                             f"host:{name}")),
+                    pcap_directory=g.pcap_directory)
+        host.cpu = Cpu()
+        if self.cfg.experimental.model_bandwidth:
+            from shadow_tpu.host.model_nic import ModelNic
+            host.model_nic = ModelNic(host.bw_up_bits,
+                                      host.bw_down_bits)
+        host.address = Address(host_id=host_id, name=name,
+                               ip=int(self.ips[host_id]))
+        host.ip = host.address.ip_str
+        app = make_app(g.path, g.args, host_id, self.n_hosts)
+        factory = (lambda p=g.path, a=g.args, hid=host_id,
+                   n=self.n_hosts: make_app(p, a, hid, n))
+        host.apps.append(app)
+        host.respawn = [(factory, g.start_time, g.stop_time, True)]
+        host.app = app
+        if self._final is not None:
+            self._apply_final(host)
+        self._cache[host_id] = host
+        return host
+
+    # -- final-stats reflection (the runner's post-run seam) ---------
+    def adopt_final(self, final: dict, replica: Optional[int] = None
+                    ) -> None:
+        """Adopt the run's per-host counters as columns (arrays may be
+        padded past n_hosts; ``replica`` selects a row of the
+        ensemble's [R,H] stacks). Already-materialized hosts update in
+        place; later materializations pick the stats up on build —
+        either way ``sim.hosts`` reads the same counters the object
+        path's reflection loop would have written."""
+        cols = {}
+        for src, dst in (("n_exec", "events_executed"),
+                         ("n_sent", "packets_sent"),
+                         ("n_drop", "packets_dropped"),
+                         ("n_deliv", "packets_delivered"),
+                         ("chk", "trace_checksum")):
+            a = np.asarray(final[src])
+            cols[dst] = a[replica] if replica is not None else a
+        self._final = cols
+        for host in self._cache.values():
+            self._apply_final(host)
+
+    def _apply_final(self, host: Host) -> None:
+        i = host.host_id
+        for attr, col in self._final.items():
+            setattr(host, attr, int(col[i]))
+
+
+class LazyHostList:
+    """Sequence view over the plane: ``sim.hosts`` for columnar
+    builds. Indexing/iteration materializes (cached) Host objects, so
+    every existing consumer — gates reading signatures, the hybrid
+    Manager, tooling — works unchanged and pays only for the hosts it
+    actually touches."""
+
+    def __init__(self, plane: HostPlane):
+        self.plane = plane
+
+    def __len__(self) -> int:
+        return self.plane.n_hosts
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        if i < 0:
+            i += len(self)
+        if not 0 <= i < len(self):
+            raise IndexError(i)
+        return self.plane.materialize(i)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self.plane.materialize(i)

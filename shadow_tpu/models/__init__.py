@@ -30,6 +30,15 @@ _REGISTRY = {
 }
 
 
+# models the columnar host plane (host/plane.py) can build without
+# per-host app objects: arg parsing happens once per GROUP (a
+# prototype app) and the device twin's arrays fill from group slices.
+# tor stays out (relay lists + route state want real per-host apps);
+# extension models register here only if their parsed fields are pure
+# functions of the args string (never of host_id).
+COLUMNAR_MODELS = {"phold", "tgen_client", "tgen_server"}
+
+
 def is_model_path(path: str) -> bool:
     return path.startswith("model:")
 
@@ -52,4 +61,5 @@ def register_model(name: str, cls) -> None:
 
 
 __all__ = ["ModelApp", "make_app", "register_model", "is_model_path",
-           "parse_kv_args", "PholdApp", "TgenClientApp", "TgenServerApp"]
+           "parse_kv_args", "COLUMNAR_MODELS",
+           "PholdApp", "TgenClientApp", "TgenServerApp"]

@@ -5,13 +5,33 @@ get a unique virtual IP — assigned sequentially while skipping reserved
 CIDR ranges (dns.c:40-60) — or keep an explicitly requested IP if it is
 valid and free. `write_hosts_file` emits the /etc/hosts-style file that
 managed (real) processes resolve against.
+
+Two registration paths share one allocator contract:
+
+* ``register`` — the scalar path: one name, one Address object, dict
+  entries for every lookup direction.
+* ``register_block`` — the bulk path for model-only host groups
+  (host/plane.py and the object build's model groups): ONE vectorized
+  allocation grants the group's whole IP column and records a compact
+  block (prefix, base id, count, ips) instead of ``count`` dict
+  entries. Addresses materialize lazily on lookup. The block draws
+  exactly the IPs ``count`` scalar calls would have drawn — both take
+  the first assignable addresses at/after ``_next_ip`` in increasing
+  order, then advance past the last grant — so mixing the two paths
+  in one build stays bit-identical to an all-scalar build.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Optional
 
+import numpy as np
+
 from shadow_tpu.routing.address import Address, int_to_ip, ip_to_int
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("dns")
 
 _RESERVED = [
     # (base, mask-bits): loopback, rfc1918, link-local, multicast+
@@ -33,11 +53,33 @@ def _is_reserved(ip: int) -> bool:
     return ip & 0xFF in (0, 255)          # network/broadcast-looking
 
 
+def _reserved_mask(ips: np.ndarray) -> np.ndarray:
+    """Vectorized ``_is_reserved`` over an int64 candidate window."""
+    low = ips & 0xFF
+    m = (low == 0) | (low == 255)
+    for base, bits in _RESERVED:
+        m |= (ips >> (32 - bits)) == (base >> (32 - bits))
+    return m
+
+
+@dataclass
+class _Block:
+    """One bulk-registered host group: names are ``{prefix}{i}`` for
+    i in [0, count), ids are base_id + i, ips[i] is host i's address
+    (strictly increasing — searchsorted resolves reverse lookups)."""
+
+    prefix: str
+    base_id: int
+    count: int
+    ips: np.ndarray
+
+
 class Dns:
     def __init__(self):
         self._by_name: dict[str, Address] = {}
         self._by_ip: dict[int, Address] = {}
         self._by_id: dict[int, Address] = {}
+        self._blocks: list[_Block] = []
         self._next_ip = ip_to_int("11.0.0.1")
 
     def _alloc_ip(self) -> int:
@@ -47,12 +89,55 @@ class Dns:
         self._next_ip = ip + 1
         return ip
 
+    def _alloc_ips(self, n: int) -> np.ndarray:
+        """The first ``n`` assignable IPs at/after ``_next_ip``, in
+        increasing order — provably the sequence ``n`` scalar
+        ``_alloc_ip`` calls produce, vectorized. Block IPs are always
+        below ``_next_ip`` (allocation advances past them), so only
+        explicitly-requested scalar IPs can occupy the window."""
+        parts: list[np.ndarray] = []
+        got = 0
+        nxt = self._next_ip
+        requested = np.array(
+            [ip for ip in self._by_ip if ip >= nxt], dtype=np.int64)
+        while got < n:
+            # window with slack for the reserved skips (2 per /24 in
+            # the unreserved space, plus whole reserved ranges)
+            width = max(4096, (n - got) * 258 // 254 + 512)
+            cand = np.arange(nxt, nxt + width, dtype=np.int64)
+            ok = ~_reserved_mask(cand)
+            if requested.size:
+                ok &= ~np.isin(cand, requested)
+            free = cand[ok][: n - got]
+            parts.append(free)
+            got += free.size
+            nxt += width
+        ips = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        self._next_ip = int(ips[-1]) + 1
+        return ips
+
+    def _block_entry(self, name: str) -> Optional[Address]:
+        for b in self._blocks:
+            if name.startswith(b.prefix):
+                suf = name[len(b.prefix):]
+                # generated names never carry leading zeros
+                if suf.isdigit() and str(int(suf)) == suf \
+                        and int(suf) < b.count:
+                    i = int(suf)
+                    return Address(host_id=b.base_id + i, name=name,
+                                   ip=int(b.ips[i]))
+        return None
+
+    def _ip_in_blocks(self, ip: int) -> bool:
+        for b in self._blocks:
+            j = int(np.searchsorted(b.ips, ip))
+            if j < b.count and int(b.ips[j]) == ip:
+                return True
+        return False
+
     def register(self, host_id: int, name: str,
                  requested_ip: Optional[str] = None) -> Address:
-        from shadow_tpu.utils.slog import get_logger
-        log = get_logger("dns")
-
-        if name in self._by_name:
+        if name in self._by_name or self._block_entry(name) is not None:
             raise ValueError(f"duplicate host name {name!r}")
         ip = None
         if requested_ip:
@@ -62,7 +147,8 @@ class Dns:
                 raise ValueError(
                     f"host {name!r}: invalid ip_address_hint "
                     f"{requested_ip!r}") from None
-            if not _is_reserved(cand) and cand not in self._by_ip:
+            if not _is_reserved(cand) and cand not in self._by_ip \
+                    and not self._ip_in_blocks(cand):
                 ip = cand
             else:
                 log.warning("host %s: requested IP %s is reserved or "
@@ -75,19 +161,76 @@ class Dns:
         self._by_id[host_id] = addr
         return addr
 
+    def register_block(self, base_id: int, prefix: str,
+                       count: int) -> np.ndarray:
+        """Bulk registration for a model-only host group: hosts
+        ``{prefix}0 .. {prefix}{count-1}`` with ids ``base_id ..``
+        get the next ``count`` sequential IPs in one vectorized
+        allocation. Returns the [count] int64 IP column; Address
+        objects materialize lazily on lookup."""
+        if count == 1:
+            # a single-host group's name has no index suffix: the
+            # scalar path is both correct and just as cheap
+            return np.array([self.register(base_id, prefix).ip],
+                            dtype=np.int64)
+        # scalar names are few: parse each against this prefix rather
+        # than probing all `count` generated names
+        risky = any(n.startswith(prefix) for n in self._by_name)
+        for b in self._blocks:
+            if b.prefix == prefix:
+                raise ValueError(f"duplicate host group {prefix!r}")
+            lo, hi = sorted((prefix, b.prefix), key=len)
+            if hi.startswith(lo):
+                # nested prefixes ("web" / "web1") CAN collide
+                # ("web10"); only an exact probe settles it
+                risky = True
+        if risky:
+            for i in range(count):
+                probe = f"{prefix}{i}"
+                if probe in self._by_name or \
+                        self._block_entry(probe) is not None:
+                    raise ValueError(f"duplicate host name {probe!r}")
+        ips = self._alloc_ips(count)
+        self._blocks.append(_Block(prefix=prefix, base_id=base_id,
+                                   count=count, ips=ips))
+        return ips
+
     def resolve_name(self, name: str) -> Optional[Address]:
-        return self._by_name.get(name)
+        addr = self._by_name.get(name)
+        return addr if addr is not None else self._block_entry(name)
 
     def resolve_ip(self, ip) -> Optional[Address]:
         if isinstance(ip, str):
             ip = ip_to_int(ip)
-        return self._by_ip.get(ip)
+        addr = self._by_ip.get(ip)
+        if addr is not None:
+            return addr
+        for b in self._blocks:
+            j = int(np.searchsorted(b.ips, ip))
+            if j < b.count and int(b.ips[j]) == ip:
+                return Address(host_id=b.base_id + j,
+                               name=f"{b.prefix}{j}", ip=ip)
+        return None
 
     def address_of(self, host_id: int) -> Optional[Address]:
-        return self._by_id.get(host_id)
+        addr = self._by_id.get(host_id)
+        if addr is not None:
+            return addr
+        for b in self._blocks:
+            if b.base_id <= host_id < b.base_id + b.count:
+                i = host_id - b.base_id
+                return Address(host_id=host_id,
+                               name=f"{b.prefix}{i}",
+                               ip=int(b.ips[i]))
+        return None
 
     def write_hosts_file(self, path: str) -> None:
+        entries = [(name, addr.ip)
+                   for name, addr in self._by_name.items()]
+        for b in self._blocks:
+            entries.extend((f"{b.prefix}{i}", int(b.ips[i]))
+                           for i in range(b.count))
         with open(path, "w") as f:
             f.write("127.0.0.1 localhost\n")
-            for name, addr in sorted(self._by_name.items()):
-                f.write(f"{int_to_ip(addr.ip)} {name}\n")
+            for name, ip in sorted(entries):
+                f.write(f"{int_to_ip(ip)} {name}\n")
