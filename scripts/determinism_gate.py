@@ -117,6 +117,7 @@ from __future__ import annotations
 
 import argparse
 import filecmp
+import glob
 import os
 import sys
 import tempfile
@@ -1530,6 +1531,177 @@ def run_host_plane_gate(config: str) -> int:
         return rc
 
 
+def run_server_gate(config: str) -> int:
+    """Campaign-server robustness gate (shadow_tpu/serve/), two legs
+    on the forced multi-device mesh:
+
+    1. kill -9 drill: submit two campaigns, run the daemon as a real
+       child process, SIGKILL it once the first rotation checkpoint
+       lands, restart with --idle-exit — journal replay must requeue
+       the mid-flight campaign, BOTH must reach DONE, and every
+       RESULT.json signature must bit-match an uninterrupted
+       standalone run of the same config.
+    2. priority drill: a higher-priority arrival preempts the running
+       campaign through the rc-75 drain; the preempted campaign
+       resumes after it and still bit-matches standalone.
+    """
+    import json as _json
+    import signal as _signal
+    import subprocess
+    import time as _time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def daemon(spool, *extra):
+        return subprocess.Popen(
+            [sys.executable, "-m", "shadow_tpu.serve", "start", spool,
+             "--poll", "0.05", "--log-level", "warning"] + list(extra),
+            env=env, cwd=repo)
+
+    def submit(spool, priority=0):
+        rc = subprocess.run(
+            [sys.executable, "-m", "shadow_tpu.serve", "submit",
+             spool, config, "--priority", str(priority)],
+            env=env, cwd=repo).returncode
+        if rc != 0:
+            raise RuntimeError(f"submit failed (rc {rc})")
+
+    def journal_rows(spool):
+        path = os.path.join(spool, "journal.jsonl")
+        rows = []
+        if os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        rows.append(_json.loads(line))
+                    except ValueError:
+                        pass
+        return rows
+
+    def wait_for(pred, what, timeout_s=900):
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            if pred():
+                return True
+            _time.sleep(0.05)
+        print(f"FAIL: timed out waiting for {what}")
+        return False
+
+    def results(spool, n):
+        out = {}
+        for i in range(n):
+            cid = f"c{i:04d}"
+            path = os.path.join(spool, "campaigns", cid,
+                                "RESULT.json")
+            if not os.path.exists(path):
+                print(f"FAIL: {path} missing")
+                return None
+            with open(path, "r", encoding="utf-8") as f:
+                out[cid] = _json.load(f)
+        return out
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ref_sig, _ = run_once(config, "tpu",
+                              os.path.join(tmp, "ref.data"))
+        ref = [list(s) for s in ref_sig]
+
+        # -- leg 1: SIGKILL mid-campaign, restart, both complete ----
+        spool = os.path.join(tmp, "spool_kill")
+        submit(spool)
+        submit(spool)
+        proc = daemon(spool)
+        ck_glob = os.path.join(spool, "campaigns", "*", "ck.npz.t*")
+        if not wait_for(lambda: glob.glob(ck_glob),
+                        "the first rotation checkpoint"):
+            proc.kill()
+            return 1
+        proc.send_signal(_signal.SIGKILL)   # the crash drill IS kill -9
+        proc.wait()
+        proc = daemon(spool, "--idle-exit")
+        rc = proc.wait(timeout=900)
+        if rc != 0:
+            print(f"FAIL: restarted server exited rc {rc}")
+            return 1
+        res = results(spool, 2)
+        if res is None:
+            return 1
+        starts = sum(1 for r in journal_rows(spool)
+                     if r.get("event") == "server_start")
+        if starts != 2:
+            print(f"FAIL: journal replayed {starts} server starts, "
+                  "want 2 (one per daemon leg)")
+            return 1
+        for cid, r in res.items():
+            if r.get("state") != "DONE":
+                print(f"FAIL: {cid} ended {r.get('state')} "
+                      f"({r.get('diagnostic', '')})")
+                return 1
+            if r.get("signature") != ref:
+                print(f"FAIL: {cid} signature diverges from the "
+                      "standalone run after the kill -9 restart")
+                return 1
+        requeued = any(r.get("state") == "PREEMPTED" and "restart"
+                       in r.get("diagnostic", "")
+                       for r in journal_rows(spool))
+        if not requeued:
+            print("FAIL: journal replay never requeued the "
+                  "mid-flight campaign (the kill missed the RUNNING "
+                  "window — shrink checkpoint cadence)")
+            return 1
+        print(f"server kill -9 drill OK: {config} — 2 campaigns "
+              "DONE across a restart, signatures bit-match "
+              "standalone")
+
+        # -- leg 2: higher priority preempts via the rc-75 drain ----
+        spool = os.path.join(tmp, "spool_prio")
+        submit(spool, priority=0)       # before the daemon, so
+        proc = daemon(spool, "--idle-exit")   # idle-exit cannot race
+        try:
+            if not wait_for(
+                    lambda: any(r.get("cid") == "c0000"
+                                and r.get("state") == "RUNNING"
+                                for r in journal_rows(spool)),
+                    "c0000 to start running"):
+                return 1
+            submit(spool, priority=5)
+            rc = proc.wait(timeout=900)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        if rc != 0:
+            print(f"FAIL: priority-leg server exited rc {rc}")
+            return 1
+        res = results(spool, 2)
+        if res is None:
+            return 1
+        rows = journal_rows(spool)
+        states = [(r.get("cid"), r.get("state"))
+                  for r in rows if r.get("state")]
+        if ("c0000", "PREEMPTED") not in states:
+            print("FAIL: the low-priority campaign was never "
+                  "preempted (the high-priority submission lost the "
+                  "race — grow stop_time)")
+            return 1
+        dones = [cid for cid, s in states if s == "DONE"]
+        if dones and dones[0] != "c0001":
+            print(f"FAIL: completion order {dones} — the "
+                  "high-priority campaign must finish first")
+            return 1
+        for cid, r in res.items():
+            if r.get("state") != "DONE" or r.get("signature") != ref:
+                print(f"FAIL: {cid} ended {r.get('state')} or "
+                      "diverged from standalone after the "
+                      "preempt/resume cycle")
+                return 1
+        print(f"server priority drill OK: {config} — preempted "
+              "campaign resumed bit-identical behind the "
+              "high-priority one")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("config", nargs="?", default="examples/minimal.yaml")
@@ -1611,12 +1783,33 @@ def main() -> int:
                          "runtime, the engine's jaxpr audit must be "
                          "clean, and an in-process audit must leave "
                          "run signatures bit-identical")
+    ap.add_argument("--server", action="store_true",
+                    help="campaign-server gate (shadow_tpu/serve/): "
+                         "kill -9 the daemon mid-campaign and "
+                         "restart — journal replay must complete "
+                         "both campaigns bit-identical to standalone "
+                         "runs; then a priority arrival must preempt "
+                         "and the drained campaign resume "
+                         "bit-identical (needs >= 4 devices)")
     args = ap.parse_args()
 
     default_policy = "serial,tpu" if args.ensemble else "serial"
     policies = [p.strip()
                 for p in (args.policy or default_policy).split(",")
                 if p.strip()]
+
+    if args.server:
+        if args.ensemble or args.preempt or args.policy or \
+                args.compile_cache or args.telemetry or args.tuned \
+                or args.analyze_consistency or args.pipelined or \
+                args.chaos or args.degrade:
+            # the server gate drives whole daemon processes; the
+            # standalone reference runs are baked into its legs
+            print("FAIL: --server does not combine with other gate "
+                  "flags (it runs its own standalone reference plus "
+                  "the kill -9 and priority-preemption daemon legs)")
+            return 1
+        return run_server_gate(args.config)
 
     if args.degrade:
         if args.ensemble or args.preempt or args.policy or \

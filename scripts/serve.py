@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Campaign server launcher — thin wrapper over
+``python -m shadow_tpu.serve`` for checkouts without an installed
+package.
+
+  python scripts/serve.py start  /var/spool/shadow
+  python scripts/serve.py submit /var/spool/shadow run.yaml --priority 5
+  python scripts/serve.py status /var/spool/shadow
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from shadow_tpu.serve.__main__ import main   # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,18 @@ def main(argv=None) -> int:
                  "mean/p5/p95/min/max in the ENSEMBLE record",
                  rec["campaign"], rec["workload"]["replicas"],
                  stats.packets_sent)
+    if stats.stale_heartbeats:
+        # staleness detection (experimental.heartbeat_stale_after):
+        # the run COMPLETED, but some heartbeat gaps blew past the
+        # threshold — under the campaign server the watchdog would
+        # have preempted + requeued; standalone, the operator should
+        # know the run stalled even though it finished
+        log.warning("%d stale heartbeat gap(s) during the run "
+                    "(gaps > %dx the expected cadence) — the run "
+                    "stalled between segment boundaries; see the "
+                    "STALE HEARTBEAT warnings above",
+                    stats.stale_heartbeats,
+                    cfg.experimental.heartbeat_stale_after)
     if stats.preempted:
         # graceful preemption (device/supervise.py): the run is
         # incomplete but resumable — a DISTINCT rc so schedulers can

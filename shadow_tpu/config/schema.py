@@ -619,6 +619,25 @@ class ExperimentalOptions:
     # records). Setting it also makes `summary` mode write its
     # METRICS_*.json (by default only `trace` writes files).
     telemetry_path: str = ""
+    # per-run artifacts DIRECTORY override for every record the run
+    # writes by label/fingerprint-derived name — OCC occupancy
+    # records, ENSEMBLE campaign records, METRICS/TRACE telemetry
+    # ("" = "artifacts", honoring $SHADOW_TPU_OCC_DIR; an explicit
+    # telemetry_path / ensemble.record_path still wins for its own
+    # artifact). This is the multi-tenant namespacing seam: two
+    # concurrent runs of the same workload derive the SAME canonical
+    # filenames, so the campaign server points each tenant at
+    # <spool>/campaigns/<cid>/artifacts and they can never clobber
+    # each other's records.
+    artifacts_dir: str = ""
+    # loud wall-clock staleness detection on the supervise/ensemble
+    # heartbeat cadence (device/supervise.py HeartbeatMonitor): a
+    # gap wider than this many times the expected cadence (EWMA of
+    # healthy gaps) warns loudly and counts into
+    # SimStats.stale_heartbeats; the campaign server's watchdog
+    # polls the same monitor to turn a wedged campaign into a
+    # supervised kill + requeue instead of a wedged slot. 0 = off.
+    heartbeat_stale_after: int = 0
     # telemetry-driven strategy plans (shadow_tpu/tune/,
     # docs/autotune.md): "off" ignores stored plans; "auto" adopts
     # the workload's PLAN_<app>_<H>_<fp>.json record (written by
@@ -709,6 +728,15 @@ class ExperimentalOptions:
             raise ValueError(
                 f"experimental.telemetry_path: {out.telemetry_path!r} "
                 "must be a directory path string")
+        if not isinstance(out.artifacts_dir, str):
+            raise ValueError(
+                f"experimental.artifacts_dir: {out.artifacts_dir!r} "
+                "must be a directory path string")
+        if out.heartbeat_stale_after < 0:
+            raise ValueError(
+                "experimental.heartbeat_stale_after must be >= 0 "
+                "(0 = staleness detection off; k = warn when a "
+                "heartbeat gap exceeds k x the expected cadence)")
         from shadow_tpu.host.tcp import CONGESTION_ALGORITHMS
         _check_choice("experimental", "tcp_congestion",
                       out.tcp_congestion,
@@ -928,9 +956,15 @@ class EnsembleOptions:
     # batches of <= k replicas each and merge the results — pinned
     # bit-identical to the full vmap (each replica's trace is the
     # standalone program's regardless of which batch carries it,
-    # determinism_gate --degrade). Incompatible with campaign
-    # checkpointing (a checkpoint stamps the full-R stacked state,
-    # which a batched campaign never materializes).
+    # determinism_gate --degrade). Combines with supervised
+    # checkpointing via checkpoint_save + checkpoint_every only:
+    # each batch writes its own rotation series
+    # (<save>.b<k>.t<ns>, stamped with the batch's replica window)
+    # and a preempted campaign resumes by replaying completed
+    # batches fresh (pure functions — bit-identical) and loading
+    # the stamped batch's entry. checkpoint_save_time is rejected
+    # (batches replay the full time range, so there is no single
+    # campaign pause point).
     replica_batch: int = 0
 
     @classmethod
@@ -1073,15 +1107,32 @@ class ConfigOptions:
                 "retries fail loudly with the last validated "
                 "checkpoint on disk")
         if ensemble is not None and ensemble.replica_batch and \
-                (out.experimental.checkpoint_save or
-                 out.experimental.checkpoint_load or
-                 out.experimental.checkpoint_every):
+                out.experimental.checkpoint_save_time:
             raise ValueError(
                 "ensemble.replica_batch cannot combine with "
-                "checkpoint_save/checkpoint_load/checkpoint_every: a "
-                "campaign checkpoint stamps the full-R stacked state, "
-                "which a batched campaign never materializes — drop "
-                "replica_batch or the checkpoint knobs")
+                "checkpoint_save_time: every sequential batch replays "
+                "the full time range, so there is no single campaign "
+                "pause point to save at — use checkpoint_every for "
+                "supervised/preemptible batched campaigns")
+        if ensemble is not None and ensemble.replica_batch and \
+                out.experimental.checkpoint_save and \
+                not out.experimental.checkpoint_every:
+            raise ValueError(
+                "ensemble.replica_batch with checkpoint_save needs "
+                "checkpoint_every: a batched campaign never "
+                "materializes the full-R stacked state, so the only "
+                "checkpoints it can write are the per-batch rotation "
+                "entries (<save>.b<k>.t<ns>) the supervised drain "
+                "produces — without checkpoint_every the end-of-run "
+                "save would be silently skipped")
+        if out.experimental.heartbeat_stale_after and \
+                not out.general.heartbeat_interval:
+            raise ValueError(
+                "experimental.heartbeat_stale_after is set but "
+                "general.heartbeat_interval is 0 — staleness is "
+                "measured on the [supervise-heartbeat] boundaries, "
+                "so without a heartbeat cadence the knob would be "
+                "silently ignored")
         return out
 
     def total_hosts(self) -> int:

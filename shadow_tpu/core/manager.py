@@ -138,6 +138,13 @@ class SimStats:
     # reason
     mem_bytes_in_use: int = -1
     mem_budget: int = -1
+    # wall-clock heartbeat gaps that exceeded the configured
+    # staleness threshold (experimental.heartbeat_stale_after x the
+    # expected cadence; device/supervise.py HeartbeatMonitor). A
+    # nonzero count means the run stalled between segment boundaries
+    # — the campaign server's watchdog polls the same monitor live
+    # to turn a wedged campaign into a supervised kill + requeue
+    stale_heartbeats: int = 0
 
     def merge(self, other: "SimStats") -> None:
         self.events_executed += other.events_executed

@@ -66,7 +66,7 @@ from shadow_tpu.utils.slog import get_logger
 log = get_logger("chaos")
 
 KINDS = ("device_loss", "dispatch_error", "checkpoint_corrupt",
-         "cache_store_fail", "oom")
+         "cache_store_fail", "oom", "server_crash")
 
 # transient by default: UNAVAILABLE matches supervise.TRANSIENT_MARKERS
 # so the scripted loss walks the real retry -> escalate ladder
@@ -93,6 +93,7 @@ class ChaosEvent:
     entry: int = -1        # checkpoint_corrupt: rotation save #
     store: int = -1        # cache_store_fail: cache store #
     compile: int = -1      # oom: program compile #
+    tick: int = -1         # server_crash: campaign-server scheduler tick #
 
 
 def event_from_dict(i: int, d: dict) -> ChaosEvent:
@@ -104,7 +105,7 @@ def event_from_dict(i: int, d: dict) -> ChaosEvent:
     if not isinstance(d, dict):
         raise ValueError(f"{section} must be a mapping")
     allowed = {"kind", "segment", "shard", "error", "entry", "store",
-               "compile"}
+               "compile", "tick"}
     unknown = set(d) - allowed
     if unknown:
         raise ValueError(f"unknown key(s) in {section}: "
@@ -118,7 +119,8 @@ def event_from_dict(i: int, d: dict) -> ChaosEvent:
             "dispatch_error": ("segment",),
             "checkpoint_corrupt": ("entry",),
             "cache_store_fail": ("store",),
-            "oom": ()}[kind]
+            "oom": (),
+            "server_crash": ("tick",)}[kind]
     for key in need:
         if d.get(key) is None or int(d[key]) < 0:
             raise ValueError(
@@ -138,9 +140,10 @@ def event_from_dict(i: int, d: dict) -> ChaosEvent:
              "dispatch_error": ("segment", "error"),
              "checkpoint_corrupt": ("entry",),
              "cache_store_fail": ("store",),
-             "oom": ("segment", "compile", "error")}[kind]
+             "oom": ("segment", "compile", "error"),
+             "server_crash": ("tick",)}[kind]
     for key in ("segment", "shard", "entry", "store", "compile",
-                "error"):
+                "tick", "error"):
         if key not in scope and d.get(key) is not None:
             raise ValueError(
                 f"{section}: {key!r} is not valid for {kind}")
@@ -153,6 +156,7 @@ def event_from_dict(i: int, d: dict) -> ChaosEvent:
         entry=int(d.get("entry", -1)),
         store=int(d.get("store", -1)),
         compile=int(d.get("compile", -1)),
+        tick=int(d.get("tick", -1)),
     )
 
 
@@ -194,6 +198,7 @@ class ChaosInjector:
         # rung — then they clear, the way a real OOM clears once the
         # footprint shrinks (on_degrade_rung)
         self._oom_cleared = False
+        self._ticks = 0                # campaign-server scheduler ticks
         self.fired: list = []          # ledger of fired events
 
     # -- dispatch seam (supervise.advance issue half) ------------------
@@ -364,6 +369,27 @@ class ChaosInjector:
                                        "chaos", store=n, key=key)
             log.warning("chaos: cache store %d (key %s) refused by "
                         "schedule", n, key)
+        return hit
+
+    # -- server seam (shadow_tpu/serve/server.py scheduler loop) -------
+    def on_server_tick(self) -> bool:
+        """Count one campaign-server scheduler tick; True = a scripted
+        ``server_crash`` fires here and the server must die the HARD
+        way (its crash_fn defaults to os._exit — no drain, no journal
+        flush beyond what append_line already fsync'd). The drill is
+        the journal's crash-replay contract, not a graceful shutdown:
+        the restarted server must requeue every non-terminal campaign
+        and finish it bit-identical."""
+        with self._lock:
+            n = self._ticks
+            self._ticks += 1
+            hit = any(ev.kind == "server_crash" and ev.tick == n
+                      for ev in self._events)
+            if hit:
+                self.fired.append({"kind": "server_crash", "tick": n})
+        if hit:
+            log.warning("chaos: scripted server crash at scheduler "
+                        "tick %d", n)
         return hit
 
 

@@ -344,6 +344,11 @@ class DeviceRunner:
         # per run() invocation; the shared advance loop reads them
         self.checkpointer = None
         self.guard = None
+        # wall-clock heartbeat staleness monitor (supervise.
+        # HeartbeatMonitor), created per run() when
+        # experimental.heartbeat_stale_after is set; the campaign
+        # server's watchdog polls it cross-thread
+        self.hb_monitor = None
         self.retries = 0
         self.reshards = 0
         # OOM degradation-ladder rungs engaged (supervise.advance
@@ -805,6 +810,8 @@ class DeviceRunner:
         from shadow_tpu.device.supervise import heartbeat_rates
         from shadow_tpu.host.tracker import Tracker
 
+        if self.hb_monitor is not None:
+            self.hb_monitor.beat()
         n_exec = np.asarray(state["n_exec"])
         n_sent = np.asarray(state["n_sent"])
         n_drop = np.asarray(state["n_drop"])
@@ -932,6 +939,9 @@ class DeviceRunner:
                 extra_meta=self._ck_extra_meta,
                 audit_enabled=xp.state_audit)
         self.guard = supervise.make_guard(self.sim.cfg)
+        self.hb_monitor = (
+            supervise.HeartbeatMonitor(xp.heartbeat_stale_after)
+            if getattr(xp, "heartbeat_stale_after", 0) else None)
         import contextlib
         t0 = _time.perf_counter()
         # shared segmented advance (supervise.advance): heartbeat /
@@ -1026,7 +1036,9 @@ class DeviceRunner:
                 log.info("occupancy record not written (run "
                          "preempted)")
             else:
-                path = capacity.record_path(self.engine)
+                path = capacity.record_path(
+                    self.engine,
+                    directory=getattr(xp, "artifacts_dir", ""))
                 try:
                     capacity.save_record(self.occ_record, path)
                     log.info("occupancy record -> %s", path)
@@ -1055,6 +1067,8 @@ class DeviceRunner:
             stats.mem_bytes_in_use, stats.mem_budget = mem
         stats.preempted = adv.preempted
         stats.resume_path = adv.resume_path
+        if self.hb_monitor is not None:
+            stats.stale_heartbeats = self.hb_monitor.stale_events
         # segment-pipeline telemetry (supervise.advance): depth,
         # issue/drain counts, sync wall, and the overlap the depth
         # bought — bench stamps it and trace_report prints the

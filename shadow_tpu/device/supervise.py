@@ -317,6 +317,76 @@ def heartbeat_rates(mark, sent_totals):
     return (wall, [float(s) for s in sent_totals]), rates
 
 
+class HeartbeatMonitor:
+    """Wall-clock staleness detector on the heartbeat cadence
+    (``experimental.heartbeat_stale_after`` = k; both runners own one
+    per run when the knob is set). The runner calls :meth:`beat` at
+    every ``[supervise-heartbeat]`` / ``[ensemble-heartbeat]``
+    boundary; the expected cadence is an EWMA of the healthy gaps, and
+    a gap wider than k times it is counted in ``stale_events`` with a
+    loud warning — SimStats.stale_heartbeats surfaces the count.
+
+    :meth:`stale` is the live probe the campaign server's watchdog
+    polls from ITS thread: a wedged device step never reaches the next
+    beat(), so only an outside observer can watch the current gap grow
+    past the threshold. All state is lock-protected for exactly that
+    cross-thread read. The clock is injectable (frozen-clock unit
+    tests drive the gap arithmetic without sleeping)."""
+
+    def __init__(self, k: int, clock=time.monotonic):
+        # k < 2 would flag ordinary cadence jitter (a segment that
+        # runs 1.3x the EWMA is normal); clamp rather than refuse so
+        # a config's `1` means "as sensitive as is sane"
+        self.k = max(2, int(k))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last = None     # wall of the previous beat
+        self._expect = None   # EWMA of healthy gaps, seconds
+        self.stale_events = 0
+
+    def beat(self) -> None:
+        """Record one heartbeat boundary; warn + count when the gap
+        since the previous one exceeded k x the expected cadence. A
+        stale gap is NOT folded into the EWMA — the expectation keeps
+        tracking the healthy cadence, so one stall cannot raise the
+        bar for detecting the next."""
+        now = self._clock()
+        with self._lock:
+            if self._last is not None:
+                gap = max(now - self._last, 1e-9)
+                if self._expect is None:
+                    self._expect = gap
+                elif gap > self.k * self._expect:
+                    self.stale_events += 1
+                    log.warning(
+                        "STALE HEARTBEAT: %.2fs since the previous "
+                        "heartbeat — %.1fx the expected %.2fs cadence "
+                        "(threshold %dx); the run stalled between "
+                        "segment boundaries (%d stale gap(s) so far)",
+                        gap, gap / self._expect, self._expect,
+                        self.k, self.stale_events)
+                else:
+                    self._expect = 0.5 * self._expect + 0.5 * gap
+            self._last = now
+
+    def gap(self) -> float:
+        """Seconds since the last beat (0.0 before the first)."""
+        with self._lock:
+            return (0.0 if self._last is None
+                    else max(0.0, self._clock() - self._last))
+
+    def stale(self) -> bool:
+        """Live cross-thread probe: is the CURRENT gap already past
+        the threshold? False until two beats have established a
+        cadence — a watchdog must not kill a run that is still
+        compiling its first program."""
+        with self._lock:
+            if self._last is None or self._expect is None:
+                return False
+            return (self._clock() - self._last) > \
+                self.k * self._expect
+
+
 def prefetch_programs(runner, ensemble: bool = False) -> None:
     """Cache-aware prefetch (the PR 6 ROADMAP leftover): when a
     capacity plan, a strategy plan, or a re-plan has just named the

@@ -133,6 +133,11 @@ class EnsembleRunner:
         # runs refuse them
         self.checkpointer = None
         self.guard = None
+        # wall-clock heartbeat staleness monitor (supervise.
+        # HeartbeatMonitor), created per run() when
+        # experimental.heartbeat_stale_after is set; the campaign
+        # server's watchdog polls it cross-thread
+        self.hb_monitor = None
         self._ck_extra_meta = {"campaign": self.worlds.campaign_fp,
                                "replicas": int(self.worlds.R)}
         # flight recorder (shadow_tpu/obs): attached by the
@@ -332,6 +337,10 @@ class EnsembleRunner:
         replica is visible from the log stream alone."""
         from shadow_tpu.device.supervise import heartbeat_rates
 
+        # getattr: obs tests drive this method on a bare stub runner
+        mon = getattr(self, "hb_monitor", None)
+        if mon is not None:
+            mon.beat()
         H = len(self.sim.hosts)
         n_exec = np.asarray(jax.device_get(states["n_exec"]))[:, :H]
         n_sent = np.asarray(jax.device_get(states["n_sent"]))[:, :H]
@@ -361,13 +370,16 @@ class EnsembleRunner:
     # ------------------------------------------------------------------
     def record_path(self) -> str:
         """Canonical campaign record path (ensemble.record_path
-        overrides; SHADOW_TPU_OCC_DIR redirects the artifacts dir —
-        the same env tests already use to keep runs out of the
-        repo)."""
+        overrides; experimental.artifacts_dir namespaces the
+        directory — the campaign server's per-tenant seam;
+        SHADOW_TPU_OCC_DIR redirects the default artifacts dir, the
+        same env tests already use to keep runs out of the repo)."""
         eopts = self.sim.cfg.ensemble
         if eopts.record_path:
             return eopts.record_path
-        directory = os.environ.get("SHADOW_TPU_OCC_DIR", "artifacts")
+        directory = (
+            getattr(self.sim.cfg.experimental, "artifacts_dir", "")
+            or os.environ.get("SHADOW_TPU_OCC_DIR", "artifacts"))
         return os.path.join(
             directory,
             f"ENSEMBLE_{type(self.app).__name__}"
@@ -425,7 +437,7 @@ class EnsembleRunner:
 
     # ------------------------------------------------------------------
     def _run_batched(self, t_start: int, pause: int, stop: int,
-                     batch: int, tracer):
+                     batch: int, tracer, resume=None):
         """Sequential replica batches: vmap over <= ``batch`` replicas
         at a time, then merge the per-batch host-side finals over the
         replica axis. Bit-identical to the full-R vmap — each
@@ -438,10 +450,23 @@ class EnsembleRunner:
         ``(merged_final, combined AdvanceResult, per-replica
         rounds)``; the merged final is host-side (the point is never
         holding all R replicas of device state at once), which the
-        downstream record/stats path consumes unchanged."""
-        from shadow_tpu.device import supervise
+        downstream record/stats path consumes unchanged.
+
+        Supervision: with ``checkpoint_every`` set each batch writes
+        its OWN rotation series (``<save>.b<k>.t<ns>``, stamped with
+        the batch's replica window) — every batch restarts sim time
+        at 0, so a shared base would collide and cross-prune. A
+        preemption drain saves the running batch's entry and stops
+        the loop; the completed batches' finals are DISCARDED, and
+        ``merged_final`` comes back None. ``resume=(path,
+        replica_lo)`` replays batches before the stamped one fresh
+        from t=0 (pure functions — bit-identical), loads the stamped
+        batch from its entry, and runs the rest fresh, so the
+        resumed campaign's record equals the uninterrupted one."""
+        from shadow_tpu.device import checkpoint, supervise
         from shadow_tpu.ensemble import spec
 
+        xp = self.sim.cfg.experimental
         w_full = self.worlds
         R = int(w_full.R)
         batch = max(1, min(int(batch), R))
@@ -455,7 +480,9 @@ class EnsembleRunner:
         # re-trigger (an OOM inside a batch walks the next rung)
         self._replica_batchable = 0
         heaps = ("ht", "hk", "hm", "hv", "hw")
+        b_resume = int(resume[1]) // batch if resume is not None else -1
         engine_full, finals, rounds_parts = self.engine, [], []
+        ck_full = self.checkpointer
         combined = supervise.AdvanceResult()
         try:
             for b in range(n_batches):
@@ -466,21 +493,41 @@ class EnsembleRunner:
                 # per-replica heartbeat rate vectors change length
                 # across batches — a stale mark would mis-zip
                 self._hb_mark = None
+                if xp.checkpoint_every:
+                    self.checkpointer = supervise.Checkpointer(
+                        f"{xp.checkpoint_save}.b{b}",
+                        xp.checkpoint_every, xp.checkpoint_keep,
+                        final_stop=stop,
+                        extra_meta={**self._ck_extra_meta,
+                                    "replica_lo": lo,
+                                    "replica_hi": hi,
+                                    "replica_batch": batch},
+                        audit_enabled=xp.state_audit)
                 with tracer.span("replica_batch", "host",
                                  sim_t0=t_start, lo=lo, hi=hi,
                                  batch_index=b):
                     self.engine = self._build_engine()
                     supervise.prefetch_programs(self, ensemble=True)
-                    states = self.engine.init_ensemble_state(
-                        self.sim.starts)
+                    if b == b_resume:
+                        states, t0 = checkpoint.load_state(
+                            self.engine, self.sim.starts, resume[0],
+                            final_stop=stop,
+                            template=self.engine.init_ensemble_state(
+                                self.sim.starts))
+                        log.info("resumed replica batch %d "
+                                 "(replicas [%d, %d)) from %s at "
+                                 "t=%d ns", b, lo, hi, resume[0], t0)
+                    else:
+                        states = self.engine.init_ensemble_state(
+                            self.sim.starts)
+                        t0 = t_start
                     states, adv = supervise.advance(
-                        self, states, t_start, pause, stop,
+                        self, states, t0, pause, stop,
                         ensemble=True)
-                    finals.append(jax.device_get(
-                        {k: v for k, v in states.items()
-                         if k not in heaps}))
-                rounds_parts.append(np.broadcast_to(
-                    np.asarray(adv.rounds), (hi - lo,)).copy())
+                    if not adv.preempted:
+                        finals.append(jax.device_get(
+                            {k: v for k, v in states.items()
+                             if k not in heaps}))
                 combined.t_end = adv.t_end
                 combined.retries += adv.retries
                 combined.reshards += adv.reshards
@@ -488,20 +535,38 @@ class EnsembleRunner:
                 combined.budget_hit |= adv.budget_hit
                 combined.overflowed |= adv.overflowed
                 combined.pipeline = adv.pipeline
+                if adv.preempted:
+                    # the drain already saved THIS batch's rotation
+                    # entry; stop the loop — later batches never
+                    # started, and the completed ones replay
+                    # bit-identically on resume (pure functions of
+                    # their world slices)
+                    combined.preempted = True
+                    combined.resume_path = adv.resume_path
+                    break
+                rounds_parts.append(np.broadcast_to(
+                    np.asarray(adv.rounds), (hi - lo,)).copy())
         finally:
             self.worlds = w_full
             self._replica_offset = 0
             self.engine = engine_full
-        merged = {k: np.concatenate([f[k] for f in finals], axis=0)
-                  for k in finals[0]}
-        rounds_r = np.concatenate(rounds_parts)
-        combined.rounds = np.int64(rounds_r.max())
+            self.checkpointer = ck_full
         pl = dict(combined.pipeline or {})
         pl["replica_batches"] = int(n_batches)
         pl["replica_batch"] = int(batch)
         combined.pipeline = pl
         if isinstance(self.admission, dict):
             self.admission["replica_batch"] = int(batch)
+        if combined.preempted:
+            rounds_r = (np.concatenate(rounds_parts)
+                        if rounds_parts else np.zeros(0, np.int64))
+            combined.rounds = np.int64(
+                rounds_r.max() if rounds_r.size else 0)
+            return None, combined, rounds_r
+        merged = {k: np.concatenate([f[k] for f in finals], axis=0)
+                  for k in finals[0]}
+        rounds_r = np.concatenate(rounds_parts)
+        combined.rounds = np.int64(rounds_r.max())
         return merged, combined, rounds_r
 
     # ------------------------------------------------------------------
@@ -521,12 +586,16 @@ class EnsembleRunner:
         w = self.worlds
         if xp.checkpoint_save:
             checkpoint.probe_writable(xp.checkpoint_save)
+        eopts = self.sim.cfg.ensemble
+        knob_batch = int(getattr(eopts, "replica_batch", 0) or 0)
         load_path = ""
+        resume_batch = None
         if xp.checkpoint_load:
             load_path = supervise.resolve_checkpoint(
                 xp.checkpoint_load)
             meta = checkpoint.peek_meta(load_path)
-            camp = (meta.get("ensemble") or {}).get("campaign")
+            ens_meta = meta.get("ensemble") or {}
+            camp = ens_meta.get("campaign")
             if camp is None:
                 raise ValueError(
                     f"checkpoint {load_path} was saved by a "
@@ -538,6 +607,30 @@ class EnsembleRunner:
                     f"campaign {camp}; this config builds "
                     f"{w.campaign_fp} — the vary block or schedules "
                     "changed, so the saved replicas would diverge")
+            saved_lo = ens_meta.get("replica_lo")
+            if saved_lo is not None:
+                # a replica-batch rotation entry: it stamps ONE
+                # batch's sliced state, so only a campaign batched
+                # the same way can place it
+                saved_batch = int(ens_meta.get("replica_batch") or 0)
+                if knob_batch != saved_batch:
+                    have = (f"uses replica_batch: {knob_batch}"
+                            if knob_batch else
+                            "expects the full-R stacked state")
+                    raise ValueError(
+                        f"checkpoint {load_path} was saved by "
+                        f"replica batch [{saved_lo}, "
+                        f"{ens_meta.get('replica_hi')}) of a "
+                        f"replica_batch={saved_batch} campaign — "
+                        f"set ensemble.replica_batch: {saved_batch} "
+                        f"to resume it (this config {have})")
+                resume_batch = (load_path, int(saved_lo))
+            elif knob_batch:
+                raise ValueError(
+                    f"checkpoint {load_path} stamps the full-R "
+                    "stacked state — a replica_batch campaign "
+                    "cannot resume it (drop ensemble.replica_batch "
+                    "or resume without the checkpoint)")
             checkpoint.prevalidate_resume(
                 load_path, stop,
                 save_path=xp.checkpoint_save,
@@ -554,8 +647,7 @@ class EnsembleRunner:
         # the capacity warm-up below would trigger). strict refuses
         # over-budget here; auto may statically degrade the pipeline
         # depth or pre-split the sweep into replica batches.
-        eopts = self.sim.cfg.ensemble
-        batch = int(getattr(eopts, "replica_batch", 0) or 0)
+        batch = knob_batch
         ck_on = bool(xp.checkpoint_save or xp.checkpoint_load
                      or xp.checkpoint_every)
         can_batch = w.R > 1 and not batch and not ck_on
@@ -567,16 +659,24 @@ class EnsembleRunner:
         if not batch and adm_ov.get("replica_batch"):
             batch = int(adm_ov["replica_batch"])
         # the OOM ladder may still degrade an unbatched campaign at
-        # runtime (supervise.DegradeToReplicaBatch); checkpointed
-        # campaigns cannot batch — the checkpoint stamps the full-R
-        # stacked state (schema.py enforces the same for the knob)
+        # runtime (supervise.DegradeToReplicaBatch); a checkpointed
+        # unbatched campaign stays unbatched — its checkpoints stamp
+        # the full-R stacked state, which a mid-run batch switch
+        # would orphan (explicit ensemble.replica_batch opts into
+        # per-batch rotation series instead)
         self._replica_batchable = (max(1, w.R // 2)
                                    if can_batch and not batch else 0)
         if xp.capacity_plan != "static" and not self._planned:
             with tracer.span("capacity.plan", "plan",
                              mode=xp.capacity_plan, ensemble=True):
                 self._plan_capacities(stop, load_path=load_path)
-        if load_path:
+        if batch:
+            # the whole point of batching is never materializing the
+            # full-R state — _run_batched inits (or loads) each
+            # batch's slice itself
+            states = None
+            t_start = 0
+        elif load_path:
             with tracer.span("checkpoint.load", "checkpoint",
                              path=load_path):
                 states, t_start = checkpoint.load_state(
@@ -598,13 +698,19 @@ class EnsembleRunner:
                     f"checkpoint_save_time {pause} ns is not after "
                     f"the campaign's start time {t_start} ns")
         self.checkpointer = None
-        if xp.checkpoint_every:
+        if xp.checkpoint_every and not batch:
+            # batched campaigns rotate per-batch checkpointers inside
+            # _run_batched (each batch restarts sim time at 0, so one
+            # shared base would collide and cross-prune)
             self.checkpointer = supervise.Checkpointer(
                 xp.checkpoint_save, xp.checkpoint_every,
                 xp.checkpoint_keep, final_stop=stop,
                 extra_meta=self._ck_extra_meta,
                 audit_enabled=xp.state_audit)
         self.guard = supervise.make_guard(self.sim.cfg)
+        self.hb_monitor = (
+            supervise.HeartbeatMonitor(xp.heartbeat_stale_after)
+            if getattr(xp, "heartbeat_stale_after", 0) else None)
         import contextlib
         t0 = time.perf_counter()
         rounds_r = None
@@ -612,7 +718,8 @@ class EnsembleRunner:
               else contextlib.nullcontext()):
             if batch:
                 states, adv, rounds_r = self._run_batched(
-                    t_start, pause, stop, batch, tracer)
+                    t_start, pause, stop, batch, tracer,
+                    resume=resume_batch)
             else:
                 try:
                     states, adv = supervise.advance(
@@ -628,6 +735,33 @@ class EnsembleRunner:
                     states, adv, rounds_r = self._run_batched(
                         t_start, pause, stop, batch, tracer)
                     adv.degrades += 1   # the rung that engaged it
+        if states is None:
+            # batched campaign preempted mid-batch: there is no
+            # merged final to record (and the completed batches'
+            # finals were discarded — the resume replays them
+            # bit-identically); surface the resumable outcome the
+            # way a standalone preempted run does
+            self.retries = adv.retries
+            self.degrades = adv.degrades
+            stats = SimStats()
+            stats.end_time = adv.t_end
+            stats.rounds = int(np.asarray(adv.rounds).max())
+            stats.strategy_plan = self._base.strategy_plan
+            if self.aot_cache is not None:
+                self.aot_cache.publish(stats)
+            stats.replans = self.replans
+            stats.retries = adv.retries
+            stats.reshards = adv.reshards
+            stats.degrades = adv.degrades
+            stats.admission = self.admission
+            stats.preempted = True
+            stats.resume_path = adv.resume_path
+            stats.pipeline = adv.pipeline or None
+            if self.hb_monitor is not None:
+                stats.stale_heartbeats = self.hb_monitor.stale_events
+            log.info("ensemble record not written (batched campaign "
+                     "preempted; resume from %s)", adv.resume_path)
+            return stats
         if rounds_r is None:
             rounds_r = np.broadcast_to(np.asarray(adv.rounds),
                                        (self.worlds.R,))
@@ -635,7 +769,18 @@ class EnsembleRunner:
         budget_hit, overflowed = adv.budget_hit, adv.overflowed
         self.retries = adv.retries
         rounds = int(np.asarray(rounds_r).max())
-        if xp.checkpoint_save:
+        if xp.checkpoint_save and batch:
+            # the merged final is host-side and heap-less — there is
+            # no full-R stacked device state to save; the per-batch
+            # rotation entries written during the run are the
+            # campaign's checkpoints (schema.py requires
+            # checkpoint_every alongside replica_batch+save for
+            # exactly this reason)
+            log.info("end-of-run campaign checkpoint skipped "
+                     "(replica_batch: the rotation entries "
+                     "%s.b<k>.t<ns> are the resumable artifacts)",
+                     xp.checkpoint_save)
+        elif xp.checkpoint_save:
             if budget_hit or overflowed:
                 log.error("%s before the checkpoint boundary — NOT "
                           "saving %s",
@@ -734,6 +879,8 @@ class EnsembleRunner:
             stats.mem_bytes_in_use, stats.mem_budget = mem
         stats.preempted = adv.preempted
         stats.resume_path = adv.resume_path
+        if self.hb_monitor is not None:
+            stats.stale_heartbeats = self.hb_monitor.stale_events
         # campaigns ride the same segment pipeline as standalone runs
         # (supervise.advance is shared) — report its telemetry too
         stats.pipeline = adv.pipeline or None

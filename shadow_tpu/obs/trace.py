@@ -77,7 +77,7 @@ MODES = ("off", "summary", "trace")
 PHASES = ("host", "judge", "dispatch", "dispatch.issue",
           "dispatch.sync", "exchange", "checkpoint",
           "retry", "compile", "plan", "reshard", "chaos",
-          "failover", "degrade")
+          "failover", "degrade", "serve")
 
 # recent-span ring size: what a watchdog stall dump embeds so a hang
 # report shows what the run WAS doing, not just where it stopped
@@ -461,5 +461,11 @@ def resolve_tracer(cfg, n_hosts: int = 0):
     if xp.telemetry == "off":
         return NullTracer()
     label = f"{xp.scheduler_policy}_{n_hosts}"
-    return Tracer(mode=xp.telemetry, directory=xp.telemetry_path,
+    # artifacts_dir is the per-tenant namespacing seam (the campaign
+    # server points it at <spool>/campaigns/<cid>/artifacts): an
+    # explicit telemetry_path still wins, but a namespaced run lands
+    # its METRICS/TRACE records inside its own directory instead of
+    # racing other tenants on the shared label-keyed filenames
+    directory = xp.telemetry_path or getattr(xp, "artifacts_dir", "")
+    return Tracer(mode=xp.telemetry, directory=directory,
                   label=label)

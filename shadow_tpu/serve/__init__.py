@@ -1,0 +1,29 @@
+"""Campaign server: a resident, multi-tenant simulation service.
+
+The production-scale framing from ROADMAP.md — a long-lived daemon
+that keeps the device mesh, AOT compile cache, and strategy plans
+warm in ONE process and serves a stream of campaign submissions,
+mirroring the paper's layer-2 controller/manager split. The package
+splits along its two durability boundaries:
+
+* :mod:`shadow_tpu.serve.journal` — the crash-safe submission
+  journal: every campaign state transition is a durably-appended
+  JSONL record (utils/artifacts.append_line), and restart replay
+  reconstructs the exact queue the dead server held.
+* :mod:`shadow_tpu.serve.server` — the scheduler/watchdog loop:
+  priority admission through the existing verdict machinery,
+  preempt-to-checkpoint reclaim for higher-priority arrivals (the
+  rc-75 drain contract), stale-heartbeat supervised kills, and the
+  chaos ``server_crash`` drill seam.
+
+``python -m shadow_tpu.serve`` (or scripts/serve.py) is the CLI:
+``start`` runs the daemon, ``submit`` drops a campaign into the
+spool, ``status`` prints the journal's view.
+"""
+
+from shadow_tpu.serve.journal import (Campaign, Journal, RUNNABLE,
+                                      STATES, TERMINAL)
+from shadow_tpu.serve.server import CampaignServer, submit
+
+__all__ = ["Campaign", "CampaignServer", "Journal", "RUNNABLE",
+           "STATES", "TERMINAL", "submit"]

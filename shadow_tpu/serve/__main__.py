@@ -1,0 +1,122 @@
+"""Campaign server CLI: ``python -m shadow_tpu.serve <cmd>``.
+
+Three verbs against one spool directory:
+
+* ``start SPOOL`` — run the resident daemon (journal replay first, so
+  restarting after a crash resumes every mid-flight campaign).
+* ``submit SPOOL CONFIG`` — drop a campaign into the spool (atomic;
+  needs no running server — the spool IS the queue).
+* ``status SPOOL`` — print the journal's replayed view of every
+  campaign, newest state per id.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from shadow_tpu.utils import slog
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="shadow-tpu-serve",
+        description="resident multi-tenant campaign server")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    st = sub.add_parser("start", help="run the daemon")
+    st.add_argument("spool", help="spool directory (journal + queue)")
+    st.add_argument("--poll", type=float, default=0.2, metavar="S",
+                    help="scheduler tick interval, seconds")
+    st.add_argument("--checkpoint-every", default="", metavar="TIME",
+                    help="rotation cadence forced onto campaigns that "
+                         "did not set one (e.g. 100ms); default "
+                         "stop_time/8")
+    st.add_argument("--stale-after", type=int, default=4, metavar="K",
+                    help="heartbeat gaps > K x the expected cadence "
+                         "count as stale (campaigns with "
+                         "general.heartbeat_interval set)")
+    st.add_argument("--watchdog-grace", type=float, default=30.0,
+                    metavar="S",
+                    help="seconds a stale campaign gets to drain "
+                         "before the supervised kill + requeue")
+    st.add_argument("--idle-exit", action="store_true",
+                    help="exit once the queue is drained (batch mode "
+                         "— the gate's restart leg uses this)")
+    st.add_argument("--chaos", default="", metavar="JSON",
+                    help="scripted server chaos, e.g. "
+                         "'[{\"kind\": \"server_crash\", \"tick\": "
+                         "40}]'")
+    st.add_argument("--log-level", default="info",
+                    choices=["error", "warning", "info", "debug",
+                             "trace"])
+
+    sb = sub.add_parser("submit", help="queue a campaign")
+    sb.add_argument("spool")
+    sb.add_argument("config", help="simulation config (YAML)")
+    sb.add_argument("--priority", type=int, default=0,
+                    help="higher preempts lower (rc-75 drain)")
+    sb.add_argument("-o", "--option", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="config override, e.g. -o general.seed=7")
+
+    ss = sub.add_parser("status", help="print the journal's view")
+    ss.add_argument("spool")
+    ss.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "submit":
+        from shadow_tpu.serve.server import submit
+        name = submit(args.spool, args.config,
+                      priority=args.priority, overrides=args.option)
+        print(f"submitted {args.config} -> {args.spool}/incoming/"
+              f"{name}")
+        return 0
+
+    if args.cmd == "status":
+        from shadow_tpu.serve.journal import Journal
+        campaigns, meta = Journal(args.spool).replay()
+        if args.json:
+            json.dump({"campaigns": {c.cid: vars(c) for c in
+                                     campaigns.values()},
+                       "meta": meta}, sys.stdout, indent=2,
+                      default=str)
+            print()
+            return 0
+        print(f"{'cid':8} {'state':10} {'prio':>4} {'att':>3} "
+              f"{'pre':>3} config")
+        for c in sorted(campaigns.values(), key=lambda c: c.seq):
+            print(f"{c.cid:8} {c.state:10} {c.priority:>4} "
+                  f"{c.attempts:>3} {c.preemptions:>3} {c.config}")
+            if c.diagnostic:
+                print(f"{'':8} {c.diagnostic}")
+        print(f"-- {len(campaigns)} campaign(s), "
+              f"{meta['server_starts']} server start(s), "
+              f"{meta['torn_lines']} torn line(s)")
+        return 0
+
+    # start
+    slog.init_logging(args.log_level)
+    chaos = None
+    if args.chaos:
+        from shadow_tpu.device.chaos import (ChaosInjector,
+                                             events_from_config)
+        chaos = ChaosInjector(events_from_config(
+            json.loads(args.chaos)))
+    every = 0
+    if args.checkpoint_every:
+        from shadow_tpu.config.schema import parse_time_ns
+        every = parse_time_ns(args.checkpoint_every)
+    from shadow_tpu.serve.server import CampaignServer
+    server = CampaignServer(
+        args.spool, poll_s=args.poll, checkpoint_every=every,
+        stale_after=args.stale_after,
+        watchdog_grace_s=args.watchdog_grace, chaos=chaos)
+    return server.serve(idle_exit=args.idle_exit)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,186 @@
+"""The campaign server's durable submission journal.
+
+Every state transition a campaign takes — QUEUED -> ADMITTED ->
+RUNNING -> PREEMPTED -> DONE/FAILED/REFUSED — is one JSON line
+appended durably (O_APPEND + fsync, utils/artifacts.append_line) to
+``<spool>/journal.jsonl``. The journal is the server's ONLY source
+of truth across restarts: a SIGKILL can tear at most the final line,
+so :meth:`Journal.replay` reconstructs the exact campaign table the
+dead server held — last state wins per campaign id — and the server
+requeues every non-terminal campaign from its newest readable
+rotation checkpoint (the kill -9 drill in determinism_gate
+--server).
+
+Why a JSONL journal and not a rewritten state file: a state file
+needs read-modify-write, and the window between the read and the
+replace is exactly where a crash loses a transition. An append-only
+journal has no such window — the transition either reached the disk
+(replay sees it) or it did not (the campaign replays from its
+previous state, which is always safe: re-running an ADMITTED
+campaign or re-resuming a PREEMPTED one is idempotent by the
+bit-identical resume contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from shadow_tpu.utils.artifacts import append_line
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("serve")
+
+JOURNAL_FORMAT = 1
+
+# the campaign lifecycle. ADMITTED marks slot assignment (the server
+# picked the campaign and is building its Controller); the in-run
+# admission verdict can still refuse it (-> REFUSED with the readable
+# diagnostic). PREEMPTED campaigns are schedulable again — they carry
+# the resume checkpoint the drain saved.
+STATES = ("QUEUED", "ADMITTED", "RUNNING", "PREEMPTED", "DONE",
+          "FAILED", "REFUSED")
+TERMINAL = ("DONE", "FAILED", "REFUSED")
+RUNNABLE = ("QUEUED", "PREEMPTED")
+
+# transition fields replay copies onto the campaign when present;
+# everything else in a record is provenance for the operator
+_REPLAY_FIELDS = ("config", "priority", "seq", "overrides",
+                  "resume_path", "diagnostic", "attempts",
+                  "preemptions", "submitted_wall", "sub")
+
+
+@dataclass
+class Campaign:
+    """One submission's live state (the replayable projection of its
+    journal lines)."""
+
+    cid: str
+    config: str = ""
+    priority: int = 0
+    seq: int = 0                 # submission order (scheduler FIFO tiebreak)
+    state: str = "QUEUED"
+    resume_path: str = ""        # newest resume checkpoint, "" = fresh
+    diagnostic: str = ""         # readable reason for FAILED/REFUSED/requeue
+    attempts: int = 0            # RUNNING launches (1 = never disturbed)
+    preemptions: int = 0         # drains absorbed (priority or watchdog)
+    submitted_wall: float = 0.0  # unix time of the QUEUED record
+    sub: str = ""                # incoming/ file name (rescan dedupe)
+    overrides: list = field(default_factory=list)
+
+
+class Journal:
+    """Append/replay access to one spool's ``journal.jsonl``."""
+
+    def __init__(self, spool: str):
+        self.spool = os.path.abspath(spool)
+        self.path = os.path.join(self.spool, "journal.jsonl")
+
+    # -- append --------------------------------------------------------
+    def _heal_tail(self) -> None:
+        """A kill mid-append can leave the file without a trailing
+        newline (the torn crash frontier). The NEXT append must not
+        concatenate onto that fragment — it would merge two records
+        into one unparseable line and lose the new transition — so
+        every append terminates a torn tail first (appends are rare
+        state transitions; one seek per append is free)."""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                if f.tell() == 0:
+                    return
+                f.seek(-1, os.SEEK_END)
+                torn = f.read(1) != b"\n"
+        except OSError:
+            return
+        if torn:
+            # terminate the fragment, then stamp a marker so replay
+            # can tell this tear was a healed crash frontier, not a
+            # hand-edit mid-file
+            append_line(self.path, "")
+            append_line(self.path, json.dumps(
+                {"format": JOURNAL_FORMAT,
+                 "event": "torn_tail_healed"}, sort_keys=True))
+            log.warning("journal: %s had a torn tail — terminated it "
+                        "before appending", self.path)
+
+    def append(self, record: dict) -> None:
+        self._heal_tail()
+        append_line(self.path,
+                    json.dumps({"format": JOURNAL_FORMAT, **record},
+                               sort_keys=True))
+
+    def transition(self, cid: str, state: str, **fields) -> None:
+        """Durably journal one campaign state transition."""
+        if state not in STATES:
+            raise ValueError(f"unknown campaign state {state!r} "
+                             f"(one of {list(STATES)})")
+        self.append({"cid": cid, "state": state, **fields})
+
+    def server_event(self, event: str, **fields) -> None:
+        """Journal a server lifecycle line (server_start/server_stop/
+        preempt_request/...) — provenance, not campaign state."""
+        self.append({"event": event, **fields})
+
+    # -- replay --------------------------------------------------------
+    def replay(self) -> tuple[dict, dict]:
+        """Reconstruct the campaign table: ``{cid: Campaign}`` with
+        last-state-wins per cid, plus a meta dict (server_starts,
+        torn_lines, events). Exactly ONE torn trailing line is the
+        expected crash frontier; a torn line mid-journal means
+        something other than our append wrote here, and is warned
+        loudly but still skipped (the lines around it are intact by
+        the append contract)."""
+        campaigns: dict = {}
+        meta = {"server_starts": 0, "torn_lines": 0, "events": []}
+        if not os.path.exists(self.path):
+            return campaigns, meta
+        with open(self.path, "r", encoding="utf-8") as f:
+            lines = f.read().split("\n")
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                meta["torn_lines"] += 1
+                # a torn line is the expected crash frontier when it
+                # ends the file, or when the next record is a
+                # server_start (the restart healed the tail and
+                # appended after it); torn lines anywhere else mean
+                # something other than our append wrote here
+                nxt = next((x for x in lines[i + 1:] if x.strip()),
+                           "")
+                frontier = (not nxt or '"torn_tail_healed"' in nxt
+                            or '"server_start"' in nxt)
+                log.log(
+                    30 if frontier else 40,
+                    "journal: %s line %d is torn (%s) — %s",
+                    self.path, i + 1,
+                    "the crash frontier" if frontier
+                    else "NOT at a crash frontier",
+                    "replaying around it" if frontier
+                    else "skipping it; the journal may have been "
+                         "edited by hand")
+                continue
+            if "event" in rec:
+                meta["events"].append(rec)
+                if rec["event"] == "server_start":
+                    meta["server_starts"] += 1
+                continue
+            cid = rec.get("cid")
+            state = rec.get("state")
+            if not cid or state not in STATES:
+                meta["torn_lines"] += 1
+                log.warning("journal: %s line %d is not a campaign "
+                            "transition — skipping", self.path, i + 1)
+                continue
+            c = campaigns.get(cid)
+            if c is None:
+                c = campaigns[cid] = Campaign(cid=cid)
+            c.state = state
+            for k in _REPLAY_FIELDS:
+                if k in rec:
+                    setattr(c, k, rec[k])
+        return campaigns, meta

@@ -1,0 +1,587 @@
+"""The campaign server: scheduler, watchdog, and recovery loop.
+
+One resident process owns the spool. Submissions arrive as atomic JSON
+drops in ``<spool>/incoming/`` (:func:`submit` — no daemon connection
+needed), the scheduler admits the highest-priority runnable campaign
+through the normal Controller path, and every state transition is
+durably journaled first (:mod:`shadow_tpu.serve.journal`), so a
+``kill -9`` at ANY instant is recoverable: restart replays the
+journal, requeues the mid-flight campaign from its newest readable
+rotation checkpoint, and the resumed run is bit-identical to an
+uninterrupted one (the checkpoint/resume contract the determinism
+gate's ``--server`` rung enforces).
+
+Scheduling model: ONE campaign runs at a time, on a worker thread,
+while the scheduler thread ticks — scanning the spool, polling the
+worker's heartbeat monitor, and reclaiming the slot for a
+higher-priority arrival by requesting the runner's preemption guard
+(the rc-75 drain: finish the in-flight dispatch segment, save a
+resume checkpoint, return preempted). Serial campaigns keep the warm
+in-process mesh and AOT compile cache across campaigns — that
+residency is the point of a server over a per-campaign subprocess.
+
+Per-campaign artifacts are namespaced under
+``<spool>/campaigns/<cid>/``: the data directory, the rotation
+checkpoints (``ck.npz.t<ns>`` / ``ck.npz.b<k>.t<ns>`` for batched
+ensembles), and ``artifacts/`` for OCC/PLAN/ENSEMBLE/METRICS/TRACE
+records (``experimental.artifacts_dir``), so two tenants can never
+clobber each other's records. ``RESULT.json`` carries the final host
+signatures for external comparison.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import re
+import threading
+import time
+import traceback
+
+from shadow_tpu.serve.journal import (Campaign, Journal, RUNNABLE,
+                                      TERMINAL)
+from shadow_tpu.utils.artifacts import atomic_write_json
+from shadow_tpu.utils.slog import get_logger
+
+log = get_logger("serve")
+
+_SUB_COUNTER = itertools.count()
+
+# rotation entries under a campaign dir: plain ``ck.npz.t<ns>`` and
+# the batched-ensemble series ``ck.npz.b<k>.t<ns>``
+_ROTATION_RE = re.compile(r"^ck\.npz(?:\.b(\d+))?\.t(\d+)$")
+
+
+def submit(spool: str, config: str, priority: int = 0,
+           overrides=()) -> str:
+    """Drop one campaign submission into the spool. Atomic (tmp +
+    rename), so the server can never observe a half-written file;
+    works with no server running — the spool IS the queue. Returns
+    the submission file name (the server assigns the campaign id
+    when it journals the QUEUED transition)."""
+    inc = os.path.join(spool, "incoming")
+    os.makedirs(inc, exist_ok=True)
+    name = (f"sub_{time.time_ns():020d}_{os.getpid()}_"
+            f"{next(_SUB_COUNTER)}.json")
+    atomic_write_json(
+        {"config": os.path.abspath(config), "priority": int(priority),
+         "overrides": [str(o) for o in overrides],
+         "submitted_wall": time.time()},
+        os.path.join(inc, name))
+    return name
+
+
+class ServerCrash(BaseException):
+    """In-process stand-in for the chaos ``server_crash`` kill: tests
+    inject ``crash_fn=_raise_server_crash`` so the drill unwinds the
+    serve loop instead of taking the interpreter down. BaseException
+    so no recovery code accidentally swallows the drill."""
+
+
+class CampaignServer:
+    """The resident daemon. ``serve()`` is the blocking loop;
+    ``tick()`` is one scheduler step (exposed so tests can drive the
+    server deterministically without threads of their own)."""
+
+    def __init__(self, spool: str, poll_s: float = 0.2,
+                 checkpoint_every: int = 0, stale_after: int = 4,
+                 watchdog_grace_s: float = 30.0, chaos=None,
+                 crash_fn=None, clock=time.monotonic):
+        self.spool = os.path.abspath(spool)
+        self.journal = Journal(self.spool)
+        self.poll_s = float(poll_s)
+        # rotation cadence forced onto campaigns that did not choose
+        # one (sim-ns); 0 = stop_time // 8
+        self.checkpoint_every = int(checkpoint_every)
+        self.stale_after = int(stale_after)
+        self.watchdog_grace_s = float(watchdog_grace_s)
+        # the server holds its OWN injector (scripted server_crash
+        # drills), distinct from any campaign's chaos config — a
+        # campaign's injector must not count scheduler ticks
+        self.chaos = chaos
+        self.crash_fn = crash_fn if crash_fn is not None \
+            else lambda: os._exit(137)
+        self.clock = clock
+        self.campaigns: dict[str, Campaign] = {}
+        self._seq = 0
+        self._slot = None          # holder dict of the running campaign
+        self._stop = False
+        self.restarts = 0          # prior server_start events replayed
+        self.slo = {"done": 0, "failed": 0, "refused": 0,
+                    "preemptions": 0, "stale_kills": 0,
+                    "requeued_on_restart": 0, "ticks": 0}
+        self._t_up = self.clock()
+        os.makedirs(os.path.join(self.spool, "incoming"), exist_ok=True)
+        os.makedirs(os.path.join(self.spool, "campaigns"),
+                    exist_ok=True)
+        # the server's own flight recorder: campaign spans + scheduler
+        # instants under the "serve" phase; METRICS_<label>.json lands
+        # in the spool on shutdown (the server SLO summary record)
+        from shadow_tpu.obs.trace import Tracer
+        self.tracer = Tracer(mode="summary", directory=self.spool,
+                             label="serve")
+
+    # -- paths ---------------------------------------------------------
+    def _cdir(self, cid: str) -> str:
+        return os.path.join(self.spool, "campaigns", cid)
+
+    # -- recovery ------------------------------------------------------
+    def recover(self) -> None:
+        """Journal replay: reconstruct the campaign table the dead
+        server held, requeue every non-terminal campaign from its
+        newest readable rotation checkpoint, and journal our own
+        server_start. Idempotent — a crash between the replay and the
+        first tick just replays again."""
+        self.campaigns, meta = self.journal.replay()
+        self.restarts = meta["server_starts"]
+        if meta["torn_lines"]:
+            log.warning("recover: tolerated %d torn journal line(s)",
+                        meta["torn_lines"])
+        for c in sorted(self.campaigns.values(), key=lambda c: c.seq):
+            self._seq = max(self._seq, c.seq + 1)
+            if c.state in ("ADMITTED", "RUNNING"):
+                # the crash caught this campaign mid-flight; its
+                # worker thread died with the server. Requeue from
+                # the newest checkpoint the rotation managed to land
+                # (bit-identical resume), or from scratch if the kill
+                # outran the first rotation save.
+                resume = self._newest_resume(c.cid)
+                c.state = "PREEMPTED"
+                c.resume_path = resume
+                c.preemptions += 1
+                c.diagnostic = (
+                    "requeued by journal replay after a server "
+                    "restart"
+                    + (f"; resuming from {resume}" if resume
+                       else "; no readable checkpoint yet — "
+                            "restarting from scratch"))
+                self.journal.transition(
+                    c.cid, "PREEMPTED", resume_path=resume,
+                    preemptions=c.preemptions,
+                    diagnostic=c.diagnostic)
+                self.slo["requeued_on_restart"] += 1
+                log.warning("recover: %s was %s at the crash — %s",
+                            c.cid, "mid-flight", c.diagnostic)
+        self.journal.server_event(
+            "server_start", restarts=self.restarts + 1,
+            pid=os.getpid(), wall=time.time())
+        runnable = sum(1 for c in self.campaigns.values()
+                       if c.state in RUNNABLE)
+        log.info("server up on %s: %d campaign(s) replayed, %d "
+                 "runnable, start #%d", self.spool,
+                 len(self.campaigns), runnable, self.restarts + 1)
+
+    def _newest_resume(self, cid: str) -> str:
+        """Newest READABLE rotation checkpoint of a campaign, walking
+        both the plain series (``ck.npz.t<ns>``) and the batched
+        series (``ck.npz.b<k>.t<ns>`` — batches restart sim time at
+        0, so order is (batch, t), not raw t)."""
+        from shadow_tpu.device import checkpoint
+
+        cdir = self._cdir(cid)
+        if not os.path.isdir(cdir):
+            return ""
+        entries = []
+        for name in os.listdir(cdir):
+            m = _ROTATION_RE.match(name)
+            if m:
+                batch = int(m.group(1)) if m.group(1) is not None \
+                    else -1
+                entries.append((batch, int(m.group(2)),
+                                os.path.join(cdir, name)))
+        for _, _, path in sorted(entries, reverse=True):
+            try:
+                meta = checkpoint.peek_meta(path)
+                if meta.get("format") != checkpoint.FORMAT:
+                    raise ValueError(f"format {meta.get('format')}")
+                return path
+            except Exception as e:      # noqa: BLE001 — unreadable
+                # entry = the file the kill outran; fall back to the
+                # previous one, exactly the rotation's purpose
+                log.warning("resume: skipping unreadable rotation "
+                            "entry %s (%s)", path, e)
+        return ""
+
+    # -- intake --------------------------------------------------------
+    def _scan_incoming(self) -> None:
+        inc = os.path.join(self.spool, "incoming")
+        try:
+            names = sorted(os.listdir(inc))
+        except OSError:
+            return
+        seen = {c.sub for c in self.campaigns.values() if c.sub}
+        for name in names:
+            if not name.endswith(".json") or name in seen:
+                continue
+            path = os.path.join(inc, name)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    sub = json.load(f)
+                config = str(sub["config"])
+            except (OSError, ValueError, KeyError) as e:
+                # submit() renames atomically, so a malformed file was
+                # not written by us — quarantine it so the scanner
+                # does not spin on it every tick
+                log.warning("incoming: %s is not a submission (%s) — "
+                            "renaming to .bad", name, e)
+                try:
+                    os.replace(path, path + ".bad")
+                except OSError:
+                    pass
+                continue
+            cid = f"c{self._seq:04d}"
+            camp = Campaign(
+                cid=cid, config=config,
+                priority=int(sub.get("priority", 0)), seq=self._seq,
+                overrides=[str(o) for o in sub.get("overrides", [])],
+                submitted_wall=float(sub.get("submitted_wall", 0.0)),
+                sub=name)
+            self._seq += 1
+            self.campaigns[cid] = camp
+            cdir = self._cdir(cid)
+            os.makedirs(cdir, exist_ok=True)
+            # journal FIRST, then consume the file: a crash in between
+            # leaves both the QUEUED record and the submission file,
+            # and the `sub` field dedupes the rescan on restart
+            self.journal.transition(
+                cid, "QUEUED", config=camp.config,
+                priority=camp.priority, seq=camp.seq,
+                overrides=camp.overrides,
+                submitted_wall=camp.submitted_wall, sub=name)
+            try:
+                os.replace(path, os.path.join(cdir, "submission.json"))
+            except OSError:
+                pass
+            self.tracer.instant("submit", phase="serve", cid=cid,
+                                priority=camp.priority)
+            log.info("queued %s: %s (priority %d)", cid, camp.config,
+                     camp.priority)
+
+    # -- scheduling ----------------------------------------------------
+    def _pick(self):
+        """Highest priority first; FIFO within a priority level."""
+        runnable = [c for c in self.campaigns.values()
+                    if c.state in RUNNABLE]
+        if not runnable:
+            return None
+        return min(runnable, key=lambda c: (-c.priority, c.seq))
+
+    def _build_cfg(self, camp: Campaign):
+        """Load the submitted config and re-home it under the
+        campaign directory: data directory, artifacts (OCC / PLAN /
+        ENSEMBLE / METRICS / TRACE records), and — for preemptible
+        policies — a forced rotation checkpoint so the drain and the
+        crash-recovery path always have a resume artifact."""
+        from shadow_tpu.config import load_config
+
+        cfg = load_config(camp.config, overrides=list(camp.overrides))
+        cdir = self._cdir(camp.cid)
+        cfg.general.data_directory = os.path.join(cdir, "shadow.data")
+        xp = cfg.experimental
+        if not xp.artifacts_dir:
+            xp.artifacts_dir = os.path.join(cdir, "artifacts")
+        if xp.scheduler_policy == "tpu":
+            xp.checkpoint_save = os.path.join(cdir, "ck.npz")
+            if not xp.checkpoint_every:
+                xp.checkpoint_every = (
+                    self.checkpoint_every
+                    or max(1, int(cfg.general.stop_time) // 8))
+            if camp.resume_path:
+                xp.checkpoint_load = camp.resume_path
+            if cfg.general.heartbeat_interval \
+                    and not xp.heartbeat_stale_after:
+                xp.heartbeat_stale_after = self.stale_after
+        elif camp.resume_path:
+            raise ValueError(
+                f"campaign {camp.cid} has a resume checkpoint but "
+                f"policy {xp.scheduler_policy!r} cannot load one")
+        # serial/thread campaigns have no checkpoint seam: they run to
+        # completion and are not preemptible — documented in
+        # docs/operations.md, and the scheduler simply waits them out
+        return cfg
+
+    def _launch(self, camp: Campaign) -> dict:
+        self.journal.transition(camp.cid, "ADMITTED")
+        camp.state = "ADMITTED"
+        camp.attempts += 1
+        # journal RUNNING BEFORE the Controller build: the slow part
+        # (mesh build + compile) happens with the RUNNING record
+        # already durable, so a crash during the build requeues — and
+        # external pollers (the gate's preemption leg) can key on
+        # RUNNING appearing to time their next submission
+        self.journal.transition(camp.cid, "RUNNING",
+                                attempts=camp.attempts,
+                                resume_path=camp.resume_path)
+        camp.state = "RUNNING"
+        holder = {"camp": camp, "controller": None, "stats": None,
+                  "error": None, "done": threading.Event(),
+                  "preempt_for": "", "stale_since": None,
+                  "t_launch": self.clock()}
+
+        def work():
+            try:
+                cfg = self._build_cfg(camp)
+                from shadow_tpu.core.controller import Controller
+                c = Controller(cfg)
+                holder["controller"] = c
+                holder["stats"] = c.run()
+            except ServerCrash:
+                raise
+            except BaseException as e:   # noqa: BLE001 — classified
+                holder["error"] = e      # by _finish into
+            finally:                     # REFUSED/FAILED
+                holder["done"].set()
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"campaign-{camp.cid}")
+        holder["thread"] = t
+        log.info("launching %s (attempt %d%s)", camp.cid,
+                 camp.attempts,
+                 f", resume {camp.resume_path}" if camp.resume_path
+                 else "")
+        t.start()
+        return holder
+
+    def _signature(self, holder):
+        """JSON-safe bit-identity signature of a finished run — the
+        same tuple the determinism gate compares for standalone runs,
+        so RESULT.json is directly comparable across server and
+        standalone executions."""
+        stats = holder["stats"]
+        if stats.ensemble is not None:
+            return [[e.get("host_checksums_sha256", ""),
+                     int(e["events_executed"]),
+                     int(e["packets_sent"]),
+                     int(e["packets_dropped"]),
+                     int(e["packets_delivered"])]
+                    for e in stats.ensemble["replicas"]]
+        c = holder["controller"]
+        return [[h.name, int(h.trace_checksum),
+                 int(h.events_executed), int(h.packets_sent),
+                 int(h.packets_dropped), int(h.packets_delivered)]
+                for h in c.sim.hosts]
+
+    def _finish(self, holder) -> None:
+        camp = holder["camp"]
+        err = holder["error"]
+        stats = holder["stats"]
+        wall = self.clock() - holder["t_launch"]
+        self.tracer.record(f"campaign_{camp.cid}", "serve", wall,
+                           cid=camp.cid, attempt=camp.attempts)
+        result = {"cid": camp.cid, "config": camp.config,
+                  "attempts": camp.attempts,
+                  "preemptions": camp.preemptions,
+                  "wall_s": round(wall, 3)}
+        if err is not None:
+            # the admission verdict's strict-mode refusal is a
+            # ValueError whose diagnostic leads with the admission
+            # story — a REFUSED campaign, not a server failure
+            diag = f"{type(err).__name__}: {err}"
+            refused = (isinstance(err, ValueError)
+                       and "admission" in str(err)[:80])
+            camp.state = "REFUSED" if refused else "FAILED"
+            camp.diagnostic = diag
+            if not refused:
+                log.error("campaign %s failed:\n%s", camp.cid,
+                          "".join(traceback.format_exception(err)))
+            self.journal.transition(camp.cid, camp.state,
+                                    diagnostic=diag)
+            self.slo["refused" if refused else "failed"] += 1
+            result.update(state=camp.state, diagnostic=diag)
+        elif stats is not None and stats.preempted:
+            camp.state = "PREEMPTED"
+            camp.resume_path = stats.resume_path
+            camp.preemptions += 1
+            camp.diagnostic = holder["preempt_for"] and (
+                f"drained for higher-priority "
+                f"{holder['preempt_for']}") or "drained"
+            self.journal.transition(
+                camp.cid, "PREEMPTED", resume_path=camp.resume_path,
+                preemptions=camp.preemptions,
+                diagnostic=camp.diagnostic)
+            self.slo["preemptions"] += 1
+            result.update(state="PREEMPTED",
+                          resume_path=camp.resume_path)
+            log.info("campaign %s preempted -> requeued (%s)",
+                     camp.cid, camp.resume_path)
+        elif stats is not None and stats.ok:
+            camp.state = "DONE"
+            result.update(state="DONE",
+                          end_time=int(stats.end_time),
+                          packets_sent=int(stats.packets_sent),
+                          stale_heartbeats=int(stats.stale_heartbeats),
+                          signature=self._signature(holder))
+            self.journal.transition(camp.cid, "DONE")
+            self.slo["done"] += 1
+            log.info("campaign %s DONE in %.2fs (attempt %d)",
+                     camp.cid, wall, camp.attempts)
+        else:
+            camp.state = "FAILED"
+            camp.diagnostic = "run reported not-ok"
+            self.journal.transition(camp.cid, "FAILED",
+                                    diagnostic=camp.diagnostic)
+            self.slo["failed"] += 1
+            result.update(state="FAILED", diagnostic=camp.diagnostic)
+        atomic_write_json(result, os.path.join(self._cdir(camp.cid),
+                                               "RESULT.json"))
+        self._write_slo()
+
+    # -- watchdog + preemption ----------------------------------------
+    def _runner_of(self, holder):
+        c = holder["controller"]
+        return c.runner if c is not None else None
+
+    def _watchdog(self, holder) -> bool:
+        """Stale-heartbeat supervision: first staleness requests a
+        graceful drain; past the grace window the slot is abandoned
+        (supervised kill — the worker thread is orphaned, the
+        campaign is requeued from its newest checkpoint). Returns
+        True when the slot was reclaimed."""
+        runner = self._runner_of(holder)
+        mon = getattr(runner, "hb_monitor", None) if runner else None
+        if mon is None or not mon.stale():
+            holder["stale_since"] = None
+            return False
+        now = self.clock()
+        if holder["stale_since"] is None:
+            holder["stale_since"] = now
+            guard = getattr(runner, "guard", None)
+            if guard is not None:
+                guard.request()
+            camp = holder["camp"]
+            log.warning("watchdog: %s heartbeat is stale (last beat "
+                        "%.1fs ago) — drain requested, %.0fs grace "
+                        "before a supervised kill", camp.cid,
+                        mon.gap(), self.watchdog_grace_s)
+            self.journal.server_event("stale_heartbeat",
+                                      cid=camp.cid, gap_s=mon.gap())
+            return False
+        if now - holder["stale_since"] <= self.watchdog_grace_s:
+            return False
+        # grace exhausted: the run is wedged. Abandon the worker
+        # thread (daemon — it dies with the process, and a wedged
+        # engine call cannot be interrupted from Python anyway),
+        # requeue from the newest rotation checkpoint.
+        camp = holder["camp"]
+        resume = self._newest_resume(camp.cid)
+        camp.state = "PREEMPTED"
+        camp.resume_path = resume
+        camp.preemptions += 1
+        camp.diagnostic = (
+            f"supervised kill: heartbeat stale for "
+            f"{now - holder['stale_since'] + 0.0:.0f}s past the drain "
+            f"request" + (f"; resuming from {resume}" if resume
+                          else "; no readable checkpoint — "
+                               "restarting from scratch"))
+        self.journal.transition(camp.cid, "PREEMPTED",
+                                resume_path=resume,
+                                preemptions=camp.preemptions,
+                                diagnostic=camp.diagnostic)
+        self.slo["stale_kills"] += 1
+        self.tracer.instant("stale_kill", phase="serve", cid=camp.cid)
+        log.error("watchdog: %s — %s", camp.cid, camp.diagnostic)
+        self._write_slo()
+        return True
+
+    def _maybe_preempt(self, holder) -> None:
+        """Reclaim the slot for a higher-priority arrival via the
+        rc-75 drain: request the guard once; the runner finishes the
+        in-flight segment, saves a resume checkpoint, and returns
+        preempted — _finish() requeues it bit-identically."""
+        if holder["preempt_for"]:
+            return
+        best = self._pick()
+        camp = holder["camp"]
+        if best is None or best.priority <= camp.priority:
+            return
+        runner = self._runner_of(holder)
+        guard = getattr(runner, "guard", None) if runner else None
+        if guard is None:
+            # controller still building, or the run has no drain seam
+            # (serial policy / no segment boundaries) — re-check next
+            # tick; an un-preemptible campaign just runs out
+            return
+        guard.request()
+        holder["preempt_for"] = best.cid
+        self.journal.server_event("preempt_request", cid=camp.cid,
+                                  for_cid=best.cid)
+        self.tracer.instant("preempt_request", phase="serve",
+                            cid=camp.cid, for_cid=best.cid)
+        log.info("preempting %s (priority %d) for %s (priority %d)",
+                 camp.cid, camp.priority, best.cid, best.priority)
+
+    # -- the loop ------------------------------------------------------
+    def tick(self) -> bool:
+        """One scheduler step. Returns True while there is work
+        (a slot occupied or runnable campaigns waiting)."""
+        self.slo["ticks"] += 1
+        if self.chaos is not None and self.chaos.on_server_tick():
+            # the drill IS a kill -9: no journal flush, no cleanup —
+            # the whole point is that the journal needs neither
+            self.crash_fn()
+        self._scan_incoming()
+        if self._slot is not None:
+            if self._slot["done"].is_set():
+                self._slot["thread"].join()
+                self._finish(self._slot)
+                self._slot = None
+            elif self._watchdog(self._slot):
+                self._slot = None
+            else:
+                self._maybe_preempt(self._slot)
+        if self._slot is None:
+            camp = self._pick()
+            if camp is not None:
+                self._slot = self._launch(camp)
+        return (self._slot is not None
+                or any(c.state in RUNNABLE
+                       for c in self.campaigns.values()))
+
+    def serve(self, idle_exit: bool = False) -> int:
+        """The blocking daemon loop. ``idle_exit`` returns once the
+        queue is empty and the slot idle for a few consecutive polls
+        (drain mode — the restart leg of the gate drill uses it)."""
+        self.recover()
+        idle = 0
+        try:
+            while not self._stop:
+                busy = self.tick()
+                if busy:
+                    idle = 0
+                elif idle_exit:
+                    idle += 1
+                    # a few grace polls absorb the submit()-vs-scan
+                    # race before declaring the spool drained
+                    if idle >= 3:
+                        break
+                time.sleep(self.poll_s)
+        finally:
+            self._shutdown()
+        return 0
+
+    def stop(self) -> None:
+        self._stop = True
+
+    def _write_slo(self) -> None:
+        atomic_write_json(
+            {"format": 1, "restarts": self.restarts + 1,
+             "uptime_s": round(self.clock() - self._t_up, 3),
+             "campaigns": {
+                 state: sum(1 for c in self.campaigns.values()
+                            if c.state == state)
+                 for state in
+                 ("QUEUED", "RUNNING", "PREEMPTED", *TERMINAL)},
+             **self.slo},
+            os.path.join(self.spool, "SLO_server.json"))
+
+    def _shutdown(self) -> None:
+        self.journal.server_event("server_stop", wall=time.time(),
+                                  **self.slo)
+        self._write_slo()
+        try:
+            self.tracer.finalize(run_info={"spool": self.spool,
+                                           **self.slo})
+        except Exception as e:      # noqa: BLE001 — telemetry must
+            log.warning("tracer finalize failed: %s", e)   # not mask
+        log.info("server stopped: %s", self.slo)           # shutdown

@@ -57,6 +57,29 @@ def atomic_write_text(text: str, path: str) -> None:
     atomic_write(path, lambda f: f.write(text), mode="w")
 
 
+def append_line(path: str, line: str) -> None:
+    """Durably append ONE line to a journal file: O_APPEND write of
+    the full line + newline in a single syscall, then fsync. The
+    append-only twin of atomic_write for logs that must accumulate
+    (the campaign server's submission journal): a crash can tear at
+    most the final line — POSIX O_APPEND writes are atomic with
+    respect to other appenders, and every line before the fsync'd
+    one is already on disk — so replay treats exactly one trailing
+    partial line as the crash frontier, never silent mid-file loss."""
+    if "\n" in line:
+        raise ValueError("append_line appends exactly one line; "
+                         f"embedded newline in {line!r}")
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+    try:
+        os.write(fd, (line + "\n").encode("utf-8"))
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 class StreamedLines:
     """Line-streamed artifact with atomic final placement — the JSONL
     flight-recorder log's writer (shadow_tpu/obs). A span log must be

@@ -148,13 +148,29 @@ def test_schema_bounds_replica_batch(tmp_path):
     assert cfg.ensemble.replica_batch == 1
 
 
-def test_schema_rejects_replica_batch_with_checkpointing(tmp_path):
+def test_schema_replica_batch_checkpoint_contract(tmp_path):
     ens = ENS.format(rec=tmp_path / "ENSEMBLE.json")
+    # per-batch rotation checkpoints (<save>.b<k>.t<ns>) made batched
+    # campaigns preemptible, so save + every is now a valid combo
+    cfg = load_config_str(
+        YAML.format(
+            extra=f"  checkpoint_save: {tmp_path / 'ck.npz'}\n"
+                  "  checkpoint_every: 200ms")
+        + ens + "  replica_batch: 1\n")
+    assert cfg.ensemble.replica_batch == 1
+    # but a batched campaign still has no single pause point, so the
+    # one-shot save-at-time form stays rejected
     with pytest.raises(ValueError, match="replica_batch"):
         load_config_str(
             YAML.format(
                 extra=f"  checkpoint_save: {tmp_path / 'ck.npz'}\n"
-                      "  checkpoint_every: 200ms")
+                      "  checkpoint_save_time: 200ms")
+            + ens + "  replica_batch: 1\n")
+    # and save without a rotation cadence can never write anything
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        load_config_str(
+            YAML.format(
+                extra=f"  checkpoint_save: {tmp_path / 'ck.npz'}")
             + ens + "  replica_batch: 1\n")
 
 

@@ -1,0 +1,102 @@
+"""Per-campaign artifact namespacing (experimental.artifacts_dir).
+
+The collision this guards against: two campaigns of the SAME
+workload shape produce identically-named records — the OCC record
+path is a deterministic function of (app, n_hosts, fingerprint), and
+a METRICS summary's name is ``METRICS_<policy>_<n_hosts>.json`` — so
+under a shared artifacts directory the second campaign silently
+clobbers the first's records. ``artifacts_dir`` is the one seam all
+record writers (OCC via capacity.record_path, METRICS/TRACE via
+resolve_tracer) route through, and the campaign server points it at
+``campaigns/<cid>/artifacts`` per tenant.
+"""
+
+import os
+
+from shadow_tpu.config.loader import load_config_str
+from shadow_tpu.device import capacity
+from shadow_tpu.obs.trace import resolve_tracer
+
+YAML = """
+general:
+  stop_time: 200ms
+  seed: 9
+network:
+  graph:
+    type: 1_gbit_switch
+experimental:
+  scheduler_policy: tpu
+  event_capacity: 48
+{extra}
+hosts:
+  left:
+    quantity: 3
+    processes:
+    - {{path: model:phold, args: msgload=2, start_time: 10ms}}
+  right:
+    quantity: 3
+    processes:
+    - {{path: model:phold, args: msgload=2, start_time: 10ms}}
+"""
+
+
+class _FakeApp:
+    pass
+
+
+class _FakeEngine:
+    class config:
+        n_hosts = 6
+
+    app = _FakeApp()
+
+
+def test_occ_record_path_collides_without_a_directory_seam(
+        monkeypatch, tmp_path):
+    # the regression: two tenants, one shared directory -> ONE path.
+    # This is the documented shared-dir behavior artifacts_dir exists
+    # to avoid, pinned here so a refactor cannot quietly change the
+    # canonical naming and hide the hazard.
+    monkeypatch.setenv("SHADOW_TPU_OCC_DIR", str(tmp_path / "shared"))
+    eng = _FakeEngine()
+    assert capacity.record_path(eng) == capacity.record_path(eng)
+
+    # the fix: an explicit directory wins over the env/shared default,
+    # so per-campaign dirs yield disjoint paths for the same workload
+    a = capacity.record_path(eng, directory=str(tmp_path / "c0000"))
+    b = capacity.record_path(eng, directory=str(tmp_path / "c0001"))
+    assert a != b
+    assert os.path.basename(a) == os.path.basename(b)
+    assert a.startswith(str(tmp_path / "c0000"))
+
+
+def test_resolve_tracer_routes_records_into_artifacts_dir(tmp_path):
+    cfg = load_config_str(YAML.format(
+        extra=f"  artifacts_dir: {tmp_path / 'c0000' / 'artifacts'}"))
+    tr = resolve_tracer(cfg, n_hosts=6)
+    # summary-mode tracers normally write METRICS only when telemetry
+    # is on; an artifacts_dir alone must also direct (and enable) the
+    # record — the server relies on this for per-tenant METRICS
+    assert tr.directory == str(tmp_path / "c0000" / "artifacts")
+    tr.finalize()
+    files = os.listdir(tmp_path / "c0000" / "artifacts")
+    assert any(n.startswith("METRICS_") for n in files)
+
+
+def test_telemetry_path_still_wins_over_artifacts_dir(tmp_path):
+    cfg = load_config_str(YAML.format(
+        extra=("  telemetry: summary\n"
+               f"  telemetry_path: {tmp_path / 'explicit'}\n"
+               f"  artifacts_dir: {tmp_path / 'campaign'}")))
+    tr = resolve_tracer(cfg, n_hosts=6)
+    # an operator's explicit telemetry_path is a deliberate choice;
+    # artifacts_dir is the namespacing default underneath it
+    assert tr.directory == str(tmp_path / "explicit")
+
+
+def test_schema_accepts_and_validates_artifacts_dir():
+    cfg = load_config_str(YAML.format(extra="  artifacts_dir: /x/y"))
+    assert cfg.experimental.artifacts_dir == "/x/y"
+    import pytest
+    with pytest.raises(ValueError, match="artifacts_dir"):
+        load_config_str(YAML.format(extra="  artifacts_dir: [1]"))

@@ -1,0 +1,133 @@
+"""Preempt -> requeue -> resume of a BATCHED ensemble campaign
+(ensemble.replica_batch) under the campaign server.
+
+Batched campaigns checkpoint per replica-batch into their own
+rotation series (``<save>.b<k>.t<ns>`` — batches restart sim time at
+0, so a shared base would cross-prune), and a resume replays the
+completed batches fresh (pure functions => bit-identical) before
+loading the interrupted batch from its stamped entry. The drill:
+the server preempts a batched campaign for a higher-priority
+arrival, requeues it with the batch-stamped resume checkpoint, and
+the resumed campaign's per-replica signatures bit-match an
+uninterrupted standalone run.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from shadow_tpu.config import load_config
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.serve.server import CampaignServer, submit
+
+# drill-scale: two full campaigns plus a preempt/resume cycle — rides
+# with the slow suite (CI's full-matrix tests job still runs it)
+pytestmark = pytest.mark.slow
+
+ENSEMBLE_YAML = """
+general:
+  stop_time: 800ms
+  seed: 9
+  heartbeat_interval: 200ms
+network:
+  graph:
+    type: 1_gbit_switch
+experimental:
+  scheduler_policy: tpu
+  event_capacity: 48
+ensemble:
+  replicas: 4
+  replica_batch: 2
+  vary:
+    seed: [9, 11, 13, 15]
+hosts:
+  left:
+    quantity: 3
+    processes:
+    - {path: model:phold, args: msgload=2, start_time: 10ms}
+  right:
+    quantity: 3
+    processes:
+    - {path: model:phold, args: msgload=2, start_time: 10ms}
+"""
+
+PLAIN_YAML = ENSEMBLE_YAML.replace(
+    "ensemble:\n  replicas: 4\n  replica_batch: 2\n  vary:\n"
+    "    seed: [9, 11, 13, 15]\n", "")
+
+
+def ensemble_sig(stats):
+    return [[e.get("host_checksums_sha256", ""),
+             int(e["events_executed"]), int(e["packets_sent"]),
+             int(e["packets_dropped"]), int(e["packets_delivered"])]
+            for e in stats.ensemble["replicas"]]
+
+
+def drive(srv, timeout_s=300, until=None):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        busy = srv.tick()
+        if until is not None:
+            if until():
+                return
+        elif not busy:
+            return
+        time.sleep(0.005)
+    raise AssertionError("server drive timed out")
+
+
+def test_batched_ensemble_preempt_requeue_resume_bit_identical(
+        tmp_path):
+    ens_cfg = tmp_path / "ensemble.yaml"
+    ens_cfg.write_text(ENSEMBLE_YAML)
+    plain_cfg = tmp_path / "plain.yaml"
+    plain_cfg.write_text(PLAIN_YAML)
+
+    # the uninterrupted reference: same batched campaign, standalone
+    cfg = load_config(str(ens_cfg))
+    cfg.general.data_directory = str(tmp_path / "ref.data")
+    cfg.experimental.artifacts_dir = str(tmp_path / "ref_artifacts")
+    stats = Controller(cfg).run()
+    assert stats.ok
+    ref = ensemble_sig(stats)
+
+    spool = str(tmp_path / "spool")
+    submit(spool, str(ens_cfg), priority=0)
+    srv = CampaignServer(spool, poll_s=0.0)
+    srv.recover()
+    state = {"submitted": False}
+
+    def inject_high_priority():
+        # the urgent (plain) campaign arrives while the batched one
+        # is mid-flight — its guard exists once run() starts
+        if not state["submitted"] and srv._slot is not None:
+            runner = srv._runner_of(srv._slot)
+            if runner is not None and getattr(runner, "guard",
+                                              None) is not None:
+                submit(spool, str(plain_cfg), priority=9)
+                state["submitted"] = True
+        return state["submitted"]
+
+    drive(srv, until=inject_high_priority)
+    drive(srv)
+    srv._shutdown()
+
+    with open(os.path.join(spool, "campaigns", "c0000",
+                           "RESULT.json"), encoding="utf-8") as f:
+        res = json.load(f)
+    assert res["state"] == "DONE"
+    assert res["preemptions"] == 1 and res["attempts"] == 2
+    # the drain saved a BATCH rotation entry and the requeue carried
+    # it — the resumed batched campaign bit-matches the reference
+    cdir = os.path.join(spool, "campaigns", "c0000")
+    assert any(".b" in n and ".t" in n for n in os.listdir(cdir)
+               if n.startswith("ck.npz"))
+    with open(os.path.join(spool, "journal.jsonl"),
+              encoding="utf-8") as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    pre = [r for r in rows if r.get("cid") == "c0000"
+           and r.get("state") == "PREEMPTED"]
+    assert pre and ".b" in pre[0]["resume_path"]
+    assert res["signature"] == ref

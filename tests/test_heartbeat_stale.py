@@ -1,0 +1,178 @@
+"""Stale-heartbeat detection (device/supervise.HeartbeatMonitor) and
+the campaign server watchdog that consumes it — all on frozen/fake
+clocks, so every staleness verdict in here is deterministic.
+
+The monitor learns the run's own heartbeat cadence (EWMA of healthy
+gaps) instead of trusting a configured wall-time number: device
+heartbeats fire per SIM interval, so their wall cadence depends on
+throughput, and a fixed wall threshold would cry wolf on slow
+configs and sleep through fast ones.
+"""
+
+import json
+import os
+
+from shadow_tpu.device.supervise import HeartbeatMonitor
+from shadow_tpu.serve import Journal
+from shadow_tpu.serve.server import CampaignServer
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# the monitor
+# ---------------------------------------------------------------------------
+
+def test_monitor_learns_cadence_and_flags_wide_gap():
+    clk = FakeClock()
+    mon = HeartbeatMonitor(3, clock=clk)
+    for t in (0.0, 1.0, 2.0, 3.1, 4.0):     # healthy ~1s cadence
+        clk.t = t
+        mon.beat()
+    assert mon.stale_events == 0
+    clk.t = 12.0                             # 8s gap >> 3x EWMA
+    mon.beat()
+    assert mon.stale_events == 1
+    # the stale gap must NOT be folded into the learned cadence —
+    # otherwise one stall doubles the threshold and hides the next
+    clk.t = 20.0
+    mon.beat()
+    assert mon.stale_events == 2
+
+
+def test_monitor_live_staleness_probe_without_a_beat():
+    # the watchdog polls stale() BETWEEN beats — a wedged run never
+    # beats again, so detection cannot wait for the next beat()
+    clk = FakeClock()
+    mon = HeartbeatMonitor(3, clock=clk)
+    clk.t = 0.0
+    mon.beat()
+    clk.t = 1.0
+    mon.beat()                               # learned cadence ~1s
+    clk.t = 3.5
+    assert not mon.stale()                   # 2.5s < 3 x 1s
+    clk.t = 9.0
+    assert mon.stale()                       # 8s > 3 x 1s
+    assert mon.gap() == 8.0
+
+
+def test_monitor_is_quiet_before_a_cadence_exists():
+    clk = FakeClock()
+    mon = HeartbeatMonitor(3, clock=clk)
+    assert not mon.stale()                   # no beats at all
+    mon.beat()
+    clk.t = 1000.0
+    assert not mon.stale()                   # one beat = no cadence yet
+
+
+def test_monitor_clamps_k_to_at_least_two():
+    # k=1 would flag ordinary jitter (any gap over the average);
+    # the schema allows >= 0 but the monitor refuses to be that jumpy
+    assert HeartbeatMonitor(0).k == 2
+    assert HeartbeatMonitor(1).k == 2
+    assert HeartbeatMonitor(5).k == 5
+
+
+# ---------------------------------------------------------------------------
+# the server watchdog consuming the monitor
+# ---------------------------------------------------------------------------
+
+class _StubGuard:
+    def __init__(self):
+        self.requested = False
+
+    def request(self):
+        self.requested = True
+
+
+class _StubRunner:
+    def __init__(self, mon, guard):
+        self.hb_monitor = mon
+        self.guard = guard
+
+
+class _StubController:
+    def __init__(self, runner):
+        self.runner = runner
+
+
+def _wedged_holder(srv, clk):
+    """A slot whose campaign beat twice (cadence ~1s) then wedged."""
+    from shadow_tpu.serve.journal import Campaign
+    import threading
+
+    camp = Campaign(cid="c0000", config="x.yaml", state="RUNNING",
+                    attempts=1)
+    srv.campaigns["c0000"] = camp
+    mon = HeartbeatMonitor(3, clock=clk)
+    mon.beat()
+    clk.t = 1.0
+    mon.beat()
+    guard = _StubGuard()
+    holder = {"camp": camp, "stats": None, "error": None,
+              "controller": _StubController(_StubRunner(mon, guard)),
+              "done": threading.Event(), "preempt_for": "",
+              "stale_since": None, "t_launch": clk.t}
+    return holder, guard
+
+
+def test_watchdog_requests_drain_then_kills_past_grace(tmp_path):
+    clk = FakeClock()
+    spool = str(tmp_path / "spool")
+    srv = CampaignServer(spool, poll_s=0.0, watchdog_grace_s=10.0,
+                         clock=clk)
+    holder, guard = _wedged_holder(srv, clk)
+
+    clk.t = 2.0
+    assert not srv._watchdog(holder)         # healthy: 1s since beat
+    assert not guard.requested
+
+    clk.t = 20.0                             # 19s gap >> 3 x 1s
+    assert not srv._watchdog(holder)         # first detection: drain
+    assert guard.requested                   # requested, slot kept
+    assert holder["stale_since"] == 20.0
+
+    clk.t = 25.0
+    assert not srv._watchdog(holder)         # inside the grace window
+
+    clk.t = 31.0                             # grace (10s) exhausted
+    assert srv._watchdog(holder)             # supervised kill
+    camp = srv.campaigns["c0000"]
+    assert camp.state == "PREEMPTED"
+    assert "supervised kill" in camp.diagnostic
+    assert srv.slo["stale_kills"] == 1
+    rows = [json.loads(line) for line in
+            open(os.path.join(spool, "journal.jsonl"),
+                 encoding="utf-8")]
+    assert any(r.get("event") == "stale_heartbeat" for r in rows)
+    assert rows[-1]["state"] == "PREEMPTED"
+    # the campaign is schedulable again
+    assert srv._pick() is camp
+
+
+def test_watchdog_recovers_when_beats_return(tmp_path):
+    clk = FakeClock()
+    srv = CampaignServer(str(tmp_path / "spool"), poll_s=0.0,
+                         watchdog_grace_s=10.0, clock=clk)
+    holder, guard = _wedged_holder(srv, clk)
+    clk.t = 20.0
+    srv._watchdog(holder)                    # drain requested
+    assert holder["stale_since"] == 20.0
+    mon = holder["controller"].runner.hb_monitor
+    clk.t = 21.0
+    mon.beat()                               # the run woke back up
+    clk.t = 21.5
+    assert not srv._watchdog(holder)
+    assert holder["stale_since"] is None     # staleness cleared
+
+
+def test_journal_reexport():
+    # the serve package re-exports the journal surface the watchdog
+    # tests use — keep the public import path stable
+    assert Journal is not None

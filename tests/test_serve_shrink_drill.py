@@ -1,0 +1,113 @@
+"""ROADMAP item (e) drill: a chaos-scripted 4 -> 3 mesh shrink
+mid-campaign UNDER THE CAMPAIGN SERVER, with the flight-recorder
+before/after comparison (``trace_report --compare``).
+
+Two campaigns through one server against the same workload: a clean
+4-shard baseline and a run whose scripted ``device_loss`` forces the
+elastic shrink to 3 shards mid-flight. The robustness bar: both
+reach DONE with IDENTICAL signatures (device loss costs throughput,
+never determinism), and the compare table attributes the shrink
+run's extra wall to the failover/reshard phases. The committed
+``artifacts/COMPARE_r17_shrink.txt`` is this drill's output
+(regenerate with SHADOW_TPU_WRITE_COMPARE=1).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+SHRINK_EXTRA = """  failover: shrink
+  chaos:
+  - {kind: device_loss, segment: 1, shard: 1}
+"""
+
+# baseline and shrink differ ONLY in the failover/chaos lines, so the
+# compare table isolates what the device loss cost
+YAML = """
+general:
+  stop_time: 800ms
+  seed: 9
+  heartbeat_interval: 200ms
+network:
+  graph:
+    type: 1_gbit_switch
+experimental:
+  scheduler_policy: tpu
+  event_capacity: 48
+  mesh_shards: 4
+  dispatch_segment: 100ms
+  state_audit: true
+  dispatch_retries: 1
+  dispatch_retry_backoff: 0.0
+{extra}hosts:
+  left:
+    quantity: 3
+    processes:
+    - {{path: model:phold, args: msgload=2, start_time: 10ms}}
+  right:
+    quantity: 3
+    processes:
+    - {{path: model:phold, args: msgload=2, start_time: 10ms}}
+"""
+
+
+@pytest.mark.slow
+def test_shrink_under_server_bit_identical_with_compare(tmp_path):
+    from shadow_tpu.serve.server import CampaignServer, submit
+
+    baseline = tmp_path / "baseline.yaml"
+    baseline.write_text(YAML.format(extra=""))
+    shrink = tmp_path / "shrink.yaml"
+    shrink.write_text(YAML.format(extra=SHRINK_EXTRA))
+
+    spool = str(tmp_path / "spool")
+    submit(spool, str(baseline))
+    submit(spool, str(shrink))
+    srv = CampaignServer(spool, poll_s=0.0)
+    srv.recover()
+    deadline = time.monotonic() + 480
+    while srv.tick() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    srv._shutdown()
+
+    res = {}
+    for cid in ("c0000", "c0001"):
+        with open(os.path.join(spool, "campaigns", cid,
+                               "RESULT.json"), encoding="utf-8") as f:
+            res[cid] = json.load(f)
+        assert res[cid]["state"] == "DONE", res[cid]
+    # device loss costs wall, never the answer
+    assert res["c0000"]["signature"] == res["c0001"]["signature"]
+
+    def metrics_of(cid):
+        adir = os.path.join(spool, "campaigns", cid, "artifacts")
+        names = [n for n in os.listdir(adir)
+                 if n.startswith("METRICS_")]
+        assert len(names) == 1, names
+        return os.path.join(adir, names[0])
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts",
+                                      "trace_report.py"),
+         "--compare", metrics_of("c0000"), metrics_of("c0001")],
+        capture_output=True, text=True, cwd=repo)
+    assert out.returncode == 0, out.stderr
+    table = out.stdout
+    assert "flight-recorder comparison" in table
+    # the shrink run's story must be visible in the attribution:
+    # reshard/failover walls exist only on the B (shrink) side
+    assert "reshard" in table or "failover" in table
+    if os.environ.get("SHADOW_TPU_WRITE_COMPARE"):
+        dst = os.path.join(repo, "artifacts",
+                           "COMPARE_r17_shrink.txt")
+        with open(dst, "w", encoding="utf-8") as f:
+            f.write("4-shard baseline vs chaos device_loss 4->3 "
+                    "shrink, both under the campaign server\n"
+                    "(tests/test_serve_shrink_drill.py; signatures "
+                    "bit-identical)\n\n")
+            f.write(table)
